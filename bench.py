@@ -1,0 +1,64 @@
+"""Headline benchmark: DeepDFA (FlowGNN) training throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference trains DeepDFA in ~9 min on 1× RTX 3090 (paper
+Table 5); with ~150k train graphs × 25 epochs / 540 s ≈ 7000 graphs/s
+aggregate (BASELINE.md "north-star"). We measure sustained training
+graphs/sec (forward+backward+update, published model config, batch 256) on
+the available chip(s).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, TrainConfig
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import make_train_state, make_train_step
+    from __graft_entry__ import _example_batch
+
+    model_cfg = FlowGNNConfig()
+    data_cfg = DataConfig(batch_size=256)
+    train_cfg = TrainConfig()
+
+    batch = _example_batch(data_cfg, model_cfg)
+    model = FlowGNN(model_cfg)
+    state, tx = make_train_state(model, batch, train_cfg)
+    step = jax.jit(make_train_step(model, tx, train_cfg), donate_argnums=(0,))
+
+    # Warmup: compile + 3 steps (reference skips 3 warmup batches,
+    # base_module.py:240-243).
+    for _ in range(3):
+        state, loss, _ = step(state, batch)
+    jax.block_until_ready(state)
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss, _ = step(state, batch)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    graphs_per_sec = n_steps * data_cfg.batch_size / dt
+    baseline = 7000.0  # reference aggregate graphs/s on 1x RTX 3090
+    print(
+        json.dumps(
+            {
+                "metric": "deepdfa_train_graphs_per_sec",
+                "value": round(graphs_per_sec, 1),
+                "unit": "graphs/s",
+                "vs_baseline": round(graphs_per_sec / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""deepdfa_tpu: a TPU-native (JAX/XLA/Pallas/pjit) vulnerability-detection framework.
+
+A from-scratch rebuild of the capability surface of the DeepDFA reference stack
+(ICSE'24, "Dataflow Analysis-Inspired Deep Learning for Efficient Vulnerability
+Detection"): the FlowGNN gated graph network over program CFGs with abstract
+dataflow embeddings, the LineVul (RoBERTa/UniXcoder) sequence classifiers, the
+CodeT5 defect classifier, combined graph+text models, the Joern-based ETL
+pipeline, and the evaluation/profiling subsystem — all designed TPU-first:
+
+- static-shape bucketed graph batching instead of dynamic `dgl.batch`
+- segment-op message passing on XLA (with a Pallas kernel for the hot loop)
+  instead of DGL's CUDA kernels
+- `jax.sharding.Mesh` + jit-sharded data parallelism instead of
+  DataParallel/DDP+NCCL
+- orbax checkpointing, HLO cost analysis instead of DeepSpeed FlopsProfiler
+
+Subpackages:
+  core      config dataclasses, pure-JAX metrics
+  graphs    padded graph batches, segment ops, bucketing
+  models    flowgnn / linevul / codet5 model families
+  ops       Pallas TPU kernels
+  parallel  mesh + sharding helpers
+  train     jit-sharded training loops, checkpointing
+  data      datasets, splits, host input pipeline
+  etl       Joern output parsing, reaching-definitions, abstract dataflow
+  eval      reports, PR curves, profiling
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+)
+from deepdfa_tpu.core.metrics import (
+    BinaryStats,
+    binary_stats,
+    compute_metrics,
+)
+
+__all__ = [
+    "DataConfig",
+    "FeatureSpec",
+    "FlowGNNConfig",
+    "TrainConfig",
+    "BinaryStats",
+    "binary_stats",
+    "compute_metrics",
+]

@@ -1,0 +1,130 @@
+"""Pure-JAX classification metrics.
+
+Replaces the reference's torchmetrics MetricCollection
+(DDFA/code_gnn/models/base_module.py:35-68): Accuracy/Precision/Recall/F1 as
+jit-friendly count accumulators that compose across sharded steps via psum,
+plus PR-curve points from stored prediction scores. All metrics accept a mask
+so padded graph slots never contribute (the static-shape replacement for
+dynamic batching).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BinaryStats(NamedTuple):
+    """Sufficient statistics for binary classification metrics.
+
+    Summable across batches and across devices (psum over the data axis), so
+    a metric epoch is just a fold of these.
+    """
+
+    tp: jnp.ndarray
+    fp: jnp.ndarray
+    tn: jnp.ndarray
+    fn: jnp.ndarray
+
+    def __add__(self, other: "BinaryStats") -> "BinaryStats":  # type: ignore[override]
+        return BinaryStats(
+            self.tp + other.tp,
+            self.fp + other.fp,
+            self.tn + other.tn,
+            self.fn + other.fn,
+        )
+
+    @staticmethod
+    def zeros() -> "BinaryStats":
+        z = jnp.zeros((), jnp.float32)
+        return BinaryStats(z, z, z, z)
+
+
+def binary_stats(
+    probs: jnp.ndarray,
+    labels: jnp.ndarray,
+    mask: jnp.ndarray,
+    threshold: float = 0.5,
+) -> BinaryStats:
+    """Confusion counts at ``threshold`` over masked entries.
+
+    ``threshold=0.5`` matches the reference's ``class_threshold``
+    (base_module.py:32).
+    """
+    pred = (probs >= threshold).astype(jnp.float32)
+    lab = labels.astype(jnp.float32)
+    m = mask.astype(jnp.float32)
+    return BinaryStats(
+        tp=jnp.sum(m * pred * lab),
+        fp=jnp.sum(m * pred * (1.0 - lab)),
+        tn=jnp.sum(m * (1.0 - pred) * (1.0 - lab)),
+        fn=jnp.sum(m * (1.0 - pred) * lab),
+    )
+
+
+def compute_metrics(stats: BinaryStats) -> Dict[str, jnp.ndarray]:
+    """Accuracy / Precision / Recall / F1 from counts.
+
+    Division-by-zero yields 0, matching torchmetrics' default behavior on
+    empty denominators.
+    """
+    tp, fp, tn, fn = stats.tp, stats.fp, stats.tn, stats.fn
+
+    def _safe(n, d):
+        return jnp.where(d > 0, n / jnp.where(d > 0, d, 1.0), 0.0)
+
+    acc = _safe(tp + tn, tp + fp + tn + fn)
+    prec = _safe(tp, tp + fp)
+    rec = _safe(tp, tp + fn)
+    f1 = _safe(2 * prec * rec, prec + rec)
+    return {"acc": acc, "precision": prec, "recall": rec, "f1": f1}
+
+
+def pr_curve(
+    probs: np.ndarray, labels: np.ndarray, num_thresholds: int = 200
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Precision/recall arrays over a threshold sweep (host-side).
+
+    Equivalent to the reference's ``torchmetrics.PrecisionRecallCurve`` export
+    to ``pr.csv`` (base_module.py:59,362-372); a fixed grid of thresholds
+    keeps the output size bounded like the binned variant.
+    """
+    probs = np.asarray(probs, np.float64)
+    labels = np.asarray(labels, np.float64)
+    thresholds = np.linspace(0.0, 1.0, num_thresholds)
+    precisions, recalls = [], []
+    for t in thresholds:
+        pred = probs >= t
+        tp = float(np.sum(pred * labels))
+        fp = float(np.sum(pred * (1 - labels)))
+        fn = float(np.sum((~pred) * labels))
+        precisions.append(tp / (tp + fp) if tp + fp > 0 else 1.0)
+        recalls.append(tp / (tp + fn) if tp + fn > 0 else 0.0)
+    return np.array(precisions), np.array(recalls), thresholds
+
+
+def classification_report_dict(
+    probs: np.ndarray, labels: np.ndarray, threshold: float = 0.5
+) -> Dict[str, Dict[str, float]]:
+    """sklearn-style per-class report (host-side), matching the reference's
+    ``classification_report`` usage (base_module.py:376-383)."""
+    pred = (np.asarray(probs) >= threshold).astype(np.int64)
+    lab = np.asarray(labels).astype(np.int64)
+    out: Dict[str, Dict[str, float]] = {}
+    for cls in (0, 1):
+        tp = float(np.sum((pred == cls) & (lab == cls)))
+        fp = float(np.sum((pred == cls) & (lab != cls)))
+        fn = float(np.sum((pred != cls) & (lab == cls)))
+        prec = tp / (tp + fp) if tp + fp > 0 else 0.0
+        rec = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+        out[str(cls)] = {
+            "precision": prec,
+            "recall": rec,
+            "f1-score": f1,
+            "support": float(np.sum(lab == cls)),
+        }
+    out["accuracy"] = {"accuracy": float(np.mean(pred == lab))}
+    return out

@@ -1,0 +1,5 @@
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.data.splits import make_splits
+from deepdfa_tpu.data.sampling import epoch_indices
+
+__all__ = ["synthetic_bigvul", "make_splits", "epoch_indices"]

@@ -1,0 +1,21 @@
+from deepdfa_tpu.graphs.batch import (
+    GraphBatch,
+    batch_graphs,
+    graph_label_from_nodes,
+    pad_budget_for,
+)
+from deepdfa_tpu.graphs.segment import (
+    segment_max,
+    segment_softmax,
+    segment_sum,
+)
+
+__all__ = [
+    "GraphBatch",
+    "batch_graphs",
+    "graph_label_from_nodes",
+    "pad_budget_for",
+    "segment_max",
+    "segment_softmax",
+    "segment_sum",
+]

@@ -1,0 +1,219 @@
+"""Static-shape graph batching.
+
+DGL batches arbitrary-size graphs dynamically (``dgl.batch``,
+reference: DDFA/sastvd/linevd/datamodule.py:110-141, dataset.py:76). XLA
+compiles one program per shape, so here a batch is a fixed budget of
+``n_graphs`` graph slots, ``max_nodes`` node slots and ``max_edges`` edge
+slots; real entries are marked by masks and padding is inert under the masked
+segment ops. Budgets are rounded to a small set of buckets so eval traffic
+causes a handful of compiles, not one per batch.
+
+Self-loop semantics: the reference bakes self-loops into its cached graphs
+(``dgl.add_self_loop``, DDFA/sastvd/scripts/dbize_graphs.py:25); here
+``batch_graphs(add_self_loops=True)`` applies the same transformation at
+batch-build time so upstream storage stays loop-free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from deepdfa_tpu.graphs.segment import segment_max
+
+
+@struct.dataclass
+class GraphBatch:
+    """A padded batch of graphs (a pytree; all leaves static-shape).
+
+    node_feats  : dict subkey -> int32[max_nodes] abstract-dataflow indices
+                  (0 = not-a-definition, 1.. = vocab; reference
+                  DDFA/sastvd/scripts/dbize_absdf.py:35-43)
+    node_vuln   : int32[max_nodes] per-node vulnerability label (_VULN)
+    senders     : int32[max_edges] source node slot of each edge
+    receivers   : int32[max_edges] destination node slot of each edge
+    node_graph  : int32[max_nodes] graph slot each node belongs to
+    node_mask   : bool[max_nodes]
+    edge_mask   : bool[max_edges]
+    graph_mask  : bool[n_graphs]
+    graph_ids   : int32[n_graphs] original example ids (host bookkeeping,
+                  -1 for empty slots)
+    """
+
+    node_feats: Dict[str, jnp.ndarray]
+    node_vuln: jnp.ndarray
+    senders: jnp.ndarray
+    receivers: jnp.ndarray
+    node_graph: jnp.ndarray
+    node_mask: jnp.ndarray
+    edge_mask: jnp.ndarray
+    graph_mask: jnp.ndarray
+    graph_ids: jnp.ndarray
+
+    @property
+    def n_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.node_mask.shape[0]
+
+    @property
+    def max_edges(self) -> int:
+        return self.edge_mask.shape[0]
+
+
+def graph_label_from_nodes(batch: GraphBatch) -> jnp.ndarray:
+    """Graph-level label = max node ``_VULN`` over real nodes.
+
+    Parity with the reference's per-graph label extraction
+    (DDFA/code_gnn/models/base_module.py:87-88: ``g.ndata["_VULN"].max()``
+    per unbatched graph). Padded nodes are routed through value 0 so an
+    all-padding slot yields label 0 (and is excluded by graph_mask anyway).
+    """
+    vuln = jnp.where(batch.node_mask, batch.node_vuln, 0)
+    return segment_max(
+        vuln.astype(jnp.float32), batch.node_graph, batch.n_graphs, initial=0.0
+    )
+
+
+# Bucket ladder for padding budgets: powers of two limit recompilation.
+_BUCKETS = [2 ** i for i in range(4, 22)]
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return n
+
+
+def pad_budget_for(
+    graphs: Sequence[Mapping], n_graphs: int, add_self_loops: bool = True
+) -> Dict[str, int]:
+    """Pick bucketed node/edge budgets covering every graph in ``graphs``
+    when packed ``n_graphs`` at a time (greedy order-preserving packing)."""
+    max_nodes = 0
+    max_edges = 0
+    for start in range(0, len(graphs), n_graphs):
+        chunk = graphs[start : start + n_graphs]
+        nodes = sum(int(g["num_nodes"]) for g in chunk)
+        edges = sum(len(g["senders"]) for g in chunk)
+        if add_self_loops:
+            edges += nodes
+        max_nodes = max(max_nodes, nodes)
+        max_edges = max(max_edges, edges)
+    return {
+        "n_graphs": n_graphs,
+        "max_nodes": _bucket(max(max_nodes, 1)),
+        "max_edges": _bucket(max(max_edges, 1)),
+    }
+
+
+def batch_graphs(
+    graphs: Sequence[Mapping],
+    n_graphs: int,
+    max_nodes: int,
+    max_edges: int,
+    subkeys: Sequence[str],
+    add_self_loops: bool = True,
+) -> "GraphBatch":
+    """Pack up to ``n_graphs`` graphs into one padded batch (host-side, numpy).
+
+    Each graph mapping needs: ``num_nodes``, ``senders``, ``receivers``,
+    ``vuln`` (int[num_nodes]), ``feats`` (dict subkey -> int[num_nodes]), and
+    optionally ``id``. Graphs that would overflow the node/edge budget raise —
+    callers size budgets with :func:`pad_budget_for` or spill to the next
+    batch upstream.
+    """
+    if len(graphs) > n_graphs:
+        raise ValueError(f"{len(graphs)} graphs > {n_graphs} slots")
+
+    feats = {k: np.zeros(max_nodes, np.int32) for k in subkeys}
+    vuln = np.zeros(max_nodes, np.int32)
+    senders = np.zeros(max_edges, np.int32)
+    receivers = np.zeros(max_edges, np.int32)
+    node_graph = np.zeros(max_nodes, np.int32)
+    node_mask = np.zeros(max_nodes, bool)
+    edge_mask = np.zeros(max_edges, bool)
+    graph_mask = np.zeros(n_graphs, bool)
+    graph_ids = np.full(n_graphs, -1, np.int64)
+
+    node_off = 0
+    edge_off = 0
+    for gi, g in enumerate(graphs):
+        n = int(g["num_nodes"])
+        s = np.asarray(g["senders"], np.int32)
+        r = np.asarray(g["receivers"], np.int32)
+        if add_self_loops:
+            loops = np.arange(n, dtype=np.int32)
+            s = np.concatenate([s, loops])
+            r = np.concatenate([r, loops])
+        e = len(s)
+        if node_off + n > max_nodes or edge_off + e > max_edges:
+            raise ValueError(
+                f"graph {gi} overflows budget "
+                f"(nodes {node_off}+{n}/{max_nodes}, edges {edge_off}+{e}/{max_edges})"
+            )
+        for k in subkeys:
+            feats[k][node_off : node_off + n] = np.asarray(g["feats"][k], np.int32)
+        vuln[node_off : node_off + n] = np.asarray(g["vuln"], np.int32)
+        senders[edge_off : edge_off + e] = s + node_off
+        receivers[edge_off : edge_off + e] = r + node_off
+        node_graph[node_off : node_off + n] = gi
+        node_mask[node_off : node_off + n] = True
+        edge_mask[edge_off : edge_off + e] = True
+        graph_mask[gi] = True
+        graph_ids[gi] = int(g.get("id", gi))
+        node_off += n
+        edge_off += e
+
+    return GraphBatch(
+        node_feats={k: jnp.asarray(v) for k, v in feats.items()},
+        node_vuln=jnp.asarray(vuln),
+        senders=jnp.asarray(senders),
+        receivers=jnp.asarray(receivers),
+        node_graph=jnp.asarray(node_graph),
+        node_mask=jnp.asarray(node_mask),
+        edge_mask=jnp.asarray(edge_mask),
+        graph_mask=jnp.asarray(graph_mask),
+        graph_ids=jnp.asarray(graph_ids),
+    )
+
+
+def batch_iterator(
+    graphs: List[Mapping],
+    n_graphs: int,
+    max_nodes: int,
+    max_edges: int,
+    subkeys: Sequence[str],
+    add_self_loops: bool = True,
+):
+    """Greedy packer: yields GraphBatches, spilling graphs that would
+    overflow the budget into the next batch (static-shape replacement for
+    DGL's GraphDataLoader)."""
+    pending: List[Mapping] = []
+    nodes = edges = 0
+
+    def _cost(g):
+        n = int(g["num_nodes"])
+        e = len(g["senders"]) + (n if add_self_loops else 0)
+        return n, e
+
+    for g in graphs:
+        n, e = _cost(g)
+        if pending and (
+            len(pending) >= n_graphs or nodes + n > max_nodes or edges + e > max_edges
+        ):
+            yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, add_self_loops)
+            pending, nodes, edges = [], 0, 0
+        if n > max_nodes or e > max_edges:
+            raise ValueError(f"single graph exceeds budget: {n} nodes / {e} edges")
+        pending.append(g)
+        nodes += n
+        edges += e
+    if pending:
+        yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, add_self_loops)

@@ -1,0 +1,63 @@
+"""Segment ops: the XLA-native replacement for DGL's message-passing kernels.
+
+DGL implements gather/scatter message passing in CUDA (GatedGraphConv's SpMM,
+GlobalAttentionPooling's per-graph softmax). On TPU the same computation is
+expressed with static-shape segment reductions that XLA lowers to efficient
+sorted-scatter code; the Pallas kernel in ``deepdfa_tpu.ops`` specializes the
+hot path further.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Sum rows of ``data`` into ``num_segments`` buckets by ``segment_ids``.
+
+    ``num_segments`` must be static for XLA. Padding contract: callers zero
+    out padded rows *before* calling (padded ids point at slot 0, so unmasked
+    garbage would accumulate there) — see the masked message step in
+    ``models/flowgnn.py`` and ``segment_softmax``'s ``mask`` argument.
+    """
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_max(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    initial: float = -jnp.inf,
+) -> jnp.ndarray:
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    # Empty segments come back as -inf; replace with `initial` when requested.
+    if initial != -jnp.inf:
+        out = jnp.where(jnp.isneginf(out), initial, out)
+    return out
+
+
+def segment_softmax(
+    logits: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Numerically-stable softmax within each segment.
+
+    This is the TPU equivalent of DGL ``GlobalAttentionPooling``'s
+    ``dgl.softmax_nodes``: gate logits are normalized over the nodes of each
+    graph. ``mask`` zeroes padded rows so they get zero weight.
+    """
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    # max per segment, broadcast back
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(jnp.isneginf(seg_max), 0.0, seg_max)
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = jnp.where(mask, exp, 0.0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    denom = jnp.where(denom > 0, denom, 1.0)
+    return exp / denom[segment_ids]

@@ -1,0 +1,3 @@
+from deepdfa_tpu.models.flowgnn import FlowGNN
+
+__all__ = ["FlowGNN"]

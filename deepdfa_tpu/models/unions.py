@@ -1,0 +1,53 @@
+"""Differentiable bitwise-union (dataflow meet) operators.
+
+Parity with the reference's experimental smooth union ops
+(DDFA/code_gnn/models/clipper.py:6-25) used to simulate dataflow-analysis
+meet functions inside a differentiable model, plus a segment-based
+union-aggregation over graph edges replacing the DGL node UDF factory
+(clipper.py:50-77).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deepdfa_tpu.graphs.segment import segment_sum
+
+
+def simple_union(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Probabilistic OR: a ∪ b = a + b − a·b (clipper.py:6-14)."""
+    return (a + b) - (a * b)
+
+
+def relu_union(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Clipped-sum OR: 1 − relu(1 − (a+b)) (clipper.py:17-25).
+
+    For binary inputs equals bitwise OR; for reals it is min(a + b, 1) when
+    a + b ≥ 0, giving a piecewise-linear, gradient-friendly union.
+    """
+    ones = jnp.ones_like(a)
+    return ones - jnp.maximum(ones - (a + b), 0.0)
+
+
+def segment_union(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    kind: str = "simple",
+) -> jnp.ndarray:
+    """Union-reduce rows into segments.
+
+    Replaces the reference's sequential mailbox loop (clipper.py:62-72) with
+    closed forms that XLA reduces in one pass:
+      simple: 1 − Π(1 − x)  computed as exp(Σ log(1−x)) — the n-ary extension
+              of a+b−ab.
+      relu:   min(Σ x, 1)   — the n-ary extension of the clipped sum.
+    """
+    if kind == "simple":
+        log_keep = jnp.log1p(-jnp.clip(data, 0.0, 1.0 - 1e-7))
+        summed = segment_sum(log_keep, segment_ids, num_segments)
+        return 1.0 - jnp.exp(summed)
+    if kind == "relu":
+        summed = segment_sum(data, segment_ids, num_segments)
+        return jnp.minimum(summed, 1.0)
+    raise ValueError(f"unknown union kind: {kind}")

@@ -1,0 +1,8 @@
+from deepdfa_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_concat,
+)
+
+__all__ = ["batch_sharding", "make_mesh", "replicated", "shard_concat"]

@@ -1,0 +1,19 @@
+from deepdfa_tpu.train.loop import (
+    EvalResult,
+    TrainState,
+    evaluate,
+    fit,
+    make_eval_step,
+    make_train_step,
+    make_train_state,
+)
+
+__all__ = [
+    "EvalResult",
+    "TrainState",
+    "evaluate",
+    "fit",
+    "make_eval_step",
+    "make_train_step",
+    "make_train_state",
+]

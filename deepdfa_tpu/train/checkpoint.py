@@ -1,0 +1,71 @@
+"""Checkpointing via orbax.
+
+Reproduces the reference's checkpoint semantics (SURVEY §5): best-by-val-loss
+with ``save_last`` (Lightning ModelCheckpoint, config_default.yaml:23-29),
+periodic every-N-epochs snapshots (periodic_checkpoint.py:8-22), and
+partial-load-and-freeze of the graph encoder for the combined models
+(main_cli.py:136-144 ``--freeze_graph`` strips head/pooling keys). Best
+checkpoint metadata is stored explicitly instead of being re-parsed out of
+filenames (main_cli.py:175-184).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, periodic_every: int = 25):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.periodic_every = periodic_every
+        self._ckpt = ocp.StandardCheckpointer()
+        self._meta_path = os.path.join(self.directory, "meta.json")
+        self._meta = {"best_epoch": -1, "best_val_loss": float("inf")}
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                self._meta = json.load(f)
+
+    def _save(self, name: str, state: Any) -> None:
+        path = os.path.join(self.directory, name)
+        self._ckpt.save(path, jax.device_get(state), force=True)
+        self._ckpt.wait_until_finished()
+
+    def save_best(self, state: Any, epoch: int, val_loss: float) -> None:
+        self._save("best", state)
+        self._meta.update({"best_epoch": epoch, "best_val_loss": val_loss})
+        with open(self._meta_path, "w") as f:
+            json.dump(self._meta, f)
+
+    def save_last(self, state: Any, epoch: int) -> None:
+        self._save("last", state)
+
+    def maybe_save_periodic(self, state: Any, epoch: int) -> None:
+        if self.periodic_every and (epoch + 1) % self.periodic_every == 0:
+            self._save(f"epoch_{epoch}", state)
+
+    def restore(self, name: str, target: Any) -> Any:
+        path = os.path.join(self.directory, name)
+        return self._ckpt.restore(path, target=jax.device_get(target))
+
+    @property
+    def best_meta(self) -> dict:
+        return dict(self._meta)
+
+
+def load_encoder_params(full_params: Any, drop_keys=("_head", "pooling")) -> Any:
+    """Partial checkpoint load for encoder freezing.
+
+    Drops the classification head (top-level key ``_head``) and ``pooling``
+    parameters, keeping embeddings + GGNN — the combined models load these
+    into an ``encoder_mode`` FlowGNN (reference main_cli.py:136-144,
+    linevul_main.py:589-602).
+    """
+    params = full_params["params"]
+    kept = {k: v for k, v in params.items() if k not in set(drop_keys)}
+    return {"params": kept}

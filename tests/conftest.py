@@ -1,0 +1,50 @@
+"""Force tests onto a virtual 8-device CPU mesh.
+
+Real multi-chip hardware is not available in CI; sharding correctness is
+validated on XLA's host-platform device partitioning (same program, same
+collectives, CPU execution), which also compiles far faster than shipping
+tiny test programs to the TPU.
+
+The platform choice must be in the environment *before* the interpreter
+starts: this image's sitecustomize registers the axon TPU PJRT plugin at
+startup, and flipping JAX_PLATFORMS after that stalls the process. So in
+``pytest_configure`` we re-exec pytest once with the corrected environment
+(guarded by a sentinel), first restoring the real stdout/stderr that
+pytest's capture layer holds. Set DEEPDFA_TPU_TEST_NO_REEXEC=1 to run tests
+on whatever platform is already configured.
+"""
+
+import os
+import sys
+
+_SENTINEL = "DEEPDFA_TPU_TEST_REEXEC"
+
+
+def _needs_reexec() -> bool:
+    return (
+        os.environ.get(_SENTINEL) != "1"
+        and os.environ.get("DEEPDFA_TPU_TEST_NO_REEXEC") != "1"
+        and os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    )
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ)
+    env[_SENTINEL] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon TPU plugin registration
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest", *config.invocation_params.args],
+        env,
+    )

@@ -1,0 +1,157 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+from deepdfa_tpu.graphs import batch_graphs
+from deepdfa_tpu.models.flowgnn import FlowGNN
+
+from test_graphs import SUBKEYS, make_graph
+
+CFG = FlowGNNConfig(
+    feature=FeatureSpec(limit_all=20, limit_subkeys=20),
+    hidden_dim=8,
+    n_steps=3,
+    num_output_layers=3,
+)
+
+
+def small_batch(n_graphs=4, max_nodes=32, max_edges=64, seed=0):
+    rng = np.random.default_rng(seed)
+    graphs = [
+        make_graph(4, [(0, 1), (1, 2), (2, 3), (3, 1)], gid=1, rng=rng),
+        make_graph(3, [(0, 1), (1, 2)], vuln=np.array([0, 1, 0]), gid=2, rng=rng),
+    ]
+    return graphs, batch_graphs(graphs, n_graphs, max_nodes, max_edges, SUBKEYS)
+
+
+def test_forward_shapes_and_finite():
+    _, batch = small_batch()
+    model = FlowGNN(CFG)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    logits = model.apply(params, batch)
+    assert logits.shape == (4,)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_encoder_mode_dim():
+    _, batch = small_batch()
+    cfg = FlowGNNConfig(
+        feature=CFG.feature, hidden_dim=8, n_steps=3, num_output_layers=3,
+        encoder_mode=True,
+    )
+    model = FlowGNN(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    emb = model.apply(params, batch)
+    # out_dim = embed(4*8) + hidden(4*8) = 64
+    assert emb.shape == (4, 64)
+    assert cfg.out_dim == 64
+
+
+def test_padding_invariance():
+    """Real-graph logits must not change when the padding budget grows."""
+    graphs, b_small = small_batch(n_graphs=4, max_nodes=32, max_edges=64)
+    b_big = batch_graphs(graphs, n_graphs=8, max_nodes=128, max_edges=256, subkeys=SUBKEYS)
+    model = FlowGNN(CFG)
+    params = model.init(jax.random.PRNGKey(0), b_small)
+    out_small = np.asarray(model.apply(params, b_small))[:2]
+    out_big = np.asarray(model.apply(params, b_big))[:2]
+    np.testing.assert_allclose(out_small, out_big, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_composition_invariance():
+    """A graph's logit must not depend on which graphs share its batch."""
+    rng = np.random.default_rng(3)
+    g1 = make_graph(5, [(0, 1), (1, 2), (2, 3), (3, 4)], gid=1, rng=rng)
+    g2 = make_graph(4, [(0, 1), (1, 2), (2, 0)], gid=2, rng=rng)
+    g3 = make_graph(3, [(0, 1)], gid=3, rng=rng)
+    model = FlowGNN(CFG)
+    b12 = batch_graphs([g1, g2], 4, 32, 64, SUBKEYS)
+    b13 = batch_graphs([g1, g3], 4, 32, 64, SUBKEYS)
+    params = model.init(jax.random.PRNGKey(0), b12)
+    out12 = np.asarray(model.apply(params, b12))
+    out13 = np.asarray(model.apply(params, b13))
+    np.testing.assert_allclose(out12[0], out13[0], rtol=1e-5, atol=1e-5)
+
+
+def _numpy_gated_forward(params, batch, cfg):
+    """Independent numpy oracle for the gated message-passing stack."""
+    p = jax.tree_util.tree_map(np.asarray, params)["params"]
+    feats = np.concatenate(
+        [p[f"embed_{k}"]["embedding"][np.asarray(batch.node_feats[k])] for k in SUBKEYS],
+        axis=-1,
+    )
+    h = feats.copy()
+    W = p["ggnn_step"]["edge_linear"]["kernel"]
+    bW = p["ggnn_step"]["edge_linear"]["bias"]
+    gru = p["ggnn_step"]["gru"]
+    senders = np.asarray(batch.senders)
+    receivers = np.asarray(batch.receivers)
+    emask = np.asarray(batch.edge_mask)
+    N = h.shape[0]
+    for _ in range(cfg.n_steps):
+        msg = h @ W + bW
+        msg = np.take(msg, senders, axis=0) * emask[:, None]
+        agg = np.zeros_like(h)
+        np.add.at(agg, receivers, msg)
+        # flax GRUCell: r/z from [x;h] dense, n = tanh(in_n(x) + r*hn(h))
+        def dense(name, x, with_bias=True):
+            k = gru[name]["kernel"]
+            b = gru[name].get("bias") if with_bias else None
+            y = x @ k
+            return y + b if b is not None else y
+        r = _sigmoid(dense("ir", agg) + dense("hr", h, False))
+        z = _sigmoid(dense("iz", agg) + dense("hz", h, False))
+        n = np.tanh(dense("in", agg) + r * dense("hn", h))
+        h = (1.0 - z) * n + z * h
+    out = np.concatenate([h, feats], axis=-1)
+    gate = out @ p["pooling"]["gate"]["kernel"] + p["pooling"]["gate"]["bias"]
+    gate = gate[:, 0]
+    nmask = np.asarray(batch.node_mask)
+    ngraph = np.asarray(batch.node_graph)
+    G = batch.n_graphs
+    pooled = np.zeros((G, out.shape[1]))
+    for g in range(G):
+        sel = (ngraph == g) & nmask
+        if not sel.any():
+            continue
+        gl = gate[sel]
+        w = np.exp(gl - gl.max())
+        w = w / w.sum()
+        pooled[g] = (out[sel] * w[:, None]).sum(0)
+    x = pooled
+    for i in range(cfg.num_output_layers):
+        layer = p["_head"][f"output_{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i != cfg.num_output_layers - 1:
+            x = np.maximum(x, 0.0)
+    return x[:, 0]
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_forward_matches_numpy_oracle():
+    _, batch = small_batch()
+    model = FlowGNN(CFG)
+    params = model.init(jax.random.PRNGKey(42), batch)
+    got = np.asarray(model.apply(params, batch))
+    want = _numpy_gated_forward(params, batch, CFG)
+    # fp32 accumulation-order differences across XLA fusion vs numpy
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+
+
+def test_gradients_flow():
+    _, batch = small_batch()
+    model = FlowGNN(CFG)
+    params = model.init(jax.random.PRNGKey(0), batch)
+
+    def loss(p):
+        return jnp.sum(model.apply(p, batch) ** 2)
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    total = sum(float(np.abs(np.asarray(l)).sum()) for l in leaves)
+    assert total > 0

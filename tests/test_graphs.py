@@ -1,0 +1,90 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deepdfa_tpu.graphs import (
+    batch_graphs,
+    graph_label_from_nodes,
+    pad_budget_for,
+    segment_max,
+    segment_softmax,
+    segment_sum,
+)
+from deepdfa_tpu.graphs.batch import batch_iterator
+
+SUBKEYS = ("api", "datatype", "literal", "operator")
+
+
+def make_graph(num_nodes, edges, vuln=None, gid=0, rng=None):
+    rng = rng or np.random.default_rng(0)
+    senders, receivers = (np.array([e[0] for e in edges]), np.array([e[1] for e in edges]))
+    return {
+        "id": gid,
+        "num_nodes": num_nodes,
+        "senders": senders,
+        "receivers": receivers,
+        "vuln": vuln if vuln is not None else np.zeros(num_nodes, np.int32),
+        "feats": {k: rng.integers(0, 10, num_nodes) for k in SUBKEYS},
+    }
+
+
+def test_segment_sum_basic():
+    data = jnp.array([[1.0], [2.0], [3.0]])
+    out = segment_sum(data, jnp.array([0, 0, 1]), 2)
+    np.testing.assert_allclose(out, [[3.0], [3.0]])
+
+
+def test_segment_softmax_masked():
+    logits = jnp.array([0.0, 0.0, 100.0])  # the masked row must not win
+    ids = jnp.array([0, 0, 0])
+    mask = jnp.array([True, True, False])
+    w = segment_softmax(logits, ids, 1, mask=mask)
+    np.testing.assert_allclose(np.asarray(w), [0.5, 0.5, 0.0], atol=1e-6)
+
+
+def test_segment_max_empty_segment():
+    out = segment_max(jnp.array([1.0, 2.0]), jnp.array([0, 0]), 3, initial=0.0)
+    np.testing.assert_allclose(out, [2.0, 0.0, 0.0])
+
+
+def test_batch_layout_and_self_loops():
+    g1 = make_graph(3, [(0, 1), (1, 2)], vuln=np.array([0, 1, 0]), gid=7)
+    g2 = make_graph(2, [(0, 1)], vuln=np.array([0, 0]), gid=9)
+    b = batch_graphs([g1, g2], n_graphs=4, max_nodes=16, max_edges=32, subkeys=SUBKEYS)
+    assert int(b.node_mask.sum()) == 5
+    # 3 real edges + 5 self loops
+    assert int(b.edge_mask.sum()) == 8
+    assert list(np.asarray(b.graph_ids)) == [7, 9, -1, -1]
+    assert list(np.asarray(b.node_graph[:5])) == [0, 0, 0, 1, 1]
+    # second graph's edge is offset by 3 nodes
+    real_edges = set(
+        zip(np.asarray(b.senders)[np.asarray(b.edge_mask)].tolist(),
+            np.asarray(b.receivers)[np.asarray(b.edge_mask)].tolist())
+    )
+    assert (3, 4) in real_edges and (0, 1) in real_edges and (4, 4) in real_edges
+    labels = graph_label_from_nodes(b)
+    np.testing.assert_allclose(np.asarray(labels), [1.0, 0.0, 0.0, 0.0])
+
+
+def test_batch_overflow_raises():
+    g = make_graph(10, [(0, 1)])
+    with pytest.raises(ValueError):
+        batch_graphs([g], n_graphs=1, max_nodes=4, max_edges=32, subkeys=SUBKEYS)
+
+
+def test_batch_iterator_spills():
+    graphs = [make_graph(6, [(0, 1)], gid=i) for i in range(5)]
+    batches = list(
+        batch_iterator(graphs, n_graphs=4, max_nodes=16, max_edges=64, subkeys=SUBKEYS)
+    )
+    # 16-node budget fits 2 six-node graphs per batch -> 3 batches
+    assert len(batches) == 3
+    seen = [int(i) for b in batches for i in np.asarray(b.graph_ids) if i >= 0]
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_pad_budget_buckets():
+    graphs = [make_graph(5, [(0, 1), (1, 2)]) for _ in range(10)]
+    budget = pad_budget_for(graphs, n_graphs=4)
+    assert budget["max_nodes"] == 32  # 4*5=20 -> bucket 32
+    assert budget["max_edges"] == 32  # 4*(2+5)=28 -> bucket 32

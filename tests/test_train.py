@@ -1,0 +1,122 @@
+import numpy as np
+import jax
+import pytest
+
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+)
+from deepdfa_tpu.data import make_splits, synthetic_bigvul
+from deepdfa_tpu.data.sampling import epoch_indices
+from deepdfa_tpu.data.splits import assert_no_leakage
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.parallel.mesh import make_mesh
+from deepdfa_tpu.train.loop import evaluate, fit, make_eval_step, make_train_state
+
+SMALL = FlowGNNConfig(
+    feature=FeatureSpec(limit_all=30, limit_subkeys=30),
+    hidden_dim=8,
+    n_steps=4,
+    num_output_layers=2,
+)
+DATA = DataConfig(
+    batch_size=16,
+    eval_batch_size=16,
+    max_nodes_per_graph=64,
+    max_edges_per_node=4,
+    undersample_factor=1.0,
+)
+
+
+def test_splits_deterministic_and_disjoint():
+    ex = synthetic_bigvul(100, SMALL.feature, seed=0)
+    s1 = make_splits(ex, "random", seed=5)
+    s2 = make_splits(ex, "random", seed=5)
+    s3 = make_splits(ex, "random", seed=6)
+    assert np.array_equal(s1["train"], s2["train"])
+    assert not np.array_equal(s1["train"], s3["train"])
+    assert_no_leakage(s1)
+    total = sum(len(v) for v in s1.values())
+    assert total == 100
+
+
+def test_cross_project_split_disjoint_projects():
+    ex = synthetic_bigvul(200, SMALL.feature, seed=0)
+    s = make_splits(ex, "cross-project", seed=1)
+    assert_no_leakage(s)
+    projs = {k: {ex[i]["project"] for i in v} for k, v in s.items()}
+    assert not (projs["train"] & projs["test"])
+    assert not (projs["train"] & projs["val"])
+
+
+def test_epoch_indices_undersample():
+    labels = [1] * 10 + [0] * 90
+    idx = epoch_indices(labels, epoch=0, seed=0, undersample_factor=1.0)
+    assert len(idx) == 20
+    chosen = np.array(labels)[idx]
+    assert chosen.sum() == 10
+    # fresh negatives each epoch
+    idx2 = epoch_indices(labels, epoch=1, seed=0, undersample_factor=1.0)
+    assert set(idx.tolist()) != set(idx2.tolist())
+    # deterministic per (seed, epoch)
+    assert np.array_equal(idx, epoch_indices(labels, 0, seed=0, undersample_factor=1.0))
+
+
+def test_fit_learns_synthetic_task():
+    """End-to-end: training must separate planted vulnerable motifs."""
+    ex = synthetic_bigvul(400, SMALL.feature, positive_fraction=0.5, seed=1)
+    splits = make_splits(ex, "random", seed=0)
+    model = FlowGNN(SMALL)
+    cfg = TrainConfig(max_epochs=16, learning_rate=2e-3, seed=0)
+    best_state, history = fit(model, ex, splits, cfg, DATA)
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    from deepdfa_tpu.core.config import subkeys_for
+
+    test = evaluate(eval_step, best_state, ex, splits["test"], DATA, subkeys_for(SMALL.feature))
+    assert test.metrics["f1"] > 0.8, (test.metrics, history["epochs"][-1])
+    assert history["best_epoch"] >= 0
+
+
+def test_fit_on_mesh_matches_shapes():
+    """Same training loop jitted over an 8-device mesh must run and improve."""
+    ex = synthetic_bigvul(120, SMALL.feature, positive_fraction=0.5, seed=2)
+    splits = make_splits(ex, "random", seed=0)
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    model = FlowGNN(SMALL)
+    cfg = TrainConfig(max_epochs=2, learning_rate=2e-3, seed=0)
+    data = DataConfig(batch_size=16, eval_batch_size=16, undersample_factor=None)
+    best_state, history = fit(model, ex, splits, cfg, data, mesh=mesh)
+    assert len(history["epochs"]) == 2
+    assert np.isfinite(history["epochs"][-1]["train_loss"])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from deepdfa_tpu.train.checkpoint import CheckpointManager, load_encoder_params
+    from deepdfa_tpu.core.config import subkeys_for
+    from deepdfa_tpu.train.loop import _batches
+
+    ex = synthetic_bigvul(40, SMALL.feature, seed=3)
+    splits = make_splits(ex, "random", seed=0)
+    model = FlowGNN(SMALL)
+    cfg = TrainConfig(seed=0)
+    batch = next(_batches(ex, splits["train"], DATA, subkeys_for(SMALL.feature), 16))
+    state, _ = make_train_state(model, batch, cfg)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), periodic_every=1)
+    mgr.save_best(state, epoch=0, val_loss=0.5)
+    mgr.save_last(state, epoch=0)
+    mgr.maybe_save_periodic(state, epoch=0)
+    restored = mgr.restore("best", state)
+    orig = jax.tree_util.tree_leaves(state.params)
+    back = jax.tree_util.tree_leaves(restored.params)
+    for a, b in zip(orig, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert mgr.best_meta["best_epoch"] == 0
+
+    enc = load_encoder_params(state.params)
+    keys = set(enc["params"].keys())
+    assert "pooling" not in keys and "_head" not in keys
+    assert any(k.startswith("embed_") for k in keys)

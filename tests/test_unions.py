@@ -1,0 +1,43 @@
+import numpy as np
+import jax.numpy as jnp
+
+from deepdfa_tpu.models.unions import relu_union, segment_union, simple_union
+
+
+def test_union_binary_parity():
+    # clipper.py:93-107 test_union
+    n1 = jnp.array([1.0, 0.0, 1.0, 0.0])
+    n2 = jnp.array([0.0, 0.0, 1.0, 1.0])
+    expected = np.array([1.0, 0.0, 1.0, 1.0])
+    for fn in (simple_union, relu_union):
+        np.testing.assert_allclose(np.asarray(fn(n1, n2)), expected, atol=1e-6)
+
+
+def test_relu_union_closed_form():
+    # clipper.py:28-47 test_smoothness: relu_union(a,b) == min(a+b, 1) on the
+    # a+b >= 0 branch and a+b otherwise
+    a = jnp.arange(-2.0, 2.0, 0.25)[:, None]
+    b = jnp.arange(-2.0, 2.0, 0.25)[None, :]
+    got = np.asarray(relu_union(jnp.broadcast_to(a, (16, 16)), jnp.broadcast_to(b, (16, 16))))
+    s = np.asarray(a + b)
+    want = np.where(s < 1.0, s, 1.0)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_segment_union_matches_pairwise_fold():
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.uniform(0, 1, size=(6, 4)).astype(np.float32))
+    ids = jnp.array([0, 0, 0, 1, 1, 2])
+    got = np.asarray(segment_union(data, ids, 3, kind="simple"))
+    d = np.asarray(data)
+    for seg, rows in [(0, [0, 1, 2]), (1, [3, 4]), (2, [5])]:
+        acc = np.zeros(4)
+        for r in rows:
+            acc = acc + d[r] - acc * d[r]
+        np.testing.assert_allclose(got[seg], acc, atol=1e-4)
+
+    got_relu = np.asarray(segment_union(data, ids, 3, kind="relu"))
+    for seg, rows in [(0, [0, 1, 2]), (1, [3, 4]), (2, [5])]:
+        np.testing.assert_allclose(
+            got_relu[seg], np.minimum(d[rows].sum(0), 1.0), atol=1e-6
+        )
