@@ -99,12 +99,10 @@ class FlowGNN(nn.Module):
             embeds.append(table(batch.node_feats[key]))
         feat_embed = jnp.concatenate(embeds, axis=-1)
 
-        # Zero-pad input width up to the GGNN hidden width, as DGL's
-        # GatedGraphConv does when in_feats < out_feats.
+        # Embedding width and GGNN width are equal by construction
+        # (FlowGNNConfig defines both as hidden_dim * n_subkeys), so unlike
+        # DGL's GatedGraphConv no zero-padding of the input is needed.
         h = feat_embed
-        if cfg.ggnn_hidden > feat_embed.shape[-1]:
-            pad = cfg.ggnn_hidden - feat_embed.shape[-1]
-            h = jnp.pad(h, ((0, 0), (0, pad)))
 
         step = GatedGraphStep(cfg.ggnn_hidden, dtype=dtype, name="ggnn_step")
         # Weight sharing across steps (one GatedGraphConv applied n_steps

@@ -1,0 +1,100 @@
+"""LineVul: transformer sequence classifier, optionally combined with the
+FlowGNN graph encoder.
+
+Re-design of the reference's combined model
+(LineVul/linevul/linevul_model.py:6-69): RoBERTa-family encoder (codebert or
+unixcoder weights), classification vector = <s>/CLS hidden state, optionally
+concatenated with the pooled FlowGNN embedding, then the RoBERTa head
+(dropout → dense(hidden+extra → hidden) → tanh → dropout → proj(2)), CE loss.
+
+Missing-graph semantics: the reference drops batch rows whose graph was not
+parsed (``keep_idx``, linevul_main.py:191-197) and counts ``num_missing``.
+Static shapes make that a mask: ``example_mask`` excludes those rows from
+loss and metrics identically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.core.config import FlowGNNConfig
+from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+
+
+class ClassificationHead(nn.Module):
+    """RobertaClassificationHead with an extra-feature slot
+    (linevul_model.py:6-24)."""
+
+    hidden_size: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, cls_vec, graph_embed, deterministic: bool = True):
+        x = cls_vec
+        if graph_embed is not None:
+            x = jnp.concatenate([x, graph_embed], axis=-1)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = nn.Dense(self.hidden_size, name="dense")(x)
+        x = jnp.tanh(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        return nn.Dense(2, name="out_proj")(x)
+
+
+class LineVul(nn.Module):
+    """Text (+graph) classifier.
+
+    ``graph_config`` None → pure LineVul; set → DeepDFA+LineVul combined
+    (the primary parity target, paper Table 3b).
+    """
+
+    encoder_config: EncoderConfig
+    graph_config: Optional[FlowGNNConfig] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        graphs: Optional[GraphBatch] = None,
+        deterministic: bool = True,
+        output_attentions: bool = False,
+    ):
+        attn_mask = input_ids != self.encoder_config.pad_token_id
+        hidden, attentions = RobertaEncoder(self.encoder_config, name="roberta")(
+            input_ids,
+            attn_mask,
+            deterministic=deterministic,
+            output_attentions=output_attentions,
+        )
+        cls_vec = hidden[:, 0, :]
+
+        graph_embed = None
+        if self.graph_config is not None:
+            assert graphs is not None, "combined model needs a GraphBatch"
+            enc_cfg = self.graph_config
+            assert enc_cfg.encoder_mode, "graph_config must set encoder_mode"
+            graph_embed = FlowGNN(enc_cfg, name="flowgnn")(graphs)
+
+        logits = ClassificationHead(
+            self.encoder_config.hidden_size,
+            self.encoder_config.dropout_rate,
+            name="classifier",
+        )(cls_vec, graph_embed, deterministic=deterministic)
+        if output_attentions:
+            return logits, attentions
+        return logits
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray, labels: jnp.ndarray, example_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Masked mean 2-class CE (linevul_model.py CE over keep_idx rows)."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(log_probs, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    m = example_mask.astype(jnp.float32)
+    return -jnp.sum(picked * m) / jnp.maximum(jnp.sum(m), 1.0)

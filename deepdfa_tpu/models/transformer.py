@@ -1,0 +1,198 @@
+"""RoBERTa-compatible transformer encoder, written natively in Flax.
+
+The reference rides HuggingFace PyTorch ``RobertaForSequenceClassification``
+(LineVul/linevul/linevul_model.py:26-69, codebert/unixcoder backbones). Here
+the encoder is our own module so the stack stays JAX-native end to end:
+bfloat16-friendly, fusable by XLA, no dependency on transformers' Flax
+classes. Weights convert 1:1 from any HF RoBERTa-family checkpoint via
+:func:`convert_hf_roberta` (codebert-base and unixcoder-base share this
+architecture).
+
+Architectural parity (post-LN BERT encoder):
+  embeddings = word + learned positions (offset by pad_id+1, RoBERTa
+  convention) + token type, then LayerNorm; N layers of MHA + FFN(gelu),
+  residual + post-LayerNorm each.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    """codebert-base / unixcoder-base shape by default."""
+
+    vocab_size: int = 50265
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 514
+    type_vocab_size: int = 1
+    pad_token_id: int = 1
+    layer_norm_eps: float = 1e-5
+    dropout_rate: float = 0.1
+    dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 128) -> "EncoderConfig":
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=32,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=66,
+        )
+
+    @classmethod
+    def codebert_base(cls) -> "EncoderConfig":
+        """microsoft/codebert-base — the LineVul backbone
+        (LineVul/linevul/scripts/msr_train_linevul.sh)."""
+        return cls()
+
+    @classmethod
+    def unixcoder_base(cls) -> "EncoderConfig":
+        """microsoft/unixcoder-base — the UniXcoder variant backbone
+        (LineVul/unixcoder/rq1_train_uxc.sh:10-28); same RoBERTa encoder
+        with a longer position table."""
+        return cls(vocab_size=51416, max_position_embeddings=1026)
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, deterministic):
+        c = self.cfg
+        d = jnp.dtype(c.dtype)
+        head_dim = c.hidden_size // c.num_heads
+        q = nn.Dense(c.hidden_size, dtype=d, name="query")(x)
+        k = nn.Dense(c.hidden_size, dtype=d, name="key")(x)
+        v = nn.Dense(c.hidden_size, dtype=d, name="value")(x)
+
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        bias = jnp.where(attn_mask[:, None, None, :], 0.0, -1e9)
+        weights = jax.nn.softmax(scores + bias, axis=-1)
+        weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        out = out.reshape(out.shape[0], out.shape[1], c.hidden_size)
+        return out, weights
+
+
+class EncoderLayer(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, deterministic):
+        c = self.cfg
+        d = jnp.dtype(c.dtype)
+        attn_out, attn_weights = SelfAttention(c, name="attention")(
+            x, attn_mask, deterministic
+        )
+        attn_out = nn.Dense(c.hidden_size, dtype=d, name="attention_output")(attn_out)
+        attn_out = nn.Dropout(c.dropout_rate)(attn_out, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="attention_ln")(x + attn_out)
+        ff = nn.Dense(c.intermediate_size, dtype=d, name="intermediate")(x)
+        ff = nn.gelu(ff, approximate=False)
+        ff = nn.Dense(c.hidden_size, dtype=d, name="output")(ff)
+        ff = nn.Dropout(c.dropout_rate)(ff, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="output_ln")(x + ff)
+        return x, attn_weights
+
+
+class RobertaEncoder(nn.Module):
+    """Returns (last_hidden_state, attentions tuple)."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attn_mask=None, deterministic: bool = True,
+                 output_attentions: bool = False):
+        c = self.cfg
+        if attn_mask is None:
+            attn_mask = input_ids != c.pad_token_id
+        word = nn.Embed(c.vocab_size, c.hidden_size, name="word_embeddings")(input_ids)
+        # RoBERTa position ids: pad positions stay at pad_id; real tokens
+        # count up from pad_id+1.
+        positions = jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) * attn_mask + c.pad_token_id
+        pos = nn.Embed(
+            c.max_position_embeddings, c.hidden_size, name="position_embeddings"
+        )(positions)
+        tok_type = nn.Embed(
+            c.type_vocab_size, c.hidden_size, name="token_type_embeddings"
+        )(jnp.zeros_like(input_ids))
+        x = word + pos + tok_type
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="embeddings_ln")(x)
+        x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+
+        attentions = []
+        for i in range(c.num_layers):
+            x, attn = EncoderLayer(c, name=f"layer_{i}")(x, attn_mask, deterministic)
+            if output_attentions:
+                attentions.append(attn)
+        return x, tuple(attentions)
+
+
+def convert_hf_roberta(state_dict: Dict[str, Any], cfg: EncoderConfig) -> Dict:
+    """Map a HuggingFace PyTorch RoBERTa ``state_dict`` (codebert-base,
+    unixcoder-base, roberta-base) onto :class:`RobertaEncoder` params.
+
+    Accepts either ``roberta.``-prefixed keys (ForSequenceClassification
+    checkpoints) or bare ``embeddings./encoder.`` keys (base models).
+    """
+
+    def get(key):
+        for prefix in ("roberta.", ""):
+            k = prefix + key
+            if k in state_dict:
+                return np.asarray(state_dict[k].detach().cpu().numpy()
+                                  if hasattr(state_dict[k], "detach")
+                                  else state_dict[k])
+        raise KeyError(key)
+
+    p: Dict[str, Any] = {
+        "word_embeddings": {"embedding": get("embeddings.word_embeddings.weight")},
+        "position_embeddings": {"embedding": get("embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {"embedding": get("embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": {
+            "scale": get("embeddings.LayerNorm.weight"),
+            "bias": get("embeddings.LayerNorm.bias"),
+        },
+    }
+
+    def dense(key):
+        return {"kernel": get(key + ".weight").T, "bias": get(key + ".bias")}
+
+    for i in range(cfg.num_layers):
+        b = f"encoder.layer.{i}."
+        p[f"layer_{i}"] = {
+            "attention": {
+                "query": dense(b + "attention.self.query"),
+                "key": dense(b + "attention.self.key"),
+                "value": dense(b + "attention.self.value"),
+            },
+            "attention_output": dense(b + "attention.output.dense"),
+            "attention_ln": {
+                "scale": get(b + "attention.output.LayerNorm.weight"),
+                "bias": get(b + "attention.output.LayerNorm.bias"),
+            },
+            "intermediate": dense(b + "intermediate.dense"),
+            "output": dense(b + "output.dense"),
+            "output_ln": {
+                "scale": get(b + "output.LayerNorm.weight"),
+                "bias": get(b + "output.LayerNorm.bias"),
+            },
+        }
+    return {"params": p}

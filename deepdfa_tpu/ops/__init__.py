@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops.
+
+The default compute path is the XLA segment-op formulation in
+``deepdfa_tpu.graphs.segment``; kernels here specialize the fused
+gather→transform→scatter-add message-passing step when profiling shows XLA's
+generated code leaving HBM bandwidth on the table. Import the XLA fallbacks
+from ``deepdfa_tpu.graphs`` unless a kernel is explicitly requested.
+"""

@@ -276,14 +276,18 @@ def fit(
         epoch_sel = train_idx[idx]
         t0 = time.time()
         stats = BinaryStats.zeros()
-        epoch_loss, n_batches = 0.0, 0
+        # Loss accumulates on-device; transferring once per epoch (and per
+        # log line) keeps host dispatch running ahead of device execution.
+        loss_sum = jnp.zeros(())
+        n_batches = 0
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys, data_cfg.batch_size, n_shards):
             state, loss, bstats = train_step(state, batch)
-            epoch_loss += float(loss)
+            loss_sum = loss_sum + loss
             stats = stats + bstats
             n_batches += 1
             if n_batches % log_every == 0:
                 logger.info("epoch %d step %d loss %.4f", epoch, n_batches, float(loss))
+        epoch_loss = float(loss_sum)
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
         val = evaluate(eval_step, state, examples, splits["val"], data_cfg, subkeys, n_shards)

@@ -1,0 +1,361 @@
+"""Fine-tuning loop for the transformer families (LineVul, UniXcoder, and
+the DeepDFA-combined variants).
+
+Reference semantics (LineVul/linevul/linevul_main.py:141-251): AdamW
+(lr 2e-5, eps 1e-8) with linear warmup over ``max_steps/5`` then linear
+decay, grad-clip 1.0, per-epoch eval keeping the best-F1 state; combined
+batches join graphs to text rows by example id, dropping rows whose graph is
+missing (here: masking them, counting ``num_missing`` identically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from deepdfa_tpu.core.config import DataConfig, TransformerTrainConfig, subkeys_for
+from deepdfa_tpu.core.metrics import BinaryStats, binary_stats, compute_metrics
+from deepdfa_tpu.graphs.batch import GraphBatch, batch_graphs, pad_budget_for
+from deepdfa_tpu.models.linevul import LineVul, cross_entropy_loss
+from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+
+logger = logging.getLogger(__name__)
+
+
+@struct.dataclass
+class TextTrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    dropout_rng: jnp.ndarray
+
+
+@dataclasses.dataclass
+class TextBatch:
+    input_ids: np.ndarray
+    labels: np.ndarray
+    example_mask: np.ndarray
+    index: np.ndarray
+    graphs: Optional[GraphBatch]
+
+
+def make_schedule(cfg: TransformerTrainConfig, max_steps: int) -> optax.Schedule:
+    warmup = max(int(max_steps * cfg.warmup_fraction), 1)
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, cfg.learning_rate, warmup),
+            optax.linear_schedule(cfg.learning_rate, 0.0, max(max_steps - warmup, 1)),
+        ],
+        [warmup],
+    )
+
+
+def make_text_optimizer(
+    cfg: TransformerTrainConfig, max_steps: int
+) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(
+            make_schedule(cfg, max_steps),
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+
+
+def text_graph_batches(
+    data: Dict[str, np.ndarray],
+    indices: np.ndarray,
+    batch_size: int,
+    graphs_by_id: Optional[Mapping[int, Mapping]] = None,
+    subkeys=None,
+    graph_budget: Optional[Dict[str, int]] = None,
+    shuffle_rng: Optional[np.random.Generator] = None,
+) -> Iterable[TextBatch]:
+    """Fixed-size text batches, each pre-joined with its graphs.
+
+    Graph slot i belongs to text row i (replacing the reference's per-batch
+    ``get_indices`` dict lookup + ``dgl.batch``, linevul/dataset.py:63-76).
+    Rows with no parsed graph stay in the batch but are masked out
+    (``keep_idx`` semantics). The final short batch is padded with masked
+    rows to keep shapes static.
+    """
+    order = np.array(indices)
+    if shuffle_rng is not None:
+        order = shuffle_rng.permutation(order)
+    for start in range(0, len(order), batch_size):
+        sel = order[start : start + batch_size]
+        pad = batch_size - len(sel)
+        ids = np.concatenate([data["input_ids"][sel],
+                              np.ones((pad,) + data["input_ids"].shape[1:], np.int32)])
+        labels = np.concatenate([data["labels"][sel], np.zeros(pad, np.int32)])
+        index = np.concatenate([data["index"][sel], np.full(pad, -1, np.int64)])
+        mask = np.concatenate([np.ones(len(sel), bool), np.zeros(pad, bool)])
+
+        gbatch = None
+        if graphs_by_id is not None:
+            budget = graph_budget or {}
+            max_nodes = budget.get("max_nodes", batch_size * 64)
+            max_edges = budget.get("max_edges", batch_size * 64 * 4)
+            slot_graphs = []
+            nodes_used = edges_used = 0
+            for row, ex_id in enumerate(index):
+                g = graphs_by_id.get(int(ex_id))
+                if g is None:
+                    mask[row] = False  # keep_idx semantics: no graph, no loss
+                    continue
+                n = int(g["num_nodes"])
+                e = len(g["senders"]) + n  # + self loops
+                if nodes_used + n > max_nodes or edges_used + e > max_edges:
+                    # Shuffling regroups batches each epoch, so a budget that
+                    # held before can overflow now; degrade like a missing
+                    # graph instead of aborting training.
+                    logger.warning(
+                        "graph for example %d dropped: batch over budget "
+                        "(%d+%d/%d nodes)", int(ex_id), nodes_used, n, max_nodes
+                    )
+                    mask[row] = False
+                    continue
+                nodes_used += n
+                edges_used += e
+                slot_graphs.append((row, g))
+            gbatch = _slotted_graph_batch(
+                slot_graphs, batch_size, max_nodes, max_edges, subkeys
+            )
+        yield TextBatch(ids, labels, mask, index, gbatch)
+
+
+def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys):
+    """batch_graphs, but graphs land in given slots (empty slots masked)."""
+    ordered = []
+    slot_of = {}
+    for row, g in slot_graphs:
+        slot_of[len(ordered)] = row
+        ordered.append(g)
+    # n_slots graph slots regardless of how many graphs exist, so batch
+    # shapes stay static across batches with missing graphs.
+    b = batch_graphs(ordered, n_slots, max_nodes, max_edges, subkeys)
+    # Remap graph slot ids to text-row slots.
+    remap = np.zeros(max(len(ordered), 1), np.int32)
+    graph_mask = np.zeros(n_slots, bool)
+    graph_ids = np.full(n_slots, -1, np.int64)
+    for k, row in slot_of.items():
+        remap[k] = row
+        graph_mask[row] = True
+        graph_ids[row] = int(np.asarray(b.graph_ids)[k])
+    node_graph = remap[np.asarray(b.node_graph)]
+    return GraphBatch(
+        node_feats=b.node_feats,
+        node_vuln=b.node_vuln,
+        senders=b.senders,
+        receivers=b.receivers,
+        node_graph=jnp.asarray(node_graph),
+        node_mask=b.node_mask,
+        edge_mask=b.edge_mask,
+        graph_mask=jnp.asarray(graph_mask),
+        graph_ids=jnp.asarray(graph_ids),
+    )
+
+
+def make_text_train_state(
+    model: LineVul,
+    example: TextBatch,
+    cfg: TransformerTrainConfig,
+    max_steps: int,
+    init_params: Optional[Any] = None,
+) -> Tuple[TextTrainState, optax.GradientTransformation]:
+    rng = jax.random.PRNGKey(cfg.seed)
+    params_rng, dropout_rng = jax.random.split(rng)
+    params = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        jnp.asarray(example.input_ids),
+        example.graphs,
+        deterministic=True,
+    )
+    if init_params is not None:
+        params = _merge_params(params, init_params)
+    tx = make_text_optimizer(cfg, max_steps)
+    return TextTrainState(jnp.zeros((), jnp.int32), params, tx.init(params), dropout_rng), tx
+
+
+def _merge_params(params: Any, overrides: Any) -> Any:
+    """Graft pretrained subtrees (e.g. converted HF weights under
+    params['params']['roberta'], or a trained flowgnn encoder) onto a fresh
+    init."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    over = flax.traverse_util.flatten_dict(overrides)
+    for k, v in over.items():
+        if k in flat:
+            assert flat[k].shape == v.shape, (k, flat[k].shape, v.shape)
+        flat[k] = v
+    return flax.traverse_util.unflatten_dict(flat)
+
+
+def make_text_train_step(model: LineVul, tx, cfg: TransformerTrainConfig) -> Callable:
+    def step(state: TextTrainState, input_ids, labels, example_mask, graphs):
+        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply(
+                params, input_ids, graphs, deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return cross_entropy_loss(logits, labels, example_mask), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+        stats = binary_stats(probs, labels.astype(jnp.float32), example_mask)
+        return (
+            TextTrainState(state.step + 1, params, opt_state, state.dropout_rng),
+            loss,
+            stats,
+        )
+
+    return step
+
+
+def make_text_eval_step(model: LineVul) -> Callable:
+    def step(state: TextTrainState, input_ids, labels, example_mask, graphs):
+        logits = model.apply(state.params, input_ids, graphs, deterministic=True)
+        loss = cross_entropy_loss(logits, labels, example_mask)
+        probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+        return loss, probs
+
+    return step
+
+
+def _run_step(step_fn, state, batch: TextBatch):
+    return step_fn(
+        state,
+        jnp.asarray(batch.input_ids),
+        jnp.asarray(batch.labels),
+        jnp.asarray(batch.example_mask),
+        batch.graphs,
+    )
+
+
+def evaluate_text(
+    eval_step, state, data, indices, cfg: TransformerTrainConfig,
+    graphs_by_id=None, subkeys=None, graph_budget=None,
+):
+    stats = BinaryStats.zeros()
+    total_loss, n = 0.0, 0
+    probs_all, labels_all, index_all = [], [], []
+    num_missing = 0
+    for batch in text_graph_batches(
+        data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget
+    ):
+        loss, probs = _run_step(eval_step, state, batch)
+        m = batch.example_mask
+        num_missing += int((batch.index >= 0).sum() - m.sum())
+        stats = stats + binary_stats(
+            jnp.asarray(probs), jnp.asarray(batch.labels, jnp.float32), jnp.asarray(m)
+        )
+        probs_all.append(np.asarray(probs)[m])
+        labels_all.append(batch.labels[m])
+        index_all.append(batch.index[m])
+        total_loss += float(loss)
+        n += 1
+    metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
+    if num_missing:
+        logger.info("eval: %d examples missing graphs (masked)", num_missing)
+    return {
+        "loss": total_loss / max(n, 1),
+        "metrics": metrics,
+        "probs": np.concatenate(probs_all) if probs_all else np.zeros(0),
+        "labels": np.concatenate(labels_all) if labels_all else np.zeros(0),
+        "index": np.concatenate(index_all) if index_all else np.zeros(0, np.int64),
+        "num_missing": num_missing,
+    }
+
+
+def fit_text(
+    model: LineVul,
+    data: Dict[str, np.ndarray],
+    splits: Dict[str, np.ndarray],
+    cfg: TransformerTrainConfig = TransformerTrainConfig(),
+    graphs_by_id: Optional[Mapping[int, Mapping]] = None,
+    subkeys=None,
+    graph_budget: Optional[Dict[str, int]] = None,
+    init_params: Optional[Any] = None,
+    mesh=None,
+) -> Tuple[TextTrainState, Dict[str, Any]]:
+    """Fine-tune, keeping the best state by val F1 (linevul_main.py:217-242)."""
+    steps_per_epoch = max(len(splits["train"]) // cfg.batch_size, 1)
+    max_steps = steps_per_epoch * cfg.max_epochs
+
+    example = next(
+        text_graph_batches(
+            data, splits["train"][: cfg.batch_size], cfg.batch_size,
+            graphs_by_id, subkeys, graph_budget,
+        )
+    )
+    state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
+    train_step = make_text_train_step(model, tx, cfg)
+    eval_step = make_text_eval_step(model)
+    if mesh is not None:
+        rep = replicated(mesh)
+        bsh = batch_sharding(mesh)
+        shard_args = (rep, bsh, bsh, bsh, bsh)
+        train_step = jax.jit(train_step, in_shardings=shard_args,
+                             out_shardings=(rep, rep, rep))
+        eval_step = jax.jit(eval_step, in_shardings=shard_args,
+                            out_shardings=(rep, rep))
+    else:
+        train_step = jax.jit(train_step)
+        eval_step = jax.jit(eval_step)
+
+    history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_f1": -1.0}
+    best_state = state
+    rng = np.random.default_rng(cfg.seed)
+    for epoch in range(cfg.max_epochs):
+        t0 = time.time()
+        stats = BinaryStats.zeros()
+        # Loss accumulates on-device; one transfer per epoch keeps dispatch
+        # running ahead of execution.
+        loss_sum = jnp.zeros(())
+        n_batches, num_missing = 0, 0
+        for batch in text_graph_batches(
+            data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
+            graph_budget, shuffle_rng=rng,
+        ):
+            num_missing += int((batch.index >= 0).sum() - batch.example_mask.sum())
+            state, loss, bstats = _run_step(train_step, state, batch)
+            loss_sum = loss_sum + loss
+            stats = stats + bstats
+            n_batches += 1
+        epoch_loss = float(loss_sum)
+        val = evaluate_text(
+            eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys, graph_budget
+        )
+        record = {
+            "epoch": epoch,
+            "train_loss": epoch_loss / max(n_batches, 1),
+            "train_metrics": {k: float(v) for k, v in compute_metrics(stats).items()},
+            "val_loss": val["loss"],
+            "val_metrics": val["metrics"],
+            "num_missing": num_missing,
+            "seconds": time.time() - t0,
+        }
+        history["epochs"].append(record)
+        logger.info(
+            "epoch %d train_loss %.4f val_f1 %.4f (%.1fs)",
+            epoch, record["train_loss"], val["metrics"]["f1"], record["seconds"],
+        )
+        if val["metrics"]["f1"] > history["best_val_f1"]:
+            history["best_val_f1"] = val["metrics"]["f1"]
+            history["best_epoch"] = epoch
+            best_state = state
+    return best_state, history

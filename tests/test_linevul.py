@@ -1,0 +1,157 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig, TransformerTrainConfig, subkeys_for
+from deepdfa_tpu.data import make_splits, synthetic_bigvul
+from deepdfa_tpu.data.text import (
+    HashingCodeTokenizer,
+    attach_synthetic_text,
+    encode_dataset,
+    encode_function,
+)
+from deepdfa_tpu.models.linevul import LineVul
+from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder, convert_hf_roberta
+
+TINY = EncoderConfig.tiny(vocab_size=512)
+BLOCK = 64
+
+
+def test_encode_function_layout():
+    tok = HashingCodeTokenizer(vocab_size=512)
+    ids = encode_function("int main() { return 0; }", tok, block_size=32)
+    assert ids.shape == (32,)
+    assert ids[0] == tok.cls_token_id
+    n_real = int((ids != tok.pad_token_id).sum())
+    assert ids[n_real - 1] == tok.sep_token_id
+    assert np.all(ids[n_real:] == tok.pad_token_id)
+    # deterministic
+    np.testing.assert_array_equal(ids, encode_function("int main() { return 0; }", tok, 32))
+
+
+def test_encoder_matches_hf_torch_reference():
+    """Our Flax encoder must reproduce HF PyTorch RobertaModel numerics."""
+    torch = pytest.importorskip("torch")
+    from transformers import RobertaConfig, RobertaModel
+
+    hf_cfg = RobertaConfig(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        type_vocab_size=1,
+        pad_token_id=1,
+        layer_norm_eps=TINY.layer_norm_eps,
+        attention_probs_dropout_prob=0.0,
+        hidden_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    hf = RobertaModel(hf_cfg, add_pooling_layer=False).eval()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(4, TINY.vocab_size, size=(2, 16)).astype(np.int64)
+    ids[:, 0] = 0
+    ids[0, 10:] = 1  # padding on row 0
+    with torch.no_grad():
+        want = hf(
+            torch.tensor(ids), attention_mask=torch.tensor(ids != 1)
+        ).last_hidden_state.numpy()
+
+    params = convert_hf_roberta(hf.state_dict(), TINY)
+    enc = RobertaEncoder(TINY)
+    got, _ = enc.apply(params, jnp.asarray(ids), deterministic=True)
+    got = np.asarray(got)
+    # compare only non-pad positions (HF computes pad rows too but they are
+    # meaningless downstream)
+    mask = ids != 1
+    np.testing.assert_allclose(got[mask], want[mask], rtol=2e-3, atol=2e-3)
+
+
+def _text_data(n=240, with_graphs=False, seed=0):
+    feature = FeatureSpec(limit_all=30)
+    ex = synthetic_bigvul(n, feature, positive_fraction=0.5, seed=seed)
+    attach_synthetic_text(ex, seed=seed)
+    tok = HashingCodeTokenizer(vocab_size=TINY.vocab_size)
+    data = encode_dataset(ex, tok, block_size=BLOCK)
+    graphs = {int(e["id"]): e for e in ex} if with_graphs else None
+    return ex, data, graphs, feature
+
+
+def test_linevul_forward_and_combined():
+    from deepdfa_tpu.train.text_loop import text_graph_batches
+
+    ex, data, graphs, feature = _text_data(20, with_graphs=True)
+    gcfg = FlowGNNConfig(feature=feature, hidden_dim=4, n_steps=2, encoder_mode=True)
+    model = LineVul(TINY, gcfg)
+    batch = next(
+        text_graph_batches(
+            data, np.arange(8), 8, graphs, subkeys_for(feature),
+            {"max_nodes": 512, "max_edges": 2048},
+        )
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(batch.input_ids), batch.graphs, deterministic=True,
+    )
+    logits = model.apply(params, jnp.asarray(batch.input_ids), batch.graphs)
+    assert logits.shape == (8, 2)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_missing_graphs_are_masked():
+    from deepdfa_tpu.train.text_loop import text_graph_batches
+
+    ex, data, graphs, feature = _text_data(8, with_graphs=True)
+    # drop half the graphs
+    for e in ex[::2]:
+        del graphs[int(e["id"])]
+    batch = next(
+        text_graph_batches(
+            data, np.arange(8), 8, graphs, subkeys_for(feature),
+            {"max_nodes": 512, "max_edges": 2048},
+        )
+    )
+    assert batch.example_mask.sum() == 4
+    # masked rows are exactly the ones without graphs
+    for row, idx in enumerate(batch.index):
+        assert batch.example_mask[row] == (int(idx) in graphs)
+
+
+def test_fit_text_learns():
+    from deepdfa_tpu.train.text_loop import evaluate_text, fit_text, make_text_eval_step
+
+    ex, data, _, _ = _text_data(240)
+    splits = make_splits(ex, "random", seed=0)
+    model = LineVul(TINY, None)
+    cfg = TransformerTrainConfig(
+        max_epochs=30, batch_size=16, learning_rate=1e-3, block_size=BLOCK, seed=0
+    )
+    best, history = fit_text(model, data, splits, cfg)
+    eval_step = jax.jit(make_text_eval_step(model))
+    test = evaluate_text(eval_step, best, data, splits["test"], cfg)
+    # vuln/safe call names differ in text -> should be nearly separable
+    assert test["metrics"]["f1"] > 0.85, (test["metrics"], history["epochs"][-1])
+
+
+def test_fit_combined_learns():
+    from deepdfa_tpu.train.text_loop import evaluate_text, fit_text, make_text_eval_step
+
+    ex, data, graphs, feature = _text_data(160, with_graphs=True)
+    splits = make_splits(ex, "random", seed=0)
+    gcfg = FlowGNNConfig(feature=feature, hidden_dim=4, n_steps=2, encoder_mode=True)
+    model = LineVul(TINY, gcfg)
+    cfg = TransformerTrainConfig(
+        max_epochs=12, batch_size=8, learning_rate=1e-3, block_size=BLOCK, seed=0
+    )
+    budget = {"max_nodes": 512, "max_edges": 2048}
+    sk = subkeys_for(feature)
+    best, history = fit_text(
+        model, data, splits, cfg, graphs_by_id=graphs, subkeys=sk, graph_budget=budget
+    )
+    eval_step = jax.jit(make_text_eval_step(model))
+    test = evaluate_text(eval_step, best, data, splits["test"], cfg, graphs, sk, budget)
+    assert test["metrics"]["f1"] > 0.7, (test["metrics"], history["epochs"][-1])
+    assert test["num_missing"] == 0
