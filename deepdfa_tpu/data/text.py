@@ -37,6 +37,7 @@ class HashingCodeTokenizer:
     cls_token_id = CLS_ID
     sep_token_id = SEP_ID
     pad_token_id = PAD_ID
+    _n_special = _N_SPECIAL  # ids below this are reserved for special tokens
 
     def __init__(self, vocab_size: int = 50265):
         self.vocab_size = vocab_size
@@ -48,7 +49,7 @@ class HashingCodeTokenizer:
         out = []
         for t in tokens:
             h = int.from_bytes(hashlib.blake2s(t.encode(), digest_size=4).digest(), "little")
-            out.append(_N_SPECIAL + h % (self.vocab_size - _N_SPECIAL))
+            out.append(self._n_special + h % (self.vocab_size - self._n_special))
         return out
 
 
@@ -65,11 +66,44 @@ def encode_function(code: str, tokenizer, block_size: int = 512) -> np.ndarray:
     return np.asarray(ids, np.int32)
 
 
+def encode_function_t5(code: str, tokenizer, block_size: int = 512) -> np.ndarray:
+    """CodeT5 convention (CodeT5/_utils.py:33 ``tokenizer.encode(...,
+    truncation=True)`` with the codet5 BPE tokenizer): <s> + tokens[:block-2]
+    + </s>, pad with 0 — exactly one eos per row, which the eos-pooled
+    classifier requires (CodeT5/_utils.py:34 asserts
+    ``source_ids.count(eos) == 1``)."""
+    tokens = tokenizer.tokenize(str(code))[: block_size - 2]
+    ids = (
+        [tokenizer.bos_token_id]
+        + tokenizer.convert_tokens_to_ids(tokens)
+        + [tokenizer.eos_token_id]
+    )
+    ids = ids + [tokenizer.pad_token_id] * (block_size - len(ids))
+    return np.asarray(ids, np.int32)
+
+
+class HashingT5Tokenizer(HashingCodeTokenizer):
+    """Hashing tokenizer with the codet5 special-token ids
+    (<pad>=0, <s>=1, </s>=2)."""
+
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+    _n_special = 3
+
+
 def encode_dataset(
-    examples: Sequence[Mapping], tokenizer, block_size: int = 512, code_key: str = "code"
+    examples: Sequence[Mapping],
+    tokenizer,
+    block_size: int = 512,
+    code_key: str = "code",
+    style: str = "roberta",
 ) -> Dict[str, np.ndarray]:
     """Batch-encode to {input_ids [N, block], labels [N], index [N]}."""
-    ids = np.stack([encode_function(ex[code_key], tokenizer, block_size) for ex in examples])
+    if style not in ("roberta", "t5"):
+        raise ValueError(f"unknown encoding style: {style!r} (want 'roberta' or 't5')")
+    enc = encode_function if style == "roberta" else encode_function_t5
+    ids = np.stack([enc(ex[code_key], tokenizer, block_size) for ex in examples])
     labels = np.asarray([int(ex["label"]) for ex in examples], np.int32)
     index = np.asarray([int(ex["id"]) for ex in examples], np.int64)
     return {"input_ids": ids, "labels": labels, "index": index}

@@ -79,6 +79,7 @@ def text_graph_batches(
     subkeys=None,
     graph_budget: Optional[Dict[str, int]] = None,
     shuffle_rng: Optional[np.random.Generator] = None,
+    pad_id: int = 1,
 ) -> Iterable[TextBatch]:
     """Fixed-size text batches, each pre-joined with its graphs.
 
@@ -95,7 +96,7 @@ def text_graph_batches(
         sel = order[start : start + batch_size]
         pad = batch_size - len(sel)
         ids = np.concatenate([data["input_ids"][sel],
-                              np.ones((pad,) + data["input_ids"].shape[1:], np.int32)])
+                              np.full((pad,) + data["input_ids"].shape[1:], pad_id, np.int32)])
         labels = np.concatenate([data["labels"][sel], np.zeros(pad, np.int32)])
         index = np.concatenate([data["index"][sel], np.full(pad, -1, np.int64)])
         mask = np.concatenate([np.ones(len(sel), bool), np.zeros(pad, bool)])
@@ -194,9 +195,18 @@ def _merge_params(params: Any, overrides: Any) -> Any:
 
     flat = flax.traverse_util.flatten_dict(params)
     over = flax.traverse_util.flatten_dict(overrides)
+    unknown = [k for k in over if k not in flat]
+    if unknown:
+        # An override that matches nothing would silently leave the model at
+        # its random init (e.g. converter output not nested under the
+        # submodule name the model uses).
+        raise KeyError(
+            f"{len(unknown)} override params not present in the model tree, "
+            f"e.g. {'/'.join(unknown[0])!r}; nest the pretrained tree under "
+            "the submodule name (e.g. params['params']['roberta'])"
+        )
     for k, v in over.items():
-        if k in flat:
-            assert flat[k].shape == v.shape, (k, flat[k].shape, v.shape)
+        assert flat[k].shape == v.shape, (k, flat[k].shape, v.shape)
         flat[k] = v
     return flax.traverse_util.unflatten_dict(flat)
 
@@ -248,14 +258,15 @@ def _run_step(step_fn, state, batch: TextBatch):
 
 def evaluate_text(
     eval_step, state, data, indices, cfg: TransformerTrainConfig,
-    graphs_by_id=None, subkeys=None, graph_budget=None,
+    graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
 ):
     stats = BinaryStats.zeros()
     total_loss, n = 0.0, 0
     probs_all, labels_all, index_all = [], [], []
     num_missing = 0
     for batch in text_graph_batches(
-        data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget
+        data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget,
+        pad_id=pad_id,
     ):
         loss, probs = _run_step(eval_step, state, batch)
         m = batch.example_mask
@@ -291,15 +302,18 @@ def fit_text(
     graph_budget: Optional[Dict[str, int]] = None,
     init_params: Optional[Any] = None,
     mesh=None,
+    pad_id: int = 1,
 ) -> Tuple[TextTrainState, Dict[str, Any]]:
     """Fine-tune, keeping the best state by val F1 (linevul_main.py:217-242)."""
-    steps_per_epoch = max(len(splits["train"]) // cfg.batch_size, 1)
+    # ceil: the padded partial batch is a real optimizer step, and the LR
+    # schedule must cover it (the reference sizes by len(train_dataloader)).
+    steps_per_epoch = max(-(-len(splits["train"]) // cfg.batch_size), 1)
     max_steps = steps_per_epoch * cfg.max_epochs
 
     example = next(
         text_graph_batches(
             data, splits["train"][: cfg.batch_size], cfg.batch_size,
-            graphs_by_id, subkeys, graph_budget,
+            graphs_by_id, subkeys, graph_budget, pad_id=pad_id,
         )
     )
     state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
@@ -329,7 +343,7 @@ def fit_text(
         n_batches, num_missing = 0, 0
         for batch in text_graph_batches(
             data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
-            graph_budget, shuffle_rng=rng,
+            graph_budget, shuffle_rng=rng, pad_id=pad_id,
         ):
             num_missing += int((batch.index >= 0).sum() - batch.example_mask.sum())
             state, loss, bstats = _run_step(train_step, state, batch)
@@ -338,7 +352,8 @@ def fit_text(
             n_batches += 1
         epoch_loss = float(loss_sum)
         val = evaluate_text(
-            eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys, graph_budget
+            eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
+            graph_budget, pad_id=pad_id,
         )
         record = {
             "epoch": epoch,
@@ -358,4 +373,13 @@ def fit_text(
             history["best_val_f1"] = val["metrics"]["f1"]
             history["best_epoch"] = epoch
             best_state = state
+        elif (
+            cfg.early_stop_patience is not None
+            and epoch - history["best_epoch"] >= cfg.early_stop_patience
+        ):
+            # CodeT5 stops after `patience` epochs without an eval-F1
+            # improvement (run_defect.py:383-405).
+            logger.info("early stop at epoch %d (best %d)", epoch, history["best_epoch"])
+            history["early_stopped"] = True
+            break
     return best_state, history

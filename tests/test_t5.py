@@ -1,0 +1,142 @@
+"""T5 stack: shapes, eos pooling, and golden parity vs HuggingFace torch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.models.t5 import (
+    CloneModel,
+    DefectModel,
+    T5Config,
+    T5Model,
+    convert_hf_t5,
+    last_eos_vector,
+    shift_right,
+)
+
+CFG = T5Config.tiny()
+
+
+def _ids(rng, batch=2, length=16):
+    ids = rng.integers(3, CFG.vocab_size, size=(batch, length)).astype(np.int32)
+    ids[:, 10] = CFG.eos_token_id
+    ids[:, 11:] = CFG.pad_token_id
+    return jnp.asarray(ids)
+
+
+def test_t5_forward_shapes():
+    rng = np.random.default_rng(0)
+    ids = _ids(rng)
+    model = T5Model(CFG)
+    dec = shift_right(ids, CFG.decoder_start_token_id)
+    params = model.init(jax.random.PRNGKey(0), ids, dec)
+    hidden = model.apply(params, ids, dec)
+    assert hidden.shape == (2, 16, CFG.d_model)
+    logits = model.apply(params, hidden, method=T5Model.logits)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+
+
+def test_last_eos_vector_picks_final_eos():
+    hidden = jnp.arange(2 * 5 * 3, dtype=jnp.float32).reshape(2, 5, 3)
+    ids = jnp.asarray([[7, 2, 8, 2, 0], [2, 9, 9, 9, 0]])
+    vec = last_eos_vector(hidden, ids, eos_token_id=2)
+    np.testing.assert_array_equal(vec[0], np.asarray(hidden)[0, 3])
+    np.testing.assert_array_equal(vec[1], np.asarray(hidden)[1, 0])
+
+
+def test_defect_model_shapes_and_grads():
+    rng = np.random.default_rng(1)
+    ids = _ids(rng)
+    model = DefectModel(CFG)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    logits = model.apply(params, ids)
+    assert logits.shape == (2, 2)
+
+    def loss(p):
+        return model.apply(p, ids).sum()
+
+    grads = jax.grad(loss)(params)
+    leaf_norms = [float(jnp.abs(g).sum()) for g in jax.tree_util.tree_leaves(grads)]
+    assert all(np.isfinite(leaf_norms))
+
+
+def test_defect_model_combined_with_flowgnn():
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, subkeys_for
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.graphs.batch import batch_graphs
+
+    gcfg = FlowGNNConfig(hidden_dim=4, n_steps=2, encoder_mode=True)
+    graphs = synthetic_bigvul(2, gcfg.feature, positive_fraction=0.5, seed=0)
+    batch = batch_graphs(graphs, 2, 64, 256, subkeys_for(gcfg.feature))
+
+    rng = np.random.default_rng(2)
+    ids = _ids(rng)
+    model = DefectModel(CFG, graph_config=gcfg)
+    params = model.init(jax.random.PRNGKey(0), ids, batch)
+    logits = model.apply(params, ids, batch)
+    assert logits.shape == (2, 2)
+
+
+def test_clone_model_shapes():
+    rng = np.random.default_rng(3)
+    ids = _ids(rng)
+    model = CloneModel(CFG)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    assert model.apply(params, ids).shape == (2, 2)
+
+
+@pytest.mark.parametrize("gated", [False])
+def test_hf_t5_parity(gated):
+    """Golden test: random HF torch T5 -> convert_hf_t5 -> identical decoder
+    hidden states (the quantity DefectModel pools, models.py:141-148)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=CFG.vocab_size,
+        d_model=CFG.d_model,
+        d_kv=CFG.d_kv,
+        d_ff=CFG.d_ff,
+        num_layers=CFG.num_layers,
+        num_decoder_layers=CFG.num_decoder_layers,
+        num_heads=CFG.num_heads,
+        relative_attention_num_buckets=CFG.relative_attention_num_buckets,
+        relative_attention_max_distance=CFG.relative_attention_max_distance,
+        dropout_rate=0.0,
+        layer_norm_epsilon=CFG.layer_norm_epsilon,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        pad_token_id=CFG.pad_token_id,
+        eos_token_id=CFG.eos_token_id,
+        decoder_start_token_id=CFG.decoder_start_token_id,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+
+    rng = np.random.default_rng(4)
+    ids_np = np.asarray(_ids(rng))
+    attn = (ids_np != CFG.pad_token_id).astype(np.int64)
+    with torch.no_grad():
+        out = hf(
+            input_ids=torch.tensor(ids_np, dtype=torch.long),
+            attention_mask=torch.tensor(attn),
+            labels=torch.tensor(ids_np, dtype=torch.long),
+            decoder_attention_mask=torch.tensor(attn),
+            output_hidden_states=True,
+        )
+    want = out.decoder_hidden_states[-1].numpy()
+
+    cfg = T5Config(
+        vocab_size=CFG.vocab_size, d_model=CFG.d_model, d_kv=CFG.d_kv,
+        d_ff=CFG.d_ff, num_layers=CFG.num_layers,
+        num_decoder_layers=CFG.num_decoder_layers, num_heads=CFG.num_heads,
+        dropout_rate=0.0, gated_ffn=gated,
+    )
+    model = T5Model(cfg)
+    params = convert_hf_t5(hf.state_dict(), cfg)
+    ids = jnp.asarray(ids_np)
+    mask = jnp.asarray(attn, bool)
+    dec_in = shift_right(ids, cfg.decoder_start_token_id)
+    got = model.apply(params, ids, dec_in, attn_mask=mask, decoder_mask=mask)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-3)
