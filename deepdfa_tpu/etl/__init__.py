@@ -1,0 +1,27 @@
+"""CPU-side ETL: Joern CPG parsing, dataflow analysis, feature extraction.
+
+This subsystem mirrors the reference's preprocessing pipeline
+(DDFA/sastvd/ + DDFA/code_gnn/analysis/) but with typed containers instead
+of ad-hoc pandas frames, and no accelerator involvement — everything here
+runs on host CPUs and feeds the padded-batch graph substrate in
+``deepdfa_tpu.graphs``.
+"""
+
+from deepdfa_tpu.etl.cpg import CPG, CPGNode, from_joern_json, reduce_graph
+from deepdfa_tpu.etl.reaching import ReachingDefinitions
+from deepdfa_tpu.etl.absdf import (
+    AbstractDataflowVocab,
+    extract_decl_features,
+    node_feature_indices,
+)
+
+__all__ = [
+    "CPG",
+    "CPGNode",
+    "from_joern_json",
+    "reduce_graph",
+    "ReachingDefinitions",
+    "AbstractDataflowVocab",
+    "extract_decl_features",
+    "node_feature_indices",
+]

@@ -1,0 +1,262 @@
+"""Abstract dataflow embedding: definition-node feature mining + vocab.
+
+The DeepDFA node feature. For every *definition* node (a Joern CALL whose
+operator is an assignment/inc/dec, reference
+DDFA/sastvd/scripts/abstract_dataflow_full.py:44-51 ``is_decl``), mine four
+subkey feature sets by AST/ARGUMENT traversal (``get_dataflow_features``,
+abstract_dataflow_full.py:54-201):
+
+- ``datatype``: the declared/assigned variable's type, resolved by
+  recursing through known operator argument positions;
+- ``literal``: codes of LITERAL descendants;
+- ``operator``: ``<operator>.X`` call names among descendants (minus
+  ``indirection``);
+- ``api``: non-operator CALL names among descendants.
+
+Each node's features hash to a canonical JSON string (``to_hash``,
+abstract_dataflow_full.py:285-295). The vocabulary is built from the TRAIN
+split only (``abs_dataflow``, datasets.py:587-692): per-subkey values are
+frequency-capped at ``limit_subkeys`` (rarer values become UNKNOWN), then
+whole-node hashes are frequency-capped at ``limit_all``. Final node index
+(dbize_absdf.py:35-43): 0 = not a definition, 1 = UNKNOWN hash, else
+frequency rank + 1 — hence ``input_dim == limit_all + 2``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from deepdfa_tpu.core.config import ALL_SUBKEYS, FeatureSpec
+from deepdfa_tpu.etl.cpg import CPG
+
+logger = logging.getLogger(__name__)
+
+# all_assignment_types (abstract_dataflow_full.py:24-42): assignments plus
+# inc/dec — "local variable declarations are not considered definitions".
+DECL_OPS = frozenset(
+    "<operator>." + op
+    for op in (
+        "assignment", "assignmentPlus", "assignmentMinus", "assignmentDivision",
+        "assignmentExponentiation", "assignmentModulo", "assignmentMultiplication",
+        "assignmentOr", "assignmentAnd", "assignmentXor",
+        "assignmentArithmeticShiftRight", "assignmentLogicalShiftRight",
+        "assignmentShiftLeft",
+        "preIncrement", "preDecrement", "postIncrement", "postDecrement",
+    )
+)
+
+# Which ARGUMENT position holds the variable when recursing through an
+# operator for datatype resolution (abstract_dataflow_full.py:72-84).
+_NAME_IDX = {
+    "<operator>.indirectIndexAccess": 1,
+    "<operator>.indirectFieldAccess": 1,
+    "<operator>.indirection": 1,
+    "<operator>.fieldAccess": 1,
+    "<operator>.postIncrement": 1,
+    "<operator>.postDecrement": 1,
+    "<operator>.preIncrement": 1,
+    "<operator>.preDecrement": 1,
+    "<operator>.addressOf": 1,
+    "<operator>.cast": 2,
+    "<operator>.addition": 1,
+}
+
+# Subkeys whose per-node feature is a single value rather than a set
+# (datasets.py:551-556 ``single``).
+SINGLE_SUBKEYS = frozenset({"datatype"})
+
+UNKNOWN = "UNKNOWN"
+
+
+def is_decl(node) -> bool:
+    return node.label == "CALL" and node.name in DECL_OPS
+
+
+def clean_datatype(dt: str) -> str:
+    """Normalize a datatype string (abstract_dataflow_full.py:240-251):
+    strip leading ``const``, collapse array extents to ``[]``, squeeze
+    whitespace."""
+    return re.sub(r"\s+", " ", re.sub(r"^const ", "", re.sub(r"\s*\[.*\]", "[]", dt))).strip()
+
+
+def _args_by_order(cpg: CPG, arg_adj, nid: int) -> Dict[int, int]:
+    return {cpg.nodes[s].order: s for s in arg_adj.get(nid, [])}
+
+
+def _recurse_datatype(cpg: CPG, arg_adj, v: int) -> Tuple[int, str]:
+    attr = cpg.nodes[v]
+    if attr.label == "IDENTIFIER":
+        return v, attr.type_full_name
+    if attr.label == "CALL" and attr.name in _NAME_IDX:
+        args = _args_by_order(cpg, arg_adj, v)
+        arg = args[_NAME_IDX[attr.name]]
+        arg_attr = cpg.nodes[arg]
+        if arg_attr.label == "IDENTIFIER":
+            return arg, arg_attr.type_full_name
+        if arg_attr.label == "CALL":
+            return _recurse_datatype(cpg, arg_adj, arg)
+        raise NotImplementedError(f"datatype recursion hit {arg_attr.label} at {arg}")
+    raise NotImplementedError(f"datatype recursion hit {attr.label}/{attr.name} at {v}")
+
+
+def _raw_datatype(cpg: CPG, arg_adj, decl: int) -> Tuple[int, str]:
+    attr = cpg.nodes[decl]
+    if attr.label == "LOCAL":
+        return decl, attr.type_full_name
+    if attr.label == "CALL" and attr.name in (DECL_OPS | {"<operator>.cast"}):
+        args = _args_by_order(cpg, arg_adj, decl)
+        return _recurse_datatype(cpg, arg_adj, args[1])
+    raise NotImplementedError(f"datatype of {attr.label}/{attr.name} at {decl}")
+
+
+def extract_decl_features(
+    cpg: CPG, raise_errors: bool = False
+) -> Dict[int, List[Tuple[str, str]]]:
+    """Per definition node: [(subkey, text), ...].
+
+    Per-node failures are caught and logged, matching the reference's
+    per-item fault tolerance (abstract_dataflow_full.py:160-166).
+    """
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    # Adjacency built once per CPG, not per definition node.
+    arg_adj = cpg.out_adjacency(("ARGUMENT",))
+    ast_adj = cpg.out_adjacency(("AST",))
+    for nid, node in cpg.nodes.items():
+        if not is_decl(node):
+            continue
+        fields: List[Tuple[str, str]] = []
+        try:
+            _, datatype = _raw_datatype(cpg, arg_adj, nid)
+            fields.append(("datatype", clean_datatype(datatype)))
+            # Descend the AST minus METHOD subtrees
+            # (abstract_dataflow_full.py:137-146).
+            for n in cpg.ast_descendants(nid, exclude_labels=("METHOD",), adj=ast_adj):
+                attr = cpg.nodes[n]
+                if attr.label == "LITERAL":
+                    fields.append(("literal", attr.code))
+                elif attr.label == "CALL":
+                    m = re.match(r"<operator>\.(.*)", attr.name)
+                    if m:
+                        if m.group(1) != "indirection":
+                            fields.append(("operator", m.group(1)))
+                    else:
+                        fields.append(("api", attr.name))
+        except Exception:
+            if raise_errors:
+                raise
+            logger.warning("decl feature extraction failed for node %d", nid, exc_info=True)
+        out[nid] = fields
+    return out
+
+
+def node_subkey_values(
+    fields: Sequence[Tuple[str, str]], subkey: str
+) -> List[str]:
+    """The node's raw value list for one subkey, sorted (``to_hash``
+    semantics: sorted with duplicates kept)."""
+    return sorted(text for key, text in fields if key == subkey)
+
+
+@dataclasses.dataclass
+class AbstractDataflowVocab:
+    """Train-split frequency vocabulary for ONE subkey's feature
+    (the concat_all model uses four of these, one per subkey)."""
+
+    subkey: str
+    limit_all: int
+    limit_subkeys: int
+    subkey_index: Dict[Optional[str], int]
+    all_index: Dict[Optional[str], int]
+
+    @classmethod
+    def build(
+        cls,
+        features_by_graph: Mapping[int, Mapping[int, Sequence[Tuple[str, str]]]],
+        train_graph_ids: Iterable[int],
+        spec: FeatureSpec,
+        subkey: Optional[str] = None,
+    ) -> "AbstractDataflowVocab":
+        subkey = subkey or spec.subkey
+        train = [gid for gid in train_graph_ids if gid in features_by_graph]
+
+        # Stage 1: per-subkey value vocabulary, frequency-capped.
+        counts: Counter = Counter()
+        for gid in train:
+            for fields in features_by_graph[gid].values():
+                values = node_subkey_values(fields, subkey)
+                if subkey in SINGLE_SUBKEYS:
+                    if values:
+                        counts[values[0]] += 1
+                else:
+                    counts.update(sorted(set(values)))
+        kept = [h for h, _ in counts.most_common(spec.limit_subkeys)]
+        subkey_index: Dict[Optional[str], int] = {None: 0}
+        for h in kept:
+            subkey_index[h] = len(subkey_index)
+
+        # Stage 2: whole-node hash vocabulary over UNKNOWN-substituted values.
+        all_counts: Counter = Counter()
+        for gid in train:
+            for fields in features_by_graph[gid].values():
+                if not fields:  # dropped by the reference's explode+dropna
+                    continue
+                all_counts[cls._all_hash(fields, subkey, subkey_index)] += 1
+        kept_all = [h for h, _ in all_counts.most_common(spec.limit_all)]
+        all_index: Dict[Optional[str], int] = {None: 0}
+        for h in kept_all:
+            all_index[h] = len(all_index)
+        return cls(subkey, spec.limit_all, spec.limit_subkeys, subkey_index, all_index)
+
+    @staticmethod
+    def _all_hash(
+        fields: Sequence[Tuple[str, str]],
+        subkey: str,
+        subkey_index: Mapping[Optional[str], int],
+    ) -> str:
+        values = node_subkey_values(fields, subkey)
+        if subkey in SINGLE_SUBKEYS:
+            values = values[:1] if values else []
+        subst = [v if v in subkey_index else UNKNOWN for v in values]
+        return json.dumps({subkey: sorted(set(subst))})
+
+    def index_for(self, fields: Optional[Sequence[Tuple[str, str]]]) -> int:
+        """0 = not a definition; 1 = UNKNOWN hash; else rank+1
+        (dbize_absdf.py:35-43). A definition whose extraction yielded no
+        fields at all is indistinguishable from a non-definition (the
+        reference's explode+dropna drops such nodes from the hash table)."""
+        if not fields:
+            return 0
+        h = self._all_hash(fields, self.subkey, self.subkey_index)
+        return self.all_index.get(h, self.all_index[None]) + 1
+
+
+def node_feature_indices(
+    cpg: CPG,
+    features: Mapping[int, Sequence[Tuple[str, str]]],
+    vocabs: Mapping[str, AbstractDataflowVocab],
+) -> Dict[str, List[int]]:
+    """Per-subkey index per node, ordered by sorted node id — the
+    ``_ABS_DATAFLOW_*`` columns the model embeds (graphmogrifier.py:74-88)."""
+    node_ids = sorted(cpg.nodes)
+    return {
+        subkey: [vocabs[subkey].index_for(features.get(n)) for n in node_ids]
+        for subkey in vocabs
+    }
+
+
+def build_all_vocabs(
+    features_by_graph: Mapping[int, Mapping[int, Sequence[Tuple[str, str]]]],
+    train_graph_ids: Iterable[int],
+    spec: FeatureSpec,
+) -> Dict[str, AbstractDataflowVocab]:
+    """One vocab per subkey (concat_all model: 4 embedding tables)."""
+    subkeys = ALL_SUBKEYS if spec.concat_all else (spec.subkey,)
+    return {
+        sk: AbstractDataflowVocab.build(features_by_graph, train_graph_ids, spec, sk)
+        for sk in subkeys
+    }

@@ -1,0 +1,196 @@
+"""Code property graph container and Joern-output parser.
+
+The reference parses Joern's ``<id>.c.nodes.json`` / ``<id>.c.edges.json``
+into pandas frames with a chain of in-place filters
+(DDFA/sastvd/helpers/joern.py:182-319 ``get_node_edges``). Here the same
+observable semantics land on a typed container:
+
+- drop COMMENT and FILE nodes (joern.py:251-253);
+- drop CONTAINS / SOURCE_FILE / DOMINATE / POST_DOMINATE edges
+  (joern.py:255-259);
+- keep only edges where at least one endpoint has a line number
+  (joern.py:261-272);
+- drop nodes with no remaining edges (joern.py:485-493 ``drop_lone_nodes``);
+- de-duplicate (src, dst, etype) triples (joern.py:306).
+
+Graph-type reduction (:func:`reduce_graph`) mirrors ``rdg``
+(joern.py:419-441): e.g. "cfg" keeps CFG edges, "pdg" keeps
+REACHING_DEF+CDG, "all" the DeepDFA training set union.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+DROPPED_NODE_LABELS = frozenset({"COMMENT", "FILE"})
+DROPPED_EDGE_TYPES = frozenset(
+    {"CONTAINS", "SOURCE_FILE", "DOMINATE", "POST_DOMINATE"}
+)
+
+# rdg() gtype -> kept edge types (joern.py:419-441).
+GRAPH_REDUCTIONS: Dict[str, frozenset] = {
+    "reftype": frozenset({"EVAL_TYPE", "REF"}),
+    "ast": frozenset({"AST"}),
+    "pdg": frozenset({"REACHING_DEF", "CDG"}),
+    "cfgcdg": frozenset({"CFG", "CDG"}),
+    "cfg": frozenset({"CFG"}),
+    "all": frozenset({"REACHING_DEF", "CDG", "AST", "EVAL_TYPE", "REF"}),
+    "dataflow": frozenset({"CFG", "AST"}),
+}
+
+
+@dataclasses.dataclass
+class CPGNode:
+    id: int
+    label: str = ""  # Joern _label: METHOD, CALL, IDENTIFIER, LOCAL, ...
+    name: str = ""
+    code: str = ""
+    line_number: int = -1
+    order: int = 0
+    type_full_name: str = ""
+    control_structure_type: str = ""
+
+
+@dataclasses.dataclass
+class CPG:
+    """Nodes + typed directed edges (src, dst, etype), with adjacency
+    helpers. Node ids are Joern ids (not dense)."""
+
+    nodes: Dict[int, CPGNode]
+    edges: List[Tuple[int, int, str]]
+
+    def successors(self, node: int, etype: Optional[str] = None) -> List[int]:
+        return [d for s, d, t in self.edges if s == node and (etype is None or t == etype)]
+
+    def out_adjacency(self, etypes: Iterable[str]) -> Dict[int, List[int]]:
+        keep = frozenset(etypes)
+        adj: Dict[int, List[int]] = {n: [] for n in self.nodes}
+        for s, d, t in self.edges:
+            if t in keep and s in adj and d in self.nodes:
+                adj[s].append(d)
+        return adj
+
+    def in_adjacency(self, etypes: Iterable[str]) -> Dict[int, List[int]]:
+        keep = frozenset(etypes)
+        adj: Dict[int, List[int]] = {n: [] for n in self.nodes}
+        for s, d, t in self.edges:
+            if t in keep and d in adj and s in self.nodes:
+                adj[d].append(s)
+        return adj
+
+    def subgraph_edges(self, gtype: str) -> List[Tuple[int, int, str]]:
+        keep = GRAPH_REDUCTIONS[gtype]
+        return [(s, d, t) for s, d, t in self.edges if t in keep]
+
+    def ast_descendants(
+        self,
+        root: int,
+        exclude_labels: Sequence[str] = (),
+        adj: Optional[Dict[int, List[int]]] = None,
+    ) -> List[int]:
+        """DFS over AST edges from ``root`` (excluding it), skipping subtrees
+        rooted at excluded labels (the reference removes METHOD nodes from
+        its AST copy before descending, abstract_dataflow_full.py:137-146).
+        Pass a prebuilt ``out_adjacency(("AST",))`` when calling per-node in
+        a loop."""
+        if adj is None:
+            adj = self.out_adjacency(("AST",))
+        excluded = frozenset(exclude_labels)
+        seen, order, stack = set(), [], [root]
+        while stack:
+            cur = stack.pop()
+            for child in adj.get(cur, []):
+                if child in seen or self.nodes[child].label in excluded:
+                    continue
+                seen.add(child)
+                order.append(child)
+                stack.append(child)
+        return order
+
+
+def _to_int(value, default: int = -1) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def from_joern_json(
+    nodes_json: Sequence[Mapping],
+    edges_json: Sequence[Sequence],
+) -> CPG:
+    """Build a filtered :class:`CPG` from Joern export payloads.
+
+    ``nodes_json``: list of node property dicts; ``edges_json``: list of
+    ``[inNode, outNode, etype, dataflow]`` rows — Joern/TinkerPop naming,
+    where the edge runs **outNode -> inNode** (get_func_graph.sc:53 exports
+    ``List(node.inNode.id, node.outNode.id, node.label, ...)``; the
+    reference builds its analysis graph as (outnode, innode) pairs,
+    dataflow.py:242-244). Edges here are stored in semantic
+    source->target direction: ``src = row[1]``, ``dst = row[0]``.
+    """
+    nodes: Dict[int, CPGNode] = {}
+    for rec in nodes_json:
+        label = str(rec.get("_label", ""))
+        if label in DROPPED_NODE_LABELS:
+            continue
+        nid = int(rec["id"])
+        nodes[nid] = CPGNode(
+            id=nid,
+            label=label,
+            name=str(rec.get("name", "") or ""),
+            code="" if rec.get("code") in (None, "<empty>") else str(rec["code"]),
+            line_number=_to_int(rec.get("lineNumber")),
+            order=_to_int(rec.get("order"), 0),
+            type_full_name=str(rec.get("typeFullName", "") or ""),
+            control_structure_type=str(rec.get("controlStructureType", "") or ""),
+        )
+    # Code falls back to the node name when empty (joern.py:242-244).
+    for n in nodes.values():
+        if not n.code:
+            n.code = n.name
+
+    if not any(n.label == "METHOD" for n in nodes.values()):
+        raise ValueError("empty graph: no METHOD node")
+
+    edges: List[Tuple[int, int, str]] = []
+    seen = set()
+    for row in edges_json:
+        src, dst, etype = int(row[1]), int(row[0]), str(row[2])
+        if etype in DROPPED_EDGE_TYPES:
+            continue
+        if src not in nodes or dst not in nodes:
+            continue
+        # Keep only edges touching at least one line-numbered node
+        # (joern.py:261-272).
+        if nodes[src].line_number < 0 and nodes[dst].line_number < 0:
+            continue
+        key = (src, dst, etype)
+        if key in seen:
+            continue
+        seen.add(key)
+        edges.append(key)
+
+    connected = {s for s, _, _ in edges} | {d for _, d, _ in edges}
+    nodes = {i: n for i, n in nodes.items() if i in connected}
+    return CPG(nodes=nodes, edges=edges)
+
+
+def load_joern_export(stem: str | Path) -> CPG:
+    """Read ``<stem>.nodes.json`` + ``<stem>.edges.json`` from disk."""
+    stem = str(stem)
+    with open(stem + ".nodes.json") as f:
+        nodes_json = json.load(f)
+    with open(stem + ".edges.json") as f:
+        edges_json = json.load(f)
+    return from_joern_json(nodes_json, edges_json)
+
+
+def reduce_graph(cpg: CPG, gtype: str) -> CPG:
+    """rdg() semantics: same nodes, edges restricted by graph type."""
+    if gtype not in GRAPH_REDUCTIONS:
+        raise ValueError(f"unknown graph type {gtype!r}; want one of {sorted(GRAPH_REDUCTIONS)}")
+    return CPG(nodes=dict(cpg.nodes), edges=cpg.subgraph_edges(gtype))

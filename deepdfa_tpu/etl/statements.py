@@ -1,0 +1,63 @@
+"""Statement-level vulnerability labels.
+
+The reference labels a statement (line) vulnerable when it is removed by the
+fix or data/control-dependent on lines the fix added
+(DDFA/sastvd/helpers/evaluate.py:194-255 ``get_dep_add_lines``). Dependence
+comes from the PDG: REACHING_DEF edges are data dependence, CDG edges are
+control dependence, aggregated to line granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+from deepdfa_tpu.etl.cpg import CPG
+
+
+def line_dependencies(cpg: CPG) -> Dict[int, Set[int]]:
+    """line -> set of lines it depends on (data or control).
+
+    A PDG edge src->dst means dst depends on src; both endpoints are mapped
+    to their line numbers (unlined nodes are skipped)."""
+    deps: Dict[int, Set[int]] = {}
+    for s, d, t in cpg.edges:
+        if t not in ("REACHING_DEF", "CDG"):
+            continue
+        src_line = cpg.nodes[s].line_number
+        dst_line = cpg.nodes[d].line_number
+        if src_line < 0 or dst_line < 0 or src_line == dst_line:
+            continue
+        deps.setdefault(dst_line, set()).add(src_line)
+    return deps
+
+
+def dependent_added_lines(
+    before_cpg: CPG, after_cpg: CPG, added_lines: Iterable[int]
+) -> List[int]:
+    """Lines of the BEFORE graph that the fix's added lines depend on
+    (evaluate.py:206-218: deps of added lines in the after graph, filtered
+    to lines present in the before graph)."""
+    added = set(added_lines)
+    deps = line_dependencies(after_cpg)
+    dep_lines: Set[int] = set()
+    for line in added:
+        dep_lines |= deps.get(line, set())
+    before_lines = {n.line_number for n in before_cpg.nodes.values() if n.line_number >= 0}
+    return sorted(dep_lines & before_lines)
+
+
+def statement_labels(
+    before_cpg: CPG,
+    removed_lines: Iterable[int],
+    dep_add_lines: Iterable[int],
+) -> Dict[int, int]:
+    """Per-line binary labels over the before graph: 1 if removed by the
+    fix or dependent on added lines (the `_VULN` node attribute's line-level
+    source, dbize.py:30-107)."""
+    vuln = set(removed_lines) | set(dep_add_lines)
+    return {
+        line: int(line in vuln)
+        for line in sorted(
+            {n.line_number for n in before_cpg.nodes.values() if n.line_number >= 0}
+        )
+    }

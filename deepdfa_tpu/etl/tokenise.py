@@ -1,0 +1,28 @@
+"""IVDetect-style subtoken tokenization
+(reference: DDFA/sastvd/helpers/tokenise.py:4-35)."""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+_SPEC_CHAR = re.compile(r"[^a-zA-Z0-9\s]")
+_CAMEL = re.compile(r".+?(?:(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])|$)")
+
+
+def tokenise(s: str) -> str:
+    """Split on special chars, then camelCase; drop single-char tokens."""
+    spec_split = re.split(_SPEC_CHAR, s)
+    space_split = " ".join(spec_split).split()
+    camel_split = [m.group(0) for tok in space_split for m in re.finditer(_CAMEL, tok)]
+    return " ".join(t for t in camel_split if len(t) > 1)
+
+
+def tokenise_lines(s: str) -> List[str]:
+    """Per-line tokenization, dropping lines that tokenize to nothing."""
+    out = []
+    for line in s.splitlines():
+        tok = tokenise(line)
+        if tok:
+            out.append(tok)
+    return out

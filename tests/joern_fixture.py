@@ -1,0 +1,84 @@
+"""Handcrafted Joern-export payload for ETL tests.
+
+Models this function (Joern v1.1.107 export shape, get_func_graph.sc):
+
+    1  int f(int a) {
+    2    int x = 1;
+    3    if (a > 0) {
+    4      x += a;
+    5    } else {
+    6      x = strlen(s);
+    7    }
+    8    return x;
+    9  }
+
+CFG: entry -> [x=1] -> [a>0] -> {[x+=a], [x=strlen(s)]} -> [return x].
+"""
+
+
+def node(id, _label, name="", code="", lineNumber=None, order=0, typeFullName=""):
+    return {
+        "id": id, "_label": _label, "name": name, "code": code,
+        "lineNumber": lineNumber, "order": order, "typeFullName": typeFullName,
+    }
+
+
+NODES = [
+    node(1, "METHOD", name="f", code="int f(int a)", lineNumber=1),
+    node(2, "METHOD_PARAMETER_IN", name="a", code="int a", lineNumber=1, typeFullName="int"),
+    # int x = 1;
+    node(3, "LOCAL", name="x", code="int x", lineNumber=2, typeFullName="int"),
+    node(10, "CALL", name="<operator>.assignment", code="x = 1", lineNumber=2),
+    node(11, "IDENTIFIER", name="x", code="x", lineNumber=2, order=1, typeFullName="int"),
+    node(12, "LITERAL", name="1", code="1", lineNumber=2, order=2),
+    # if (a > 0)
+    node(20, "CALL", name="<operator>.greaterThan", code="a > 0", lineNumber=3),
+    node(21, "IDENTIFIER", name="a", code="a", lineNumber=3, order=1, typeFullName="int"),
+    node(22, "LITERAL", name="0", code="0", lineNumber=3, order=2),
+    # x += a;
+    node(30, "CALL", name="<operator>.assignmentPlus", code="x += a", lineNumber=4),
+    node(31, "IDENTIFIER", name="x", code="x", lineNumber=4, order=1, typeFullName="int"),
+    node(32, "IDENTIFIER", name="a", code="a", lineNumber=4, order=2, typeFullName="int"),
+    # x = strlen(s);
+    node(40, "CALL", name="<operator>.assignment", code="x = strlen(s)", lineNumber=6),
+    node(41, "IDENTIFIER", name="x", code="x", lineNumber=6, order=1, typeFullName="int"),
+    node(42, "CALL", name="strlen", code="strlen(s)", lineNumber=6, order=2),
+    node(43, "IDENTIFIER", name="s", code="s", lineNumber=6, order=1, typeFullName="char *"),
+    # return x;
+    node(50, "RETURN", name="return", code="return x", lineNumber=8),
+    node(51, "IDENTIFIER", name="x", code="x", lineNumber=8, order=1, typeFullName="int"),
+    # noise the parser must drop:
+    node(90, "COMMENT", name="", code="// nothing", lineNumber=5),
+    node(91, "FILE", name="f.c", code=""),
+]
+
+# Real Joern export row order: [inNode (target), outNode (source), label]
+# (get_func_graph.sc:53). E() takes semantic (source, target, type).
+E = lambda s, d, t: [d, s, t, ""]
+
+EDGES = [
+    # CFG spine
+    E(1, 10, "CFG"), E(10, 20, "CFG"),
+    E(20, 30, "CFG"), E(20, 40, "CFG"),
+    E(30, 50, "CFG"), E(40, 50, "CFG"),
+    # AST
+    E(1, 3, "AST"), E(1, 10, "AST"), E(1, 20, "AST"), E(1, 30, "AST"),
+    E(1, 40, "AST"), E(1, 50, "AST"),
+    E(10, 11, "AST"), E(10, 12, "AST"),
+    E(20, 21, "AST"), E(20, 22, "AST"),
+    E(30, 31, "AST"), E(30, 32, "AST"),
+    E(40, 41, "AST"), E(40, 42, "AST"), E(42, 43, "AST"),
+    E(50, 51, "AST"),
+    # ARGUMENT
+    E(10, 11, "ARGUMENT"), E(10, 12, "ARGUMENT"),
+    E(20, 21, "ARGUMENT"), E(20, 22, "ARGUMENT"),
+    E(30, 31, "ARGUMENT"), E(30, 32, "ARGUMENT"),
+    E(40, 41, "ARGUMENT"), E(40, 42, "ARGUMENT"), E(42, 43, "ARGUMENT"),
+    # PDG
+    E(10, 30, "REACHING_DEF"), E(10, 40, "REACHING_DEF"),
+    E(30, 50, "REACHING_DEF"), E(40, 50, "REACHING_DEF"),
+    E(20, 30, "CDG"), E(20, 40, "CDG"),
+    # edges the parser must drop
+    E(1, 10, "CONTAINS"), E(1, 20, "DOMINATE"), E(20, 10, "POST_DOMINATE"),
+    E(91, 1, "SOURCE_FILE"),
+]

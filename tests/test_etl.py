@@ -1,0 +1,194 @@
+"""ETL subsystem: Joern parsing, reaching defs, abstract dataflow, labels."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core.config import FeatureSpec
+from deepdfa_tpu.etl.absdf import (
+    AbstractDataflowVocab,
+    build_all_vocabs,
+    clean_datatype,
+    extract_decl_features,
+    is_decl,
+)
+from deepdfa_tpu.etl.cpg import from_joern_json, reduce_graph
+from deepdfa_tpu.etl.export import cpg_to_example
+from deepdfa_tpu.etl.gitdiff import code2diff, combined_function
+from deepdfa_tpu.etl.reaching import Definition, ReachingDefinitions
+from deepdfa_tpu.etl.statements import (
+    dependent_added_lines,
+    line_dependencies,
+    statement_labels,
+)
+from deepdfa_tpu.etl.tokenise import tokenise, tokenise_lines
+
+from joern_fixture import EDGES, NODES
+
+
+@pytest.fixture()
+def cpg():
+    return from_joern_json(NODES, EDGES)
+
+
+def test_parser_filters(cpg):
+    # COMMENT/FILE nodes gone; dropped edge types gone.
+    assert all(n.label not in ("COMMENT", "FILE") for n in cpg.nodes.values())
+    etypes = {t for _, _, t in cpg.edges}
+    assert etypes.isdisjoint({"CONTAINS", "DOMINATE", "POST_DOMINATE", "SOURCE_FILE"})
+    # lone node 2 (param with no kept edges) dropped
+    assert 2 not in cpg.nodes
+    # code falls back to name; <empty> cleared
+    assert cpg.nodes[12].code == "1"
+
+
+def test_parser_requires_method():
+    with pytest.raises(ValueError):
+        from_joern_json([n for n in NODES if n["_label"] != "METHOD"], EDGES)
+
+
+def test_reduce_graph(cpg):
+    cfg = reduce_graph(cpg, "cfg")
+    assert {t for _, _, t in cfg.edges} == {"CFG"}
+    assert len(cfg.edges) == 6
+    pdg = reduce_graph(cpg, "pdg")
+    assert {t for _, _, t in pdg.edges} == {"REACHING_DEF", "CDG"}
+    with pytest.raises(ValueError):
+        reduce_graph(cpg, "nope")
+
+
+def test_reaching_definitions_fixpoint(cpg):
+    rd = ReachingDefinitions(cpg)
+    # Three definitions of x: nodes 10, 30, 40.
+    assert rd.domain == {Definition("x", 10), Definition("x", 30), Definition("x", 40)}
+    assert rd.assigned_variable(10) == "x"
+    assert rd.assigned_variable(20) is None
+
+    in_sets, out_sets = rd.solve()
+    # x=1 reaches the branch condition...
+    assert in_sets[20] == {Definition("x", 10)}
+    # ...and each branch kills it:
+    assert out_sets[30] == {Definition("x", 30)}
+    assert out_sets[40] == {Definition("x", 40)}
+    # both branch defs merge at the return, original killed on every path
+    assert in_sets[50] == {Definition("x", 30), Definition("x", 40)}
+
+
+def test_solution_bits(cpg):
+    bits, domain = ReachingDefinitions(cpg).solution_bits()
+    assert [d.node for d in domain] == [10, 30, 40]
+    assert bits[50] == [1, 2]
+    assert bits[20] == [0]
+
+
+def test_decl_feature_extraction(cpg):
+    assert is_decl(cpg.nodes[10]) and is_decl(cpg.nodes[30]) and is_decl(cpg.nodes[40])
+    assert not is_decl(cpg.nodes[20])
+    feats = extract_decl_features(cpg, raise_errors=True)
+    assert set(feats) == {10, 30, 40}
+    assert ("datatype", "int") in feats[10]
+    assert ("literal", "1") in feats[10]
+    # x = strlen(s): api call captured, datatype resolved through identifier
+    assert ("api", "strlen") in feats[40]
+    assert ("datatype", "int") in feats[40]
+    # x += a: no literal/api, operator list excludes the decl node itself
+    assert feats[30] == [("datatype", "int")]
+
+
+def test_clean_datatype():
+    assert clean_datatype("const char [ 12 ]") == "char[]"
+    assert clean_datatype("unsigned   long\tlong") == "unsigned long long"
+
+
+def test_vocab_build_and_index(cpg):
+    feats = extract_decl_features(cpg)
+    by_graph = {0: feats}
+    spec = FeatureSpec(limit_all=10, limit_subkeys=10)
+    vocabs = build_all_vocabs(by_graph, [0], spec)
+    assert set(vocabs) == {"api", "datatype", "literal", "operator"}
+    dt = vocabs["datatype"]
+    # non-definition -> 0
+    assert dt.index_for(None) == 0
+    assert dt.index_for([]) == 0
+    # known hash -> rank+1 >= 2
+    assert dt.index_for(feats[10]) >= 2
+    # unseen value -> UNKNOWN hash; may itself be unseen -> 1
+    assert dt.index_for([("datatype", "some_weird_t")]) == 1
+    # determinism
+    again = build_all_vocabs(by_graph, [0], spec)
+    assert again["datatype"].all_index == dt.all_index
+
+
+def test_vocab_limit_caps():
+    spec = FeatureSpec(limit_all=2, limit_subkeys=2)
+    by_graph = {
+        g: {n: [("api", f"call_{(g + n) % 5}")] for n in range(6)}
+        for g in range(4)
+    }
+    v = AbstractDataflowVocab.build(by_graph, range(4), spec, "api")
+    # None + at most limit_subkeys kept values
+    assert len(v.subkey_index) <= 3
+    assert len(v.all_index) <= 3
+
+
+def test_export_example(cpg):
+    feats = extract_decl_features(cpg)
+    vocabs = build_all_vocabs({7: feats}, [7], FeatureSpec(limit_all=10, limit_subkeys=10))
+    labels = {4: 1, 6: 0, 2: 0, 3: 0, 8: 0}
+    ex = cpg_to_example(cpg, vocabs, feats, graph_id=7, line_labels=labels)
+    assert ex["num_nodes"] == len(cpg.nodes)
+    assert ex["label"] == 1
+    assert ex["senders"].shape == ex["receivers"].shape
+    assert set(ex["feats"]) == {"api", "datatype", "literal", "operator"}
+    # node for line 4 (id 30) carries the vuln bit
+    i30 = list(sorted(cpg.nodes)).index(30)
+    assert ex["vuln"][i30] == 1
+    # exported graph feeds the batcher directly
+    from deepdfa_tpu.graphs.batch import batch_graphs
+
+    batch = batch_graphs([ex], 1, 64, 256, list(vocabs))
+    assert int(np.asarray(batch.graph_mask).sum()) == 1
+
+
+def test_code2diff_indices():
+    old = "a\nb\nc\n"
+    new = "a\nB\nc\nd\n"
+    d = code2diff(old, new)
+    # hunk body: ' a', '-b', '+B', ' c', '+d'
+    assert d["removed"] == [2]
+    assert d["added"] == [3, 5]
+    assert code2diff(old, old) == {"added": [], "removed": [], "diff": ""}
+
+
+def test_combined_function_aligns_with_diff():
+    old = "a\nb\nc\n"
+    new = "a\nB\nc\n"
+    d = code2diff(old, new)
+    # "before": removed lines live (the vulnerable code), added commented out
+    before = combined_function(old, new, "before").splitlines()
+    assert before[d["removed"][0] - 1] == "b"
+    assert before[d["added"][0] - 1] == "// B"
+    # "after": the fix live, removed lines commented out
+    after = combined_function(old, new, "after").splitlines()
+    assert after[d["removed"][0] - 1] == "// b"
+    assert after[d["added"][0] - 1] == "B"
+    with pytest.raises(ValueError):
+        combined_function(old, new, "both")
+
+
+def test_tokenise():
+    assert tokenise("FooBar fooBar foo bar_blub23/x~y'z") == "Foo Bar foo Bar foo bar blub23"
+    assert tokenise_lines("line1a line1b\nf f\nok") == ["line1a line1b", "ok"]
+
+
+def test_statement_labels(cpg):
+    deps = line_dependencies(cpg)
+    # return (line 8) data-depends on lines 4 and 6; branches control-depend on 3
+    assert deps[8] == {4, 6}
+    assert 3 in deps[4] and 3 in deps[6]
+
+    # pretend the fix added line 8 in the after graph: its deps in before
+    dep_add = dependent_added_lines(cpg, cpg, added_lines=[8])
+    assert dep_add == [4, 6]
+    labels = statement_labels(cpg, removed_lines=[2], dep_add_lines=dep_add)
+    assert labels[2] == 1 and labels[4] == 1 and labels[6] == 1
+    assert labels[3] == 0 and labels[8] == 0

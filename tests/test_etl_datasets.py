@@ -1,0 +1,62 @@
+"""Dataset loaders over synthetic CSV/JSON files."""
+
+import csv
+import json
+
+from deepdfa_tpu.etl.datasets import load_bigvul, load_devign, remove_comments
+
+GOOD_BEFORE = """int f(int a) {
+  int x = 1; // init
+  if (a > 0) {
+    x += a;
+  } else {
+    x = strlen(s);
+  }
+  return x;
+}"""
+
+GOOD_AFTER = GOOD_BEFORE.replace("x += a;", "x += checked(a);")
+
+
+def test_remove_comments():
+    assert remove_comments("int x; // hi\n/* yo */int y;") == "int x;  \n int y;"
+    # string literals untouched
+    assert remove_comments('s = "// not a comment";') == 's = "// not a comment";'
+
+
+def test_load_bigvul(tmp_path):
+    p = tmp_path / "msr.csv"
+    rows = [
+        {"func_before": GOOD_BEFORE, "func_after": GOOD_AFTER, "vul": "1", "project": "a"},
+        {"func_before": GOOD_BEFORE, "func_after": GOOD_BEFORE, "vul": "0", "project": "b"},
+        # vulnerable but no change -> filtered
+        {"func_before": GOOD_BEFORE, "func_after": GOOD_BEFORE, "vul": "1", "project": "c"},
+        # vulnerable but too short -> filtered
+        {"func_before": "int g(){}", "func_after": "int g(){ return 1; }", "vul": "1", "project": "d"},
+    ]
+    with open(p, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+
+    out = load_bigvul(p)
+    assert [r["vul"] for r in out] == [1, 0]
+    assert out[0]["added"] and out[0]["removed"]
+    # combined "before" text keeps removed line commented
+    assert any(l.startswith("// ") for l in out[0]["before"].splitlines())
+    assert load_bigvul(p, sample=1)[0]["id"] == 0
+
+
+def test_load_devign(tmp_path):
+    p = tmp_path / "function.json"
+    json.dump(
+        [
+            {"project": "qemu", "target": 1, "func": "int f() { return 1; } // x"},
+            {"project": "ffmpeg", "target": 0, "func": "int g() { return 0; }"},
+        ],
+        open(p, "w"),
+    )
+    out = load_devign(p)
+    assert len(out) == 2
+    assert out[0]["vul"] == 1 and "//" not in out[0]["before"]
+    assert out[1]["project"] == "ffmpeg"

@@ -1,0 +1,27 @@
+"""Joern session driver: escaping logic always; live REPL only if installed."""
+
+import pytest
+
+from deepdfa_tpu.etl.joern_session import JoernSession, joern_available, shesc
+
+
+def test_shesc():
+    assert shesc('a"b\\c') == 'a\\"b\\\\c'
+    assert shesc("plain") == "plain"
+
+
+def test_session_requires_binary():
+    if joern_available():
+        pytest.skip("joern installed; covered by live test")
+    with pytest.raises(RuntimeError, match="joern binary not found"):
+        JoernSession()
+
+
+@pytest.mark.skipif(not joern_available(), reason="joern not installed")
+def test_live_session(tmp_path):
+    s = JoernSession(0, tmp_path)
+    try:
+        out = s.send("val x = 41 + 1")
+        assert "42" in out
+    finally:
+        s.close()
