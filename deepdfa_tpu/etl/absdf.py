@@ -157,8 +157,11 @@ def extract_decl_features(
 def node_subkey_values(
     fields: Sequence[Tuple[str, str]], subkey: str
 ) -> List[str]:
-    """The node's raw value list for one subkey, sorted (``to_hash``
-    semantics: sorted with duplicates kept)."""
+    """The node's raw value list for one subkey, sorted with duplicates kept
+    — the stored-hash form (``to_hash``, abstract_dataflow_full.py:285-295).
+    Consumers that mirror ``abs_dataflow``'s vocab/index stages dedupe this
+    list themselves (datasets.py:624-625,670-672 apply ``sorted(set(...))``
+    before counting and before the final all-hash)."""
     return sorted(text for key, text in fields if key == subkey)
 
 
@@ -222,6 +225,8 @@ class AbstractDataflowVocab:
         if subkey in SINGLE_SUBKEYS:
             values = values[:1] if values else []
         subst = [v if v in subkey_index else UNKNOWN for v in values]
+        # sorted(set(...)) matches get_all_hash (datasets.py:670-672): the
+        # final hash is over the deduplicated UNKNOWN-substituted values.
         return json.dumps({subkey: sorted(set(subst))})
 
     def index_for(self, fields: Optional[Sequence[Tuple[str, str]]]) -> int:
@@ -255,7 +260,9 @@ def build_all_vocabs(
     spec: FeatureSpec,
 ) -> Dict[str, AbstractDataflowVocab]:
     """One vocab per subkey (concat_all model: 4 embedding tables)."""
-    subkeys = ALL_SUBKEYS if spec.concat_all else (spec.subkey,)
+    from deepdfa_tpu.core.config import subkeys_for
+
+    subkeys = subkeys_for(spec)
     return {
         sk: AbstractDataflowVocab.build(features_by_graph, train_graph_ids, spec, sk)
         for sk in subkeys

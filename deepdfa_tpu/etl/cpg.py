@@ -62,9 +62,6 @@ class CPG:
     nodes: Dict[int, CPGNode]
     edges: List[Tuple[int, int, str]]
 
-    def successors(self, node: int, etype: Optional[str] = None) -> List[int]:
-        return [d for s, d, t in self.edges if s == node and (etype is None or t == etype)]
-
     def out_adjacency(self, etypes: Iterable[str]) -> Dict[int, List[int]]:
         keep = frozenset(etypes)
         adj: Dict[int, List[int]] = {n: [] for n in self.nodes}

@@ -57,17 +57,29 @@ class JoernSession:
         import pty
 
         self._master, slave = pty.openpty()
-        self._proc = subprocess.Popen(
-            [binary],
-            stdin=slave,
-            stdout=slave,
-            stderr=slave,
-            cwd=self.workspace,
-            env={**os.environ, "TERM": "dumb"},
-            close_fds=True,
-        )
+        try:
+            self._proc = subprocess.Popen(
+                [binary],
+                stdin=slave,
+                stdout=slave,
+                stderr=slave,
+                cwd=self.workspace,
+                env={**os.environ, "TERM": "dumb"},
+                close_fds=True,
+            )
+        except BaseException:
+            os.close(self._master)
+            os.close(slave)
+            raise
         os.close(slave)
-        self._read_until_prompt()
+        try:
+            self._read_until_prompt()
+        except BaseException:
+            # Startup failed: don't leak the JVM or the pty master.
+            self._proc.kill()
+            self._proc.wait()
+            os.close(self._master)
+            raise
 
     def _read_until_prompt(self) -> str:
         import select
@@ -116,6 +128,7 @@ class JoernSession:
             self._proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self._proc.kill()
+            self._proc.wait()
         os.close(self._master)
 
 
@@ -126,16 +139,20 @@ def extract_cpg_batch(
     failed_log: Optional[Path] = None,
 ) -> List[Path]:
     """Run Joern over a batch of single-function C files, exporting
-    ``<name>.nodes.json``/``.edges.json`` next to each (getgraphs.py:71-156
-    semantics: per-item fault tolerance, failures logged and skipped)."""
+    ``<name>.nodes.json``/``.edges.json`` next to each via
+    ``scripts/export_cpg.sc`` (getgraphs.py:71-156 semantics: per-item fault
+    tolerance, failures logged and skipped)."""
     if not joern_available():
         raise RuntimeError("joern binary not found on PATH")
+    script = Path(__file__).parent / "scripts" / "export_cpg.sc"
     done: List[Path] = []
     session = JoernSession(0, out_dir / "ws")
     try:
         for path in c_files:
             try:
-                session.import_code(path)
+                session.run_script(script, {"filename": str(Path(path).resolve())})
+                if not path.with_suffix(path.suffix + ".nodes.json").exists():
+                    raise RuntimeError("export produced no nodes.json")
                 done.append(path)
             except Exception as exc:  # per-item fault tolerance
                 if failed_log:

@@ -72,16 +72,15 @@ class ReachingDefinitions:
         self._cfg_succ = cpg.out_adjacency(("CFG",))
         self._cfg_pred = cpg.in_adjacency(("CFG",))
         self.gen: Dict[int, FrozenSet[Definition]] = {}
+        self._assigned: Dict[int, Optional[str]] = {}
         for nid, node in cpg.nodes.items():
-            if node.name in MOD_OPS:
-                var = self.assigned_variable(nid)
-                self.gen[nid] = (
-                    frozenset({Definition(var, nid)}) if var is not None else frozenset()
-                )
-            else:
-                self.gen[nid] = frozenset()
+            var = self._compute_assigned_variable(nid)
+            self._assigned[nid] = var
+            self.gen[nid] = (
+                frozenset({Definition(var, nid)}) if var is not None else frozenset()
+            )
 
-    def assigned_variable(self, nid: int) -> Optional[str]:
+    def _compute_assigned_variable(self, nid: int) -> Optional[str]:
         """Code of the first ARGUMENT child by order (dataflow.py:124-134)."""
         if self.cpg.nodes[nid].name not in MOD_OPS:
             return None
@@ -89,6 +88,11 @@ class ReachingDefinitions:
         if not children:
             return None
         return self.cpg.nodes[children[0]].code
+
+    def assigned_variable(self, nid: int) -> Optional[str]:
+        """Cached per-node assigned variable (fixed once the CPG is built;
+        the worklist revisits nodes many times)."""
+        return self._assigned[nid]
 
     @property
     def domain(self) -> Set[Definition]:

@@ -1,0 +1,33 @@
+// Export a single-function CPG as nodes/edges JSON.
+//
+// TPU-framework equivalent of the reference's Joern export script
+// (DDFA/storage/external/get_func_graph.sc:26-81): import the C file, run
+// the ossdataflow overlay, and write `<file>.nodes.json` + `<file>.edges.json`
+// next to it. Written fresh for Joern v1.1.x (same version the reference
+// pins, scripts/install_joern.sh:6-8).
+//
+// Invoked through the REPL protocol as
+//   export_cpg.exec(filename="/abs/path/x.c")
+
+import io.shiftleft.semanticcpg.language._
+import io.joern.dataflowengineoss.language._
+
+@main def exec(filename: String) = {
+  importCode(inputPath = filename, projectName = filename)
+  run.ossdataflow
+
+  val nodes = cpg.all.map { node =>
+    val props = node.propertiesMap.asScala.map { case (k, v) =>
+      s""""${k}": ${ujson.write(v.toString)}"""
+    }.mkString(", ")
+    s"""{"id": ${node.id}, "_label": "${node.label}", ${props}}"""
+  }.l
+
+  val edges = cpg.graph.edges.map { e =>
+    s"""{"innode": ${e.inNode.id}, "outnode": ${e.outNode.id}, "etype": "${e.label}"}"""
+  }.l
+
+  os.write.over(os.Path(filename + ".nodes.json"), "[" + nodes.mkString(",\n") + "]")
+  os.write.over(os.Path(filename + ".edges.json"), "[" + edges.mkString(",\n") + "]")
+  delete  // drop the project so the workspace does not grow per file
+}

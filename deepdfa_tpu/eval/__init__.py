@@ -1,0 +1,33 @@
+"""Evaluation, profiling and reporting subsystem.
+
+TPU-native replacement for the reference's two instruments — DeepSpeed
+``FlopsProfiler`` and CUDA-event timing (reference:
+DDFA/code_gnn/models/base_module.py:238-323,
+LineVul/linevul/linevul_main.py:332-394) — plus the report aggregation of
+scripts/report_profiling.py:18-66 and the PR-curve / classification-report
+exports of base_module.py:348-383.
+"""
+
+from deepdfa_tpu.eval.profiling import (
+    ProfileRecorder,
+    count_params,
+    cost_analysis,
+    time_steps,
+)
+from deepdfa_tpu.eval.report import (
+    aggregate_profile,
+    aggregate_time,
+    export_pr_csv,
+    test_report,
+)
+
+__all__ = [
+    "ProfileRecorder",
+    "cost_analysis",
+    "count_params",
+    "time_steps",
+    "aggregate_profile",
+    "aggregate_time",
+    "export_pr_csv",
+    "test_report",
+]

@@ -1,0 +1,146 @@
+"""Report aggregation and export.
+
+Replaces scripts/report_profiling.py:18-66 (GFLOPs/GMACs + ms/example from
+the JSONL records) and the test-epoch exports of base_module.py:348-383
+(overall + positive-only/negative-only metrics, PR curves to ``pr.csv`` /
+``pr_binned.csv``, confusion matrix, classification report).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepdfa_tpu.core.metrics import (
+    classification_report_dict,
+    pr_curve,
+)
+
+
+def _read_jsonl(path: str):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def aggregate_profile(path: str) -> Dict[str, float]:
+    """GFLOPs / GMACs per example from ``profiledata.jsonl``
+    (reference report_profiling.py:18-42)."""
+    recs = _read_jsonl(path)
+    if not recs:
+        return {"gflops_per_example": 0.0, "gmacs_per_example": 0.0, "params": 0.0}
+    flops = np.array([r["flops"] for r in recs], np.float64)
+    macs = np.array([r["macs"] for r in recs], np.float64)
+    bs = np.array([max(int(r["batch_size"]), 1) for r in recs], np.float64)
+    return {
+        "gflops_per_example": float(np.mean(flops / bs) / 1e9),
+        "gmacs_per_example": float(np.mean(macs / bs) / 1e9),
+        "params": float(recs[0].get("params", 0)),
+    }
+
+
+def aggregate_time(path: str) -> Dict[str, float]:
+    """ms per example from ``timedata.jsonl``
+    (reference report_profiling.py:44-66)."""
+    recs = _read_jsonl(path)
+    if not recs:
+        return {"ms_per_example": 0.0, "examples_per_sec": 0.0}
+    dur = np.array([r["duration"] for r in recs], np.float64)
+    bs = np.array([max(int(r["batch_size"]), 1) for r in recs], np.float64)
+    ms_per_ex = float(np.mean(dur / bs) * 1e3)
+    return {
+        "ms_per_example": ms_per_ex,
+        "examples_per_sec": float(np.sum(bs) / np.sum(dur)) if np.sum(dur) else 0.0,
+    }
+
+
+def export_pr_csv(
+    probs: np.ndarray,
+    labels: np.ndarray,
+    path: str,
+    binned_path: Optional[str] = None,
+    num_thresholds: int = 200,
+    num_bins: int = 20,
+) -> None:
+    """Write precision/recall/threshold rows to ``path`` and a coarse binned
+    variant, matching the reference's ``pr.csv`` / ``pr_binned.csv`` export
+    (base_module.py:362-372)."""
+    prec, rec, thr = pr_curve(probs, labels, num_thresholds=num_thresholds)
+
+    def _write(p, ps, rs, ts):
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["precision", "recall", "threshold"])
+            for a, b, c in zip(ps, rs, ts):
+                w.writerow([f"{a:.6f}", f"{b:.6f}", f"{c:.6f}"])
+
+    _write(path, prec, rec, thr)
+    if binned_path is not None:
+        idx = np.linspace(0, len(thr) - 1, num_bins).round().astype(int)
+        _write(binned_path, prec[idx], rec[idx], thr[idx])
+
+
+def _counts(pred: np.ndarray, lab: np.ndarray) -> Dict[str, float]:
+    tp = float(np.sum(pred * lab))
+    fp = float(np.sum(pred * (1 - lab)))
+    tn = float(np.sum((1 - pred) * (1 - lab)))
+    fn = float(np.sum((1 - pred) * lab))
+    prec = tp / (tp + fp) if tp + fp else 0.0
+    rec = tp / (tp + fn) if tp + fn else 0.0
+    return {
+        "acc": (tp + tn) / max(tp + fp + tn + fn, 1.0),
+        "precision": prec,
+        "recall": rec,
+        "f1": 2 * prec * rec / (prec + rec) if prec + rec else 0.0,
+    }
+
+
+def test_report(
+    probs: np.ndarray,
+    labels: np.ndarray,
+    out_dir: Optional[str] = None,
+    threshold: float = 0.5,
+) -> Dict[str, object]:
+    """Full test-epoch report.
+
+    Overall + positive-only + negative-only metric splits (the reference
+    clones its MetricCollection three ways, base_module.py:55-58,348-361),
+    confusion matrix, sklearn-style classification report; optionally writes
+    ``pr.csv``/``pr_binned.csv`` and ``report.json`` into ``out_dir``.
+    """
+    probs = np.asarray(probs, np.float64)
+    labels = np.asarray(labels, np.float64)
+    pred = (probs >= threshold).astype(np.float64)
+
+    pos, neg = labels == 1, labels == 0
+    report = {
+        "overall": _counts(pred, labels),
+        # On a single-class slice recall-on-that-class is the only
+        # informative number; the reference reports the full collection
+        # anyway, so we do too.
+        "positive_only": _counts(pred[pos], labels[pos]),
+        "negative_only": _counts(pred[neg], labels[neg]),
+        "confusion": {
+            "tp": float(np.sum(pred * labels)),
+            "fp": float(np.sum(pred * (1 - labels))),
+            "tn": float(np.sum((1 - pred) * (1 - labels))),
+            "fn": float(np.sum((1 - pred) * labels)),
+        },
+        "classification_report": classification_report_dict(
+            probs, labels, threshold=threshold
+        ),
+    }
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        export_pr_csv(
+            probs,
+            labels,
+            os.path.join(out_dir, "pr.csv"),
+            os.path.join(out_dir, "pr_binned.csv"),
+        )
+        with open(os.path.join(out_dir, "report.json"), "w") as f:
+            json.dump(report, f, indent=2)
+    return report

@@ -1,0 +1,134 @@
+"""Tests for the profiling + reporting subsystem (deepdfa_tpu/eval/)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.eval import (
+    ProfileRecorder,
+    aggregate_profile,
+    aggregate_time,
+    cost_analysis,
+    count_params,
+    export_pr_csv,
+    time_steps,
+)
+from deepdfa_tpu.eval import test_report as build_test_report
+from deepdfa_tpu.eval.profiling import profile_eval
+
+
+def test_count_params():
+    params = {"a": np.zeros((3, 4)), "b": {"c": np.zeros(5)}}
+    assert count_params(params) == 17
+
+
+def test_cost_analysis_matmul():
+    a = jnp.ones((64, 64), jnp.float32)
+
+    def fn(x):
+        return x @ x
+
+    costs = cost_analysis(fn, a)
+    # A 64^3 matmul is 2*64^3 flops; XLA's count should be at least the MACs.
+    assert costs["flops"] >= 64**3
+    assert costs["macs"] == costs["flops"] / 2
+
+
+def test_time_steps_warmup():
+    calls = []
+
+    def step():
+        calls.append(1)
+        return jnp.zeros(())
+
+    times = time_steps(step, n_steps=5, n_warmup=3)
+    assert len(times) == 5
+    assert len(calls) == 8
+    assert all(t >= 0 for t in times)
+
+
+def test_recorder_and_aggregate(tmp_path):
+    ppath = str(tmp_path / "profiledata.jsonl")
+    tpath = str(tmp_path / "timedata.jsonl")
+    rec = ProfileRecorder(ppath, tpath)
+    for _ in range(4):
+        rec.record_profile(flops=2e9, macs=1e9, params=1000, batch_size=16)
+        rec.record_time(0.008, 16)
+        rec.next_step()
+
+    prof = aggregate_profile(ppath)
+    assert prof["gflops_per_example"] == pytest.approx(2e9 / 16 / 1e9)
+    assert prof["gmacs_per_example"] == pytest.approx(1e9 / 16 / 1e9)
+    assert prof["params"] == 1000
+
+    tim = aggregate_time(tpath)
+    assert tim["ms_per_example"] == pytest.approx(0.5)
+    assert tim["examples_per_sec"] == pytest.approx(16 / 0.008)
+
+
+def test_profile_eval_flow(tmp_path):
+    ppath = str(tmp_path / "p.jsonl")
+    tpath = str(tmp_path / "t.jsonl")
+    rec = ProfileRecorder(ppath, tpath)
+    w = jnp.ones((8, 8))
+
+    def step(x):
+        return x @ w
+
+    batches = [jnp.ones((4, 8)) for _ in range(6)]
+    summary = profile_eval(step, batches, {"w": w}, lambda b: b.shape[0], rec)
+    assert summary["params"] == 64
+    assert summary["flops_per_batch"] > 0
+    # 6 batches, 3 warmup → 3 recorded.
+    recs = [json.loads(l) for l in open(ppath)]
+    assert len(recs) == 3
+    assert recs[0]["batch_size"] == 4
+
+
+def test_export_pr_csv(tmp_path):
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 200)
+    probs = np.clip(labels * 0.6 + rng.random(200) * 0.4, 0, 1)
+    p, pb = str(tmp_path / "pr.csv"), str(tmp_path / "pr_binned.csv")
+    export_pr_csv(probs, labels, p, pb)
+    rows = open(p).read().strip().splitlines()
+    assert rows[0] == "precision,recall,threshold"
+    assert len(rows) == 201
+    assert len(open(pb).read().strip().splitlines()) == 21
+
+
+def test_test_report(tmp_path):
+    labels = np.array([1, 1, 1, 0, 0, 0, 0, 0])
+    probs = np.array([0.9, 0.8, 0.2, 0.1, 0.1, 0.7, 0.2, 0.3])
+    rep = build_test_report(probs, labels, out_dir=str(tmp_path))
+    # tp=2 fp=1 fn=1 tn=4
+    assert rep["confusion"] == {"tp": 2.0, "fp": 1.0, "tn": 4.0, "fn": 1.0}
+    assert rep["overall"]["precision"] == pytest.approx(2 / 3)
+    assert rep["overall"]["recall"] == pytest.approx(2 / 3)
+    # Positive-only slice: all labels 1, recall = 2/3, accuracy = 2/3.
+    assert rep["positive_only"]["acc"] == pytest.approx(2 / 3)
+    # Negative-only slice: no positives → precision 0, acc = 4/5.
+    assert rep["negative_only"]["acc"] == pytest.approx(4 / 5)
+    assert (tmp_path / "pr.csv").exists()
+    assert (tmp_path / "report.json").exists()
+    saved = json.loads((tmp_path / "report.json").read_text())
+    assert saved["overall"]["f1"] == pytest.approx(rep["overall"]["f1"])
+
+
+def test_flowgnn_cost_analysis_smoke():
+    """The instrument works on the real model forward (tiny config)."""
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from __graft_entry__ import _example_batch
+
+    model_cfg = FlowGNNConfig(hidden_dim=8, n_steps=2)
+    data_cfg = DataConfig(batch_size=4, max_nodes_per_graph=16, max_edges_per_node=4)
+    batch = _example_batch(data_cfg, model_cfg)
+    model = FlowGNN(model_cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)
+
+    costs = cost_analysis(lambda b: model.apply(params, b), batch)
+    assert costs["flops"] > 0
