@@ -92,6 +92,9 @@ class FlowGNNConfig:
     encoder_mode: bool = False
     # Computation dtype for messages/GRU; params stay float32.
     dtype: str = "float32"
+    # "segment": XLA gather/scatter-add; "tile": Pallas block-sparse tile
+    # SpMM (requires batches built with build_tile_adj=True).
+    message_impl: str = "segment"
 
     @property
     def input_dim(self) -> int:

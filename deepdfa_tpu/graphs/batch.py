@@ -16,7 +16,7 @@ batch-build time so upstream storage stays loop-free.
 
 from __future__ import annotations
 
-from typing import Dict, List, Mapping, Optional, Sequence
+from typing import Any, Dict, List, Mapping, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -52,6 +52,9 @@ class GraphBatch:
     edge_mask: jnp.ndarray
     graph_mask: jnp.ndarray
     graph_ids: jnp.ndarray
+    # Optional block-sparse adjacency (ops/tile_spmm.TileAdjacency) for the
+    # Pallas MXU message-passing path; None → XLA segment ops.
+    tile_adj: Optional[Any] = None
 
     @property
     def n_graphs(self) -> int:
@@ -120,6 +123,9 @@ def batch_graphs(
     max_edges: int,
     subkeys: Sequence[str],
     add_self_loops: bool = True,
+    build_tile_adj: bool = False,
+    tile: int = 128,
+    tile_pad_nz: Optional[int] = None,
 ) -> "GraphBatch":
     """Pack up to ``n_graphs`` graphs into one padded batch (host-side, numpy).
 
@@ -171,6 +177,14 @@ def batch_graphs(
         node_off += n
         edge_off += e
 
+    tile_adj = None
+    if build_tile_adj:
+        from deepdfa_tpu.ops.tile_spmm import build_tile_adjacency
+
+        tile_adj = build_tile_adjacency(
+            senders, receivers, edge_mask, max_nodes, tile=tile, pad_nz=tile_pad_nz
+        )
+
     return GraphBatch(
         node_feats={k: jnp.asarray(v) for k, v in feats.items()},
         node_vuln=jnp.asarray(vuln),
@@ -181,6 +195,7 @@ def batch_graphs(
         edge_mask=jnp.asarray(edge_mask),
         graph_mask=jnp.asarray(graph_mask),
         graph_ids=jnp.asarray(graph_ids),
+        tile_adj=tile_adj,
     )
 
 

@@ -37,17 +37,32 @@ class GatedGraphStep(nn.Module):
     Semantics of DGL ``GatedGraphConv`` with ``n_etypes=1`` (ggnn.py:57-60):
     a single edge-typed linear applied to sender states, summed into
     receivers, fed to a GRU cell as the input with the node state as carry.
+
+    Two aggregation paths: XLA segment ops (gather + scatter-add), or the
+    Pallas block-sparse tile SpMM (``deepdfa_tpu.ops.tile_spmm``) when the
+    batch carries a precomputed ``TileAdjacency`` — dense MXU tiles instead
+    of irregular memory traffic.
     """
 
     hidden: int
     dtype: jnp.dtype = jnp.float32
+    message_impl: str = "segment"
 
     @nn.compact
-    def __call__(self, h, senders, receivers, edge_mask, num_nodes):
+    def __call__(self, h, batch: GraphBatch):
         msg = nn.Dense(self.hidden, dtype=self.dtype, name="edge_linear")(h)
-        msg = jnp.take(msg, senders, axis=0)
-        msg = jnp.where(edge_mask[:, None], msg, 0.0)
-        agg = segment_sum(msg, receivers, num_nodes)
+        if self.message_impl == "tile":
+            if batch.tile_adj is None:
+                raise ValueError(
+                    "message_impl='tile' needs batch_graphs(build_tile_adj=True)"
+                )
+            from deepdfa_tpu.ops.tile_spmm import tile_spmm
+
+            agg = tile_spmm(batch.tile_adj, msg)
+        else:
+            gathered = jnp.take(msg, batch.senders, axis=0)
+            gathered = jnp.where(batch.edge_mask[:, None], gathered, 0.0)
+            agg = segment_sum(gathered, batch.receivers, batch.max_nodes)
         new_h, _ = nn.GRUCell(self.hidden, dtype=self.dtype, name="gru")(h, agg)
         return new_h
 
@@ -104,14 +119,16 @@ class FlowGNN(nn.Module):
         # DGL's GatedGraphConv no zero-padding of the input is needed.
         h = feat_embed
 
-        step = GatedGraphStep(cfg.ggnn_hidden, dtype=dtype, name="ggnn_step")
+        step = GatedGraphStep(
+            cfg.ggnn_hidden,
+            dtype=dtype,
+            message_impl=cfg.message_impl,
+            name="ggnn_step",
+        )
         # Weight sharing across steps (one GatedGraphConv applied n_steps
         # times) — scan over a length-n_steps axis with broadcast params.
         scan = nn.scan(
-            lambda mod, carry, _: (
-                mod(carry, batch.senders, batch.receivers, batch.edge_mask, batch.max_nodes),
-                None,
-            ),
+            lambda mod, carry, _: (mod(carry, batch), None),
             variable_broadcast="params",
             split_rngs={"params": False},
             length=cfg.n_steps,

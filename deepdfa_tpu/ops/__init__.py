@@ -1,8 +1,17 @@
 """Pallas TPU kernels for the hot ops.
 
 The default compute path is the XLA segment-op formulation in
-``deepdfa_tpu.graphs.segment``; kernels here specialize the fused
-gather→transform→scatter-add message-passing step when profiling shows XLA's
-generated code leaving HBM bandwidth on the table. Import the XLA fallbacks
-from ``deepdfa_tpu.graphs`` unless a kernel is explicitly requested.
+``deepdfa_tpu.graphs.segment``; kernels here specialize the hot ops when
+profiling shows XLA's generated code leaving HBM bandwidth on the table.
+
+- ``tile_spmm``: block-sparse dense-tile SpMM for GNN message aggregation
+  (MXU matmuls over scalar-prefetched tile coordinates), with a custom VJP.
+  Select with ``FlowGNNConfig(message_impl="tile")`` on batches built with
+  ``batch_graphs(build_tile_adj=True)``.
 """
+
+from deepdfa_tpu.ops.tile_spmm import (  # noqa: F401
+    TileAdjacency,
+    build_tile_adjacency,
+    tile_spmm,
+)

@@ -1,0 +1,271 @@
+"""Block-sparse tile SpMM: the Pallas TPU kernel for GNN message aggregation.
+
+The hot op of the reference's training step is DGL ``GatedGraphConv``'s
+CUDA SpMM (reference: DDFA/code_gnn/models/flow_gnn/ggnn.py:57-60,95 — the
+per-step scatter-add of transformed sender states into receivers). A CUDA
+scatter translates badly to TPU: the MXU wants dense tiles, not per-row
+atomics. But the batch layout gives us structure for free — every graph's
+nodes are contiguous (graphs/batch.py), and CFG edges never cross graphs, so
+the batched adjacency is block-sparse with nonzero tiles hugging the
+diagonal.
+
+This module therefore represents aggregation as ``agg = A @ msg`` where A is
+stored as a sorted list of dense ``tile × tile`` blocks, and computes it with
+one MXU matmul per nonzero tile:
+
+- grid = one step per nonzero tile, sequential on a TPU core;
+- scalar-prefetched (row, col) tile coordinates drive the BlockSpec index
+  maps, DMA-ing the right ``msg`` row-tile in and the right ``out`` row-tile
+  out;
+- tiles are sorted by row, so the output block stays resident in VMEM across
+  a row's tiles and is zeroed exactly when the row changes (the classic
+  k-loop accumulation pattern).
+
+Dense-tile FLOPs exceed the "true" edge-gather work, but they run on the MXU
+at full tilt instead of serializing through irregular memory traffic; for
+CFG-sized graphs (~40-200 nodes) the tile occupancy is high.
+
+The backward pass is the same kernel over host-pretransposed tiles
+(d msg = Aᵀ @ d out), wired in with ``jax.custom_vjp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@struct.dataclass
+class TileAdjacency:
+    """Sorted block-sparse adjacency plus its transpose (for the VJP).
+
+    vals    : f32[n_nz, tile, tile] — dense tile values, sorted by ``rows``;
+              ``vals[k][i, j]`` = multiplicity of edge (sender s, receiver r)
+              with r = rows[k]*tile + i, s = cols[k]*tile + j.
+    rows    : i32[n_nz] non-decreasing receiver tile indices; every row tile
+              in [0, n_row_tiles) appears at least once (filler zero tiles
+              keep uncovered output rows defined).
+    cols    : i32[n_nz] sender tile indices.
+    t_vals/t_rows/t_cols : the transposed adjacency in the same layout.
+    """
+
+    vals: jnp.ndarray
+    rows: jnp.ndarray
+    cols: jnp.ndarray
+    t_vals: jnp.ndarray
+    t_rows: jnp.ndarray
+    t_cols: jnp.ndarray
+    tile: int = struct.field(pytree_node=False, default=128)
+    n_row_tiles: int = struct.field(pytree_node=False, default=0)
+
+
+def _dense_tiles(rows, cols, data, tile, n_tiles, pad_nz):
+    """Group COO entries into sorted dense tiles with full row coverage."""
+    tr, tc = rows // tile, cols // tile
+    order = np.lexsort((tc, tr))
+    tr, tc = tr[order], tc[order]
+    rows, cols, data = rows[order], cols[order], data[order]
+
+    # Unique (row_tile, col_tile) pairs and the span of edges in each.
+    key = tr.astype(np.int64) * n_tiles + tc
+    uniq, start = np.unique(key, return_index=True)
+    end = np.append(start[1:], len(key))
+
+    out_rows, out_cols, out_vals = [], [], []
+    covered = np.zeros(n_tiles, bool)
+    for u, s, e in zip(uniq, start, end):
+        r, c = int(u // n_tiles), int(u % n_tiles)
+        block = np.zeros((tile, tile), np.float32)
+        np.add.at(block, (rows[s:e] - r * tile, cols[s:e] - c * tile), data[s:e])
+        out_rows.append(r)
+        out_cols.append(c)
+        out_vals.append(block)
+        covered[r] = True
+
+    # Filler zero tiles so every output row tile is visited (and zeroed).
+    for r in np.nonzero(~covered)[0]:
+        out_rows.append(int(r))
+        out_cols.append(int(r))
+        out_vals.append(np.zeros((tile, tile), np.float32))
+
+    order = np.argsort(np.asarray(out_rows), kind="stable")
+    out_rows = np.asarray(out_rows, np.int32)[order]
+    out_cols = np.asarray(out_cols, np.int32)[order]
+    out_vals = np.stack([out_vals[i] for i in order])
+
+    # Pad the tile list to a fixed budget with zero tiles on the last row
+    # (keeps `rows` sorted; adding zeros is inert).
+    n_nz = len(out_rows)
+    if pad_nz < n_nz:
+        raise ValueError(f"tile budget {pad_nz} < {n_nz} nonzero tiles")
+    pad = pad_nz - n_nz
+    if pad:
+        out_rows = np.concatenate([out_rows, np.full(pad, n_tiles - 1, np.int32)])
+        out_cols = np.concatenate([out_cols, np.full(pad, n_tiles - 1, np.int32)])
+        out_vals = np.concatenate(
+            [out_vals, np.zeros((pad, tile, tile), np.float32)]
+        )
+    return out_vals, out_rows, out_cols
+
+
+def _round_up_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def build_tile_adjacency(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    edge_mask: np.ndarray,
+    max_nodes: int,
+    tile: int = 128,
+    pad_nz: Optional[int] = None,
+) -> TileAdjacency:
+    """Host-side: build the sorted dense-tile adjacency for one GraphBatch.
+
+    ``agg[r] = Σ_{(s,r)∈E} msg[s]`` becomes A[r, s] += 1 per edge. ``pad_nz``
+    fixes the tile-count so batches of similar sparsity share one compiled
+    kernel; default rounds to the next power of two.
+    """
+    if max_nodes % tile:
+        raise ValueError(f"max_nodes {max_nodes} not a multiple of tile {tile}")
+    n_tiles = max_nodes // tile
+    s = np.asarray(senders)[np.asarray(edge_mask)].astype(np.int64)
+    r = np.asarray(receivers)[np.asarray(edge_mask)].astype(np.int64)
+    data = np.ones(len(s), np.float32)
+
+    # Worst-case nonzero tile count (before filler/padding) to size budgets.
+    if pad_nz is None:
+        tr, tc = r // tile, s // tile
+        nz = len(np.unique(tr * n_tiles + tc))
+        nz = max(nz, n_tiles)  # filler guarantees one tile per row
+        pad_nz = _round_up_pow2(nz + n_tiles)  # headroom for filler rows
+
+    vals, rows, cols = _dense_tiles(r, s, data, tile, n_tiles, pad_nz)
+    # Aᵀ[s, r] = A[r, s]: swapping the (row, col) roles of each edge when
+    # building tiles yields the transposed adjacency directly.
+    t_vals, t_rows, t_cols = _dense_tiles(s, r, data, tile, n_tiles, pad_nz)
+
+    return TileAdjacency(
+        vals=jnp.asarray(vals),
+        rows=jnp.asarray(rows),
+        cols=jnp.asarray(cols),
+        t_vals=jnp.asarray(t_vals),
+        t_rows=jnp.asarray(t_rows),
+        t_cols=jnp.asarray(t_cols),
+        tile=tile,
+        n_row_tiles=n_tiles,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _spmm_kernel(rows_ref, cols_ref, vals_ref, msg_ref, out_ref):
+    i = pl.program_id(0)
+
+    first = jnp.where(i == 0, True, rows_ref[i] != rows_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(first)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # HIGHEST precision: the kernel is HBM-bound, so the extra MXU passes
+    # that give exact f32 products are free (measured ~1.54ms vs ~1.46ms on
+    # v5e for the 256-graph training shape) and keep parity with the
+    # segment-sum path bit-tight.
+    out_ref[:] += jnp.dot(
+        vals_ref[0].astype(msg_ref.dtype),
+        msg_ref[:],
+        preferred_element_type=out_ref.dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _spmm_pallas(vals, rows, cols, msg, tile, n_row_tiles, interpret):
+    n_nz = vals.shape[0]
+    h = msg.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_nz,),
+        in_specs=[
+            pl.BlockSpec((1, tile, tile), lambda i, rows, cols: (i, 0, 0)),
+            pl.BlockSpec((tile, h), lambda i, rows, cols: (cols[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, h), lambda i, rows, cols: (rows[i], 0)),
+    )
+    return pl.pallas_call(
+        _spmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_row_tiles * tile, h), msg.dtype),
+        interpret=interpret,
+    )(rows, cols, vals, msg)
+
+
+def _spmm_xla(vals, rows, cols, msg, tile, n_row_tiles):
+    """Pure-XLA oracle/fallback: gather msg row-tiles, batched matmul,
+    segment-sum by row tile."""
+    msg_tiles = msg.reshape(n_row_tiles, tile, -1)[cols]
+    prod = jnp.einsum(
+        "krc,kch->krh", vals.astype(msg.dtype), msg_tiles,
+        preferred_element_type=msg.dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out = jax.ops.segment_sum(prod, rows, num_segments=n_row_tiles)
+    return out.reshape(n_row_tiles * tile, -1)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def tile_spmm(adj: TileAdjacency, msg: jnp.ndarray, impl: str = "auto") -> jnp.ndarray:
+    """agg = A @ msg over the block-sparse tiles.
+
+    impl: "pallas" | "xla" | "interpret" | "auto" (pallas on TPU, xla
+    elsewhere). Differentiable in ``msg`` (adjacency is structural).
+    """
+    return _spmm_fwd(adj, msg, impl)[0]
+
+
+def _dispatch(vals, rows, cols, msg, tile, n_row_tiles, impl):
+    if impl == "auto":
+        impl = "pallas" if _use_pallas() else "xla"
+    if impl == "pallas":
+        return _spmm_pallas(vals, rows, cols, msg, tile, n_row_tiles, False)
+    if impl == "interpret":
+        return _spmm_pallas(vals, rows, cols, msg, tile, n_row_tiles, True)
+    if impl == "xla":
+        return _spmm_xla(vals, rows, cols, msg, tile, n_row_tiles)
+    raise ValueError(f"unknown impl {impl!r}")
+
+
+def _spmm_fwd(adj, msg, impl):
+    out = _dispatch(
+        adj.vals, adj.rows, adj.cols, msg, adj.tile, adj.n_row_tiles, impl
+    )
+    return out, adj
+
+
+def _spmm_bwd(impl, adj, g):
+    # d msg = Aᵀ @ g, computed with the same kernel over the transposed tiles.
+    dmsg = _dispatch(
+        adj.t_vals, adj.t_rows, adj.t_cols, g, adj.tile, adj.n_row_tiles, impl
+    )
+    return jax.tree_util.tree_map(jnp.zeros_like, adj), dmsg
+
+
+tile_spmm.defvjp(_spmm_fwd, _spmm_bwd)

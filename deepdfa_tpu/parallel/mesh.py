@@ -92,4 +92,9 @@ def shard_concat(shards: Sequence[GraphBatch]) -> GraphBatch:
         edge_mask=jnp.asarray(cat("edge_mask")),
         graph_mask=jnp.asarray(cat("graph_mask")),
         graph_ids=jnp.asarray(cat("graph_ids")),
+        # The Pallas tile adjacency is per-device state; a concatenated tile
+        # list would not partition along the data axis, so sharded batches
+        # carry no adjacency and models running on them must use
+        # message_impl="segment" (the model raises otherwise).
+        tile_adj=None,
     )
