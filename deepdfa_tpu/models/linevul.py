@@ -55,6 +55,7 @@ class LineVul(nn.Module):
 
     encoder_config: EncoderConfig
     graph_config: Optional[FlowGNNConfig] = None
+    mesh: object = None  # required when encoder_config.attention_impl == "ring"
 
     @nn.compact
     def __call__(
@@ -65,7 +66,9 @@ class LineVul(nn.Module):
         output_attentions: bool = False,
     ):
         attn_mask = input_ids != self.encoder_config.pad_token_id
-        hidden, attentions = RobertaEncoder(self.encoder_config, name="roberta")(
+        hidden, attentions = RobertaEncoder(
+            self.encoder_config, mesh=self.mesh, name="roberta"
+        )(
             input_ids,
             attn_mask,
             deterministic=deterministic,

@@ -40,6 +40,14 @@ class EncoderConfig:
     layer_norm_eps: float = 1e-5
     dropout_rate: float = 0.1
     dtype: str = "float32"
+    # Attention implementation: "dense" (O(T^2), returns weights — required
+    # for line-level localization), "blockwise" (streaming-softmax lax.scan,
+    # O(T) memory), "flash" (Pallas TPU kernel), or "ring" (sequence-parallel
+    # over the mesh's seq axis — the long-context path the reference lacks,
+    # SURVEY §5). Non-dense impls compute exact attention but apply no
+    # attention-probability dropout (standard for fused kernels).
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
 
     @classmethod
     def tiny(cls, vocab_size: int = 128) -> "EncoderConfig":
@@ -68,6 +76,7 @@ class EncoderConfig:
 
 class SelfAttention(nn.Module):
     cfg: EncoderConfig
+    mesh: Any = None  # required for attention_impl="ring" under a dp×sp mesh
 
     @nn.compact
     def __call__(self, x, attn_mask, deterministic):
@@ -82,23 +91,42 @@ class SelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        bias = jnp.where(attn_mask[:, None, None, :], 0.0, -1e9)
-        weights = jax.nn.softmax(scores + bias, axis=-1)
-        weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+        if c.attention_impl == "dense":
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            bias = jnp.where(attn_mask[:, None, None, :], 0.0, -1e9)
+            weights = jax.nn.softmax(scores + bias, axis=-1)
+            weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        elif c.attention_impl in ("blockwise", "flash"):
+            from deepdfa_tpu.ops.attention import attention as attn_fn
+
+            out = attn_fn(q, k, v, kv_mask=attn_mask, impl=c.attention_impl)
+            weights = None
+        elif c.attention_impl == "ring":
+            from deepdfa_tpu.parallel.ring import ring_attention_sharded
+
+            out = ring_attention_sharded(
+                q, k, v, kv_mask=attn_mask, mesh=self.mesh,
+                axis_name=c.seq_axis,
+            )
+            weights = None
+        else:
+            raise ValueError(f"unknown attention_impl {c.attention_impl!r}")
+        out = out.astype(d)
         out = out.reshape(out.shape[0], out.shape[1], c.hidden_size)
         return out, weights
 
 
 class EncoderLayer(nn.Module):
     cfg: EncoderConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, attn_mask, deterministic):
         c = self.cfg
         d = jnp.dtype(c.dtype)
-        attn_out, attn_weights = SelfAttention(c, name="attention")(
+        attn_out, attn_weights = SelfAttention(c, mesh=self.mesh, name="attention")(
             x, attn_mask, deterministic
         )
         attn_out = nn.Dense(c.hidden_size, dtype=d, name="attention_output")(attn_out)
@@ -113,9 +141,12 @@ class EncoderLayer(nn.Module):
 
 
 class RobertaEncoder(nn.Module):
-    """Returns (last_hidden_state, attentions tuple)."""
+    """Returns (last_hidden_state, attentions tuple). ``output_attentions``
+    requires ``attention_impl="dense"`` (fused/ring impls never materialize
+    the T×T weights)."""
 
     cfg: EncoderConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic: bool = True,
@@ -137,9 +168,16 @@ class RobertaEncoder(nn.Module):
         x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="embeddings_ln")(x)
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
 
+        if output_attentions and c.attention_impl != "dense":
+            raise ValueError(
+                "output_attentions needs attention_impl='dense'; "
+                f"got {c.attention_impl!r}"
+            )
         attentions = []
         for i in range(c.num_layers):
-            x, attn = EncoderLayer(c, name=f"layer_{i}")(x, attn_mask, deterministic)
+            x, attn = EncoderLayer(c, mesh=self.mesh, name=f"layer_{i}")(
+                x, attn_mask, deterministic
+            )
             if output_attentions:
                 attentions.append(attn)
         return x, tuple(attentions)

@@ -25,19 +25,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deepdfa_tpu.graphs.batch import GraphBatch
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 def make_mesh(
     n_data: Optional[int] = None,
     n_model: int = 1,
+    n_seq: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
+    """3-axis mesh (data, seq, model): dp over ``data``, ring/sequence
+    parallelism over ``seq`` (ICI neighbors), tensor parallelism over
+    ``model``. Unused axes have size 1 and cost nothing."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        n_data = len(devices) // n_model
-    use = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
-    return Mesh(use, (DATA_AXIS, MODEL_AXIS))
+        n_data = len(devices) // (n_model * n_seq)
+    use = np.asarray(devices[: n_data * n_seq * n_model]).reshape(
+        n_data, n_seq, n_model
+    )
+    return Mesh(use, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,113 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+The reference has no sequence parallelism at all — every input is truncated
+to 512 tokens (SURVEY §5 "Long-context"). Here sequences shard over a
+``seq`` mesh axis: each device holds a ``[B, T/n, H, D]`` slice of q/k/v,
+and KV slices rotate around the ICI ring via ``ppermute`` while each device
+folds the arriving chunk into its streaming-softmax state
+(deepdfa_tpu/ops/attention.py). After ``n`` steps every query has attended
+to every key — exact softmax attention with O(T/n) memory per device and
+communication overlapped against the per-chunk matmuls by XLA's latency
+hiding scheduler.
+
+Two entry points:
+  - :func:`ring_attention` — the per-shard collective body; call inside
+    ``shard_map``/``pjit`` manual code with a named ``seq`` axis.
+  - :func:`ring_attention_sharded` — wraps a global ``[B, T, H, D]`` array
+    in ``jax.shard_map`` (manual only over the seq axis; batch/model axes
+    stay under GSPMD auto partitioning).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepdfa_tpu.ops import attention as A
+
+SEQ_AXIS = "seq"
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    axis_name: str = SEQ_AXIS,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """Per-shard ring attention. Arrays are the local sequence shard
+    ``[B, Ts, H, D]`` (mask ``[B, Ts]``); must run under a mesh with
+    ``axis_name`` manual (shard_map)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, ts, h, d = q.shape
+    qs = q  # scaling happens inside blockwise_attention
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    mask = kv_mask if kv_mask is not None else jnp.ones((b, ts), bool)
+
+    def body(i, carry):
+        kk, vv, mm, state = carry
+        # After i rotations each device holds the KV slice that originated
+        # on shard (idx - i) mod n; its global offset positions the causal
+        # comparison.
+        src = jax.lax.rem(idx - i + n, n)
+        state = A.blockwise_attention(
+            qs, kk, vv, kv_mask=mm, causal=causal,
+            q_offset=idx * ts, kv_offset=src * ts,
+            block_size=block_size, state=state, return_state=True,
+        )
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        mm = jax.lax.ppermute(mm, axis_name, perm)
+        return kk, vv, mm, state
+
+    state = A.init_state(b, ts, h, d)
+    # n is a static mesh property, so unroll: each step's ppermute overlaps
+    # with the next step's compute under XLA's scheduler.
+    carry = (k, v, mask, state)
+    for i in range(n):
+        carry = body(i, carry)
+    _, _, _, state = carry
+    return A.finalize_state(state, dtype=q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    mesh=None,
+    axis_name: str = SEQ_AXIS,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """Global-view ring attention: shards ``[B, T, H, D]`` over ``axis_name``
+    and runs :func:`ring_attention` manually on each shard. Other mesh axes
+    (data/model) remain auto-partitioned by GSPMD, so this composes with a
+    dp×sp mesh inside one ``jit``."""
+    spec_qkv = P(None, axis_name)
+    spec_mask = P(None, axis_name)
+
+    fn = partial(ring_attention, causal=causal, axis_name=axis_name,
+                 block_size=block_size)
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
+        out_specs=spec_qkv,
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    # Partial-manual shard_map (axis_names ⊂ mesh axes) only traces under
+    # jit; the jit wrapper inlines when an outer jit is already tracing and
+    # covers eager callers (e.g. Flax model.init).
+    return jax.jit(mapped)(q, k, v, kv_mask)

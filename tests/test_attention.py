@@ -1,0 +1,183 @@
+"""Long-context attention: blockwise / flash / ring vs the dense oracle.
+
+Ring tests run on the virtual 8-device CPU mesh (conftest) — same program
+and collectives as the TPU ICI ring, CPU execution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.ops.attention import (
+    attention,
+    blockwise_attention,
+    dense_attention,
+    flash_attention,
+)
+
+
+def _rand(b=2, tq=64, tk=64, h=2, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, tq, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, tk, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, tk, h, d), jnp.float32)
+    lengths = rng.randint(tk // 2, tk + 1, size=b)
+    mask = jnp.asarray(np.arange(tk)[None, :] < lengths[:, None])
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [16, 24, 64])
+def test_blockwise_matches_dense(causal, block):
+    q, k, v, mask = _rand()
+    ref = dense_attention(q, k, v, kv_mask=mask, causal=causal)
+    out = blockwise_attention(q, k, v, kv_mask=mask, causal=causal, block_size=block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_blockwise_no_mask():
+    q, k, v, _ = _rand(tk=48)
+    ref = dense_attention(q, k, v)
+    out = blockwise_attention(q, k, v, block_size=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_blockwise_gradients_match_dense():
+    q, k, v, mask = _rand(tq=32, tk=32)
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v, kv_mask=mask)
+            return (out * out).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_ref = loss(dense_attention)
+    g_blk = loss(lambda *a, **kw: blockwise_attention(*a, block_size=8, **kw))
+    for a, b in zip(g_ref, g_blk):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_matches_dense(causal):
+    # interpret mode on CPU covers the Pallas kernel math
+    q, k, v, mask = _rand(tq=32, tk=32)
+    ref = dense_attention(q, k, v, kv_mask=mask, causal=causal)
+    out = flash_attention(q, k, v, kv_mask=mask, causal=causal,
+                          block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_gradients_via_blockwise_bwd():
+    q, k, v, mask = _rand(tq=16, tk=16)
+
+    def f(fn, q, k, v):
+        return (fn(q, k, v) ** 2).sum()
+
+    g_ref = jax.grad(lambda q, k, v: f(
+        lambda *a: dense_attention(*a, kv_mask=mask), q, k, v), (0, 1, 2))(q, k, v)
+    g_fl = jax.grad(lambda q, k, v: f(
+        lambda *a: flash_attention(*a, kv_mask=mask, block_q=8, block_k=8),
+        q, k, v), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_dispatch():
+    q, k, v, mask = _rand(tq=16, tk=16)
+    ref = dense_attention(q, k, v, kv_mask=mask)
+    for impl in ("blockwise", "flash", "auto"):
+        out = attention(q, k, v, kv_mask=mask, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(causal):
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh(n_data=2, n_seq=4)
+    q, k, v, mask = _rand(b=4, tq=64, tk=64, h=2, d=8)
+    ref = dense_attention(q, k, v, kv_mask=mask, causal=causal)
+
+    out = jax.jit(
+        lambda q, k, v, m: ring_attention_sharded(
+            q, k, v, kv_mask=m, causal=causal, mesh=mesh, block_size=16
+        )
+    )(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_gradients_match_dense():
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh(n_data=1, n_seq=8)
+    q, k, v, mask = _rand(b=2, tq=64, tk=64)
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_ref = loss(lambda q, k, v: dense_attention(q, k, v, kv_mask=mask))
+    g_ring = loss(
+        jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, kv_mask=mask, mesh=mesh, block_size=8))
+    )
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_encoder_blockwise_matches_dense():
+    import dataclasses
+
+    from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+
+    cfg = EncoderConfig.tiny()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(2, cfg.vocab_size, size=(2, 64)))
+
+    enc_d = RobertaEncoder(cfg)
+    params = enc_d.init(jax.random.PRNGKey(0), ids)
+    ref, _ = enc_d.apply(params, ids)
+
+    cfg_b = dataclasses.replace(cfg, attention_impl="blockwise")
+    out, _ = RobertaEncoder(cfg_b).apply(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_encoder_ring_matches_dense():
+    import dataclasses
+
+    from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+    from deepdfa_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_data=2, n_seq=4)
+    cfg = EncoderConfig.tiny()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(2, cfg.vocab_size, size=(4, 64)))
+
+    enc_d = RobertaEncoder(cfg)
+    params = enc_d.init(jax.random.PRNGKey(0), ids)
+    ref, _ = enc_d.apply(params, ids)
+
+    cfg_r = dataclasses.replace(cfg, attention_impl="ring")
+    enc_r = RobertaEncoder(cfg_r, mesh=mesh)
+    out = jax.jit(lambda p, i: enc_r.apply(p, i)[0])(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_output_attentions_requires_dense():
+    import dataclasses
+
+    from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+
+    cfg = dataclasses.replace(EncoderConfig.tiny(), attention_impl="flash")
+    ids = jnp.ones((1, 16), jnp.int32) * 5
+    with pytest.raises(ValueError, match="output_attentions"):
+        RobertaEncoder(cfg).init(
+            jax.random.PRNGKey(0), ids, output_attentions=True
+        )
