@@ -101,20 +101,77 @@ class ReachingDefinitions:
             out |= g
         return out
 
-    def solve(self) -> Tuple[Dict[int, FrozenSet[Definition]], Dict[int, FrozenSet[Definition]]]:
-        """Return (in_sets, out_sets) at the fixpoint.
-
-        Standard forward may-analysis: IN[n] = ∪ OUT[p]; OUT[n] = GEN[n] ∪
-        (IN[n] − KILL[n]) where KILL[n] is every *other* definition of the
-        variable n defines (dataflow.py:146-177).
-        """
+    def _cfg_node_list(self) -> List[int]:
         # Only nodes incident to a CFG edge, matching the reference's
         # edge-subgraph worklist (dataflow.py:156 iterates self.cfg.nodes()
         # of an nx.edge_subgraph).
-        cfg_nodes = sorted(
+        return sorted(
             {n for n, succs in self._cfg_succ.items() if succs}
             | {n for n, preds in self._cfg_pred.items() if preds}
         )
+
+    def solve(
+        self, backend: str = "auto"
+    ) -> Tuple[Dict[int, FrozenSet[Definition]], Dict[int, FrozenSet[Definition]]]:
+        """Return (in_sets, out_sets) at the fixpoint.
+
+        ``backend``: "native" (C++ bitset worklist, deepdfa_tpu/native),
+        "python" (this module — the oracle), or "auto" (native when it
+        builds, else python). Both produce identical sets: the fixpoint of
+        this monotone system is unique.
+        """
+        if backend not in ("auto", "native", "python"):
+            raise ValueError(f"unknown backend {backend!r}")
+        if backend in ("auto", "native"):
+            try:
+                return self._solve_native()
+            except RuntimeError:
+                if backend == "native":
+                    raise
+        return self._solve_python()
+
+    def _solve_native(self):
+        import numpy as np
+
+        from deepdfa_tpu import native
+
+        cfg_nodes = self._cfg_node_list()
+        idx = {n: i for i, n in enumerate(cfg_nodes)}
+        var_ids: Dict[str, int] = {}
+        gen_var = np.full(len(cfg_nodes), -1, np.int32)
+        for n in cfg_nodes:
+            var = self._assigned[n]
+            if var is not None:
+                gen_var[idx[n]] = var_ids.setdefault(var, len(var_ids))
+
+        def csr(adj):
+            indptr = np.zeros(len(cfg_nodes) + 1, np.int32)
+            indices = []
+            for i, n in enumerate(cfg_nodes):
+                nbrs = [idx[m] for m in adj.get(n, []) if m in idx]
+                indices.extend(nbrs)
+                indptr[i + 1] = len(indices)
+            return indptr, np.asarray(indices, np.int32)
+
+        s_ptr, s_idx = csr(self._cfg_succ)
+        p_ptr, p_idx = csr(self._cfg_pred)
+        in_defs, out_defs = native.solve_reaching(
+            len(cfg_nodes), s_ptr, s_idx, p_ptr, p_idx, gen_var
+        )
+
+        def to_sets(per_node):
+            out: Dict[int, FrozenSet[Definition]] = {}
+            for i, n in enumerate(cfg_nodes):
+                out[n] = frozenset(
+                    Definition(self._assigned[cfg_nodes[d]], cfg_nodes[d])
+                    for d in per_node[i]
+                )
+            return out
+
+        return to_sets(in_defs), to_sets(out_defs)
+
+    def _solve_python(self):
+        cfg_nodes = self._cfg_node_list()
         out_sets: Dict[int, FrozenSet[Definition]] = {n: frozenset() for n in cfg_nodes}
         in_sets: Dict[int, FrozenSet[Definition]] = {n: frozenset() for n in cfg_nodes}
         work = deque(cfg_nodes)

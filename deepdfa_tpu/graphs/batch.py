@@ -126,56 +126,86 @@ def batch_graphs(
     build_tile_adj: bool = False,
     tile: int = 128,
     tile_pad_nz: Optional[int] = None,
+    impl: str = "auto",
 ) -> "GraphBatch":
-    """Pack up to ``n_graphs`` graphs into one padded batch (host-side, numpy).
+    """Pack up to ``n_graphs`` graphs into one padded batch (host-side).
 
     Each graph mapping needs: ``num_nodes``, ``senders``, ``receivers``,
     ``vuln`` (int[num_nodes]), ``feats`` (dict subkey -> int[num_nodes]), and
     optionally ``id``. Graphs that would overflow the node/edge budget raise —
     callers size budgets with :func:`pad_budget_for` or spill to the next
     batch upstream.
+
+    ``impl``: "native" (C++ batcher, deepdfa_tpu/native — the production
+    input-pipeline path), "python" (numpy loop — the oracle), or "auto".
     """
     if len(graphs) > n_graphs:
         raise ValueError(f"{len(graphs)} graphs > {n_graphs} slots")
 
-    feats = {k: np.zeros(max_nodes, np.int32) for k in subkeys}
-    vuln = np.zeros(max_nodes, np.int32)
-    senders = np.zeros(max_edges, np.int32)
-    receivers = np.zeros(max_edges, np.int32)
-    node_graph = np.zeros(max_nodes, np.int32)
-    node_mask = np.zeros(max_nodes, bool)
-    edge_mask = np.zeros(max_edges, bool)
     graph_mask = np.zeros(n_graphs, bool)
     graph_ids = np.full(n_graphs, -1, np.int64)
-
-    node_off = 0
-    edge_off = 0
     for gi, g in enumerate(graphs):
-        n = int(g["num_nodes"])
-        s = np.asarray(g["senders"], np.int32)
-        r = np.asarray(g["receivers"], np.int32)
-        if add_self_loops:
-            loops = np.arange(n, dtype=np.int32)
-            s = np.concatenate([s, loops])
-            r = np.concatenate([r, loops])
-        e = len(s)
-        if node_off + n > max_nodes or edge_off + e > max_edges:
-            raise ValueError(
-                f"graph {gi} overflows budget "
-                f"(nodes {node_off}+{n}/{max_nodes}, edges {edge_off}+{e}/{max_edges})"
-            )
-        for k in subkeys:
-            feats[k][node_off : node_off + n] = np.asarray(g["feats"][k], np.int32)
-        vuln[node_off : node_off + n] = np.asarray(g["vuln"], np.int32)
-        senders[edge_off : edge_off + e] = s + node_off
-        receivers[edge_off : edge_off + e] = r + node_off
-        node_graph[node_off : node_off + n] = gi
-        node_mask[node_off : node_off + n] = True
-        edge_mask[edge_off : edge_off + e] = True
         graph_mask[gi] = True
         graph_ids[gi] = int(g.get("id", gi))
-        node_off += n
-        edge_off += e
+
+    if impl not in ("auto", "native", "python"):
+        raise ValueError(f"unknown impl {impl!r}")
+    use_native = False
+    if impl in ("auto", "native"):
+        from deepdfa_tpu import native as _native
+
+        use_native = _native.available()
+        if impl == "native" and not use_native:
+            raise RuntimeError(f"native batcher unavailable: {_native.build_error()}")
+
+    if use_native:
+        from deepdfa_tpu import native as _native
+
+        arrs = _native.fill_batch(
+            graphs, n_graphs, max_nodes, max_edges, subkeys, add_self_loops
+        )
+        feats = {k: arrs["feats"][ki] for ki, k in enumerate(subkeys)}
+        vuln = arrs["vuln"]
+        senders = arrs["senders"]
+        receivers = arrs["receivers"]
+        node_graph = arrs["node_graph"]
+        node_mask = arrs["node_mask"].astype(bool)
+        edge_mask = arrs["edge_mask"].astype(bool)
+    else:
+        feats = {k: np.zeros(max_nodes, np.int32) for k in subkeys}
+        vuln = np.zeros(max_nodes, np.int32)
+        senders = np.zeros(max_edges, np.int32)
+        receivers = np.zeros(max_edges, np.int32)
+        node_graph = np.zeros(max_nodes, np.int32)
+        node_mask = np.zeros(max_nodes, bool)
+        edge_mask = np.zeros(max_edges, bool)
+
+        node_off = 0
+        edge_off = 0
+        for gi, g in enumerate(graphs):
+            n = int(g["num_nodes"])
+            s = np.asarray(g["senders"], np.int32)
+            r = np.asarray(g["receivers"], np.int32)
+            if add_self_loops:
+                loops = np.arange(n, dtype=np.int32)
+                s = np.concatenate([s, loops])
+                r = np.concatenate([r, loops])
+            e = len(s)
+            if node_off + n > max_nodes or edge_off + e > max_edges:
+                raise ValueError(
+                    f"graph {gi} overflows budget "
+                    f"(nodes {node_off}+{n}/{max_nodes}, edges {edge_off}+{e}/{max_edges})"
+                )
+            for k in subkeys:
+                feats[k][node_off : node_off + n] = np.asarray(g["feats"][k], np.int32)
+            vuln[node_off : node_off + n] = np.asarray(g["vuln"], np.int32)
+            senders[edge_off : edge_off + e] = s + node_off
+            receivers[edge_off : edge_off + e] = r + node_off
+            node_graph[node_off : node_off + n] = gi
+            node_mask[node_off : node_off + n] = True
+            edge_mask[edge_off : edge_off + e] = True
+            node_off += n
+            edge_off += e
 
     tile_adj = None
     if build_tile_adj:

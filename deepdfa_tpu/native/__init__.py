@@ -1,0 +1,226 @@
+"""Native (C++) runtime components, built on demand with g++ and bound via
+ctypes.
+
+The reference's runtime leans on native code throughout — DGL's C++ graph
+batching kernels, Joern's Scala dataflow solver (SURVEY §2.2 N1/N4). The
+TPU rebuild keeps that split: JAX/XLA/Pallas own the accelerator, and the
+host-side hot paths live here:
+
+- ``reachdef.cpp``   — bitset worklist reaching-definitions solver
+  (production path; the pure-Python ``etl/reaching.py`` is the oracle)
+- ``batcher.cpp``    — padded graph batch assembly feeding the device
+
+Build: one shared library compiled from every ``src/*.cpp`` on first use,
+cached under ``_build/`` keyed by a source+flags hash. No pybind11 (not in
+the image): plain ``extern "C"`` + ctypes. If no C++ toolchain is available
+the callers fall back to their Python implementations (``available()``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_SRC_DIR = Path(__file__).resolve().parent / "src"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_CXX = os.environ.get("CXX", "g++")
+_CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    h.update(" ".join([_CXX] + _CXXFLAGS).encode())
+    for src in sorted(_SRC_DIR.glob("*.cpp")):
+        h.update(src.name.encode())
+        h.update(src.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _build() -> Path:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    out = _BUILD_DIR / f"libdeepdfa_native_{_source_hash()}.so"
+    if out.exists():
+        return out
+    sources = sorted(str(p) for p in _SRC_DIR.glob("*.cpp"))
+    tmp = out.with_suffix(f".so.tmp{os.getpid()}")  # unique per builder
+    cmd = [_CXX, *_CXXFLAGS, "-o", str(tmp), *sources]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_error is not None:
+            raise RuntimeError(_lib_error)
+        try:
+            lib = ctypes.CDLL(str(_build()))
+        except Exception as e:  # toolchain missing, build error, bad .so
+            _lib_error = str(e)
+            raise RuntimeError(_lib_error) from e
+
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+        lib.reachdef_words.restype = ctypes.c_int32
+        lib.reachdef_words.argtypes = [ctypes.c_int32, i32p]
+        lib.reachdef_solve.restype = None
+        lib.reachdef_solve.argtypes = [
+            ctypes.c_int32, i32p, i32p, i32p, i32p, i32p, u64p, u64p,
+            ctypes.c_int32,
+        ]
+        lib.batch_fill.restype = ctypes.c_int32
+        lib.batch_fill.argtypes = [
+            ctypes.c_int32, i32p, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p, i32p, i32p, i32p, u8p, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library loads (builds) on this host."""
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def build_error() -> Optional[str]:
+    if _lib is None and _lib_error is None:
+        available()
+    return _lib_error
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions
+# ---------------------------------------------------------------------------
+
+def solve_reaching(
+    n: int,
+    succ_indptr: np.ndarray,
+    succ_indices: np.ndarray,
+    pred_indptr: np.ndarray,
+    pred_indices: np.ndarray,
+    gen_var: np.ndarray,
+) -> Tuple[List[List[int]], List[List[int]]]:
+    """Run the C++ solver over a dense-indexed CFG.
+
+    ``gen_var[i]`` is the interned variable id node i defines (-1 if none).
+    Returns (in_defs, out_defs): per node, the sorted list of *defining node
+    indices* whose definitions reach it.
+    """
+    lib = _load()
+    gen_var = np.ascontiguousarray(gen_var, np.int32)
+    words = int(lib.reachdef_words(n, gen_var)) if n else 1
+    in_bits = np.zeros((max(n, 1), words), np.uint64)
+    out_bits = np.zeros((max(n, 1), words), np.uint64)
+    if n:
+        lib.reachdef_solve(
+            n,
+            np.ascontiguousarray(succ_indptr, np.int32),
+            np.ascontiguousarray(succ_indices, np.int32),
+            np.ascontiguousarray(pred_indptr, np.int32),
+            np.ascontiguousarray(pred_indices, np.int32),
+            gen_var,
+            in_bits,
+            out_bits,
+            words,
+        )
+    def_nodes = np.flatnonzero(gen_var >= 0)
+
+    def unpack(bits: np.ndarray) -> List[List[int]]:
+        # [n, words] uint64 -> per-node defining-node index lists
+        u8 = bits.view(np.uint8)
+        expanded = np.unpackbits(u8, axis=1, bitorder="little")
+        out = []
+        for i in range(n):
+            ranks = np.flatnonzero(expanded[i, : len(def_nodes)])
+            out.append(def_nodes[ranks].tolist())
+        return out
+
+    return unpack(in_bits)[:n], unpack(out_bits)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Graph batching
+# ---------------------------------------------------------------------------
+
+def fill_batch(
+    graphs,
+    n_graphs: int,
+    max_nodes: int,
+    max_edges: int,
+    subkeys,
+    add_self_loops: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Assemble the padded batch arrays for ``graphs`` natively.
+
+    Same contract as the Python loop in graphs/batch.py:batch_graphs; raises
+    ValueError on budget overflow with the same message shape.
+    """
+    lib = _load()
+    num_nodes = np.array([int(g["num_nodes"]) for g in graphs], np.int32)
+    num_edges = np.array([len(g["senders"]) for g in graphs], np.int32)
+    cat = lambda key, dt: (
+        np.concatenate([np.asarray(g[key], dt) for g in graphs])
+        if graphs else np.zeros(0, dt)
+    )
+    senders_cat = cat("senders", np.int32)
+    receivers_cat = cat("receivers", np.int32)
+    vuln_cat = cat("vuln", np.int32)
+    total_nodes = int(num_nodes.sum())
+    feats_cat = np.zeros((len(subkeys), total_nodes), np.int32)
+    for ki, k in enumerate(subkeys):
+        off = 0
+        for g in graphs:
+            n = int(g["num_nodes"])
+            feats_cat[ki, off : off + n] = np.asarray(g["feats"][k], np.int32)
+            off += n
+
+    out = {
+        "feats": np.zeros((len(subkeys), max_nodes), np.int32),
+        "vuln": np.zeros(max_nodes, np.int32),
+        "senders": np.zeros(max_edges, np.int32),
+        "receivers": np.zeros(max_edges, np.int32),
+        "node_graph": np.zeros(max_nodes, np.int32),
+        "node_mask": np.zeros(max_nodes, np.uint8),
+        "edge_mask": np.zeros(max_edges, np.uint8),
+    }
+    rc = lib.batch_fill(
+        len(graphs), num_nodes, num_edges, senders_cat, receivers_cat,
+        vuln_cat, feats_cat, len(subkeys), int(add_self_loops),
+        max_nodes, max_edges,
+        out["feats"], out["vuln"], out["senders"], out["receivers"],
+        out["node_graph"], out["node_mask"], out["edge_mask"],
+    )
+    if rc < 0:
+        gi = -rc - 1
+        node_off = int(num_nodes[:gi].sum())
+        edge_off = int((num_edges[:gi] + (num_nodes[:gi] if add_self_loops else 0)).sum())
+        e = int(num_edges[gi]) + (int(num_nodes[gi]) if add_self_loops else 0)
+        raise ValueError(
+            f"graph {gi} overflows budget "
+            f"(nodes {node_off}+{num_nodes[gi]}/{max_nodes}, "
+            f"edges {edge_off}+{e}/{max_edges})"
+        )
+    return out

@@ -1,0 +1,106 @@
+// Native worklist reaching-definitions solver.
+//
+// The reference gets its training-time dataflow solutions from Joern's
+// Scala ReachingDefProblem solver (DDFA/storage/external/get_dataflow_output.sc:37-55)
+// and keeps a pure-Python checker (DDFA/code_gnn/analysis/dataflow.py:103-181).
+// This is the TPU-native framework's production solver: a C++ bitset
+// worklist over the CFG, bit-identical to the Python oracle in
+// deepdfa_tpu/etl/reaching.py (the fixpoint of a monotone union/mask system
+// is unique, so agreement is exact, not approximate).
+//
+// Graph encoding (prepared by the Python caller):
+//   n            dense CFG node count (0..n-1)
+//   succ/pred    CSR adjacency (indptr int32[n+1], indices int32[m])
+//   gen_var[i]   variable id this node defines, or -1 (identity of a
+//                definition is its node index; variable ids are interned
+//                strings)
+// Outputs: packed uint64 bitsets, `words` words per node, definition d's
+// bit is (rank of d among gen nodes) — in_bits/out_bits are the IN/OUT sets
+// of the fixpoint.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+int32_t reachdef_words(int32_t n_nodes, const int32_t* gen_var) {
+  int32_t ndefs = 0;
+  for (int32_t i = 0; i < n_nodes; ++i) ndefs += gen_var[i] >= 0;
+  return ndefs ? (ndefs + 63) / 64 : 1;
+}
+
+void reachdef_solve(int32_t n,
+                    const int32_t* succ_indptr, const int32_t* succ_indices,
+                    const int32_t* pred_indptr, const int32_t* pred_indices,
+                    const int32_t* gen_var,
+                    uint64_t* in_bits, uint64_t* out_bits, int32_t words) {
+  // Definition rank per node (-1 if the node defines nothing).
+  std::vector<int32_t> def_rank(n, -1);
+  int32_t ndefs = 0;
+  int32_t max_var = -1;
+  for (int32_t i = 0; i < n; ++i) {
+    if (gen_var[i] >= 0) {
+      def_rank[i] = ndefs++;
+      if (gen_var[i] > max_var) max_var = gen_var[i];
+    }
+  }
+
+  // Per-variable kill mask: every definition of that variable.
+  std::vector<uint64_t> var_mask((size_t)(max_var + 1) * words, 0);
+  for (int32_t i = 0; i < n; ++i) {
+    if (gen_var[i] >= 0) {
+      uint64_t* m = var_mask.data() + (size_t)gen_var[i] * words;
+      m[def_rank[i] >> 6] |= 1ull << (def_rank[i] & 63);
+    }
+  }
+
+  std::memset(in_bits, 0, (size_t)n * words * sizeof(uint64_t));
+  std::memset(out_bits, 0, (size_t)n * words * sizeof(uint64_t));
+
+  // FIFO worklist seeded with every node in index order (matches the
+  // Python deque; the fixpoint is order-independent anyway).
+  std::vector<int32_t> queue(n);
+  std::vector<uint8_t> queued(n, 1);
+  for (int32_t i = 0; i < n; ++i) queue[i] = i;
+  size_t head = 0;
+
+  std::vector<uint64_t> in_n(words), out_n(words);
+  while (head < queue.size()) {
+    int32_t u = queue[head++];
+    queued[u] = 0;
+
+    // IN[u] = union of OUT[p]
+    std::memset(in_n.data(), 0, words * sizeof(uint64_t));
+    for (int32_t e = pred_indptr[u]; e < pred_indptr[u + 1]; ++e) {
+      const uint64_t* po = out_bits + (size_t)pred_indices[e] * words;
+      for (int32_t w = 0; w < words; ++w) in_n[w] |= po[w];
+    }
+    std::memcpy(in_bits + (size_t)u * words, in_n.data(),
+                words * sizeof(uint64_t));
+
+    // OUT[u] = GEN[u] | (IN[u] \ KILL[u]); KILL = other defs of u's var.
+    if (gen_var[u] >= 0) {
+      const uint64_t* vm = var_mask.data() + (size_t)gen_var[u] * words;
+      for (int32_t w = 0; w < words; ++w) out_n[w] = in_n[w] & ~vm[w];
+      out_n[def_rank[u] >> 6] |= 1ull << (def_rank[u] & 63);
+    } else {
+      std::memcpy(out_n.data(), in_n.data(), words * sizeof(uint64_t));
+    }
+
+    uint64_t* uo = out_bits + (size_t)u * words;
+    bool changed = std::memcmp(uo, out_n.data(), words * sizeof(uint64_t)) != 0;
+    if (changed) {
+      std::memcpy(uo, out_n.data(), words * sizeof(uint64_t));
+      for (int32_t e = succ_indptr[u]; e < succ_indptr[u + 1]; ++e) {
+        int32_t s = succ_indices[e];
+        if (!queued[s]) {
+          queued[s] = 1;
+          queue.push_back(s);
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"

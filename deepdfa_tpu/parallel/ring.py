@@ -28,8 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepdfa_tpu.ops import attention as A
-
-SEQ_AXIS = "seq"
+from deepdfa_tpu.parallel.mesh import SEQ_AXIS
 
 
 def ring_attention(

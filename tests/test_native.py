@@ -1,0 +1,174 @@
+"""C++ native runtime: reaching-defs solver and graph batcher vs the Python
+oracles."""
+
+import numpy as np
+import pytest
+
+from joern_fixture import EDGES, NODES
+
+from deepdfa_tpu import native
+from deepdfa_tpu.etl.cpg import from_joern_json
+from deepdfa_tpu.etl.reaching import ReachingDefinitions
+from deepdfa_tpu.graphs.batch import batch_graphs
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native build unavailable: {native.build_error()}"
+)
+
+
+def test_native_builds():
+    assert native.available()
+
+
+def test_reaching_parity_on_fixture():
+    rd = ReachingDefinitions(from_joern_json(NODES, EDGES))
+    in_py, out_py = rd.solve(backend="python")
+    in_nat, out_nat = rd.solve(backend="native")
+    assert in_py == in_nat
+    assert out_py == out_nat
+    # and at least one nonempty set so the test has teeth
+    assert any(in_py.values())
+
+
+def _random_cfg(rng, n, n_vars, p_edge=0.15, p_def=0.6):
+    """Random dense-indexed CFG + gen_var table, plus a python reference."""
+    gen_var = np.full(n, -1, np.int32)
+    for i in range(n):
+        if rng.rand() < p_def:
+            gen_var[i] = rng.randint(n_vars)
+    edges = [
+        (i, j)
+        for i in range(n)
+        for j in range(n)
+        if i != j and rng.rand() < p_edge
+    ]
+
+    def csr(pairs, key):
+        indptr = np.zeros(n + 1, np.int32)
+        buckets = [[] for _ in range(n)]
+        for s, d in pairs:
+            buckets[s if key == "out" else d].append(d if key == "out" else s)
+        indices = []
+        for i in range(n):
+            indices.extend(buckets[i])
+            indptr[i + 1] = len(indices)
+        return indptr, np.asarray(indices, np.int32)
+
+    s_ptr, s_idx = csr(edges, "out")
+    p_ptr, p_idx = csr(edges, "in")
+    return gen_var, (s_ptr, s_idx), (p_ptr, p_idx), edges
+
+
+def _python_fixpoint(n, edges, gen_var):
+    from collections import deque
+
+    preds = {i: [] for i in range(n)}
+    succs = {i: [] for i in range(n)}
+    for s, d in edges:
+        preds[d].append(s)
+        succs[s].append(d)
+    in_s = {i: frozenset() for i in range(n)}
+    out_s = {i: frozenset() for i in range(n)}
+    work = deque(range(n))
+    queued = set(range(n))
+    while work:
+        u = work.popleft()
+        queued.discard(u)
+        i_u = frozenset().union(*(out_s[p] for p in preds[u])) if preds[u] else frozenset()
+        in_s[u] = i_u
+        if gen_var[u] >= 0:
+            o_u = frozenset({u}) | frozenset(
+                d for d in i_u if not (gen_var[d] == gen_var[u] and d != u)
+            )
+        else:
+            o_u = i_u
+        if o_u != out_s[u]:
+            out_s[u] = o_u
+            for s in succs[u]:
+                if s not in queued:
+                    work.append(s)
+                    queued.add(s)
+    return in_s, out_s
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_reaching_random_graphs(seed):
+    rng = np.random.RandomState(seed)
+    n = rng.randint(5, 120)
+    gen_var, (s_ptr, s_idx), (p_ptr, p_idx), edges = _random_cfg(
+        rng, n, n_vars=rng.randint(1, 8)
+    )
+    in_nat, out_nat = native.solve_reaching(n, s_ptr, s_idx, p_ptr, p_idx, gen_var)
+    in_ref, out_ref = _python_fixpoint(n, edges, gen_var)
+    for i in range(n):
+        assert set(in_nat[i]) == set(in_ref[i]), i
+        assert set(out_nat[i]) == set(out_ref[i]), i
+
+
+def test_reaching_many_defs_multiword_bitset():
+    # >64 definitions forces multiple uint64 words per set
+    rng = np.random.RandomState(7)
+    n = 150
+    gen_var, (s_ptr, s_idx), (p_ptr, p_idx), edges = _random_cfg(
+        rng, n, n_vars=100, p_def=0.95, p_edge=0.05
+    )
+    assert (gen_var >= 0).sum() > 64
+    in_nat, _ = native.solve_reaching(n, s_ptr, s_idx, p_ptr, p_idx, gen_var)
+    in_ref, _ = _python_fixpoint(n, edges, gen_var)
+    for i in range(n):
+        assert set(in_nat[i]) == set(in_ref[i]), i
+
+
+def _random_graphs(rng, count, subkeys):
+    out = []
+    for i in range(count):
+        n = rng.randint(1, 12)
+        e = rng.randint(0, 20)
+        out.append(
+            {
+                "id": 100 + i,
+                "num_nodes": n,
+                "senders": rng.randint(0, n, size=e).astype(np.int32),
+                "receivers": rng.randint(0, n, size=e).astype(np.int32),
+                "vuln": rng.randint(0, 2, size=n).astype(np.int32),
+                "feats": {k: rng.randint(0, 50, size=n).astype(np.int32) for k in subkeys},
+            }
+        )
+    return out
+
+
+@pytest.mark.parametrize("add_self_loops", [True, False])
+def test_batcher_parity(add_self_loops):
+    subkeys = ["api", "datatype", "literal", "operator"]
+    rng = np.random.RandomState(0)
+    graphs = _random_graphs(rng, 6, subkeys)
+    kw = dict(
+        n_graphs=8, max_nodes=128, max_edges=256, subkeys=subkeys,
+        add_self_loops=add_self_loops,
+    )
+    py = batch_graphs(graphs, impl="python", **kw)
+    nat = batch_graphs(graphs, impl="native", **kw)
+    for field in ("node_vuln", "senders", "receivers", "node_graph",
+                  "node_mask", "edge_mask", "graph_mask", "graph_ids"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(py, field)), np.asarray(getattr(nat, field)), field
+        )
+    for k in subkeys:
+        np.testing.assert_array_equal(
+            np.asarray(py.node_feats[k]), np.asarray(nat.node_feats[k]), k
+        )
+
+
+def test_batcher_overflow_matches():
+    subkeys = ["a"]
+    g = {
+        "num_nodes": 10,
+        "senders": np.zeros(5, np.int32),
+        "receivers": np.zeros(5, np.int32),
+        "vuln": np.zeros(10, np.int32),
+        "feats": {"a": np.zeros(10, np.int32)},
+    }
+    for impl in ("python", "native"):
+        with pytest.raises(ValueError, match="overflows budget"):
+            batch_graphs([g, g], 2, max_nodes=16, max_edges=64,
+                         subkeys=subkeys, impl=impl)
