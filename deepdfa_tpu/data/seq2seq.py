@@ -1,0 +1,168 @@
+"""Seq2seq generation-task datasets (the CodeT5 capability surface beyond
+defect classification).
+
+Reader parity with the reference (CodeT5/_utils.py):
+  - summarize: jsonl, source = joined ``code_tokens``, target = joined
+    ``docstring_tokens``, whitespace-normalized (_utils.py:235-258)
+  - translate / refine: "src_file,tgt_file" line-parallel pair
+    (_utils.py:168-212)
+  - concode: jsonl with ``nl`` -> ``code`` (_utils.py:215-232)
+  - clone: "index_file + url_to_code jsonl" pair labels (_utils.py:283-305)
+  - defect-as-data: jsonl ``func``/``target`` (handled by etl/datasets.py)
+
+Tokenization/padding land in fixed [N, L] int32 arrays (static shapes for
+XLA); every task becomes {"source_ids", "target_ids", "index"}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Example:
+    idx: int
+    source: str
+    target: str
+
+
+def _norm(tokens) -> str:
+    return " ".join(" ".join(tokens).replace("\n", " ").strip().split())
+
+
+def read_summarize_examples(path: str, limit: Optional[int] = None) -> List[Example]:
+    out: List[Example] = []
+    with open(path, encoding="utf-8") as f:
+        for idx, line in enumerate(f):
+            if limit is not None and idx >= limit:
+                break
+            js = json.loads(line)
+            out.append(
+                Example(
+                    idx=js.get("idx", idx),
+                    source=_norm(js["code_tokens"]),
+                    target=_norm(js["docstring_tokens"]),
+                )
+            )
+    return out
+
+
+def read_pair_examples(path_pair: str, limit: Optional[int] = None) -> List[Example]:
+    """translate / refine: comma-joined "src,tgt" line-parallel files."""
+    src_path, tgt_path = path_pair.split(",")
+    out: List[Example] = []
+    with open(src_path) as f1, open(tgt_path) as f2:
+        for idx, (l1, l2) in enumerate(zip(f1, f2)):
+            if limit is not None and idx >= limit:
+                break
+            out.append(Example(idx=idx, source=l1.strip(), target=l2.strip()))
+    return out
+
+
+def read_concode_examples(path: str, limit: Optional[int] = None) -> List[Example]:
+    out: List[Example] = []
+    with open(path) as f:
+        for idx, line in enumerate(f):
+            if limit is not None and idx >= limit:
+                break
+            js = json.loads(line)
+            out.append(
+                Example(idx=idx, source=js["nl"].strip(), target=js["code"].strip())
+            )
+    return out
+
+
+def read_clone_examples(
+    index_path: str, code_path: str, limit: Optional[int] = None
+) -> List[Tuple[str, str, int]]:
+    """BigCloneBench-style: jsonl of {idx, func} + tab-separated
+    "url1 url2 label" index (CodeT5/_utils.py:283-305)."""
+    url_to_code: Dict[str, str] = {}
+    with open(code_path) as f:
+        for line in f:
+            js = json.loads(line)
+            url_to_code[str(js["idx"])] = js["func"]
+    out: List[Tuple[str, str, int]] = []
+    with open(index_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) != 3:
+                continue
+            u1, u2, label = parts
+            if u1 not in url_to_code or u2 not in url_to_code:
+                continue
+            out.append((url_to_code[u1], url_to_code[u2], int(label)))
+            if limit is not None and len(out) >= limit:
+                break
+    return out
+
+
+READERS: Dict[str, Callable] = {
+    "summarize": read_summarize_examples,
+    "translate": read_pair_examples,
+    "refine": read_pair_examples,
+    "concode": read_concode_examples,
+}
+
+
+def encode_examples(
+    examples: Sequence[Example],
+    tokenize: Callable[[str], Sequence[int]],
+    max_source_length: int,
+    max_target_length: int,
+    pad_id: int = 0,
+    eos_id: int = 2,
+) -> Dict[str, np.ndarray]:
+    """Tokenize + pad to fixed [N, L] arrays. ``tokenize`` maps a string to
+    token ids WITHOUT eos; eos is appended then the row padded (HF
+    ``padding='max_length', truncation=True`` semantics with one eos,
+    CodeT5/_utils.py:33-34)."""
+
+    def fit(ids, max_len):
+        ids = list(ids)[: max_len - 1] + [eos_id]
+        return ids + [pad_id] * (max_len - len(ids))
+
+    n = len(examples)
+    src = np.full((n, max_source_length), pad_id, np.int32)
+    tgt = np.full((n, max_target_length), pad_id, np.int32)
+    index = np.zeros(n, np.int64)
+    for i, ex in enumerate(examples):
+        src[i] = fit(tokenize(ex.source), max_source_length)
+        tgt[i] = fit(tokenize(ex.target), max_target_length)
+        index[i] = ex.idx
+    return {"source_ids": src, "target_ids": tgt, "index": index}
+
+
+def synthetic_seq2seq(
+    n: int,
+    vocab_size: int = 64,
+    max_source_length: int = 24,
+    max_target_length: int = 12,
+    pad_id: int = 0,
+    eos_id: int = 2,
+    seed: int = 0,
+    reverse: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Deterministic learnable toy task (target = reversed — or copied —
+    source prefix): the generation-loop integration test, like
+    synthetic_bigvul for graphs."""
+    rng = np.random.RandomState(seed)
+    src = np.full((n, max_source_length), pad_id, np.int32)
+    tgt = np.full((n, max_target_length), pad_id, np.int32)
+    for i in range(n):
+        ln = rng.randint(3, max_target_length - 1)
+        toks = rng.randint(3, vocab_size, size=ln)
+        src[i, :ln] = toks
+        src[i, ln] = eos_id
+        out = toks[::-1] if reverse else toks
+        tgt[i, :ln] = out
+        tgt[i, ln] = eos_id
+    return {
+        "source_ids": src,
+        "target_ids": tgt,
+        "index": np.arange(n, dtype=np.int64),
+    }

@@ -121,17 +121,35 @@ class T5Attention(nn.Module):
     causal: bool = False
     has_relative_bias: bool = False
 
-    def _rel_bias(self, q_len: int, k_len: int) -> jnp.ndarray:
+    def _bias_table(self):
         c = self.cfg
-        table = self.param(
+        return self.param(
             "relative_attention_bias",
             nn.initializers.normal(1.0 / np.sqrt(c.d_model)),
             (c.relative_attention_num_buckets, c.num_heads),
         )
+
+    def _rel_bias(self, q_len: int, k_len: int) -> jnp.ndarray:
+        c = self.cfg
+        table = self._bias_table()
         ctx = jnp.arange(q_len)[:, None]
         mem = jnp.arange(k_len)[None, :]
         buckets = relative_position_bucket(
             mem - ctx,
+            bidirectional=not self.causal,
+            num_buckets=c.relative_attention_num_buckets,
+            max_distance=c.relative_attention_max_distance,
+        )
+        return jnp.take(table, buckets, axis=0).transpose(2, 0, 1)[None]
+
+    def _rel_bias_row(self, q_pos: jnp.ndarray, k_len: int) -> jnp.ndarray:
+        """Bias for one (traced) query position over k_len keys — the
+        incremental-decode analogue of :meth:`_rel_bias`."""
+        c = self.cfg
+        table = self._bias_table()
+        mem = jnp.arange(k_len)[None, :]
+        buckets = relative_position_bucket(
+            mem - q_pos,
             bidirectional=not self.causal,
             num_buckets=c.relative_attention_num_buckets,
             max_distance=c.relative_attention_max_distance,
@@ -146,20 +164,51 @@ class T5Attention(nn.Module):
         mask: jnp.ndarray,
         position_bias: Optional[jnp.ndarray],
         deterministic: bool,
+        decode: bool = False,
     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         c = self.cfg
         d = jnp.dtype(c.dtype)
         inner = c.num_heads * c.d_kv
         kv = x if kv is None else kv
-        q = nn.Dense(inner, use_bias=False, dtype=d, name="q")(x)
-        k = nn.Dense(inner, use_bias=False, dtype=d, name="k")(kv)
-        v = nn.Dense(inner, use_bias=False, dtype=d, name="v")(kv)
+        # T5's factor-1.0 init compensates for the missing 1/sqrt(d_kv)
+        # score scaling; with default lecun init the softmax saturates at
+        # init and gradients vanish.
+        init_q = nn.initializers.normal((c.d_model * c.d_kv) ** -0.5)
+        init_kv = nn.initializers.normal(c.d_model**-0.5)
+        q = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_q, name="q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv, name="k")(kv)
+        v = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv, name="v")(kv)
 
         def split(t):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, c.d_kv)
 
+        q, k, v = split(q), split(k), split(v)
+
+        if decode:
+            # Incremental decoding (self-attention only): the cache is
+            # created at full target length by a priming call (init_cache);
+            # step calls write this token's K/V at cache_index and attend
+            # over the whole buffer with positions > index masked.
+            assert self.causal, "decode cache is for the causal self-attention"
+            is_init = not self.has_variable("cache", "cached_k")
+            ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
+            cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
+            ci = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if not is_init:
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+                ci.value = idx + 1
+                k, v = ck.value, cv.value
+                max_len = k.shape[1]
+                mask = (jnp.arange(max_len) <= idx)[None, None, None, :]
+                if self.has_relative_bias:
+                    position_bias = self._rel_bias_row(idx, max_len)
+
         # No sqrt(d_kv) scaling — T5 folds it into the init.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", split(q), split(k))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
         if position_bias is None and self.has_relative_bias:
             position_bias = self._rel_bias(x.shape[1], kv.shape[1])
         if position_bias is not None:
@@ -167,9 +216,14 @@ class T5Attention(nn.Module):
         scores = scores + jnp.where(mask, 0.0, -1e9)
         weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(d)
         weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, split(v))
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(out.shape[0], out.shape[1], inner)
-        return nn.Dense(c.d_model, use_bias=False, dtype=d, name="o")(out), position_bias
+        init_o = nn.initializers.normal((c.num_heads * c.d_kv) ** -0.5)
+        return (
+            nn.Dense(c.d_model, use_bias=False, dtype=d, kernel_init=init_o,
+                     name="o")(out),
+            position_bias,
+        )
 
 
 class T5FFN(nn.Module):
@@ -179,14 +233,24 @@ class T5FFN(nn.Module):
     def __call__(self, x, deterministic):
         c = self.cfg
         d = jnp.dtype(c.dtype)
+        init_in = nn.initializers.normal(c.d_model**-0.5)
+        init_out = nn.initializers.normal(c.d_ff**-0.5)
         if c.gated_ffn:
-            gate = nn.gelu(nn.Dense(c.d_ff, use_bias=False, dtype=d, name="wi_0")(x))
-            lin = nn.Dense(c.d_ff, use_bias=False, dtype=d, name="wi_1")(x)
+            gate = nn.gelu(
+                nn.Dense(c.d_ff, use_bias=False, dtype=d, kernel_init=init_in,
+                         name="wi_0")(x)
+            )
+            lin = nn.Dense(c.d_ff, use_bias=False, dtype=d, kernel_init=init_in,
+                           name="wi_1")(x)
             h = gate * lin
         else:
-            h = nn.relu(nn.Dense(c.d_ff, use_bias=False, dtype=d, name="wi")(x))
+            h = nn.relu(
+                nn.Dense(c.d_ff, use_bias=False, dtype=d, kernel_init=init_in,
+                         name="wi")(x)
+            )
         h = nn.Dropout(c.dropout_rate)(h, deterministic=deterministic)
-        return nn.Dense(c.d_model, use_bias=False, dtype=d, name="wo")(h)
+        return nn.Dense(c.d_model, use_bias=False, dtype=d, kernel_init=init_out,
+                        name="wo")(h)
 
 
 class T5Block(nn.Module):
@@ -204,13 +268,14 @@ class T5Block(nn.Module):
         enc_out=None,
         cross_mask=None,
         deterministic: bool = True,
+        decode: bool = False,
     ):
         c = self.cfg
         h = T5LayerNorm(c.layer_norm_epsilon, name="self_attn_ln")(x)
         attn, position_bias = T5Attention(
             c, causal=self.causal, has_relative_bias=self.has_relative_bias,
             name="self_attn",
-        )(h, None, self_mask, position_bias, deterministic)
+        )(h, None, self_mask, position_bias, deterministic, decode=decode)
         x = x + nn.Dropout(c.dropout_rate)(attn, deterministic=deterministic)
 
         if self.has_cross_attention:
@@ -239,12 +304,14 @@ class T5Stack(nn.Module):
         enc_out: Optional[jnp.ndarray] = None,
         enc_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
+        decode: bool = False,
     ) -> jnp.ndarray:
         c = self.cfg
         q_len = embeds.shape[1]
         # [B, 1, Q, K] self-attention mask; decoder adds the causal triangle.
+        # In decode mode the cache supplies the causal structure instead.
         self_mask = attn_mask[:, None, None, :]
-        if self.causal:
+        if self.causal and not decode:
             causal = jnp.tril(jnp.ones((q_len, q_len), bool))
             self_mask = self_mask & causal[None, None]
         cross_mask = None
@@ -260,7 +327,8 @@ class T5Stack(nn.Module):
                 has_relative_bias=(i == 0),
                 has_cross_attention=enc_out is not None,
                 name=f"block_{i}",
-            )(x, self_mask, position_bias, enc_out, cross_mask, deterministic)
+            )(x, self_mask, position_bias, enc_out, cross_mask, deterministic,
+              decode=decode)
         x = T5LayerNorm(c.layer_norm_epsilon, name="final_ln")(x)
         return nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
 
@@ -294,12 +362,23 @@ class T5Model(nn.Module):
 
     def decode(
         self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
-        deterministic: bool = True,
+        deterministic: bool = True, decode: bool = False,
     ):
         return self.decoder(
             self.shared(decoder_input_ids), decoder_mask, enc_out, enc_mask,
-            deterministic=deterministic,
+            deterministic=deterministic, decode=decode,
         )
+
+    def decode_logits(
+        self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
+        deterministic: bool = True, decode: bool = False,
+    ):
+        """decode() + lm logits in one apply (generation step fn)."""
+        hidden = self.decode(
+            decoder_input_ids, decoder_mask, enc_out, enc_mask,
+            deterministic=deterministic, decode=decode,
+        )
+        return self.logits(hidden)
 
     def logits(self, decoder_hidden):
         c = self.cfg
@@ -387,25 +466,29 @@ class DefectModel(nn.Module):
 
 
 class CloneModel(nn.Module):
-    """Clone detection: eos-pooled vector -> RoBERTa-style head -> 2 logits
-    (CodeT5/models.py:64-122; source pairs are concatenated upstream into
-    one ``source_ids`` row, CodeT5/utils.py clone path)."""
+    """Clone detection (CodeT5/models.py:64-122): ``source_ids`` holds the
+    token ids of BOTH snippets back to back ([B, 2L], CodeT5/_utils.py:71
+    ``code1 + code2``); each snippet is eos-pooled *separately* (the
+    reference's ``view(-1, max_source_length)``), the two vectors concat to
+    [B, 2d], then dense(2d→d) → tanh → proj(2). (The reference's clone head,
+    CodeT5/models.py:48-61, applies no dropout — unlike LineVul's.)"""
 
     cfg: T5Config
-    dropout_rate: float = 0.1
 
     @nn.compact
     def __call__(self, source_ids: jnp.ndarray, deterministic: bool = True):
         c = self.cfg
-        attn_mask = source_ids != c.pad_token_id
+        b, two_l = source_ids.shape
+        assert two_l % 2 == 0, "clone input must concatenate two equal halves"
+        rows = source_ids.reshape(b * 2, two_l // 2)
+        attn_mask = rows != c.pad_token_id
         t5 = T5Model(c, name="t5")
-        dec_in = shift_right(source_ids, c.decoder_start_token_id)
-        hidden = t5(source_ids, dec_in, attn_mask=attn_mask, decoder_mask=attn_mask,
+        dec_in = shift_right(rows, c.decoder_start_token_id)
+        hidden = t5(rows, dec_in, attn_mask=attn_mask, decoder_mask=attn_mask,
                     deterministic=deterministic)
-        x = last_eos_vector(hidden, source_ids, c.eos_token_id)
-        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        vec = last_eos_vector(hidden, rows, c.eos_token_id)  # [2B, d]
+        x = vec.reshape(b, 2 * c.d_model)
         x = jnp.tanh(nn.Dense(c.d_model, name="dense")(x))
-        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         return nn.Dense(2, name="out_proj")(x)
 
 

@@ -1,0 +1,215 @@
+"""Autoregressive generation for the T5 family: greedy and beam search.
+
+The reference generates with HF ``model.generate(num_beams=args.beam_size,
+early_stopping=..., max_length=...)`` (CodeT5/run_gen.py:104-112) on the
+CUDA stack, and hand-rolls a ``Beam`` class for the RoBERTa path
+(CodeT5/models.py:195-408). Here decoding is a single jitted ``lax.scan``
+over steps with a KV cache (models/t5.py decode path): static trip count,
+static shapes, no host round-trips — the XLA-native shape of a decode loop.
+Beam search follows the standard alive/finished formulation (score =
+logprob / length**length_penalty, HF semantics) with the cache gathered
+along the beam axis at every reorder.
+
+All functions take ``model``/``params`` explicitly and are jit-compatible;
+wrap in ``jax.jit`` (or pjit with a sharded batch) at the call site.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.t5 import T5Config, T5Model
+
+NEG_INF = -1.0e7
+
+
+def _init_cache(model: T5Model, params, batch: int, max_len: int, enc_out, enc_mask):
+    """Prime the decode cache at full target length (flax idiom: run the
+    decoder once in decode mode with a dummy of the final shape)."""
+    dummy = jnp.zeros((batch, max_len), jnp.int32)
+    _, variables = model.apply(
+        {"params": params["params"]},
+        dummy,
+        jnp.ones_like(dummy, bool),
+        enc_out,
+        enc_mask,
+        decode=True,
+        method=T5Model.decode,
+        mutable=["cache"],
+    )
+    return variables["cache"]
+
+
+def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask):
+    """One cached decode step. token: [B, 1] -> logits [B, V], new cache."""
+    logits, variables = model.apply(
+        {"params": params["params"], "cache": cache},
+        token,
+        jnp.ones_like(token, bool),
+        enc_out,
+        enc_mask,
+        decode=True,
+        method=T5Model.decode_logits,
+        mutable=["cache"],
+    )
+    return logits[:, -1, :], variables["cache"]
+
+
+def greedy_decode(
+    model: T5Model,
+    params,
+    input_ids: jnp.ndarray,
+    max_len: int,
+    attn_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Greedy generation; returns [B, max_len] padded with pad_token after
+    each row's eos."""
+    c = model.cfg
+    if attn_mask is None:
+        attn_mask = input_ids != c.pad_token_id
+    enc_out = model.apply(
+        {"params": params["params"]}, input_ids, attn_mask, method=T5Model.encode
+    )
+    b = input_ids.shape[0]
+    cache = _init_cache(model, params, b, max_len, enc_out, attn_mask)
+
+    def body(carry, _):
+        cache, token, finished = carry
+        logits, cache = _step_logits(model, params, cache, token, enc_out, attn_mask)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, c.pad_token_id, nxt)
+        finished = finished | (nxt == c.eos_token_id)
+        return (cache, nxt[:, None], finished), nxt
+
+    start = jnp.full((b, 1), c.decoder_start_token_id, jnp.int32)
+    (_, _, _), tokens = jax.lax.scan(
+        body, (cache, start, jnp.zeros(b, bool)), None, length=max_len
+    )
+    return tokens.T  # [max_len, B] -> [B, max_len]
+
+
+def _gather_beams(tree, beam_idx, batch: int, beams: int):
+    """Reorder the beam-flattened leading axis of every array leaf by
+    ``beam_idx`` [batch, new_beams]."""
+
+    def gather(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x  # cache_index scalars are shared across beams
+        shaped = x.reshape(batch, beams, *x.shape[1:])
+        out = jnp.take_along_axis(
+            shaped,
+            beam_idx.reshape(batch, -1, *([1] * (x.ndim - 1))),
+            axis=1,
+        )
+        return out.reshape(-1, *x.shape[1:])
+
+    return jax.tree_util.tree_map(gather, tree)
+
+
+def beam_search(
+    model: T5Model,
+    params,
+    input_ids: jnp.ndarray,
+    max_len: int,
+    beam_size: int = 10,
+    length_penalty: float = 1.0,
+    attn_mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam search; returns (sequences [B, max_len], scores [B]) — the best
+    finished hypothesis per row (falling back to the best alive one if none
+    finished). Score = sum logprob / len**length_penalty (HF convention)."""
+    c = model.cfg
+    if attn_mask is None:
+        attn_mask = input_ids != c.pad_token_id
+    b = input_ids.shape[0]
+    k = beam_size
+
+    enc_out = model.apply(
+        {"params": params["params"]}, input_ids, attn_mask, method=T5Model.encode
+    )
+    # Expand batch to B*K rows (beam-major flatten).
+    rep = lambda x: jnp.repeat(x, k, axis=0)
+    enc_out_k, mask_k = rep(enc_out), rep(attn_mask)
+    cache = _init_cache(model, params, b * k, max_len, enc_out_k, mask_k)
+
+    # Alive state: only beam 0 starts live so the first step's top-k is not
+    # k copies of the same hypothesis.
+    alive_logp = jnp.tile(jnp.array([0.0] + [NEG_INF] * (k - 1)), (b, 1))
+    alive_seq = jnp.full((b, k, max_len), c.pad_token_id, jnp.int32)
+    fin_seq = jnp.full((b, k, max_len), c.pad_token_id, jnp.int32)
+    fin_score = jnp.full((b, k), NEG_INF)
+    token = jnp.full((b * k, 1), c.decoder_start_token_id, jnp.int32)
+
+    def body(carry, t):
+        cache, token, alive_logp, alive_seq, fin_seq, fin_score = carry
+        logits, cache = _step_logits(model, params, cache, token, enc_out_k, mask_k)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # [B*K, V]
+        v = logp.shape[-1]
+        total = alive_logp[:, :, None] + logp.reshape(b, k, v)  # [B, K, V]
+
+        # Top 2K candidates over (beam, token): enough survive even if K of
+        # them are eos.
+        flat = total.reshape(b, k * v)
+        cand_logp, cand_idx = jax.lax.top_k(flat, 2 * k)
+        cand_beam = cand_idx // v  # [B, 2K]
+        cand_tok = (cand_idx % v).astype(jnp.int32)
+
+        cand_seq = jnp.take_along_axis(alive_seq, cand_beam[:, :, None], axis=1)
+        cand_seq = jax.lax.dynamic_update_slice_in_dim(
+            cand_seq, cand_tok[:, :, None], t, axis=2
+        )
+        is_eos = cand_tok == c.eos_token_id
+
+        # Finished pool: merge newly-eos candidates (length-normalized).
+        cand_score = cand_logp / ((t + 1).astype(jnp.float32) ** length_penalty)
+        new_fin_score = jnp.where(is_eos, cand_score, NEG_INF)
+        all_fin_score = jnp.concatenate([fin_score, new_fin_score], axis=1)
+        all_fin_seq = jnp.concatenate([fin_seq, cand_seq], axis=1)
+        fin_score, fin_top = jax.lax.top_k(all_fin_score, k)
+        fin_seq = jnp.take_along_axis(all_fin_seq, fin_top[:, :, None], axis=1)
+
+        # Alive pool: best K non-eos candidates.
+        alive_cand = jnp.where(is_eos, NEG_INF, cand_logp)
+        alive_logp, alive_top = jax.lax.top_k(alive_cand, k)
+        alive_seq = jnp.take_along_axis(cand_seq, alive_top[:, :, None], axis=1)
+        chosen_beam = jnp.take_along_axis(cand_beam, alive_top, axis=1)  # [B, K]
+        chosen_tok = jnp.take_along_axis(cand_tok, alive_top, axis=1)
+
+        cache = _gather_beams(cache, chosen_beam, b, k)
+        token = chosen_tok.reshape(b * k, 1)
+        return (cache, token, alive_logp, alive_seq, fin_seq, fin_score), None
+
+    carry = (cache, token, alive_logp, alive_seq, fin_seq, fin_score)
+    (cache, token, alive_logp, alive_seq, fin_seq, fin_score), _ = jax.lax.scan(
+        body, carry, jnp.arange(max_len)
+    )
+
+    # Prefer finished hypotheses; fall back to the best alive (unterminated)
+    # beam when nothing finished within max_len.
+    alive_score = alive_logp / (float(max_len) ** length_penalty)
+    none_fin = fin_score[:, 0] <= NEG_INF / 2
+    best_seq = jnp.where(none_fin[:, None], alive_seq[:, 0], fin_seq[:, 0])
+    best_score = jnp.where(none_fin, alive_score[:, 0], fin_score[:, 0])
+    return best_seq, best_score
+
+
+def generate(
+    model: T5Model,
+    params,
+    input_ids: jnp.ndarray,
+    max_len: int = 128,
+    beam_size: int = 1,
+    length_penalty: float = 1.0,
+) -> jnp.ndarray:
+    """HF-generate-shaped convenience: beam_size 1 → greedy."""
+    if beam_size <= 1:
+        return greedy_decode(model, params, input_ids, max_len)
+    seq, _ = beam_search(
+        model, params, input_ids, max_len, beam_size, length_penalty
+    )
+    return seq

@@ -1,0 +1,217 @@
+"""Seq2seq fine-tuning loop for the T5 generation tasks
+(summarize/translate/refine/concode — reference CodeT5/run_gen.py).
+
+Reference semantics: teacher-forced CE over target tokens with pads ignored
+(HF ``labels=-100`` masking), AdamW + linear warmup, per-epoch eval with
+best-loss/best-metric checkpointing, beam-search generation for the final
+metric (run_gen.py:104-112 with num_beams=args.beam_size). Here the loss
+masks pads explicitly, the train step is one jitted function over a pjit
+mesh, and generation uses models/t5_generate.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from deepdfa_tpu.core.config import TransformerTrainConfig
+from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
+from deepdfa_tpu.models.t5_generate import generate
+from deepdfa_tpu.train.text_loop import make_schedule
+
+
+@struct.dataclass
+class GenTrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    dropout_rng: jnp.ndarray
+
+
+def seq2seq_loss(
+    model: T5Model, params, source_ids, target_ids, dropout_rng=None,
+    deterministic: bool = True,
+):
+    """Masked teacher-forced CE (mean over non-pad target tokens)."""
+    c = model.cfg
+    dec_in = shift_right(target_ids, c.decoder_start_token_id)
+    dec_mask = dec_in != c.pad_token_id
+    # position 0 is the start token: always attended
+    dec_mask = dec_mask.at[:, 0].set(True)
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    hidden = model.apply(
+        params, source_ids, dec_in, decoder_mask=dec_mask,
+        deterministic=deterministic, rngs=rngs,
+    )
+    logits = model.apply(params, hidden, method=T5Model.logits)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    tok_lp = jnp.take_along_axis(logp, target_ids[..., None], axis=-1)[..., 0]
+    mask = (target_ids != c.pad_token_id).astype(jnp.float32)
+    return -(tok_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_gen_optimizer(cfg: TransformerTrainConfig, max_steps: int):
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(
+            make_schedule(cfg, max_steps),
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+
+
+def make_gen_train_state(
+    model: T5Model, example_src, example_tgt, cfg: TransformerTrainConfig,
+    max_steps: int, init_params: Optional[Any] = None,
+) -> Tuple[GenTrainState, optax.GradientTransformation]:
+    rng = jax.random.PRNGKey(cfg.seed)
+    params_rng, dropout_rng = jax.random.split(rng)
+    if init_params is not None:
+        params = init_params
+    else:
+        params = model.init(
+            {"params": params_rng, "dropout": dropout_rng},
+            jnp.asarray(example_src),
+            shift_right(jnp.asarray(example_tgt), model.cfg.decoder_start_token_id),
+        )
+    tx = make_gen_optimizer(cfg, max_steps)
+    return (
+        GenTrainState(jnp.zeros((), jnp.int32), params, tx.init(params), dropout_rng),
+        tx,
+    )
+
+
+def make_gen_train_step(model: T5Model, tx, cfg: TransformerTrainConfig) -> Callable:
+    def step(state: GenTrainState, source_ids, target_ids):
+        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+
+        def loss_fn(params):
+            return seq2seq_loss(
+                model, params, source_ids, target_ids,
+                dropout_rng=dropout_rng, deterministic=False,
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            GenTrainState(state.step + 1, params, opt_state, state.dropout_rng),
+            loss,
+        )
+
+    return step
+
+
+def _batches(data: Dict[str, np.ndarray], batch_size: int, rng=None,
+             pad_tail: bool = False):
+    """Yield (source, target, n_valid). With ``pad_tail`` the final short
+    batch is padded with rows whose targets are all pad — such rows
+    contribute nothing to the masked loss, so metrics cover every row."""
+    n = len(data["source_ids"])
+    order = np.arange(n)
+    if rng is not None:
+        order = rng.permutation(order)
+    stop = n if pad_tail else n - batch_size + 1
+    for start in range(0, stop, batch_size):
+        sel = order[start : start + batch_size]
+        src, tgt = data["source_ids"][sel], data["target_ids"][sel]
+        n_valid = len(sel)
+        if n_valid < batch_size:
+            pad = batch_size - n_valid
+            src = np.concatenate([src, np.zeros((pad, src.shape[1]), src.dtype)])
+            tgt = np.concatenate([tgt, np.zeros((pad, tgt.shape[1]), tgt.dtype)])
+        yield src, tgt, n_valid
+
+
+def exact_match(pred: np.ndarray, target: np.ndarray, pad_id: int, eos_id: int) -> float:
+    """Fraction of rows whose generated tokens (up to eos) equal the
+    reference target tokens (up to eos)."""
+
+    def strip(row):
+        out = []
+        for t in row:
+            if t == eos_id:
+                break
+            if t != pad_id:
+                out.append(int(t))
+        return out
+
+    hits = sum(
+        strip(p) == strip(t) for p, t in zip(pred, target)
+    )
+    return hits / max(len(pred), 1)
+
+
+def fit_gen(
+    model: T5Model,
+    train_data: Dict[str, np.ndarray],
+    eval_data: Dict[str, np.ndarray],
+    cfg: TransformerTrainConfig,
+    max_target_length: int = 32,
+    beam_size: int = 1,
+    init_params: Optional[Any] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Mini run_gen: train, per-epoch eval loss, final generation metric.
+    Returns {"state", "eval_loss", "exact_match"}."""
+    n = len(train_data["source_ids"])
+    steps_per_epoch = max(n // cfg.batch_size, 1)
+    max_steps = steps_per_epoch * cfg.max_epochs
+    state, tx = make_gen_train_state(
+        model,
+        train_data["source_ids"][: cfg.batch_size],
+        train_data["target_ids"][: cfg.batch_size],
+        cfg,
+        max_steps,
+        init_params=init_params,
+    )
+    step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
+    eval_loss_fn = jax.jit(
+        lambda params, s, t: seq2seq_loss(model, params, s, t)
+    )
+
+    rng = np.random.RandomState(cfg.seed)
+    for epoch in range(cfg.max_epochs):
+        losses = []
+        for src, tgt, _ in _batches(train_data, cfg.batch_size, rng):
+            state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
+            losses.append(loss)
+        if log:
+            log(f"epoch {epoch}: train_loss={float(np.mean(jax.device_get(losses))):.4f}")
+
+    eval_losses = [
+        float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t)))
+        for s, t, _ in _batches(eval_data, cfg.eval_batch_size, pad_tail=True)
+    ]
+
+    gen = jax.jit(
+        lambda params, src: generate(
+            model, params, src, max_len=max_target_length, beam_size=beam_size
+        )
+    )
+    preds = []
+    for src, _, n_valid in _batches(eval_data, cfg.eval_batch_size, pad_tail=True):
+        preds.append(np.asarray(gen(state.params, jnp.asarray(src)))[:n_valid])
+    pred = (
+        np.concatenate(preds)
+        if preds
+        else np.zeros((0, max_target_length), np.int32)
+    )
+    em = exact_match(
+        pred,
+        eval_data["target_ids"][: len(pred)],
+        model.cfg.pad_token_id,
+        model.cfg.eos_token_id,
+    )
+    return {
+        "state": state,
+        "eval_loss": float(np.mean(eval_losses)) if eval_losses else float("nan"),
+        "exact_match": em,
+    }

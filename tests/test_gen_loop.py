@@ -1,0 +1,90 @@
+"""Generation-task loop: readers, loss masking, and end-to-end learning on
+the synthetic reverse task."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.core.config import TransformerTrainConfig
+from deepdfa_tpu.data.seq2seq import (
+    Example,
+    encode_examples,
+    read_concode_examples,
+    read_pair_examples,
+    read_summarize_examples,
+    synthetic_seq2seq,
+)
+from deepdfa_tpu.models.t5 import T5Config, T5Model
+from deepdfa_tpu.train.gen_loop import fit_gen, seq2seq_loss
+
+
+def test_readers(tmp_path):
+    summ = tmp_path / "s.jsonl"
+    summ.write_text(
+        json.dumps({"code_tokens": ["def", "f", "(", ")"], "docstring_tokens": ["do", "it"]})
+        + "\n"
+    )
+    ex = read_summarize_examples(str(summ))
+    assert ex[0].source == "def f ( )" and ex[0].target == "do it"
+
+    src = tmp_path / "a.txt"
+    tgt = tmp_path / "b.txt"
+    src.write_text("x = 1\ny = 2\n")
+    tgt.write_text("int x = 1;\nint y = 2;\n")
+    pairs = read_pair_examples(f"{src},{tgt}")
+    assert len(pairs) == 2 and pairs[1].target == "int y = 2;"
+
+    cc = tmp_path / "c.jsonl"
+    cc.write_text(json.dumps({"nl": "add two numbers", "code": "a + b"}) + "\n")
+    ex = read_concode_examples(str(cc))
+    assert ex[0].source == "add two numbers"
+
+
+def test_encode_examples_pads_and_eos():
+    toks = {"ab": [5, 6], "c": [7]}
+    enc = encode_examples(
+        [Example(0, "ab", "c")],
+        tokenize=lambda s: toks[s],
+        max_source_length=6,
+        max_target_length=4,
+        pad_id=0,
+        eos_id=2,
+    )
+    np.testing.assert_array_equal(enc["source_ids"][0], [5, 6, 2, 0, 0, 0])
+    np.testing.assert_array_equal(enc["target_ids"][0], [7, 2, 0, 0])
+
+
+def test_loss_ignores_pad():
+    cfg = T5Config.tiny(vocab_size=32)
+    model = T5Model(cfg)
+    src = jnp.asarray(np.random.RandomState(0).randint(3, 32, size=(2, 8)))
+    tgt = jnp.asarray([[5, 6, 2, 0, 0, 0], [7, 8, 9, 10, 2, 0]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), src, tgt)
+    l1 = seq2seq_loss(model, params, src, tgt)
+    # Extending padding must not change the loss.
+    tgt2 = jnp.pad(tgt, ((0, 0), (0, 4)))
+    l2 = seq2seq_loss(model, params, src, tgt2)
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
+
+
+def test_fit_gen_learns_copy_task():
+    """Pipeline integration: fit_gen must drive the loss down and greedy
+    decode must reproduce the fitted sequences (teacher-forcing, scheduling,
+    cache decode, and metric plumbing all in one path). A tiny T5 memorizes
+    8 rows; generalization at this scale is not the test's subject."""
+    import dataclasses
+
+    cfg = dataclasses.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    model = T5Model(cfg)
+    data = synthetic_seq2seq(
+        8, vocab_size=32, max_source_length=12, max_target_length=8,
+        seed=0, reverse=False,
+    )
+    tcfg = TransformerTrainConfig(
+        learning_rate=1e-3, max_epochs=500, batch_size=8, eval_batch_size=8
+    )
+    out = fit_gen(model, data, data, tcfg, max_target_length=8)
+    assert out["eval_loss"] < 1.5, out
+    assert out["exact_match"] >= 0.75, out
