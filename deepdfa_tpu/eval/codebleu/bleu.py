@@ -1,0 +1,105 @@
+"""Corpus BLEU and the CodeBLEU weighted-recall variant.
+
+Math parity with the reference's vendored nltk BLEU
+(CodeT5/evaluator/CodeBLEU/bleu.py) and weighted variant
+(weighted_ngram_match.py): clipped modified precision summed over the
+corpus, geometric mean under uniform 4-gram weights, brevity penalty
+exp(1 - r/h); the weighted variant is modified *recall* (denominator =
+reference counts) with unigram counts scaled by per-token weights
+(weighted_ngram_match.py ``modified_recall``). Zero precisions are floored
+at a tiny epsilon (smoothing method-1 style) instead of zeroing the whole
+corpus score.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+_EPS = 1e-12
+
+
+def ngrams(tokens: Sequence[str], n: int):
+    return [tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def _closest_ref_length(refs: Sequence[Sequence[str]], hyp_len: int) -> int:
+    return min((abs(len(r) - hyp_len), len(r)) for r in refs)[1]
+
+
+def _brevity_penalty(ref_len: int, hyp_len: int) -> float:
+    if hyp_len > ref_len:
+        return 1.0
+    if hyp_len == 0:
+        return 0.0
+    return math.exp(1 - ref_len / hyp_len)
+
+
+def corpus_bleu(
+    list_of_references: Sequence[Sequence[Sequence[str]]],
+    hypotheses: Sequence[Sequence[str]],
+    max_n: int = 4,
+) -> float:
+    """Standard corpus BLEU-N (uniform weights) with clipped counts against
+    the per-example reference union."""
+    num = [0] * max_n
+    den = [0] * max_n
+    ref_len = hyp_len = 0
+    for refs, hyp in zip(list_of_references, hypotheses):
+        hyp_len += len(hyp)
+        ref_len += _closest_ref_length(refs, len(hyp))
+        for n in range(1, max_n + 1):
+            counts = Counter(ngrams(hyp, n))
+            max_counts: Dict[Tuple, int] = {}
+            for ref in refs:
+                for ng, c in Counter(ngrams(ref, n)).items():
+                    max_counts[ng] = max(max_counts.get(ng, 0), c)
+            clipped = {ng: min(c, max_counts.get(ng, 0)) for ng, c in counts.items()}
+            num[n - 1] += sum(clipped.values())
+            den[n - 1] += max(1, sum(counts.values()))
+    if hyp_len == 0:
+        return 0.0
+    log_p = sum(
+        (1.0 / max_n) * math.log(max(num[i], _EPS) / den[i]) for i in range(max_n)
+    )
+    return _brevity_penalty(ref_len, hyp_len) * math.exp(log_p)
+
+
+def corpus_weighted_recall(
+    list_of_references: Sequence[Sequence[Tuple[Sequence[str], Dict[str, float]]]],
+    hypotheses: Sequence[Sequence[str]],
+    max_n: int = 4,
+) -> float:
+    """CodeBLEU's keyword-weighted modified recall: references arrive as
+    (tokens, token->weight) pairs; at n=1 the clipped and total counts are
+    weighted per token (weighted_ngram_match.py:96-120)."""
+    num = [0.0] * max_n
+    den = [0.0] * max_n
+    ref_len = hyp_len = 0
+    for refs, hyp in zip(list_of_references, hypotheses):
+        hyp_len += len(hyp)
+        ref_len += _closest_ref_length([r for r, _ in refs], len(hyp))
+        for n in range(1, max_n + 1):
+            counts = Counter(ngrams(hyp, n))
+            for ref, weights in refs:
+                ref_counts = Counter(ngrams(ref, n))
+                clipped = {
+                    ng: min(c, counts.get(ng, 0)) for ng, c in ref_counts.items()
+                }
+                if n == 1:
+                    w = lambda ng: weights.get(ng[0], 1.0)
+                    num[0] += sum(c * w(ng) for ng, c in clipped.items())
+                    den[0] += max(
+                        1.0, sum(c * w(ng) for ng, c in ref_counts.items())
+                    )
+                else:
+                    num[n - 1] += sum(clipped.values())
+                    den[n - 1] += max(1, sum(ref_counts.values()))
+    if hyp_len == 0:
+        return 0.0
+    log_p = sum(
+        (1.0 / max_n) * math.log(max(num[i], _EPS) / max(den[i], 1.0))
+        for i in range(max_n)
+    )
+    return _brevity_penalty(ref_len, hyp_len) * math.exp(log_p)

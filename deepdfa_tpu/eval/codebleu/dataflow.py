@@ -1,0 +1,122 @@
+"""Dataflow match: fraction of the reference's normalized def-use edges
+found in the hypothesis (CodeT5/evaluator/CodeBLEU/dataflow_match.py).
+
+The reference extracts a DFG from the tree-sitter parse with per-language
+extractors (parser/DFG.py); here edges come from a statement-level scan of
+our own parse (parser.py): an assignment's left identifier receives a
+``comesFrom`` edge when the RHS is a single identifier, else
+``computedFrom`` from every RHS identifier (augmented assignments and
+``++``/``--`` include the target itself); ``for x in expr`` (Python) is a
+``comesFrom``. Variable names are normalized to ``var_i`` in first-use
+order exactly like the reference's ``normalize_dataflow``
+(dataflow_match.py:132-148), and matching removes each matched candidate
+edge (multiset semantics, dataflow_match.py:63-70).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from deepdfa_tpu.eval.codebleu.parser import Token, iter_statements, parse
+
+_ASSIGN_AUG = {
+    "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<=", ">>=", "**=", "//=",
+}
+
+Edge = Tuple[str, str, Tuple[str, ...]]  # (target, relationship, parents)
+
+
+def _idents(tokens: Sequence[Token]) -> List[str]:
+    return [t.text for t in tokens if t.cat == "id"]
+
+
+def extract_dataflow(code: str, lang: str) -> List[Edge]:
+    edges: List[Edge] = []
+    for stmt in iter_statements(parse(code, lang)):
+        # increments/decrements anywhere in the statement
+        for i, t in enumerate(stmt):
+            if t.cat == "op" and t.text in ("++", "--"):
+                nbr = None
+                if i + 1 < len(stmt) and stmt[i + 1].cat == "id":
+                    nbr = stmt[i + 1].text
+                elif i > 0 and stmt[i - 1].cat == "id":
+                    nbr = stmt[i - 1].text
+                if nbr:
+                    edges.append((nbr, "computedFrom", (nbr,)))
+
+        # python for-in binding
+        if (
+            lang == "python"
+            and len(stmt) >= 4
+            and stmt[0].cat == "kw"
+            and stmt[0].text == "for"
+        ):
+            try:
+                in_pos = next(
+                    i for i, t in enumerate(stmt) if t.cat == "kw" and t.text == "in"
+                )
+            except StopIteration:
+                in_pos = None
+            if in_pos:
+                for tgt in _idents(stmt[1:in_pos]):
+                    src = tuple(_idents(stmt[in_pos + 1 :]))
+                    if src:
+                        edges.append((tgt, "comesFrom", src))
+            continue
+
+        # first top-level assignment operator in the statement
+        for i, t in enumerate(stmt):
+            if t.cat != "op":
+                continue
+            if t.text == "=" or t.text in _ASSIGN_AUG:
+                lhs_ids = _idents(stmt[:i])
+                if not lhs_ids:
+                    break
+                target = lhs_ids[-1]
+                rhs = stmt[i + 1 :]
+                parents = _idents(rhs)
+                if t.text in _ASSIGN_AUG:
+                    parents = [target] + parents
+                    edges.append((target, "computedFrom", tuple(parents)))
+                elif len(rhs) == 1 and rhs[0].cat == "id":
+                    edges.append((target, "comesFrom", tuple(parents)))
+                elif parents:
+                    edges.append((target, "computedFrom", tuple(parents)))
+                else:
+                    edges.append((target, "comesFrom", ()))
+                break
+    return edges
+
+
+def normalize_dataflow(edges: Sequence[Edge]) -> List[Edge]:
+    """First-appearance var_i renaming, parents before target per edge
+    (dataflow_match.py:132-148)."""
+    names = {}
+
+    def norm(v: str) -> str:
+        if v not in names:
+            names[v] = f"var_{len(names)}"
+        return names[v]
+
+    out: List[Edge] = []
+    for target, rel, parents in edges:
+        np = tuple(norm(p) for p in parents)
+        out.append((norm(target), rel, np))
+    return out
+
+
+def corpus_dataflow_match(
+    references: Sequence[Sequence[str]], hypotheses: Sequence[str], lang: str
+) -> float:
+    match = total = 0
+    for refs, hyp in zip(references, hypotheses):
+        cand = normalize_dataflow(extract_dataflow(hyp, lang))
+        for ref in refs:
+            ref_dfg = normalize_dataflow(extract_dataflow(ref, lang))
+            pool = list(cand)
+            for edge in ref_dfg:
+                if edge in pool:
+                    match += 1
+                    pool.remove(edge)
+            total += len(ref_dfg)
+    return match / total if total else 0.0

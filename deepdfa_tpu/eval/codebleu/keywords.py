@@ -1,0 +1,82 @@
+"""Per-language reserved-word lists for the weighted ngram component
+(CodeBLEU keyword weight 1.0 vs 0.2 for other tokens; reference keyword
+files: CodeT5/evaluator/CodeBLEU/keywords/)."""
+
+from __future__ import annotations
+
+_C_COMMON = """
+auto break case char const continue default do double else enum extern float
+for goto if int long register return short signed sizeof static struct switch
+typedef union unsigned void volatile while
+"""
+
+_JAVA = """
+abstract assert boolean break byte case catch char class const continue
+default do double else enum extends final finally float for goto if
+implements import instanceof int interface long native new package private
+protected public return short static strictfp super switch synchronized this
+throw throws transient try void volatile while true false null
+"""
+
+_C_SHARP = """
+abstract as base bool break byte case catch char checked class const continue
+decimal default delegate do double else enum event explicit extern false
+finally fixed float for foreach goto if implicit in int interface internal is
+lock long namespace new null object operator out override params private
+protected public readonly ref return sbyte sealed short sizeof stackalloc
+static string struct switch this throw true try typeof uint ulong unchecked
+unsafe ushort using virtual void volatile while
+"""
+
+_PYTHON = """
+False None True and as assert async await break class continue def del elif
+else except finally for from global if import in is lambda nonlocal not or
+pass raise return try while with yield
+"""
+
+_JS = """
+await break case catch class const continue debugger default delete do else
+export extends false finally for function if import in instanceof new null
+return super switch this throw true try typeof var void while with yield let
+static async of
+"""
+
+_GO = """
+break case chan const continue default defer else fallthrough for func go
+goto if import interface map package range return select struct switch type
+var
+"""
+
+_PHP = """
+abstract and array as break callable case catch class clone const continue
+declare default die do echo else elseif empty enddeclare endfor endforeach
+endif endswitch endwhile eval exit extends final finally fn for foreach
+function global goto if implements include include_once instanceof insteadof
+interface isset list match namespace new or print private protected public
+readonly require require_once return static switch throw trait try unset use
+var while xor yield true false null
+"""
+
+_RUBY = """
+BEGIN END alias and begin break case class def defined? do else elsif end
+ensure false for if in module next nil not or redo rescue retry return self
+super then true undef unless until when while yield
+"""
+
+
+def _set(text: str) -> frozenset:
+    return frozenset(text.split())
+
+
+KEYWORDS = {
+    "c": _set(_C_COMMON),
+    "cpp": _set(_C_COMMON) | _set("class namespace template new delete try catch throw public private protected virtual"),
+    "java": _set(_JAVA),
+    "c_sharp": _set(_C_SHARP),
+    "python": _set(_PYTHON),
+    "js": _set(_JS),
+    "javascript": _set(_JS),
+    "go": _set(_GO),
+    "php": _set(_PHP),
+    "ruby": _set(_RUBY),
+}

@@ -1,0 +1,212 @@
+"""Self-contained code parser for the CodeBLEU syntax/dataflow components.
+
+The reference uses tree-sitter grammars compiled to ``my-languages.so``
+(CodeT5/evaluator/CodeBLEU/parser/build.py); that toolchain is unavailable
+here, so this module provides the same *metric surface* with a lightweight
+parser: a language-aware tokenizer (comments/strings/numbers/operators) and
+a bracket/statement tree for C-family languages, plus an indentation-based
+grouping for Python. Serialized s-expressions play the role of tree-sitter's
+``node.sexp()``: token *categories* appear (keywords literally, ``id`` /
+``num`` / ``str`` placeholders, operator literals), so syntax match is
+structure-sensitive but identifier-name-insensitive — the property the
+CodeBLEU paper wants from its syntax component.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Sequence, Union
+
+from deepdfa_tpu.eval.codebleu.keywords import KEYWORDS
+
+
+@dataclasses.dataclass
+class Token:
+    cat: str  # "kw" | "id" | "num" | "str" | "op"
+    text: str
+
+    def sexp(self) -> str:
+        if self.cat == "kw":
+            return self.text
+        if self.cat == "op":
+            return self.text
+        return self.cat  # id / num / str placeholders
+
+
+@dataclasses.dataclass
+class Node:
+    kind: str  # "program" | "block" | "parens" | "brackets" | "stmt"
+    children: List[Union["Node", Token]]
+
+    def sexp(self) -> str:
+        inner = " ".join(c.sexp() for c in self.children)
+        return f"({self.kind} {inner})" if inner else f"({self.kind})"
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<comment>//[^\n]*|\#[^\n]*|/\*.*?\*/)
+  | (?P<str>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*'|`(?:\\.|[^`\\])*`)
+  | (?P<num>0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?[fFlLuU]*)
+  | (?P<id>[A-Za-z_$][A-Za-z0-9_$]*)
+  | (?P<op><<=|>>=|===|!==|\*\*=|//=|<<|>>|<=|>=|==|!=|&&|\|\||->|=>|\+\+|--|\+=|-=|\*=|/=|%=|&=|\|=|\^=|::|[{}()\[\];,.:?~!@%^&*\-+=<>/|])
+  | (?P<ws>\s+)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+# '#' starts a comment only in these languages ('#include' etc. make it a
+# preprocessor line in C — dropping it is fine for metric purposes).
+_HASH_COMMENT_LANGS = {"python", "ruby", "php"}
+
+
+def tokenize(code: str, lang: str = "java") -> List[Token]:
+    kws = KEYWORDS.get(lang, frozenset())
+    out: List[Token] = []
+    pos = 0
+    while pos < len(code):
+        m = _TOKEN_RE.match(code, pos)
+        if not m:
+            pos += 1  # unknown byte: skip
+            continue
+        pos = m.end()
+        if m.lastgroup in ("ws",):
+            continue
+        if m.lastgroup == "comment":
+            text = m.group()
+            if text.startswith("#") and lang not in _HASH_COMMENT_LANGS:
+                continue  # preprocessor/other: drop either way
+            continue
+        text = m.group()
+        if m.lastgroup == "id":
+            out.append(Token("kw" if text in kws else "id", text))
+        elif m.lastgroup == "num":
+            out.append(Token("num", text))
+        elif m.lastgroup == "str":
+            out.append(Token("str", text))
+        else:
+            out.append(Token("op", text))
+    return out
+
+
+_OPEN = {"(": "parens", "[": "brackets", "{": "block"}
+_CLOSE = {")": "(", "]": "[", "}": "{"}
+_CONTINUATIONS = {"else", "catch", "finally", "while"}
+
+
+def _parse_group(tokens: List[Token], i: int, kind: str, closer: str):
+    """Parse until ``closer`` (or EOF); returns (Node, next_i). Statements
+    split at ';'; a trailing block ends the statement unless the next token
+    continues it (else/catch/finally/do-while)."""
+    children: List[Union[Node, Token]] = []
+    stmt: List[Union[Node, Token]] = []
+
+    def flush():
+        nonlocal stmt
+        if stmt:
+            children.append(Node("stmt", stmt))
+            stmt = []
+
+    while i < len(tokens):
+        t = tokens[i]
+        if t.cat == "op" and t.text == closer:
+            flush()
+            return Node(kind, children), i + 1
+        if t.cat == "op" and t.text in _OPEN:
+            sub, i = _parse_group(tokens, i + 1, _OPEN[t.text], {v: k for k, v in _CLOSE.items()}[t.text])
+            stmt.append(sub)
+            if sub.kind == "block":
+                nxt = tokens[i] if i < len(tokens) else None
+                if not (nxt and nxt.cat == "kw" and nxt.text in _CONTINUATIONS):
+                    flush()
+            continue
+        if t.cat == "op" and t.text in _CLOSE:
+            # stray closer (unbalanced code): treat as end of this group
+            flush()
+            return Node(kind, children), i + 1
+        i += 1
+        if t.cat == "op" and t.text == ";":
+            flush()
+        else:
+            stmt.append(t)
+    flush()
+    return Node(kind, children), i
+
+
+def _parse_python(code: str) -> Node:
+    """Indentation blocks: logical lines (joined inside brackets) become
+    stmts; deeper indent after a ':'-ended line opens a nested block."""
+    lines: List[tuple] = []  # (indent, tokens)
+    buf: List[Token] = []
+    depth = 0
+    indent = 0
+    for raw in code.split("\n"):
+        stripped = raw.strip()
+        if not stripped:
+            continue
+        toks = tokenize(raw, "python")
+        if not toks:
+            continue
+        if depth == 0:
+            indent = len(raw) - len(raw.lstrip())
+            buf = []
+        buf.extend(toks)
+        depth += sum(1 for t in toks if t.cat == "op" and t.text in _OPEN)
+        depth -= sum(1 for t in toks if t.cat == "op" and t.text in _CLOSE)
+        depth = max(depth, 0)
+        if depth == 0:
+            lines.append((indent, buf))
+
+    def build(start: int, level: int) -> tuple:
+        children: List[Union[Node, Token]] = []
+        i = start
+        while i < len(lines):
+            ind, toks = lines[i]
+            if ind < level:
+                break
+            if ind > level:
+                block, i = build(i, ind)
+                if children and isinstance(children[-1], Node):
+                    children[-1].children.append(block)
+                else:
+                    children.append(block)
+                continue
+            children.append(Node("stmt", list(toks)))
+            i += 1
+        return Node("block" if level > 0 else "program", children), i
+
+    root, _ = build(0, 0)
+    return root
+
+
+def parse(code: str, lang: str = "java") -> Node:
+    if lang == "python":
+        return _parse_python(code)
+    tokens = tokenize(code, lang)
+    node, _ = _parse_group(tokens, 0, "program", "\x00")
+    return node
+
+
+def iter_statements(root: Node):
+    """Yield every stmt node's flat token list (tokens inside nested
+    parens/brackets included; nested blocks are their own statements)."""
+
+    def flat(n: Union[Node, Token]):
+        if isinstance(n, Token):
+            return [n]
+        if n.kind == "block":
+            return []
+        out = []
+        for c in n.children:
+            out.extend(flat(c))
+        return out
+
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, Token):
+            continue
+        if n.kind == "stmt":
+            yield flat(n)
+        stack.extend(c for c in n.children if isinstance(c, Node))

@@ -1,0 +1,37 @@
+"""Syntax match: fraction of reference AST subtrees present in the
+hypothesis AST (CodeT5/evaluator/CodeBLEU/syntax_match.py:26-75, with our
+parser's s-expressions standing in for tree-sitter's)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from deepdfa_tpu.eval.codebleu.parser import Node, parse
+
+
+def all_subtree_sexps(root: Node) -> List[str]:
+    """Every internal node's s-expression (the reference pushes only nodes
+    with children, syntax_match.py:57-60)."""
+    out: List[str] = []
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        out.append(n.sexp())
+        for c in n.children:
+            if isinstance(c, Node) and c.children:
+                stack.append(c)
+    return out
+
+
+def corpus_syntax_match(
+    references: Sequence[Sequence[str]], hypotheses: Sequence[str], lang: str
+) -> float:
+    match = total = 0
+    for refs, hyp in zip(references, hypotheses):
+        cand_sexps = set(all_subtree_sexps(parse(hyp, lang)))
+        for ref in refs:
+            for sexp in all_subtree_sexps(parse(ref, lang)):
+                if sexp in cand_sexps:
+                    match += 1
+                total += 1
+    return match / total if total else 0.0

@@ -169,6 +169,7 @@ class T5Attention(nn.Module):
         c = self.cfg
         d = jnp.dtype(c.dtype)
         inner = c.num_heads * c.d_kv
+        is_cross = kv is not None
         kv = x if kv is None else kv
         # T5's factor-1.0 init compensates for the missing 1/sqrt(d_kv)
         # score scaling; with default lecun init the softmax saturates at
@@ -176,15 +177,34 @@ class T5Attention(nn.Module):
         init_q = nn.initializers.normal((c.d_model * c.d_kv) ** -0.5)
         init_kv = nn.initializers.normal(c.d_model**-0.5)
         q = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_q, name="q")(x)
-        k = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv, name="k")(kv)
-        v = nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv, name="v")(kv)
 
         def split(t):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, c.d_kv)
 
-        q, k, v = split(q), split(k), split(v)
+        q = split(q)
 
-        if decode:
+        cross_cached = (
+            decode and is_cross and self.has_variable("cache", "cross_k")
+        )
+        if cross_cached:
+            # Encoder K/V are step-invariant: projected once at cache
+            # priming, reused every decode step.
+            k = self.get_variable("cache", "cross_k")
+            v = self.get_variable("cache", "cross_v")
+        else:
+            k = split(
+                nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv,
+                         name="k")(kv)
+            )
+            v = split(
+                nn.Dense(inner, use_bias=False, dtype=d, kernel_init=init_kv,
+                         name="v")(kv)
+            )
+            if decode and is_cross:
+                self.variable("cache", "cross_k", lambda: k)
+                self.variable("cache", "cross_v", lambda: v)
+
+        if decode and not is_cross:
             # Incremental decoding (self-attention only): the cache is
             # created at full target length by a priming call (init_cache);
             # step calls write this token's K/V at cache_index and attend
@@ -281,7 +301,7 @@ class T5Block(nn.Module):
         if self.has_cross_attention:
             h = T5LayerNorm(c.layer_norm_epsilon, name="cross_attn_ln")(x)
             attn, _ = T5Attention(c, name="cross_attn")(
-                h, enc_out, cross_mask, None, deterministic
+                h, enc_out, cross_mask, None, deterministic, decode=decode
             )
             x = x + nn.Dropout(c.dropout_rate)(attn, deterministic=deterministic)
 

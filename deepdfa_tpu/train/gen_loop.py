@@ -23,7 +23,7 @@ from flax import struct
 from deepdfa_tpu.core.config import TransformerTrainConfig
 from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
 from deepdfa_tpu.models.t5_generate import generate
-from deepdfa_tpu.train.text_loop import make_schedule
+from deepdfa_tpu.train.text_loop import make_schedule, make_text_optimizer
 
 
 @struct.dataclass
@@ -56,15 +56,8 @@ def seq2seq_loss(
     return -(tok_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-def make_gen_optimizer(cfg: TransformerTrainConfig, max_steps: int):
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.adamw(
-            make_schedule(cfg, max_steps),
-            eps=cfg.adam_epsilon,
-            weight_decay=cfg.weight_decay,
-        ),
-    )
+# Same optimizer recipe as the classifier fine-tunes (one source of truth).
+make_gen_optimizer = make_text_optimizer
 
 
 def make_gen_train_state(
@@ -110,10 +103,10 @@ def make_gen_train_step(model: T5Model, tx, cfg: TransformerTrainConfig) -> Call
 
 
 def _batches(data: Dict[str, np.ndarray], batch_size: int, rng=None,
-             pad_tail: bool = False):
+             pad_tail: bool = False, pad_id: int = 0):
     """Yield (source, target, n_valid). With ``pad_tail`` the final short
-    batch is padded with rows whose targets are all pad — such rows
-    contribute nothing to the masked loss, so metrics cover every row."""
+    batch is padded with all-``pad_id`` rows — such targets contribute
+    nothing to the masked loss, so metrics cover every row."""
     n = len(data["source_ids"])
     order = np.arange(n)
     if rng is not None:
@@ -125,8 +118,12 @@ def _batches(data: Dict[str, np.ndarray], batch_size: int, rng=None,
         n_valid = len(sel)
         if n_valid < batch_size:
             pad = batch_size - n_valid
-            src = np.concatenate([src, np.zeros((pad, src.shape[1]), src.dtype)])
-            tgt = np.concatenate([tgt, np.zeros((pad, tgt.shape[1]), tgt.dtype)])
+            src = np.concatenate(
+                [src, np.full((pad, src.shape[1]), pad_id, src.dtype)]
+            )
+            tgt = np.concatenate(
+                [tgt, np.full((pad, tgt.shape[1]), pad_id, tgt.dtype)]
+            )
         yield src, tgt, n_valid
 
 
@@ -162,7 +159,7 @@ def fit_gen(
     """Mini run_gen: train, per-epoch eval loss, final generation metric.
     Returns {"state", "eval_loss", "exact_match"}."""
     n = len(train_data["source_ids"])
-    steps_per_epoch = max(n // cfg.batch_size, 1)
+    steps_per_epoch = -(-n // cfg.batch_size)  # ceil: small sets still train
     max_steps = steps_per_epoch * cfg.max_epochs
     state, tx = make_gen_train_state(
         model,
@@ -177,10 +174,13 @@ def fit_gen(
         lambda params, s, t: seq2seq_loss(model, params, s, t)
     )
 
+    pad_id = model.cfg.pad_token_id
     rng = np.random.RandomState(cfg.seed)
     for epoch in range(cfg.max_epochs):
         losses = []
-        for src, tgt, _ in _batches(train_data, cfg.batch_size, rng):
+        for src, tgt, _ in _batches(
+            train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
+        ):
             state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
             losses.append(loss)
         if log:
@@ -188,7 +188,9 @@ def fit_gen(
 
     eval_losses = [
         float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t)))
-        for s, t, _ in _batches(eval_data, cfg.eval_batch_size, pad_tail=True)
+        for s, t, _ in _batches(
+            eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
+        )
     ]
 
     gen = jax.jit(
@@ -197,7 +199,9 @@ def fit_gen(
         )
     )
     preds = []
-    for src, _, n_valid in _batches(eval_data, cfg.eval_batch_size, pad_tail=True):
+    for src, _, n_valid in _batches(
+        eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
+    ):
         preds.append(np.asarray(gen(state.params, jnp.asarray(src)))[:n_valid])
     pred = (
         np.concatenate(preds)

@@ -1,0 +1,113 @@
+"""CodeBLEU: ngram math, parser, syntax/dataflow components, composite."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.eval.codebleu import get_codebleu, get_codebleu_from_files
+from deepdfa_tpu.eval.codebleu.bleu import corpus_bleu, corpus_weighted_recall
+from deepdfa_tpu.eval.codebleu.dataflow import extract_dataflow, normalize_dataflow
+from deepdfa_tpu.eval.codebleu.parser import parse, tokenize
+from deepdfa_tpu.eval.codebleu.syntax import all_subtree_sexps
+
+JAVA = "int x = a + b ; if ( x > 0 ) { return x ; } else { return 0 ; }"
+
+
+def test_corpus_bleu_perfect_and_disjoint():
+    refs = [[JAVA.split()]]
+    assert corpus_bleu(refs, [JAVA.split()]) == pytest.approx(1.0)
+    assert corpus_bleu(refs, ["totally different words entirely now".split()]) < 1e-6
+
+
+def test_corpus_bleu_partial_ordering():
+    ref = [["the cat sat on the mat".split()]]
+    close = corpus_bleu(ref, ["the cat sat on a mat".split()])
+    far = corpus_bleu(ref, ["a dog stood under a rug".split()])
+    assert 0 < far < close < 1
+
+
+def test_weighted_recall_boosts_keywords():
+    ref_toks = "if x return y".split()
+    weights_kw = {t: (1.0 if t in ("if", "return") else 0.2) for t in ref_toks}
+    refs = [[(ref_toks, weights_kw)]]
+    # hypothesis matching only keywords scores higher than one matching only
+    # identifiers, despite equal token overlap
+    kw_hyp = "if q return z".split()
+    id_hyp = "aa x bb y".split()
+    assert corpus_weighted_recall(refs, [kw_hyp]) > corpus_weighted_recall(refs, [id_hyp])
+
+
+def test_tokenizer_categories():
+    toks = tokenize('if (x1 >= 0x1F) s = "a\\"b"; // done', "java")
+    cats = [(t.cat, t.text) for t in toks]
+    assert ("kw", "if") in cats
+    assert ("id", "x1") in cats
+    assert ("num", "0x1F") in cats
+    assert ("op", ">=") in cats
+    assert any(c == "str" for c, _ in cats)
+    assert all("done" not in t for _, t in cats)  # comment stripped
+
+
+def test_parser_blocks_and_stmts():
+    tree = parse("if (a) { x = 1; y = 2; } else { z = 3; }", "java")
+    sexps = all_subtree_sexps(tree)
+    assert any(s.startswith("(program") for s in sexps)
+    assert sum(s.startswith("(block") for s in sexps) == 2
+    # structure matters, names don't: same shape different identifiers match
+    tree2 = parse("if (q) { m = 1; n = 2; } else { k = 3; }", "java")
+    assert set(all_subtree_sexps(tree)) == set(all_subtree_sexps(tree2))
+
+
+def test_syntax_match_name_insensitive_structure_sensitive():
+    ref = ["while (i < n) { total = total + i ; i ++ ; }"]
+    hyp_same = "while (j < m) { acc = acc + j ; j ++ ; }"
+    hyp_diff = "return 0 ;"
+    out_same = get_codebleu([ref], [hyp_same], "java")
+    out_diff = get_codebleu([ref], [hyp_diff], "java")
+    assert out_same["syntax_match"] == pytest.approx(1.0)
+    assert out_diff["syntax_match"] < out_same["syntax_match"]
+
+
+def test_dataflow_extraction():
+    edges = extract_dataflow("int x = a ; y = x + b ; y += 1 ; i ++ ;", "java")
+    assert ("x", "comesFrom", ("a",)) in edges
+    assert ("y", "computedFrom", ("x", "b")) in edges
+    assert ("y", "computedFrom", ("y",)) in edges
+    assert ("i", "computedFrom", ("i",)) in edges
+
+
+def test_dataflow_normalization_name_insensitive():
+    a = normalize_dataflow(extract_dataflow("x = a ; b = x + a ;", "java"))
+    b = normalize_dataflow(extract_dataflow("q = w ; e = q + w ;", "java"))
+    assert a == b
+
+
+def test_python_parser_and_dataflow():
+    code = "def f(xs):\n    total = 0\n    for x in xs:\n        total += x\n    return total\n"
+    edges = extract_dataflow(code, "python")
+    assert ("x", "comesFrom", ("xs",)) in edges
+    assert ("total", "computedFrom", ("total", "x")) in edges
+    sexps = all_subtree_sexps(parse(code, "python"))
+    assert sum(s.startswith("(block") for s in sexps) >= 2
+
+
+def test_composite_bounds_and_perfect():
+    refs = [[JAVA]]
+    out = get_codebleu(refs, [JAVA], "java")
+    assert out["codebleu"] == pytest.approx(1.0, abs=1e-6)
+    for k, v in out.items():
+        assert 0.0 <= v <= 1.0 + 1e-9, (k, v)
+
+    worse = get_codebleu(refs, ["return 0 ;"], "java")
+    assert worse["codebleu"] < out["codebleu"]
+
+
+def test_from_files(tmp_path):
+    ref = tmp_path / "ref.txt"
+    hyp = tmp_path / "hyp.txt"
+    # every line needs >= 4 tokens: an n-gram-free line still contributes a
+    # denominator of 1 (nltk semantics the reference inherits), so a short
+    # identical line scores < 1.
+    ref.write_text(f"{JAVA}\nreturn 1 + 2 ;\n")
+    hyp.write_text(f"{JAVA}\nreturn 1 + 2 ;\n")
+    out = get_codebleu_from_files([str(ref)], str(hyp), "java")
+    assert out["codebleu"] == pytest.approx(1.0, abs=1e-6)
