@@ -1,0 +1,286 @@
+"""Line-level vulnerability localization and the RQ2 effort/recall metrics.
+
+Re-design of the UniXcoder-variant explanation stack
+(LineVul/unixcoder/linevul_main.py:886-1380): per-token relevance scores
+come from one of
+  - ``attention``  — total attention each token receives in the FIRST
+    encoder layer, summed over heads and query positions
+    (linevul_main.py:1155-1170), special tokens zeroed;
+  - ``saliency``   — |d logit_vuln / d embedding| summed over the hidden dim
+    and L2-normalized (captum Saliency + summarize_attributions,
+    linevul_main.py:946-949,1066-1078) — here a plain ``jax.grad``;
+  - ``integrated_gradients`` — Riemann-sum IG against a pad-embedding
+    baseline (captum LayerIntegratedGradients, linevul_main.py:1171-1186).
+
+Token scores aggregate into per-line scores by splitting the decoded token
+stream at newline markers (get_all_lines_score, linevul_main.py:1335-1363);
+per-function evaluation ranks lines and reports Top-k accuracy, IFA, and
+effort (line_level_evaluation, :1242-1332); corpus-level Effort@TopK% /
+Recall@TopK% walk the ranked concatenation (:886-944).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Token-level scores
+# ---------------------------------------------------------------------------
+
+
+def attention_token_scores(
+    attentions: Sequence[jnp.ndarray], special_mask: np.ndarray
+) -> np.ndarray:
+    """attentions: per-layer [B, H, Q, K] weights (output_attentions=True).
+    Score = attention received per key token in the first layer, summed over
+    heads and queries; special/pad positions zeroed
+    (linevul_main.py:1155-1170 uses attentions[0])."""
+    att = np.asarray(attentions[0], np.float32)  # [B, H, Q, K]
+    scores = att.sum(axis=(1, 2))  # [B, K]
+    return np.where(special_mask, 0.0, scores)
+
+
+def saliency_token_scores(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    embed_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    target: int = 1,
+) -> np.ndarray:
+    """|grad of logits[:, target] wrt input embeddings|, summed over hidden,
+    L2-normalized per row (summarize_attributions semantics)."""
+    embeds = embed_fn(input_ids)
+
+    def logit_sum(e):
+        logits = model.apply(params, input_ids, input_embeds=e)
+        return logits[:, target].sum()
+
+    grads = jax.grad(logit_sum)(embeds)
+    attr = jnp.abs(grads).sum(axis=-1)
+    norm = jnp.linalg.norm(attr, axis=-1, keepdims=True)
+    return np.asarray(attr / jnp.maximum(norm, 1e-12))
+
+
+def integrated_gradients_token_scores(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    embed_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    baseline_ids: Optional[jnp.ndarray] = None,
+    pad_id: Optional[int] = None,
+    target: int = 1,
+    steps: int = 20,
+) -> np.ndarray:
+    """IG = (x - x0) * mean_alpha grad(f(x0 + alpha(x-x0))), summed over
+    hidden and L2-normalized. Pass ``pad_id`` to use the reference's
+    baseline — pad embeddings with the original first/last tokens kept
+    (create_ref_input_ids, linevul_main.py:951-954); with neither
+    ``baseline_ids`` nor ``pad_id`` the baseline is the zero embedding."""
+    embeds = embed_fn(input_ids)
+    if baseline_ids is None and pad_id is not None:
+        mid = jnp.full_like(input_ids[:, 1:-1], pad_id)
+        baseline_ids = jnp.concatenate(
+            [input_ids[:, :1], mid, input_ids[:, -1:]], axis=1
+        )
+    if baseline_ids is None:
+        base = jnp.zeros_like(embeds)
+    else:
+        base = embed_fn(baseline_ids)
+
+    def logit_sum(e):
+        logits = model.apply(params, input_ids, input_embeds=e)
+        return logits[:, target].sum()
+
+    grad_fn = jax.grad(logit_sum)
+    delta = embeds - base
+
+    def body(acc, alpha):
+        return acc + grad_fn(base + alpha * delta), None
+
+    alphas = (jnp.arange(steps, dtype=jnp.float32) + 0.5) / steps
+    total, _ = jax.lax.scan(body, jnp.zeros_like(embeds), alphas)
+    attr = (delta * total / steps).sum(axis=-1)
+    attr = jnp.abs(attr)
+    norm = jnp.linalg.norm(attr, axis=-1, keepdims=True)
+    return np.asarray(attr / jnp.maximum(norm, 1e-12))
+
+
+# ---------------------------------------------------------------------------
+# Line aggregation
+# ---------------------------------------------------------------------------
+
+NEWLINE_MARKERS = ("\n", " \n", "\n\n", " \n\n", "Ċ", " Ċ", "ĊĊ", " ĊĊ")
+
+
+def line_scores(
+    tokens: Sequence[str], scores: Sequence[float],
+    flaw_lines: Sequence[str] = (),
+) -> Tuple[List[float], List[int]]:
+    """Accumulate token scores into line scores, splitting at newline
+    markers; a line whose concatenated text equals a flaw line (whitespace-
+    stripped) is marked (get_all_lines_score parity: lines with zero
+    accumulated score do not emit)."""
+    flaw = {"".join(l.split()) for l in flaw_lines}
+    all_lines: List[float] = []
+    flaw_idx: List[int] = []
+    acc = 0.0
+    line = ""
+
+    def emit():
+        nonlocal acc, line
+        all_lines.append(acc)
+        if "".join(line.split()) in flaw:
+            flaw_idx.append(len(all_lines) - 1)
+        line = ""
+        acc = 0.0
+
+    for tok, sc in zip(tokens, scores):
+        if tok in NEWLINE_MARKERS:
+            if acc != 0.0:
+                acc += float(sc)  # separator score joins its line (parity)
+                emit()
+        else:
+            line += tok
+            acc += float(sc)
+    # Trailing line without a separator: the reference folds the last token
+    # into the emit *condition* and drops its text (a latent quirk); here the
+    # final line flushes completely so an end-of-function flaw line is
+    # scored and matchable.
+    if acc != 0.0:
+        emit()
+    return all_lines, flaw_idx
+
+
+# ---------------------------------------------------------------------------
+# Per-function evaluation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FunctionLocalization:
+    total_lines: int
+    num_flaw_lines: int
+    correct_at_k: Dict[float, int]  # top_k fraction -> flaw lines caught
+    top_n_hit: Dict[int, bool]      # top-k constant (e.g. 10) -> any caught
+    ifa: int                        # clean lines read before first flaw line
+    all_effort: int                 # rank of the worst flaw line
+
+
+def evaluate_function(
+    all_lines_score: Sequence[float],
+    flaw_line_indices: Sequence[int],
+    top_k_loc: Sequence[float] = (0.01, 0.05, 0.1, 0.2),
+    top_k_constant: Sequence[int] = (10,),
+) -> Optional[FunctionLocalization]:
+    """line_level_evaluation (true-positive path, linevul_main.py:1242-1332);
+    None when the function has no verified flaw lines."""
+    if not flaw_line_indices:
+        return None
+    ranking = sorted(
+        range(len(all_lines_score)), key=lambda i: all_lines_score[i], reverse=True
+    )
+    positions = [ranking.index(i) for i in flaw_line_indices]
+    correct_at_k = {}
+    for k_frac in top_k_loc:
+        k = int(len(all_lines_score) * k_frac)
+        correct_at_k[k_frac] = sum(1 for i in flaw_line_indices if i in ranking[:k])
+    top_n_hit = {
+        k: any(i in ranking[:k] for i in flaw_line_indices) for k in top_k_constant
+    }
+    return FunctionLocalization(
+        total_lines=len(all_lines_score),
+        num_flaw_lines=len(flaw_line_indices),
+        correct_at_k=correct_at_k,
+        top_n_hit=top_n_hit,
+        ifa=min(positions),
+        all_effort=max(positions),
+    )
+
+
+def summarize_localizations(
+    results: Sequence[FunctionLocalization],
+    top_k_loc: Sequence[float] = (0.01, 0.05, 0.1, 0.2),
+    top_k_constant: Sequence[int] = (10,),
+) -> Dict[str, float]:
+    """Corpus roll-up: Top-N accuracy (fraction of functions with any flaw
+    line in the top N), recall@k% (caught / total flaw lines), mean IFA."""
+    out: Dict[str, float] = {}
+    n = max(len(results), 1)
+    for k in top_k_constant:
+        out[f"top_{k}_accuracy"] = sum(r.top_n_hit[k] for r in results) / n
+    total_flaw = max(sum(r.num_flaw_lines for r in results), 1)
+    for k_frac in top_k_loc:
+        out[f"recall_at_{k_frac}"] = (
+            sum(r.correct_at_k[k_frac] for r in results) / total_flaw
+        )
+    out["mean_ifa"] = float(np.mean([r.ifa for r in results])) if results else 0.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Corpus-level RQ2 Effort@TopK% / Recall@TopK% (linevul_main.py:886-944)
+# ---------------------------------------------------------------------------
+
+
+def top_k_effort(
+    line_labels_ranked: Sequence[int], top_k: float = 0.2
+) -> Tuple[float, int]:
+    """Lines of the whole corpus ranked by score desc; effort = fraction of
+    lines inspected until top_k of all flaw lines are caught."""
+    total = len(line_labels_ranked)
+    flaw_total = sum(line_labels_ranked)
+    target = int(flaw_total * top_k)
+    caught = inspected = 0
+    for label in line_labels_ranked:
+        if caught >= target:  # checked first: target 0 costs 0 inspections
+            break
+        inspected += 1
+        caught += int(label == 1)
+    return (inspected / total if total else 0.0), inspected
+
+
+def top_k_recall(
+    pos_labels_ranked: Sequence[int],
+    neg_labels_ranked: Sequence[int],
+    top_k: float = 0.01,
+) -> float:
+    """Recall of flaw lines within the top_k fraction of all lines: inspect
+    predicted-positive functions' lines first, then negatives
+    (linevul_main.py:912-931)."""
+    total = len(pos_labels_ranked) + len(neg_labels_ranked)
+    flaw_total = sum(pos_labels_ranked) + sum(neg_labels_ranked)
+    budget = int(total * top_k)
+    caught = inspected = 0
+    for label in list(pos_labels_ranked) + list(neg_labels_ranked):
+        inspected += 1
+        if inspected > budget:
+            break
+        caught += int(label == 1)
+    return caught / flaw_total if flaw_total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Prediction export (eval_export, linevul_main.py:742-830)
+# ---------------------------------------------------------------------------
+
+
+def export_predictions(
+    path: str,
+    index: Sequence[int],
+    probs: Sequence[float],
+    labels: Sequence[int],
+    threshold: float = 0.5,
+) -> None:
+    """CSV dump of per-example predictions for downstream analysis."""
+    import csv
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["index", "prob", "pred", "label"])
+        for i, p, l in zip(index, probs, labels):
+            w.writerow([int(i), float(p), int(p >= threshold), int(l)])

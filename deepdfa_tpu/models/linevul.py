@@ -64,6 +64,7 @@ class LineVul(nn.Module):
         graphs: Optional[GraphBatch] = None,
         deterministic: bool = True,
         output_attentions: bool = False,
+        input_embeds: Optional[jnp.ndarray] = None,
     ):
         attn_mask = input_ids != self.encoder_config.pad_token_id
         hidden, attentions = RobertaEncoder(
@@ -73,6 +74,7 @@ class LineVul(nn.Module):
             attn_mask,
             deterministic=deterministic,
             output_attentions=output_attentions,
+            input_embeds=input_embeds,
         )
         cls_vec = hidden[:, 0, :]
 

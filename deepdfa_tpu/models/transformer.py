@@ -150,11 +150,20 @@ class RobertaEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic: bool = True,
-                 output_attentions: bool = False):
+                 output_attentions: bool = False, input_embeds=None):
+        """``input_embeds`` (optional [B, T, H]) replaces the word-embedding
+        lookup — the hook for gradient-based attribution (saliency /
+        integrated gradients differentiate wrt the embedding, the captum
+        pattern in the reference, unixcoder/linevul_main.py:1052-1078)."""
         c = self.cfg
         if attn_mask is None:
             attn_mask = input_ids != c.pad_token_id
-        word = nn.Embed(c.vocab_size, c.hidden_size, name="word_embeddings")(input_ids)
+        if input_embeds is None:
+            word = nn.Embed(c.vocab_size, c.hidden_size, name="word_embeddings")(
+                input_ids
+            )
+        else:
+            word = input_embeds
         # RoBERTa position ids: pad positions stay at pad_id; real tokens
         # count up from pad_id+1.
         positions = jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) * attn_mask + c.pad_token_id

@@ -1,0 +1,175 @@
+"""Line-level localization: token scores (attention/saliency/IG), line
+aggregation, per-function and corpus metrics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.eval.localization import (
+    attention_token_scores,
+    evaluate_function,
+    export_predictions,
+    integrated_gradients_token_scores,
+    line_scores,
+    saliency_token_scores,
+    summarize_localizations,
+    top_k_effort,
+    top_k_recall,
+)
+from deepdfa_tpu.models.linevul import LineVul
+from deepdfa_tpu.models.transformer import EncoderConfig
+
+
+def _model(seed=0):
+    cfg = EncoderConfig.tiny()
+    model = LineVul(cfg)
+    ids = jnp.asarray(np.random.RandomState(seed).randint(2, cfg.vocab_size, size=(2, 16)))
+    params = model.init(jax.random.PRNGKey(0), ids)
+    return cfg, model, params, ids
+
+
+def test_attention_token_scores():
+    cfg, model, params, ids = _model()
+    logits, attentions = model.apply(params, ids, output_attentions=True)
+    special = np.zeros(ids.shape, bool)
+    special[:, 0] = True  # CLS
+    scores = attention_token_scores(attentions, special)
+    assert scores.shape == ids.shape
+    assert (scores[:, 0] == 0).all()
+    assert (scores[:, 1:] > 0).any()
+
+
+def _embed_fn(model, params, cfg):
+    emb = params["params"]["roberta"]["word_embeddings"]["embedding"]
+
+    def fn(ids):
+        return jnp.asarray(np.asarray(emb))[ids]
+
+    return fn
+
+
+def test_saliency_scores_shape_and_norm():
+    cfg, model, params, ids = _model()
+    scores = saliency_token_scores(model, params, ids, _embed_fn(model, params, cfg))
+    assert scores.shape == ids.shape
+    np.testing.assert_allclose(np.linalg.norm(scores, axis=-1), 1.0, atol=1e-5)
+    assert (scores >= 0).all()
+
+
+def test_integrated_gradients_completeness_direction():
+    """IG attributions must reflect input-output sensitivity: for the linear
+    model f(e) = w·sum_t e_t the IG of token t is |w·(e_t - base_t)| exactly."""
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(8))
+
+    class Linear:
+        def apply(self, params, input_ids, input_embeds=None):
+            out = (input_embeds * w).sum(axis=(1, 2))
+            return jnp.stack([jnp.zeros_like(out), out], axis=1)
+
+    ids = jnp.asarray(rng.randint(0, 16, size=(1, 5)))
+    table = jnp.asarray(rng.randn(16, 8))
+    embed_fn = lambda i: table[i]
+    scores = integrated_gradients_token_scores(
+        Linear(), None, ids, embed_fn, steps=50
+    )
+    expected = np.abs(np.asarray((embed_fn(ids) * w).sum(-1)))
+    expected = expected / np.linalg.norm(expected, axis=-1, keepdims=True)
+    np.testing.assert_allclose(scores, expected, atol=1e-4)
+
+
+def test_line_scores_grouping_and_flaw_marking():
+    tokens = ["int", " x", "\n", "x", "++", "\n", "ret", "urn", "\n"]
+    scores = [1.0, 2.0, 0.5, 3.0, 4.0, 0.5, 1.0, 1.0, 0.5]
+    lines, flaw = line_scores(tokens, scores, flaw_lines=["x ++"])
+    assert len(lines) == 3
+    assert lines[0] == pytest.approx(3.5)  # 1 + 2 + separator 0.5
+    assert lines[1] == pytest.approx(7.5)
+    assert flaw == [1]
+
+
+def test_line_scores_trailing_line_without_separator():
+    # Final line lacks a newline token: its text and score must still emit.
+    tokens = ["int", " x", "\n", "x", "++"]
+    scores = [1.0, 1.0, 0.5, 3.0, 4.0]
+    lines, flaw = line_scores(tokens, scores, flaw_lines=["x ++"])
+    assert len(lines) == 2
+    assert lines[1] == pytest.approx(7.0)
+    assert flaw == [1]
+
+
+def test_top_k_effort_zero_target():
+    # flaw_total*top_k < 1 -> target 0 -> nothing needs inspecting; a
+    # perfect ranking must not score worse than a bad one.
+    perfect = [1, 0, 0, 0, 0, 0, 0, 0, 0, 0]
+    eff, inspected = top_k_effort(perfect, top_k=0.2)
+    assert inspected == 0 and eff == 0.0
+
+
+def test_ig_pad_baseline_construction():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(8))
+
+    class Linear:
+        def apply(self, params, input_ids, input_embeds=None):
+            out = (input_embeds * w).sum(axis=(1, 2))
+            return jnp.stack([jnp.zeros_like(out), out], axis=1)
+
+    table = jnp.asarray(rng.randn(16, 8))
+    embed_fn = lambda i: table[i]
+    ids = jnp.asarray([[3, 5, 7, 9, 4]])
+    scores = integrated_gradients_token_scores(
+        Linear(), None, ids, embed_fn, pad_id=1, steps=50
+    )
+    # first/last tokens keep their own embedding as baseline -> zero attr
+    assert scores[0, 0] == pytest.approx(0.0, abs=1e-6)
+    assert scores[0, -1] == pytest.approx(0.0, abs=1e-6)
+    assert (scores[0, 1:-1] > 0).all()
+
+
+def test_evaluate_function_and_summary():
+    # 10 lines, flaw at index 0 which ranks first
+    scores = [10.0] + [float(9 - i) for i in range(9)]
+    r = evaluate_function(scores, [0], top_k_loc=(0.1, 0.5), top_k_constant=(10,))
+    assert r.ifa == 0 and r.all_effort == 0
+    assert r.correct_at_k[0.1] == 1
+    assert r.top_n_hit[10]
+
+    # flaw line ranked last
+    r2 = evaluate_function(
+        list(range(10, 0, -1)) + [0.5], [10], top_k_loc=(0.1,), top_k_constant=(10,)
+    )
+    assert r2.ifa == 10
+    assert not r2.correct_at_k[0.1]
+
+    summary = summarize_localizations([r, r2], top_k_loc=(0.1,), top_k_constant=(10,))
+    assert summary["top_10_accuracy"] == pytest.approx(0.5)
+    assert summary["recall_at_0.1"] == pytest.approx(0.5)
+    assert summary["mean_ifa"] == pytest.approx(5.0)
+
+
+def test_evaluate_function_no_flaw_lines_is_none():
+    assert evaluate_function([1.0, 2.0], []) is None
+
+
+def test_top_k_effort_and_recall():
+    # ranked labels: flaw lines early -> low effort
+    good = [1, 1, 0, 0, 0, 0, 0, 0, 1, 1]
+    effort_good, _ = top_k_effort(good, top_k=0.5)
+    bad = [0, 0, 0, 0, 0, 0, 0, 0, 1, 1]
+    effort_bad, _ = top_k_effort(bad, top_k=0.5)
+    assert effort_good < effort_bad
+
+    rec = top_k_recall([1, 0, 1, 0], [0, 0, 0, 1], top_k=0.5)
+    assert rec == pytest.approx(2 / 3)
+
+
+def test_export_predictions(tmp_path):
+    path = tmp_path / "preds.csv"
+    export_predictions(str(path), [3, 4], [0.9, 0.2], [1, 0])
+    rows = path.read_text().strip().split("\n")
+    assert rows[0] == "index,prob,pred,label"
+    assert rows[1].startswith("3,0.9,1,1")
