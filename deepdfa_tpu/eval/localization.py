@@ -117,15 +117,21 @@ def integrated_gradients_token_scores(
 NEWLINE_MARKERS = ("\n", " \n", "\n\n", " \n\n", "Ċ", " Ċ", "ĊĊ", " ĊĊ")
 
 
+SPECIAL_TOKENS = ("<s>", "</s>", "<unk>", "<pad>", "<cls>", "<sep>")
+
+
 def line_scores(
     tokens: Sequence[str], scores: Sequence[float],
     flaw_lines: Sequence[str] = (),
+    special_tokens: Sequence[str] = SPECIAL_TOKENS,
 ) -> Tuple[List[float], List[int]]:
     """Accumulate token scores into line scores, splitting at newline
     markers; a line whose concatenated text equals a flaw line (whitespace-
     stripped) is marked (get_all_lines_score parity: lines with zero
-    accumulated score do not emit)."""
+    accumulated score do not emit). Special tokens contribute neither text
+    nor score (clean_word_attr_scores, linevul_main.py:1196-1202)."""
     flaw = {"".join(l.split()) for l in flaw_lines}
+    special = frozenset(special_tokens)
     all_lines: List[float] = []
     flaw_idx: List[int] = []
     acc = 0.0
@@ -140,10 +146,14 @@ def line_scores(
         acc = 0.0
 
     for tok, sc in zip(tokens, scores):
+        if tok in special:
+            continue
         if tok in NEWLINE_MARKERS:
             if acc != 0.0:
                 acc += float(sc)  # separator score joins its line (parity)
                 emit()
+            else:
+                line = ""  # dead line: drop its text, don't leak it forward
         else:
             line += tok
             acc += float(sc)

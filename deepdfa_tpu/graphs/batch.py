@@ -236,12 +236,21 @@ def batch_iterator(
     max_edges: int,
     subkeys: Sequence[str],
     add_self_loops: bool = True,
+    build_tile_adj: bool = False,
+    tile: int = 128,
+    tile_pad_nz: Optional[int] = None,
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would
     overflow the budget into the next batch (static-shape replacement for
-    DGL's GraphDataLoader)."""
+    DGL's GraphDataLoader). With ``build_tile_adj`` every batch carries the
+    Pallas block-sparse adjacency (pin ``tile_pad_nz`` so all batches share
+    one compiled kernel)."""
     pending: List[Mapping] = []
     nodes = edges = 0
+    kw = dict(
+        add_self_loops=add_self_loops, build_tile_adj=build_tile_adj,
+        tile=tile, tile_pad_nz=tile_pad_nz,
+    )
 
     def _cost(g):
         n = int(g["num_nodes"])
@@ -253,7 +262,7 @@ def batch_iterator(
         if pending and (
             len(pending) >= n_graphs or nodes + n > max_nodes or edges + e > max_edges
         ):
-            yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, add_self_loops)
+            yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, **kw)
             pending, nodes, edges = [], 0, 0
         if n > max_nodes or e > max_edges:
             raise ValueError(f"single graph exceeds budget: {n} nodes / {e} edges")
@@ -261,4 +270,4 @@ def batch_iterator(
         nodes += n
         edges += e
     if pending:
-        yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, add_self_loops)
+        yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, **kw)

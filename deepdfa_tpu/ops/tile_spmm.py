@@ -42,6 +42,14 @@ from flax import struct
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# MXU-native tile edge; batching callers align node budgets with
+# align_to_tile() so the single source of truth lives here.
+DEFAULT_TILE = 128
+
+
+def align_to_tile(n: int, tile: int = DEFAULT_TILE) -> int:
+    return -(-n // tile) * tile
+
 
 @struct.dataclass
 class TileAdjacency:

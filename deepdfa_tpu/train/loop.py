@@ -138,6 +138,7 @@ def _batches(
     subkeys,
     batch_size: int,
     n_shards: int = 1,
+    build_tile_adj: bool = False,
 ) -> Iterable[GraphBatch]:
     """Pack examples into padded batches.
 
@@ -153,7 +154,17 @@ def _batches(
     per_shard = max(batch_size // n_shards, 1)
     budget_nodes = per_shard * data_cfg.max_nodes_per_graph
     budget_edges = budget_nodes * data_cfg.max_edges_per_node
-    sub_iter = batch_iterator(chosen, per_shard, budget_nodes, budget_edges, subkeys)
+    if build_tile_adj:
+        from deepdfa_tpu.ops.tile_spmm import align_to_tile
+
+        budget_nodes = align_to_tile(budget_nodes)
+    # Tile counts pad to powers of two inside build_tile_adjacency, so the
+    # jitted step sees a handful of distinct adjacency shapes (the same
+    # bucket-ladder compromise as the node/edge budgets), not one per batch.
+    sub_iter = batch_iterator(
+        chosen, per_shard, budget_nodes, budget_edges, subkeys,
+        build_tile_adj=build_tile_adj,
+    )
     if n_shards == 1:
         yield from sub_iter
         return
@@ -177,12 +188,14 @@ def evaluate(
     data_cfg: DataConfig,
     subkeys,
     n_shards: int = 1,
+    build_tile_adj: bool = False,
 ) -> EvalResult:
     total_loss, n_batches = 0.0, 0
     stats = BinaryStats.zeros()
     probs_all, labels_all, ids_all = [], [], []
     for batch in _batches(
-        examples, indices, data_cfg, subkeys, data_cfg.eval_batch_size, n_shards
+        examples, indices, data_cfg, subkeys, data_cfg.eval_batch_size, n_shards,
+        build_tile_adj,
     ):
         loss, probs, labels, mask = eval_step(state, batch)
         m = np.asarray(mask)
@@ -228,9 +241,17 @@ def fit(
     """
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    use_tile = model.config.message_impl == "tile"
+    if use_tile and n_shards > 1:
+        # shard_concat carries no tile adjacency (per-device tile lists do
+        # not partition along the data axis, parallel/mesh.py).
+        raise ValueError(
+            "message_impl='tile' is single-shard only; use "
+            "message_impl='segment' on a sharded mesh"
+        )
     example_batch = next(
         _batches(examples, splits["train"][:data_cfg.batch_size], data_cfg, subkeys,
-                 data_cfg.batch_size, n_shards)
+                 data_cfg.batch_size, n_shards, use_tile)
     )
     state, tx = make_train_state(model, example_batch, train_cfg)
 
@@ -280,7 +301,8 @@ def fit(
         # log line) keeps host dispatch running ahead of device execution.
         loss_sum = jnp.zeros(())
         n_batches = 0
-        for batch in _batches(examples, epoch_sel, data_cfg, subkeys, data_cfg.batch_size, n_shards):
+        for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
+                              data_cfg.batch_size, n_shards, use_tile):
             state, loss, bstats = train_step(state, batch)
             loss_sum = loss_sum + loss
             stats = stats + bstats
@@ -290,7 +312,8 @@ def fit(
         epoch_loss = float(loss_sum)
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
-        val = evaluate(eval_step, state, examples, splits["val"], data_cfg, subkeys, n_shards)
+        val = evaluate(eval_step, state, examples, splits["val"], data_cfg,
+                       subkeys, n_shards, use_tile)
         record = {
             "epoch": epoch,
             "train_loss": epoch_loss / max(n_batches, 1),

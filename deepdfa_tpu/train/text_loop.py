@@ -80,6 +80,7 @@ def text_graph_batches(
     graph_budget: Optional[Dict[str, int]] = None,
     shuffle_rng: Optional[np.random.Generator] = None,
     pad_id: int = 1,
+    build_tile_adj: bool = False,
 ) -> Iterable[TextBatch]:
     """Fixed-size text batches, each pre-joined with its graphs.
 
@@ -129,12 +130,14 @@ def text_graph_batches(
                 edges_used += e
                 slot_graphs.append((row, g))
             gbatch = _slotted_graph_batch(
-                slot_graphs, batch_size, max_nodes, max_edges, subkeys
+                slot_graphs, batch_size, max_nodes, max_edges, subkeys,
+                build_tile_adj,
             )
         yield TextBatch(ids, labels, mask, index, gbatch)
 
 
-def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys):
+def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
+                         build_tile_adj: bool = False):
     """batch_graphs, but graphs land in given slots (empty slots masked)."""
     ordered = []
     slot_of = {}
@@ -143,7 +146,12 @@ def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys):
         ordered.append(g)
     # n_slots graph slots regardless of how many graphs exist, so batch
     # shapes stay static across batches with missing graphs.
-    b = batch_graphs(ordered, n_slots, max_nodes, max_edges, subkeys)
+    if build_tile_adj:
+        from deepdfa_tpu.ops.tile_spmm import align_to_tile
+
+        max_nodes = align_to_tile(max_nodes)
+    b = batch_graphs(ordered, n_slots, max_nodes, max_edges, subkeys,
+                     build_tile_adj=build_tile_adj)
     # Remap graph slot ids to text-row slots.
     remap = np.zeros(max(len(ordered), 1), np.int32)
     graph_mask = np.zeros(n_slots, bool)
@@ -163,6 +171,9 @@ def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys):
         edge_mask=b.edge_mask,
         graph_mask=jnp.asarray(graph_mask),
         graph_ids=jnp.asarray(graph_ids),
+        # The tile adjacency depends only on senders/receivers, which the
+        # slot remap leaves untouched.
+        tile_adj=b.tile_adj,
     )
 
 
@@ -259,6 +270,7 @@ def _run_step(step_fn, state, batch: TextBatch):
 def evaluate_text(
     eval_step, state, data, indices, cfg: TransformerTrainConfig,
     graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
+    build_tile_adj: bool = False,
 ):
     stats = BinaryStats.zeros()
     total_loss, n = 0.0, 0
@@ -266,7 +278,7 @@ def evaluate_text(
     num_missing = 0
     for batch in text_graph_batches(
         data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget,
-        pad_id=pad_id,
+        pad_id=pad_id, build_tile_adj=build_tile_adj,
     ):
         loss, probs = _run_step(eval_step, state, batch)
         m = batch.example_mask
@@ -310,10 +322,20 @@ def fit_text(
     steps_per_epoch = max(-(-len(splits["train"]) // cfg.batch_size), 1)
     max_steps = steps_per_epoch * cfg.max_epochs
 
+    build_tile_adj = (
+        model.graph_config is not None
+        and model.graph_config.message_impl == "tile"
+    )
+    if build_tile_adj and mesh is not None:
+        raise ValueError(
+            "message_impl='tile' is single-shard only; use "
+            "message_impl='segment' on a sharded mesh"
+        )
     example = next(
         text_graph_batches(
             data, splits["train"][: cfg.batch_size], cfg.batch_size,
             graphs_by_id, subkeys, graph_budget, pad_id=pad_id,
+            build_tile_adj=build_tile_adj,
         )
     )
     state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
@@ -344,6 +366,7 @@ def fit_text(
         for batch in text_graph_batches(
             data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
             graph_budget, shuffle_rng=rng, pad_id=pad_id,
+            build_tile_adj=build_tile_adj,
         ):
             num_missing += int((batch.index >= 0).sum() - batch.example_mask.sum())
             state, loss, bstats = _run_step(train_step, state, batch)
@@ -353,7 +376,7 @@ def fit_text(
         epoch_loss = float(loss_sum)
         val = evaluate_text(
             eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
-            graph_budget, pad_id=pad_id,
+            graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
         )
         record = {
             "epoch": epoch,

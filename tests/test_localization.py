@@ -101,6 +101,18 @@ def test_line_scores_trailing_line_without_separator():
     assert flaw == [1]
 
 
+def test_line_scores_special_tokens_and_dead_lines():
+    # special tokens contribute neither text nor score; a zero-score line's
+    # text must not leak into the next line
+    tokens = ["<s>", "void", " f", "\n", "dead", "\n", "x", "++", "\n", "</s>"]
+    scores = [0.0, 1.0, 1.0, 0.5, 0.0, 0.0, 2.0, 2.0, 0.5, 0.0]
+    lines, flaw = line_scores(
+        tokens, scores, flaw_lines=["void f", "x ++", "dead"]
+    )
+    assert len(lines) == 2  # "dead" line has zero score -> not emitted
+    assert flaw == [0, 1]  # neither polluted by '<s>' nor by 'dead'
+
+
 def test_top_k_effort_zero_target():
     # flaw_total*top_k < 1 -> target 0 -> nothing needs inspecting; a
     # perfect ranking must not score worse than a bad one.
