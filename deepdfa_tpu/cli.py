@@ -1,0 +1,384 @@
+"""Command-line entry point: fit / test / analyze / tune.
+
+Replaces the reference's three coexisting config systems (SURVEY §5 —
+LightningCLI+YAML with link_arguments, plain argparse, and NNI injection)
+with one structured CLI over the dataclass configs:
+
+  python -m deepdfa_tpu.cli fit  --config cfg.yaml --set train.max_epochs=5
+  python -m deepdfa_tpu.cli test --checkpoint-dir runs/x --which best
+  python -m deepdfa_tpu.cli analyze --dataset synthetic:256
+  python -m deepdfa_tpu.cli tune --trials 8 --dataset synthetic:256
+
+Reference semantics carried over:
+  - layered ``--config`` YAML files, later files override earlier
+    (main_cli.py:315-321 config chains);
+  - ``--set section.key=value`` overrides anything (NNI param injection,
+    main_cli.py:110-121 — also honored from the ``DEEPDFA_TUNE_PARAMS``
+    env var as JSON);
+  - data→model linking: the model's ``input_dim`` derives from the feature
+    spec (link_arguments, main_cli.py:73-99) by construction here;
+  - crash handling renames the run log to ``.error`` and re-raises
+    (main_cli.py:324-336);
+  - after fit, the best-val-loss state is evaluated and reported
+    (main_cli.py:167-184) — tracked explicitly, not re-parsed from
+    checkpoint filenames;
+  - ``analyze`` reports abstract-dataflow feature coverage like
+    ``--analyze_dataset`` (get_coverage, main_cli.py:192-313).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+    subkeys_for,
+)
+
+logger = logging.getLogger("deepdfa_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Config assembly
+# ---------------------------------------------------------------------------
+
+_SECTIONS = {"model": FlowGNNConfig, "data": DataConfig, "train": TrainConfig}
+
+
+def _coerce(value: str, field_type: Any):
+    if field_type is bool or str(field_type) == "bool":
+        return value.lower() in ("1", "true", "yes")
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except (TypeError, ValueError):
+            continue
+    return value
+
+
+def build_configs(
+    config_files: List[str], overrides: List[str]
+) -> Dict[str, Any]:
+    """Layered YAML + key=value overrides -> {"model", "data", "train"}."""
+    import yaml
+
+    merged: Dict[str, Dict[str, Any]] = {k: {} for k in _SECTIONS}
+    for path in config_files:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        for section, values in doc.items():
+            if section not in merged:
+                raise ValueError(f"unknown config section {section!r} in {path}")
+            merged[section].update(values or {})
+
+    env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
+    if env_params:
+        for dotted, value in json.loads(env_params).items():
+            overrides = overrides + [f"{dotted}={value}"]
+    for item in overrides:
+        dotted, _, value = item.partition("=")
+        section, _, key = dotted.partition(".")
+        if section not in merged or not key:
+            raise ValueError(f"override must be section.key=value, got {item!r}")
+        merged[section][key] = value
+
+    out: Dict[str, Any] = {}
+    for section, cls in _SECTIONS.items():
+        kwargs = dict(merged[section])
+        if section == "model" and "feature" in kwargs:
+            feat = kwargs["feature"]
+            kwargs["feature"] = (
+                FeatureSpec.parse_legacy(feat) if isinstance(feat, str)
+                else FeatureSpec(**feat)
+            )
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for k in list(kwargs):
+            if k not in fields:
+                raise ValueError(f"unknown {section} option {k!r}")
+            if isinstance(kwargs[k], str) and fields[k].type not in (str, "str"):
+                kwargs[k] = _coerce(kwargs[k], fields[k].type)
+        out[section] = cls(**kwargs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Datasets
+# ---------------------------------------------------------------------------
+
+
+def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0):
+    """"synthetic[:N]" for the built-in sample generator, or a ``.jsonl``
+    of exported graph examples (the etl/export.py ``cpg_to_example``
+    format: num_nodes/senders/receivers/vuln/feats/label/id per line)."""
+    from deepdfa_tpu.data.splits import make_splits
+
+    if spec.startswith("synthetic"):
+        from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+        n = int(spec.split(":")[1]) if ":" in spec else 256
+        examples = synthetic_bigvul(
+            n, feature, positive_fraction=0.5, seed=seed
+        )
+        for i, ex in enumerate(examples):
+            ex["label"] = int(np.asarray(ex["vuln"]).max())
+            ex["id"] = i
+        splits = make_splits(examples, mode="random", seed=seed)
+        return examples, splits
+    if spec.endswith(".jsonl") and os.path.exists(spec):
+        examples = []
+        with open(spec) as f:
+            for i, line in enumerate(f):
+                ex = json.loads(line)
+                for key in ("senders", "receivers", "vuln"):
+                    ex[key] = np.asarray(ex[key], np.int32)
+                ex["feats"] = {
+                    k: np.asarray(v, np.int32) for k, v in ex["feats"].items()
+                }
+                ex.setdefault("id", i)
+                ex.setdefault("label", int(ex["vuln"].max()) if len(ex["vuln"]) else 0)
+                examples.append(ex)
+        splits = make_splits(examples, mode="random", seed=seed)
+        return examples, splits
+    raise ValueError(f"unknown dataset spec {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Logging + crash handling (main_cli.py:31-65,324-336)
+# ---------------------------------------------------------------------------
+
+
+def _setup_run_logging(run_dir: str):
+    os.makedirs(run_dir, exist_ok=True)
+    log_path = os.path.join(run_dir, f"run_{time.strftime('%Y%m%d_%H%M%S')}.log")
+    handler = logging.FileHandler(log_path)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+    )
+    logging.getLogger().addHandler(handler)
+    logging.getLogger().setLevel(logging.INFO)
+    return log_path, handler
+
+
+class _CrashLog:
+    """Rename the run log to ``.error`` on crash (main_cli.py:324-336) and
+    detach its handler either way (repeat invocations must not stack)."""
+
+    def __init__(self, log_path: str, handler: logging.Handler):
+        self.log_path = log_path
+        self.handler = handler
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        logging.getLogger().removeHandler(self.handler)
+        self.handler.close()
+        if exc_type is not None and os.path.exists(self.log_path):
+            os.replace(self.log_path, self.log_path + ".error")
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_fit(args) -> Dict[str, Any]:
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    cfgs = build_configs(args.config, args.set)
+    model_cfg, data_cfg = cfgs["model"], cfgs["data"]
+    train_cfg = cfgs["train"]
+    if args.checkpoint_dir:
+        train_cfg = dataclasses.replace(train_cfg, checkpoint_dir=args.checkpoint_dir)
+
+    run_dir = args.checkpoint_dir or "runs/default"
+    log_path, handler = _setup_run_logging(run_dir)
+    with _CrashLog(log_path, handler):
+        examples, splits = load_dataset(args.dataset, model_cfg.feature,
+                                        seed=train_cfg.seed)
+        model = FlowGNN(model_cfg)
+        mesh = None
+        if args.n_devices > 1:
+            from deepdfa_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(n_data=args.n_devices)
+        state, history = fit(model, examples, splits, train_cfg, data_cfg, mesh=mesh)
+        result = {
+            "best_epoch": history["best_epoch"],
+            "best_val_loss": history["best_val_loss"],
+            "final_val_metrics": history["epochs"][-1]["val_metrics"]
+            if history["epochs"] else {},
+        }
+        with open(os.path.join(run_dir, "history.json"), "w") as f:
+            json.dump(history, f, indent=1)
+        print(json.dumps(result))
+        return result
+
+
+def cmd_test(args) -> Dict[str, Any]:
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.loop import (
+        evaluate,
+        make_eval_step,
+        make_train_state,
+        _batches,
+    )
+
+    cfgs = build_configs(args.config, args.set)
+    model_cfg, data_cfg, train_cfg = cfgs["model"], cfgs["data"], cfgs["train"]
+    examples, splits = load_dataset(args.dataset, model_cfg.feature,
+                                    seed=train_cfg.seed)
+    model = FlowGNN(model_cfg)
+    subkeys = subkeys_for(model_cfg.feature)
+    use_tile = model_cfg.message_impl == "tile"
+    example_batch = next(
+        _batches(examples, splits["test"][: data_cfg.eval_batch_size], data_cfg,
+                 subkeys, data_cfg.eval_batch_size, build_tile_adj=use_tile)
+    )
+    state, _ = make_train_state(model, example_batch, train_cfg)
+    ckpt = CheckpointManager(args.checkpoint_dir)
+    state = ckpt.restore(args.which, state)
+
+    import jax
+
+    eval_step = jax.jit(make_eval_step(model, train_cfg))
+    res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
+                   build_tile_adj=use_tile)
+    report = {"loss": res.loss, **res.metrics}
+    print(json.dumps(report))
+    return report
+
+
+def cmd_analyze(args) -> Dict[str, Any]:
+    """Feature coverage: share of definition nodes whose abstract-dataflow
+    index is known vs UNKNOWN (index 1) vs not-a-definition (index 0) —
+    get_coverage semantics (main_cli.py:192-313, paper Table 2 ~79% at
+    k=1000)."""
+    cfgs = build_configs(args.config, args.set)
+    model_cfg = cfgs["model"]
+    examples, _ = load_dataset(args.dataset, model_cfg.feature)
+    subkeys = subkeys_for(model_cfg.feature)
+    report: Dict[str, Any] = {"n_examples": len(examples)}
+    for k in subkeys:
+        known = unknown = nondef = 0
+        for ex in examples:
+            feats = np.asarray(ex["feats"][k])
+            nondef += int((feats == 0).sum())
+            unknown += int((feats == 1).sum())
+            known += int((feats > 1).sum())
+        defs = known + unknown
+        report[k] = {
+            "definitions": defs,
+            "coverage": known / defs if defs else 0.0,
+            "nondef_nodes": nondef,
+        }
+    print(json.dumps(report))
+    return report
+
+
+def cmd_tune(args) -> Dict[str, Any]:
+    """Random hyperparameter search (the NNI replacement): samples the
+    published search space (paper Table 2 context), runs short fits, ranks
+    by best val F1, writes tune_results.jsonl."""
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    cfgs = build_configs(args.config, args.set)
+    base_model, base_data, base_train = cfgs["model"], cfgs["data"], cfgs["train"]
+    rng = np.random.RandomState(base_train.seed)
+    space = {
+        "train.learning_rate": [1e-4, 5e-4, 1e-3, 5e-3],
+        "train.weight_decay": [0.0, 1e-3, 1e-2],
+        "model.hidden_dim": [16, 32, 64],
+        "model.n_steps": [3, 5, 7],
+    }
+    examples, splits = load_dataset(args.dataset, base_model.feature,
+                                    seed=base_train.seed)
+    results = []
+    out_path = os.path.join(args.out_dir, "tune_results.jsonl")
+    os.makedirs(args.out_dir, exist_ok=True)
+    for trial in range(args.trials):
+        pick = {k: v[rng.randint(len(v))] for k, v in space.items()}
+        model_cfg = dataclasses.replace(
+            base_model,
+            hidden_dim=int(pick["model.hidden_dim"]),
+            n_steps=int(pick["model.n_steps"]),
+        )
+        train_cfg = dataclasses.replace(
+            base_train,
+            learning_rate=float(pick["train.learning_rate"]),
+            weight_decay=float(pick["train.weight_decay"]),
+            max_epochs=args.epochs_per_trial,
+        )
+        _, history = fit(FlowGNN(model_cfg), examples, splits, train_cfg, base_data)
+        best_f1 = max(
+            (e["val_metrics"].get("f1", 0.0) for e in history["epochs"]),
+            default=0.0,
+        )
+        record = {"trial": trial, "params": pick, "best_val_f1": best_f1,
+                  "best_val_loss": history["best_val_loss"]}
+        results.append(record)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        logger.info("trial %d: f1=%.4f %s", trial, best_f1, pick)
+    best = max(results, key=lambda r: r["best_val_f1"])
+    print(json.dumps(best))
+    return best
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="deepdfa_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def common(p):
+        p.add_argument("--config", action="append", default=[],
+                       help="YAML config file (repeatable; later overrides)")
+        p.add_argument("--set", action="append", default=[], metavar="S.K=V",
+                       help="override any config value")
+        p.add_argument("--dataset", default="synthetic:256")
+
+    p_fit = sub.add_parser("fit")
+    common(p_fit)
+    p_fit.add_argument("--checkpoint-dir", default=None)
+    p_fit.add_argument("--n-devices", type=int, default=1)
+    p_fit.set_defaults(func=cmd_fit)
+
+    p_test = sub.add_parser("test")
+    common(p_test)
+    p_test.add_argument("--checkpoint-dir", required=True)
+    p_test.add_argument("--which", default="best", help="best | last | epoch_N")
+    p_test.set_defaults(func=cmd_test)
+
+    p_an = sub.add_parser("analyze")
+    common(p_an)
+    p_an.set_defaults(func=cmd_analyze)
+
+    p_tune = sub.add_parser("tune")
+    common(p_tune)
+    p_tune.add_argument("--trials", type=int, default=8)
+    p_tune.add_argument("--epochs-per-trial", type=int, default=3)
+    p_tune.add_argument("--out-dir", default="runs/tune")
+    p_tune.set_defaults(func=cmd_tune)
+
+    args = parser.parse_args(argv)
+    args.func(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,7 +124,7 @@ def batch_graphs(
     subkeys: Sequence[str],
     add_self_loops: bool = True,
     build_tile_adj: bool = False,
-    tile: int = 128,
+    tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
     impl: str = "auto",
 ) -> "GraphBatch":
@@ -209,10 +209,12 @@ def batch_graphs(
 
     tile_adj = None
     if build_tile_adj:
-        from deepdfa_tpu.ops.tile_spmm import build_tile_adjacency
+        from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, build_tile_adjacency
 
         tile_adj = build_tile_adjacency(
-            senders, receivers, edge_mask, max_nodes, tile=tile, pad_nz=tile_pad_nz
+            senders, receivers, edge_mask, max_nodes,
+            tile=tile if tile is not None else DEFAULT_TILE,
+            pad_nz=tile_pad_nz,
         )
 
     return GraphBatch(
@@ -237,7 +239,7 @@ def batch_iterator(
     subkeys: Sequence[str],
     add_self_loops: bool = True,
     build_tile_adj: bool = False,
-    tile: int = 128,
+    tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would

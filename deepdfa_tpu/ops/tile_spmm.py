@@ -71,7 +71,7 @@ class TileAdjacency:
     t_vals: jnp.ndarray
     t_rows: jnp.ndarray
     t_cols: jnp.ndarray
-    tile: int = struct.field(pytree_node=False, default=128)
+    tile: int = struct.field(pytree_node=False, default=DEFAULT_TILE)
     n_row_tiles: int = struct.field(pytree_node=False, default=0)
 
 
@@ -136,7 +136,7 @@ def build_tile_adjacency(
     receivers: np.ndarray,
     edge_mask: np.ndarray,
     max_nodes: int,
-    tile: int = 128,
+    tile: int = DEFAULT_TILE,
     pad_nz: Optional[int] = None,
 ) -> TileAdjacency:
     """Host-side: build the sorted dense-tile adjacency for one GraphBatch.

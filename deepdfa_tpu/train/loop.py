@@ -155,6 +155,13 @@ def _batches(
     budget_nodes = per_shard * data_cfg.max_nodes_per_graph
     budget_edges = budget_nodes * data_cfg.max_edges_per_node
     if build_tile_adj:
+        if n_shards > 1:
+            # shard_concat constructs the global batch without tile_adj
+            # (per-device tile lists do not partition along the data axis).
+            raise ValueError(
+                "build_tile_adj requires n_shards == 1; use "
+                "message_impl='segment' on a sharded mesh"
+            )
         from deepdfa_tpu.ops.tile_spmm import align_to_tile
 
         budget_nodes = align_to_tile(budget_nodes)

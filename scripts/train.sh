@@ -1,0 +1,6 @@
+#!/bin/bash
+# DeepDFA training (reference DDFA/scripts/train.sh).
+set -e
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli fit --config configs/default.yaml \
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" "$@"

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Hyperparameter search (the reference's NNI loop, main_cli.py:110-121).
+set -e
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli tune --config configs/default.yaml \
+  --trials "${TRIALS:-8}" --epochs-per-trial "${EPOCHS:-3}" "$@"

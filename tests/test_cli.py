@@ -1,0 +1,132 @@
+"""CLI: config assembly, fit/test/analyze/tune over synthetic data."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.cli import build_configs, load_dataset, main
+from deepdfa_tpu.core.config import FeatureSpec
+
+
+def test_build_configs_layering_and_overrides(tmp_path):
+    base = tmp_path / "base.yaml"
+    base.write_text(
+        "model:\n  hidden_dim: 16\ntrain:\n  learning_rate: 0.001\n"
+    )
+    over = tmp_path / "over.yaml"
+    over.write_text("model:\n  hidden_dim: 64\n")
+    cfgs = build_configs([str(base), str(over)], ["train.max_epochs=2"])
+    assert cfgs["model"].hidden_dim == 64  # later file wins
+    assert cfgs["train"].learning_rate == pytest.approx(1e-3)
+    assert cfgs["train"].max_epochs == 2  # --set wins
+
+
+def test_build_configs_feature_forms(tmp_path):
+    f = tmp_path / "c.yaml"
+    f.write_text(
+        "model:\n  feature: _ABS_DATAFLOW_api_all_limitall_500_limitsubkeys_100\n"
+    )
+    cfgs = build_configs([str(f)], [])
+    assert cfgs["model"].feature.subkey == "api"
+    assert cfgs["model"].feature.limit_all == 500
+
+    cfgs2 = build_configs([], ["model.hidden_dim=8"])
+    assert cfgs2["model"].hidden_dim == 8
+
+
+def test_build_configs_env_injection(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_TUNE_PARAMS", json.dumps({"train.seed": 7}))
+    cfgs = build_configs([], [])
+    assert cfgs["train"].seed == 7
+
+
+def test_build_configs_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown"):
+        build_configs([], ["model.not_a_field=1"])
+
+
+def test_load_dataset_jsonl(tmp_path):
+    path = tmp_path / "ex.jsonl"
+    rows = []
+    for i in range(6):
+        rows.append(
+            {
+                "num_nodes": 3,
+                "senders": [0, 1],
+                "receivers": [1, 2],
+                "vuln": [0, i % 2, 0],
+                "feats": {k: [1, 2, 3] for k in ("api", "datatype", "literal", "operator")},
+            }
+        )
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    examples, splits = load_dataset(str(path), FeatureSpec())
+    assert len(examples) == 6
+    assert examples[1]["label"] == 1
+    assert set(splits) == {"train", "val", "test"}
+
+
+def test_cli_fit_and_test_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "run")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        main(
+            [
+                "fit", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt,
+                "--set", "train.max_epochs=2",
+                "--set", "data.batch_size=16",
+                "--set", "data.eval_batch_size=16",
+                "--set", "model.hidden_dim=8",
+                "--set", "model.n_steps=2",
+            ]
+        )
+        assert os.path.exists(os.path.join(ckpt, "history.json"))
+        main(
+            [
+                "test", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt,
+                "--set", "data.batch_size=16",
+                "--set", "data.eval_batch_size=16",
+                "--set", "model.hidden_dim=8",
+                "--set", "model.n_steps=2",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+
+
+def test_cli_analyze(capsys):
+    main(["analyze", "--dataset", "synthetic:32"])
+    out = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+    assert out["n_examples"] == 32
+    assert 0.0 <= out["datatype"]["coverage"] <= 1.0
+
+
+def test_cli_tune(tmp_path):
+    out_dir = str(tmp_path / "tune")
+    main(
+        [
+            "tune", "--dataset", "synthetic:32", "--trials", "2",
+            "--epochs-per-trial", "1", "--out-dir", out_dir,
+            "--set", "data.batch_size=16",
+            "--set", "data.eval_batch_size=16",
+        ]
+    )
+    lines = open(os.path.join(out_dir, "tune_results.jsonl")).read().strip().split("\n")
+    assert len(lines) == 2
+    assert "best_val_f1" in json.loads(lines[0])
+
+
+def test_crash_renames_log(tmp_path, monkeypatch):
+    from deepdfa_tpu import cli
+
+    def boom(*a, **k):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(cli, "load_dataset", boom)
+    ckpt = str(tmp_path / "crash")
+    with pytest.raises(RuntimeError):
+        main(["fit", "--dataset", "synthetic:8", "--checkpoint-dir", ckpt])
+    logs = os.listdir(ckpt)
+    assert any(name.endswith(".error") for name in logs), logs
