@@ -74,6 +74,13 @@ def build_configs(
     """Layered YAML + key=value overrides -> {"model", "data", "train"}."""
     import yaml
 
+    def deep_update(dst: Dict, src: Dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                deep_update(dst[k], v)
+            else:
+                dst[k] = v
+
     merged: Dict[str, Dict[str, Any]] = {k: {} for k in _SECTIONS}
     for path in config_files:
         with open(path) as f:
@@ -81,12 +88,16 @@ def build_configs(
         for section, values in doc.items():
             if section not in merged:
                 raise ValueError(f"unknown config section {section!r} in {path}")
-            merged[section].update(values or {})
+            deep_update(merged[section], values or {})
 
+    # Injected tune params apply before explicit --set: the command line
+    # always wins.
     env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
     if env_params:
-        for dotted, value in json.loads(env_params).items():
-            overrides = overrides + [f"{dotted}={value}"]
+        overrides = [
+            f"{dotted}={value}"
+            for dotted, value in json.loads(env_params).items()
+        ] + list(overrides)
     for item in overrides:
         dotted, _, value = item.partition("=")
         section, _, key = dotted.partition(".")
@@ -202,10 +213,10 @@ def cmd_fit(args) -> Dict[str, Any]:
     cfgs = build_configs(args.config, args.set)
     model_cfg, data_cfg = cfgs["model"], cfgs["data"]
     train_cfg = cfgs["train"]
-    if args.checkpoint_dir:
-        train_cfg = dataclasses.replace(train_cfg, checkpoint_dir=args.checkpoint_dir)
-
-    run_dir = args.checkpoint_dir or "runs/default"
+    # One run directory for checkpoints, log, and history: CLI flag beats
+    # YAML beats the default — and checkpoints are always written.
+    run_dir = args.checkpoint_dir or train_cfg.checkpoint_dir or "runs/default"
+    train_cfg = dataclasses.replace(train_cfg, checkpoint_dir=run_dir)
     log_path, handler = _setup_run_logging(run_dir)
     with _CrashLog(log_path, handler):
         examples, splits = load_dataset(args.dataset, model_cfg.feature,
@@ -312,6 +323,7 @@ def cmd_tune(args) -> Dict[str, Any]:
     results = []
     out_path = os.path.join(args.out_dir, "tune_results.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
+    open(out_path, "w").close()  # fresh file per run: no stale trials
     for trial in range(args.trials):
         pick = {k: v[rng.randint(len(v))] for k, v in space.items()}
         model_cfg = dataclasses.replace(

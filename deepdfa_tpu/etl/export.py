@@ -67,3 +67,32 @@ def cpg_to_example(
             [cpg.nodes[n].line_number for n in node_ids], np.int32
         ),
     }
+
+
+def export_codet5_defect_jsonl(
+    rows: Sequence[Mapping],
+    path: str,
+    graphs_by_id: Optional[Mapping[int, Mapping]] = None,
+) -> int:
+    """Dump examples to the CodeT5 defect JSONL schema ``{idx, code,
+    target}`` (get_examples_list_codet5, unixcoder/linevul_main.py:1400-1423)
+    so a LineVul-prepared dataset feeds the CodeT5 trainers directly. With
+    ``graphs_by_id`` rows lacking a parsed graph are dropped (the
+    ``keep_idx`` filter). Returns the number of rows written."""
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = 0
+    with open(path, "w") as f:
+        for row in rows:
+            idx = int(row["idx"])
+            if graphs_by_id is not None and idx not in graphs_by_id:
+                continue
+            f.write(json.dumps({
+                "idx": idx,
+                "code": row["code"],
+                "target": int(row["target"]),
+            }) + "\n")
+            n += 1
+    return n

@@ -1,0 +1,92 @@
+"""Tokenizer training: byte-level BPE and word-level vocabularies.
+
+Parity with the reference's tokenizer assets (SURVEY §2 #28):
+  - CodeT5's BPE training script (CodeT5/tokenizer/train_tokenizer.py:1-22:
+    ByteLevelBPETokenizer over code+doc corpora, vocab 32000, min_frequency
+    3, the five special tokens);
+  - LineVul's bpe_tokenizer / word_level_tokenizer JSON assets
+    (LineVul/linevul/{bpe_tokenizer,word_level_tokenizer}/).
+
+Uses the ``tokenizers`` Rust library bundled with transformers; gated so
+environments without it fail with a clear error, not an import crash.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+SPECIAL_TOKENS = ["<pad>", "<s>", "</s>", "<unk>", "<mask>"]
+
+
+def _require_tokenizers():
+    try:
+        import tokenizers  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "tokenizer training needs the `tokenizers` package"
+        ) from e
+
+
+def train_bpe(
+    files: Sequence[str],
+    out_dir: str,
+    prefix: str = "codet5",
+    vocab_size: int = 32000,
+    min_frequency: int = 3,
+    special_tokens: Optional[List[str]] = None,
+) -> List[str]:
+    """Train a byte-level BPE tokenizer; writes ``<prefix>-vocab.json`` and
+    ``<prefix>-merges.txt`` (the salesforce/codet5 asset layout)."""
+    _require_tokenizers()
+    from tokenizers import ByteLevelBPETokenizer
+
+    tok = ByteLevelBPETokenizer()
+    tok.train(
+        files=list(files),
+        vocab_size=vocab_size,
+        min_frequency=min_frequency,
+        special_tokens=special_tokens or SPECIAL_TOKENS,
+    )
+    Path(out_dir).mkdir(parents=True, exist_ok=True)
+    return tok.save_model(out_dir, prefix)
+
+
+def train_word_level(
+    files: Sequence[str],
+    out_path: str,
+    vocab_size: int = 50000,
+    special_tokens: Optional[List[str]] = None,
+) -> str:
+    """Train a whitespace word-level tokenizer to one JSON file (the
+    LineVul word_level_tokenizer asset shape)."""
+    _require_tokenizers()
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import WordLevelTrainer
+
+    tok = Tokenizer(WordLevel(unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = WordLevelTrainer(
+        vocab_size=vocab_size,
+        special_tokens=special_tokens or SPECIAL_TOKENS,
+    )
+    tok.train(list(files), trainer)
+    Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+    tok.save(out_path)
+    return out_path
+
+
+def load_tokenizer(path: str):
+    """Load a saved tokenizer JSON (word-level) or a BPE vocab/merges pair
+    (pass the vocab.json path; merges.txt expected alongside)."""
+    _require_tokenizers()
+    if path.endswith("vocab.json"):
+        from tokenizers import ByteLevelBPETokenizer
+
+        merges = path.replace("vocab.json", "merges.txt")
+        return ByteLevelBPETokenizer(path, merges)
+    from tokenizers import Tokenizer
+
+    return Tokenizer.from_file(path)

@@ -1,0 +1,244 @@
+"""RoBERTa-encoder seq2seq: the reference's ``model_type=roberta``
+generation path, TPU-native.
+
+Re-design of CodeT5/models.py:195-408 (``Seq2Seq`` = RoBERTa encoder +
+6-layer torch ``nn.TransformerDecoder`` + tied lm head + hand-rolled
+``Beam``): the encoder is our Flax :class:`RobertaEncoder`, the decoder a
+causal transformer with cross-attention and a KV cache, embeddings shared
+between encoder input, decoder input, and the lm head (the reference ties
+``lm_head.weight`` to ``encoder.embeddings.word_embeddings``). Decoding
+reuses models/t5_generate.py's generic greedy/beam (this class implements
+the same encode/decode/decode_logits protocol).
+
+Decoder block layout follows torch ``nn.TransformerDecoderLayer`` defaults
+the reference relies on: post-LN residuals, ReLU FFN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    """Encoder shape + decoder depth + special ids. ``decoder_start_token_id``
+    is the CLS/sos id and ``eos_token_id`` the SEP id (models.py:34-35
+    ``sos_id=tokenizer.cls_token_id, eos_id=tokenizer.sep_token_id``)."""
+
+    encoder: EncoderConfig = dataclasses.field(default_factory=EncoderConfig)
+    num_decoder_layers: int = 6
+    decoder_ffn_dim: int = 2048  # torch TransformerDecoderLayer default
+    max_target_positions: int = 512
+
+    @property
+    def vocab_size(self) -> int:
+        return self.encoder.vocab_size
+
+    @property
+    def hidden_size(self) -> int:
+        return self.encoder.hidden_size
+
+    @property
+    def pad_token_id(self) -> int:
+        return self.encoder.pad_token_id
+
+    @property
+    def decoder_start_token_id(self) -> int:
+        return 0  # <s> / CLS in the RoBERTa vocab
+
+    @property
+    def eos_token_id(self) -> int:
+        return 2  # </s> / SEP in the RoBERTa vocab
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 128) -> "Seq2SeqConfig":
+        return cls(
+            encoder=EncoderConfig.tiny(vocab_size),
+            num_decoder_layers=2,
+            decoder_ffn_dim=64,
+            max_target_positions=32,
+        )
+
+
+class _DecoderAttention(nn.Module):
+    """MHA with an optional decode cache: self-attention caches K/V by step,
+    cross-attention caches the encoder projections (same scheme as
+    models/t5.py T5Attention)."""
+
+    cfg: Seq2SeqConfig
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv, mask, deterministic, decode=False):
+        c = self.cfg
+        h = c.encoder.num_heads
+        d = c.hidden_size
+        head_dim = d // h
+        is_cross = kv is not None
+        kv = x if kv is None else kv
+
+        q = nn.Dense(d, name="q")(x)
+
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], h, head_dim)
+
+        q = split(q)
+        cross_cached = decode and is_cross and self.has_variable("cache", "cross_k")
+        if cross_cached:
+            k = self.get_variable("cache", "cross_k")
+            v = self.get_variable("cache", "cross_v")
+        else:
+            k = split(nn.Dense(d, name="k")(kv))
+            v = split(nn.Dense(d, name="v")(kv))
+            if decode and is_cross:
+                self.variable("cache", "cross_k", lambda: k)
+                self.variable("cache", "cross_v", lambda: v)
+
+        pos = None
+        if decode and not is_cross:
+            is_init = not self.has_variable("cache", "cached_k")
+            ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
+            cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if not is_init:
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+                ci.value = idx + 1
+                k, v = ck.value, cv.value
+                mask = (jnp.arange(k.shape[1]) <= idx)[None, None, None, :]
+                pos = idx
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        if self.causal and not decode and not is_cross:
+            t = x.shape[1]
+            causal = jnp.tril(jnp.ones((t, t), bool))
+            mask = mask & causal[None, None]
+        scores = scores + jnp.where(mask, 0.0, -1e9)
+        weights = jax.nn.softmax(scores, axis=-1)
+        weights = nn.Dropout(c.encoder.dropout_rate)(
+            weights, deterministic=deterministic
+        )
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        out = out.reshape(out.shape[0], out.shape[1], d)
+        return nn.Dense(d, name="out")(out), pos
+
+
+class _DecoderLayer(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x, self_mask, enc_out, enc_mask, deterministic,
+                 decode=False):
+        c = self.cfg
+        eps = c.encoder.layer_norm_eps
+        drop = c.encoder.dropout_rate
+        attn, _ = _DecoderAttention(c, causal=True, name="self_attn")(
+            x, None, self_mask, deterministic, decode=decode
+        )
+        attn = nn.Dropout(drop)(attn, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=eps, name="self_ln")(x + attn)
+
+        cross, _ = _DecoderAttention(c, name="cross_attn")(
+            x, enc_out, enc_mask, deterministic, decode=decode
+        )
+        cross = nn.Dropout(drop)(cross, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=eps, name="cross_ln")(x + cross)
+
+        ff = nn.Dense(c.decoder_ffn_dim, name="ffn_in")(x)
+        ff = nn.relu(ff)
+        ff = nn.Dense(c.hidden_size, name="ffn_out")(ff)
+        ff = nn.Dropout(drop)(ff, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=eps, name="ffn_ln")(x + ff)
+
+
+class _PositionCache(nn.Module):
+    """Tracks the decoder position across cached decode steps (variables
+    must be created in a compact method, hence this tiny submodule)."""
+
+    @nn.compact
+    def __call__(self, length: int, decode: bool):
+        if not decode:
+            return jnp.arange(length)
+        is_init = not self.has_variable("cache", "idx")
+        var = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        if is_init:
+            return jnp.arange(length)
+        pos = var.value + jnp.arange(length)
+        var.value = var.value + length
+        return pos
+
+
+class RobertaSeq2Seq(nn.Module):
+    """Implements the t5_generate decode protocol (encode / decode /
+    decode_logits / logits) over a RoBERTa encoder."""
+
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        c = self.cfg
+        self.shared = nn.Embed(c.vocab_size, c.hidden_size, name="shared")
+        self.encoder = RobertaEncoder(c.encoder, name="encoder")
+        self.tgt_positions = nn.Embed(
+            c.max_target_positions, c.hidden_size, name="tgt_positions"
+        )
+        self.layers = [
+            _DecoderLayer(c, name=f"layer_{i}") for i in range(c.num_decoder_layers)
+        ]
+        self.pos_cache = _PositionCache(name="pos_cache")
+
+    def encode(self, input_ids, attn_mask=None, deterministic: bool = True):
+        if attn_mask is None:
+            attn_mask = input_ids != self.cfg.pad_token_id
+        # Shared embedding feeds the encoder via input_embeds (the tied-
+        # weight scheme: one table for encoder input, decoder input, and the
+        # lm head, models.py:212-217 tie_weights).
+        hidden, _ = self.encoder(
+            input_ids, attn_mask, deterministic=deterministic,
+            input_embeds=self.shared(input_ids),
+        )
+        return hidden
+
+    def decode(self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
+               deterministic: bool = True, decode: bool = False):
+        c = self.cfg
+        x = self.shared(decoder_input_ids)
+        positions = self.pos_cache(decoder_input_ids.shape[1], decode)
+        x = x + self.tgt_positions(jnp.minimum(positions, c.max_target_positions - 1))
+
+        self_mask = decoder_mask[:, None, None, :]
+        cross_mask = enc_mask[:, None, None, :]
+        for layer in self.layers:
+            x = layer(x, self_mask, enc_out, cross_mask, deterministic,
+                      decode=decode)
+        return x
+
+    def logits(self, hidden):
+        return hidden @ self.shared.embedding.T
+
+    def decode_logits(self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
+                      deterministic: bool = True, decode: bool = False):
+        hidden = self.decode(decoder_input_ids, decoder_mask, enc_out, enc_mask,
+                             deterministic=deterministic, decode=decode)
+        return self.logits(hidden)
+
+    def __call__(self, input_ids, decoder_input_ids,
+                 attn_mask=None, decoder_mask=None,
+                 deterministic: bool = True):
+        c = self.cfg
+        if attn_mask is None:
+            attn_mask = input_ids != c.pad_token_id
+        if decoder_mask is None:
+            decoder_mask = jnp.ones_like(decoder_input_ids, bool)
+        enc_out = self.encode(input_ids, attn_mask, deterministic)
+        return self.decode(decoder_input_ids, decoder_mask, enc_out, attn_mask,
+                           deterministic)

@@ -1,4 +1,10 @@
-"""Autoregressive generation for the T5 family: greedy and beam search.
+"""Autoregressive generation: greedy and beam search with a KV cache.
+
+Works over any encoder-decoder implementing the decode protocol —
+``encode(input_ids, attn_mask)``, ``decode(ids, mask, enc_out, enc_mask,
+deterministic=..., decode=...)``, ``decode_logits(...)`` — with a config
+exposing ``pad_token_id`` / ``eos_token_id`` / ``decoder_start_token_id``:
+models/t5.py's T5Model and models/seq2seq.py's RobertaSeq2Seq both qualify.
 
 The reference generates with HF ``model.generate(num_beams=args.beam_size,
 early_stopping=..., max_length=...)`` (CodeT5/run_gen.py:104-112) on the
@@ -39,7 +45,7 @@ def _init_cache(model: T5Model, params, batch: int, max_len: int, enc_out, enc_m
         enc_out,
         enc_mask,
         decode=True,
-        method=T5Model.decode,
+        method=type(model).decode,
         mutable=["cache"],
     )
     return variables["cache"]
@@ -54,7 +60,7 @@ def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask):
         enc_out,
         enc_mask,
         decode=True,
-        method=T5Model.decode_logits,
+        method=type(model).decode_logits,
         mutable=["cache"],
     )
     return logits[:, -1, :], variables["cache"]
@@ -73,7 +79,7 @@ def greedy_decode(
     if attn_mask is None:
         attn_mask = input_ids != c.pad_token_id
     enc_out = model.apply(
-        {"params": params["params"]}, input_ids, attn_mask, method=T5Model.encode
+        {"params": params["params"]}, input_ids, attn_mask, method=type(model).encode
     )
     b = input_ids.shape[0]
     cache = _init_cache(model, params, b, max_len, enc_out, attn_mask)
@@ -130,7 +136,7 @@ def beam_search(
     k = beam_size
 
     enc_out = model.apply(
-        {"params": params["params"]}, input_ids, attn_mask, method=T5Model.encode
+        {"params": params["params"]}, input_ids, attn_mask, method=type(model).encode
     )
     # Expand batch to B*K rows (beam-major flatten).
     rep = lambda x: jnp.repeat(x, k, axis=0)

@@ -49,7 +49,7 @@ def seq2seq_loss(
         params, source_ids, dec_in, decoder_mask=dec_mask,
         deterministic=deterministic, rngs=rngs,
     )
-    logits = model.apply(params, hidden, method=T5Model.logits)
+    logits = model.apply(params, hidden, method=type(model).logits)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     tok_lp = jnp.take_along_axis(logp, target_ids[..., None], axis=-1)[..., 0]
     mask = (target_ids != c.pad_token_id).astype(jnp.float32)
@@ -219,3 +219,83 @@ def fit_gen(
         "eval_loss": float(np.mean(eval_losses)) if eval_losses else float("nan"),
         "exact_match": em,
     }
+
+
+def task_sampling_probs(sizes: Dict[str, int], alpha: float = 0.7) -> Dict[str, float]:
+    """Size-proportional task mixing with temperature smoothing: normalize,
+    raise to ``alpha``, renormalize (run_multi_gen.py:269-272)."""
+    total = sum(sizes.values())
+    p = {k: (v / total) ** alpha for k, v in sizes.items()}
+    z = sum(p.values())
+    return {k: v / z for k, v in p.items()}
+
+
+def fit_gen_multitask(
+    model: T5Model,
+    task_data: Dict[str, Dict[str, np.ndarray]],
+    eval_data: Dict[str, Dict[str, np.ndarray]],
+    cfg: TransformerTrainConfig,
+    max_steps: int,
+    alpha: float = 0.7,
+    max_target_length: int = 32,
+    init_params: Optional[Any] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Multi-task fine-tuning (run_multi_gen.py parity): each step samples a
+    task by smoothed size-proportional probability and trains on a random
+    batch from it; eval reports per-task loss + exact match. Task prefixes
+    ("Summarize python: ...") belong in the data prep, as in the reference.
+    """
+    names = sorted(task_data)
+    probs = task_sampling_probs({k: len(task_data[k]["source_ids"]) for k in names},
+                                alpha)
+    first = task_data[names[0]]
+    state, tx = make_gen_train_state(
+        model, first["source_ids"][: cfg.batch_size],
+        first["target_ids"][: cfg.batch_size], cfg, max_steps,
+        init_params=init_params,
+    )
+    step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
+
+    rng = np.random.RandomState(cfg.seed)
+    p_vec = np.asarray([probs[k] for k in names])
+    for i in range(max_steps):
+        task = names[rng.choice(len(names), p=p_vec)]
+        data = task_data[task]
+        sel = rng.choice(len(data["source_ids"]),
+                         min(cfg.batch_size, len(data["source_ids"])),
+                         replace=False)
+        src = data["source_ids"][sel]
+        tgt = data["target_ids"][sel]
+        if len(sel) < cfg.batch_size:  # pad short task batches
+            pad = cfg.batch_size - len(sel)
+            src = np.concatenate([src, np.full((pad, src.shape[1]),
+                                               model.cfg.pad_token_id, src.dtype)])
+            tgt = np.concatenate([tgt, np.full((pad, tgt.shape[1]),
+                                               model.cfg.pad_token_id, tgt.dtype)])
+        state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
+        if log and (i + 1) % max(max_steps // 10, 1) == 0:
+            log(f"step {i+1}/{max_steps} [{task}] loss={float(loss):.4f}")
+
+    eval_loss_fn = jax.jit(lambda params, s, t: seq2seq_loss(model, params, s, t))
+    gen = jax.jit(
+        lambda params, src: generate(model, params, src, max_len=max_target_length)
+    )
+    out: Dict[str, Any] = {"state": state, "tasks": {}}
+    for task in sorted(eval_data):
+        data = eval_data[task]
+        losses, preds = [], []
+        for s, t, n_valid in _batches(
+            data, cfg.eval_batch_size, pad_tail=True, pad_id=model.cfg.pad_token_id
+        ):
+            losses.append(float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t))))
+            preds.append(np.asarray(gen(state.params, jnp.asarray(s)))[:n_valid])
+        pred = np.concatenate(preds) if preds else np.zeros((0, max_target_length), np.int32)
+        out["tasks"][task] = {
+            "eval_loss": float(np.mean(losses)) if losses else float("nan"),
+            "exact_match": exact_match(
+                pred, data["target_ids"][: len(pred)],
+                model.cfg.pad_token_id, model.cfg.eos_token_id,
+            ),
+        }
+    return out

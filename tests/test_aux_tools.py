@@ -1,0 +1,97 @@
+"""Auxiliary tooling: tokenizer training, CodeT5-format export, multi-task
+generation loop."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.etl.export import export_codet5_defect_jsonl
+from deepdfa_tpu.train.gen_loop import fit_gen_multitask, task_sampling_probs
+
+tokenizers = pytest.importorskip("tokenizers")
+
+from deepdfa_tpu.etl.tokenizer_train import (  # noqa: E402
+    load_tokenizer,
+    train_bpe,
+    train_word_level,
+)
+
+CORPUS = [
+    "int main ( void ) { return 0 ; }",
+    "static int add ( int a , int b ) { return a + b ; }",
+    "void free_buf ( char * p ) { free ( p ) ; }",
+] * 30
+
+
+def test_train_bpe_roundtrip(tmp_path):
+    corpus = tmp_path / "code.txt"
+    corpus.write_text("\n".join(CORPUS))
+    files = train_bpe([str(corpus)], str(tmp_path / "bpe"), vocab_size=300,
+                      min_frequency=1)
+    assert any(f.endswith("vocab.json") for f in files)
+    tok = load_tokenizer([f for f in files if f.endswith("vocab.json")][0])
+    enc = tok.encode("int main ( void )")
+    assert len(enc.ids) > 0
+    assert tok.decode(enc.ids).strip() == "int main ( void )"
+
+
+def test_train_word_level(tmp_path):
+    corpus = tmp_path / "code.txt"
+    corpus.write_text("\n".join(CORPUS))
+    path = train_word_level([str(corpus)], str(tmp_path / "wl.json"),
+                            vocab_size=100)
+    tok = load_tokenizer(path)
+    enc = tok.encode("int main unseen_token_xyz")
+    toks = enc.tokens
+    assert "int" in toks and "main" in toks
+    assert "<unk>" in toks  # unseen word maps to unk
+
+
+def test_export_codet5_defect_jsonl(tmp_path):
+    rows = [
+        {"idx": 0, "code": "int a;", "target": 0},
+        {"idx": 1, "code": "char *p = gets(b);", "target": 1},
+        {"idx": 2, "code": "return 0;", "target": 0},
+    ]
+    path = tmp_path / "defect.jsonl"
+    # graph for ids 0 and 2 only -> row 1 dropped (keep_idx semantics)
+    n = export_codet5_defect_jsonl(rows, str(path), graphs_by_id={0: {}, 2: {}})
+    assert n == 2
+    lines = [json.loads(l) for l in path.read_text().strip().split("\n")]
+    assert [l["idx"] for l in lines] == [0, 2]
+    assert lines[0] == {"idx": 0, "code": "int a;", "target": 0}
+
+
+def test_task_sampling_probs():
+    p = task_sampling_probs({"a": 1000, "b": 10}, alpha=0.7)
+    assert abs(sum(p.values()) - 1) < 1e-9
+    assert p["a"] > p["b"]
+    # temperature flattens relative to raw proportions
+    raw_ratio = 1000 / 10
+    assert p["a"] / p["b"] < raw_ratio
+
+
+def test_fit_gen_multitask_runs_and_reports():
+    from deepdfa_tpu.core.config import TransformerTrainConfig
+    from deepdfa_tpu.data.seq2seq import synthetic_seq2seq
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+
+    cfg = dataclasses.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    model = T5Model(cfg)
+    task_data = {
+        "copy": synthetic_seq2seq(24, vocab_size=32, max_source_length=10,
+                                  max_target_length=6, seed=0, reverse=False),
+        "reverse": synthetic_seq2seq(12, vocab_size=32, max_source_length=10,
+                                     max_target_length=6, seed=1, reverse=True),
+    }
+    out = fit_gen_multitask(
+        model, task_data, task_data,
+        TransformerTrainConfig(batch_size=8, eval_batch_size=8),
+        max_steps=30, max_target_length=6,
+    )
+    assert set(out["tasks"]) == {"copy", "reverse"}
+    for task, metrics in out["tasks"].items():
+        assert np.isfinite(metrics["eval_loss"]), (task, metrics)
+        assert 0.0 <= metrics["exact_match"] <= 1.0

@@ -40,6 +40,19 @@ def test_build_configs_env_injection(tmp_path, monkeypatch):
     monkeypatch.setenv("DEEPDFA_TUNE_PARAMS", json.dumps({"train.seed": 7}))
     cfgs = build_configs([], [])
     assert cfgs["train"].seed == 7
+    # explicit --set always beats the environment
+    cfgs = build_configs([], ["train.seed=3"])
+    assert cfgs["train"].seed == 3
+
+
+def test_build_configs_deep_merges_feature(tmp_path):
+    base = tmp_path / "base.yaml"
+    base.write_text("model:\n  feature:\n    subkey: api\n    limit_all: 500\n")
+    over = tmp_path / "over.yaml"
+    over.write_text("model:\n  feature:\n    limit_all: 1000\n")
+    cfgs = build_configs([str(base), str(over)], [])
+    assert cfgs["model"].feature.subkey == "api"  # preserved from base
+    assert cfgs["model"].feature.limit_all == 1000  # overridden
 
 
 def test_build_configs_rejects_unknown():
