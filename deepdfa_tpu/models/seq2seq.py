@@ -102,7 +102,6 @@ class _DecoderAttention(nn.Module):
                 self.variable("cache", "cross_k", lambda: k)
                 self.variable("cache", "cross_v", lambda: v)
 
-        pos = None
         if decode and not is_cross:
             is_init = not self.has_variable("cache", "cached_k")
             ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
@@ -116,7 +115,6 @@ class _DecoderAttention(nn.Module):
                 ci.value = idx + 1
                 k, v = ck.value, cv.value
                 mask = (jnp.arange(k.shape[1]) <= idx)[None, None, None, :]
-                pos = idx
 
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
         if self.causal and not decode and not is_cross:
@@ -130,7 +128,7 @@ class _DecoderAttention(nn.Module):
         )
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(out.shape[0], out.shape[1], d)
-        return nn.Dense(d, name="out")(out), pos
+        return nn.Dense(d, name="out")(out)
 
 
 class _DecoderLayer(nn.Module):
@@ -142,13 +140,13 @@ class _DecoderLayer(nn.Module):
         c = self.cfg
         eps = c.encoder.layer_norm_eps
         drop = c.encoder.dropout_rate
-        attn, _ = _DecoderAttention(c, causal=True, name="self_attn")(
+        attn = _DecoderAttention(c, causal=True, name="self_attn")(
             x, None, self_mask, deterministic, decode=decode
         )
         attn = nn.Dropout(drop)(attn, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=eps, name="self_ln")(x + attn)
 
-        cross, _ = _DecoderAttention(c, name="cross_attn")(
+        cross = _DecoderAttention(c, name="cross_attn")(
             x, enc_out, enc_mask, deterministic, decode=decode
         )
         cross = nn.Dropout(drop)(cross, deterministic=deterministic)

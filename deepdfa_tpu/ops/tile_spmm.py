@@ -190,15 +190,23 @@ def _spmm_kernel(rows_ref, cols_ref, vals_ref, msg_ref, out_ref):
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # HIGHEST precision: the kernel is HBM-bound, so the extra MXU passes
-    # that give exact f32 products are free (measured ~1.54ms vs ~1.46ms on
-    # v5e for the 256-graph training shape) and keep parity with the
-    # segment-sum path bit-tight.
+    # The accumulator (out_ref) is always f32 — the MXU requires 32-bit
+    # accumulation, and for f32 inputs HIGHEST precision is free here (the
+    # kernel is HBM-bound; measured ~1.54ms vs ~1.46ms on v5e for the
+    # 256-graph training shape) and keeps parity with the segment-sum path
+    # bit-tight. bf16 inputs ride the MXU's native mixed-precision path
+    # (bf16 × bf16 → f32).
+    msg = msg_ref[:]
+    precision = (
+        jax.lax.Precision.HIGHEST
+        if msg.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
     out_ref[:] += jnp.dot(
-        vals_ref[0].astype(msg_ref.dtype),
-        msg_ref[:],
-        preferred_element_type=out_ref.dtype,
-        precision=jax.lax.Precision.HIGHEST,
+        vals_ref[0].astype(msg.dtype),
+        msg,
+        preferred_element_type=jnp.float32,
+        precision=precision,
     )
 
 
@@ -214,12 +222,13 @@ def _spmm_pallas(vals, rows, cols, msg, tile, n_row_tiles, interpret):
         ],
         out_specs=pl.BlockSpec((tile, h), lambda i, rows, cols: (rows[i], 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _spmm_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_row_tiles * tile, h), msg.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_row_tiles * tile, h), jnp.float32),
         interpret=interpret,
     )(rows, cols, vals, msg)
+    return out.astype(msg.dtype)
 
 
 def _spmm_xla(vals, rows, cols, msg, tile, n_row_tiles):

@@ -105,3 +105,28 @@ def shard_concat(shards: Sequence[GraphBatch]) -> GraphBatch:
         # message_impl="segment" (the model raises otherwise).
         tile_adj=None,
     )
+
+
+def host_shard_indices(
+    indices,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+):
+    """Per-host strided slice of an epoch's example indices, truncated so
+    every host gets the SAME length — in multi-controller JAX all processes
+    must run the same number of jitted steps or the collectives deadlock
+    (the reason DistributedSampler pads to equal shards,
+    reference CodeT5/run_defect.py:274-277).
+
+    This is an *IO-sharding building block*, not wired into the training
+    loops: a host feeding a globally-sharded step must assemble arrays with
+    ``jax.make_array_from_process_local_data`` from its local slice, which
+    is a multi-host input-pipeline concern the single-host loops here don't
+    have. No-op on a single host.
+    """
+    pc = jax.process_count() if process_count is None else process_count
+    if pc <= 1:
+        return indices
+    pi = jax.process_index() if process_index is None else process_index
+    per_host = len(indices) // pc  # truncate: equal step counts on all hosts
+    return indices[pi::pc][:per_host]

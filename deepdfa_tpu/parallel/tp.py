@@ -1,0 +1,67 @@
+"""Tensor parallelism: parameter sharding rules over the mesh's ``model``
+axis.
+
+The reference never shards a model (SURVEY §2.3 — parity is pure dp), but
+codet5-large at longer contexts wants its matmuls split across chips. Under
+GSPMD that is a *data layout* choice, not a code change: place each
+parameter with a NamedSharding and jit propagates the partitioning,
+inserting the all-reduces a Megatron implementation writes by hand.
+
+Rules follow the Megatron pairing so every attention/FFN block needs one
+collective, not two:
+  - q/k/v (and wi / wi_0 / wi_1) kernels: column-parallel — output feature
+    dim sharded over ``model``;
+  - o / wo kernels: row-parallel — input feature dim sharded (their
+    matmul's contraction produces the partial sums the all-reduce joins);
+  - embeddings, layer norms, biases, relative-position tables: replicated.
+
+Works for both param trees in this repo (models/t5.py T5Model and
+models/transformer.py RobertaEncoder) since the rules key on the owning
+module name, not the tree shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepdfa_tpu.parallel.mesh import MODEL_AXIS
+
+# Module names whose Dense kernel is column-parallel (shard dim 1) vs
+# row-parallel (shard dim 0). T5Attention: q/k/v/o; T5FFN: wi*/wo;
+# RobertaEncoder SelfAttention: query/key/value + attention_output;
+# EncoderLayer FFN: intermediate/output.
+_COLUMN = {"q", "k", "v", "wi", "wi_0", "wi_1", "query", "key", "value",
+           "intermediate", "ffn_in"}
+_ROW = {"o", "wo", "attention_output", "output", "out", "ffn_out"}
+
+
+def _spec_for(path) -> P:
+    names = [getattr(k, "key", None) for k in path]
+    leaf = names[-1] if names else None
+    owner = names[-2] if len(names) >= 2 else None
+    if leaf == "kernel" and owner in _COLUMN:
+        return P(None, MODEL_AXIS)
+    if leaf == "kernel" and owner in _ROW:
+        return P(MODEL_AXIS, None)
+    if leaf == "bias" and owner in _COLUMN:
+        return P(MODEL_AXIS)
+    return P()  # replicated: embeddings, norms, heads, row-parallel biases
+
+
+def tp_param_shardings(params: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree matching ``params`` under the Megatron rules.
+
+    ``jax.device_put(params, tp_param_shardings(params, mesh))`` + jitting
+    the existing train step is the whole TP story; batches still shard over
+    ``data``.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, _spec_for(path)), params
+    )
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    return jax.device_put(params, tp_param_shardings(params, mesh))

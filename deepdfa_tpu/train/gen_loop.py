@@ -146,6 +146,43 @@ def exact_match(pred: np.ndarray, target: np.ndarray, pad_id: int, eos_id: int) 
     return hits / max(len(pred), 1)
 
 
+def evaluate_gen(
+    model: T5Model,
+    state: GenTrainState,
+    eval_data: Dict[str, np.ndarray],
+    cfg: TransformerTrainConfig,
+    max_target_length: int = 32,
+    beam_size: int = 1,
+) -> Dict[str, float]:
+    """Eval loss over padded batches + generation exact-match (shared by
+    fit_gen and fit_gen_multitask)."""
+    pad_id = model.cfg.pad_token_id
+    eval_loss_fn = jax.jit(lambda params, s, t: seq2seq_loss(model, params, s, t))
+    gen = jax.jit(
+        lambda params, src: generate(
+            model, params, src, max_len=max_target_length, beam_size=beam_size
+        )
+    )
+    losses, preds = [], []
+    for s, t, n_valid in _batches(
+        eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
+    ):
+        losses.append(float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t))))
+        preds.append(np.asarray(gen(state.params, jnp.asarray(s)))[:n_valid])
+    pred = (
+        np.concatenate(preds)
+        if preds
+        else np.zeros((0, max_target_length), np.int32)
+    )
+    return {
+        "eval_loss": float(np.mean(losses)) if losses else float("nan"),
+        "exact_match": exact_match(
+            pred, eval_data["target_ids"][: len(pred)],
+            model.cfg.pad_token_id, model.cfg.eos_token_id,
+        ),
+    }
+
+
 def fit_gen(
     model: T5Model,
     train_data: Dict[str, np.ndarray],
@@ -170,10 +207,6 @@ def fit_gen(
         init_params=init_params,
     )
     step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
-    eval_loss_fn = jax.jit(
-        lambda params, s, t: seq2seq_loss(model, params, s, t)
-    )
-
     pad_id = model.cfg.pad_token_id
     rng = np.random.RandomState(cfg.seed)
     for epoch in range(cfg.max_epochs):
@@ -186,39 +219,8 @@ def fit_gen(
         if log:
             log(f"epoch {epoch}: train_loss={float(np.mean(jax.device_get(losses))):.4f}")
 
-    eval_losses = [
-        float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t)))
-        for s, t, _ in _batches(
-            eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
-        )
-    ]
-
-    gen = jax.jit(
-        lambda params, src: generate(
-            model, params, src, max_len=max_target_length, beam_size=beam_size
-        )
-    )
-    preds = []
-    for src, _, n_valid in _batches(
-        eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
-    ):
-        preds.append(np.asarray(gen(state.params, jnp.asarray(src)))[:n_valid])
-    pred = (
-        np.concatenate(preds)
-        if preds
-        else np.zeros((0, max_target_length), np.int32)
-    )
-    em = exact_match(
-        pred,
-        eval_data["target_ids"][: len(pred)],
-        model.cfg.pad_token_id,
-        model.cfg.eos_token_id,
-    )
-    return {
-        "state": state,
-        "eval_loss": float(np.mean(eval_losses)) if eval_losses else float("nan"),
-        "exact_match": em,
-    }
+    ev = evaluate_gen(model, state, eval_data, cfg, max_target_length, beam_size)
+    return {"state": state, **ev}
 
 
 def task_sampling_probs(sizes: Dict[str, int], alpha: float = 0.7) -> Dict[str, float]:
@@ -277,25 +279,9 @@ def fit_gen_multitask(
         if log and (i + 1) % max(max_steps // 10, 1) == 0:
             log(f"step {i+1}/{max_steps} [{task}] loss={float(loss):.4f}")
 
-    eval_loss_fn = jax.jit(lambda params, s, t: seq2seq_loss(model, params, s, t))
-    gen = jax.jit(
-        lambda params, src: generate(model, params, src, max_len=max_target_length)
-    )
     out: Dict[str, Any] = {"state": state, "tasks": {}}
     for task in sorted(eval_data):
-        data = eval_data[task]
-        losses, preds = [], []
-        for s, t, n_valid in _batches(
-            data, cfg.eval_batch_size, pad_tail=True, pad_id=model.cfg.pad_token_id
-        ):
-            losses.append(float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t))))
-            preds.append(np.asarray(gen(state.params, jnp.asarray(s)))[:n_valid])
-        pred = np.concatenate(preds) if preds else np.zeros((0, max_target_length), np.int32)
-        out["tasks"][task] = {
-            "eval_loss": float(np.mean(losses)) if losses else float("nan"),
-            "exact_match": exact_match(
-                pred, data["target_ids"][: len(pred)],
-                model.cfg.pad_token_id, model.cfg.eos_token_id,
-            ),
-        }
+        out["tasks"][task] = evaluate_gen(
+            model, state, eval_data[task], cfg, max_target_length
+        )
     return out
