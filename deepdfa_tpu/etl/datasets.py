@@ -148,3 +148,35 @@ def load_devign(
         )
     logger.info("devign: %d rows from %s", len(out), json_path)
     return out
+
+
+def load_mutated(
+    rows: List[Dict], jsonl_path: str | Path, subdataset: str
+) -> List[Dict]:
+    """Join Big-Vul rows with a mutated-code JSONL (reference
+    datasets.py:105-125 ``mutated``): each JSONL line carries
+    ``{idx, source, target}``; ``*_flip`` subdatasets take ``source`` as the
+    function body, others take ``target``. Inner join — only rows with a
+    mutated counterpart survive; diff-derived fields are dropped (mutants
+    have no before/after pair)."""
+    use_source = "flip" in subdataset
+    mutated_by_id: Dict[int, str] = {}
+    with open(jsonl_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            code = rec["source"] if use_source else rec["target"]
+            mutated_by_id[int(rec["idx"])] = code
+    out: List[Dict] = []
+    for row in rows:
+        code = mutated_by_id.get(int(row["id"]))
+        if code is None:
+            continue
+        new = {k: v for k, v in row.items()
+               if k not in ("after", "added", "removed", "diff")}
+        new["before"] = code
+        new["func_before"] = code
+        new["dataset"] = f"mutated_{subdataset}"
+        out.append(new)
+    logger.info("mutated_%s: %d rows joined from %s",
+                subdataset, len(out), jsonl_path)
+    return out

@@ -135,18 +135,20 @@ class JoernSession:
 def extract_cpg_batch(
     c_files: List[Path],
     out_dir: Path,
-    n_workers: int = 1,
+    worker_id: int = 0,
     failed_log: Optional[Path] = None,
 ) -> List[Path]:
     """Run Joern over a batch of single-function C files, exporting
     ``<name>.nodes.json``/``.edges.json`` next to each via
     ``scripts/export_cpg.sc`` (getgraphs.py:71-156 semantics: per-item fault
-    tolerance, failures logged and skipped)."""
+    tolerance, failures logged and skipped). ``worker_id`` keys the Joern
+    workspace — concurrent sessions must not share one (the REPL writes
+    project metadata into its workspace directory)."""
     if not joern_available():
         raise RuntimeError("joern binary not found on PATH")
     script = Path(__file__).parent / "scripts" / "export_cpg.sc"
     done: List[Path] = []
-    session = JoernSession(0, out_dir / "ws")
+    session = JoernSession(worker_id, out_dir / "ws")
     try:
         for path in c_files:
             try:

@@ -1,0 +1,110 @@
+"""Loader for the reference pipeline's cache artifacts.
+
+The reference's dbize stage writes ``nodes[_sample].csv`` /
+``edges[_sample].csv`` (DDFA/sastvd/scripts/dbize.py:75-76: per-node rows
+with ``graph_id``/``dgl_id``/``node_id``/``vuln``; per-edge rows with
+``graph_id``/``innode``/``outnode``) plus per-feature
+``nodes_feat_<feat>_<split>[_sample].csv`` files holding the abstract-
+dataflow vocab index per (graph_id, node_id) (dbize_absdf.py:21-45), and
+bakes the graphs into DGL's ``graphs.bin``. This module reads the CSVs —
+the complete information; ``graphs.bin`` is just the edge list re-serialized
+(dbize_graphs.py:15-27, self-loops re-added at our batch time) — and
+produces the example dicts ``graphs/batch.py`` consumes, so datasets
+preprocessed by the reference pipeline feed this framework without rerunning
+Joern.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.core.config import ALL_SUBKEYS, FeatureSpec
+
+
+def _feat_path(processed_dir: Path, feature: FeatureSpec, subkey: str,
+               split: str, sample: bool) -> Path:
+    name = (
+        f"_ABS_DATAFLOW_{subkey}_all"
+        f"_limitall_{feature.limit_all}_limitsubkeys_{feature.limit_subkeys}"
+    )
+    sample_text = "_sample" if sample else ""
+    return processed_dir / f"nodes_feat_{name}_{split}{sample_text}.csv"
+
+
+def load_reference_cache(
+    processed_dir: str,
+    feature: Optional[FeatureSpec] = None,
+    split: str = "fixed",
+    sample: bool = False,
+    labels_by_id: Optional[Dict[int, int]] = None,
+) -> List[Dict]:
+    """Read nodes/edges/nodes_feat CSVs into example dicts.
+
+    Node order within a graph is ``dgl_id`` (the dense ids ``graphs.bin``
+    used); graph label defaults to max node vuln (base_module.py:87-88)
+    unless ``labels_by_id`` provides it.
+    """
+    import pandas as pd
+
+    feature = feature or FeatureSpec()
+    root = Path(processed_dir)
+    sample_text = "_sample" if sample else ""
+    nodes = pd.read_csv(root / f"nodes{sample_text}.csv", index_col=0)
+    edges = pd.read_csv(root / f"edges{sample_text}.csv", index_col=0)
+
+    subkeys = ALL_SUBKEYS if feature.concat_all else (feature.subkey,)
+    feats_frames = {}
+    for subkey in subkeys:
+        path = _feat_path(root, feature, subkey, split, sample)
+        fdf = pd.read_csv(path, index_col=0)
+        feat_col = [c for c in fdf.columns if c.startswith("_ABS_DATAFLOW")]
+        if len(feat_col) != 1:
+            raise ValueError(f"{path} has no unique feature column: {list(fdf.columns)}")
+        feats_frames[subkey] = fdf.set_index(["graph_id", "node_id"])[feat_col[0]]
+
+    out: List[Dict] = []
+    edge_groups = dict(tuple(edges.groupby("graph_id")))
+    for graph_id, n in nodes.groupby("graph_id"):
+        n = n.sort_values("dgl_id")
+        num_nodes = int(n["dgl_id"].max()) + 1
+        vuln = np.zeros(num_nodes, np.int32)
+        vuln[n["dgl_id"].to_numpy()] = n["vuln"].to_numpy()
+
+        e = edge_groups.get(graph_id)
+        senders = (
+            e["innode"].to_numpy(np.int32) if e is not None else np.zeros(0, np.int32)
+        )
+        receivers = (
+            e["outnode"].to_numpy(np.int32) if e is not None else np.zeros(0, np.int32)
+        )
+
+        feats = {}
+        node_ids = n["node_id"].to_numpy()
+        dgl_ids = n["dgl_id"].to_numpy()
+        for subkey in subkeys:
+            series = feats_frames[subkey]
+            vals = np.zeros(num_nodes, np.int64)
+            for nid, did in zip(node_ids, dgl_ids):
+                vals[did] = int(series.get((graph_id, nid), 0))
+            feats[subkey] = vals
+
+        gid = int(graph_id)
+        out.append(
+            {
+                "id": gid,
+                "num_nodes": num_nodes,
+                "senders": senders,
+                "receivers": receivers,
+                "vuln": vuln,
+                "feats": feats,
+                "label": (
+                    labels_by_id[gid]
+                    if labels_by_id is not None and gid in labels_by_id
+                    else int(vuln.max(initial=0))
+                ),
+            }
+        )
+    return out

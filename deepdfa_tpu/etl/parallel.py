@@ -1,0 +1,78 @@
+"""Process-parallel ETL map with per-item fault tolerance.
+
+Parity with the reference's ``dfmp`` (DDFA/sastvd/__init__.py:198-244:
+multiprocessing Pool map over dataframe rows, 6 workers default, tqdm
+progress, ordered results) and its ETL failure posture (SURVEY §5: every
+per-function step catches, logs, and continues — failures land in
+``failed_joern.txt``-style sidecar files rather than aborting a multi-hour
+preprocessing run).
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL_ERROR = "__pmap_error__"
+
+# The mapped function travels to fork()ed workers by memory inheritance,
+# not pickling — so closures and lambdas work (the reference's dfmp
+# requires module-level functions; this lifts that restriction).
+_ACTIVE_FN: Optional[Callable] = None
+
+
+def _call(item):
+    try:
+        return _ACTIVE_FN(item)
+    except Exception as e:  # per-item fault tolerance: record, don't abort
+        return (_SENTINEL_ERROR, repr(item)[:200], f"{type(e).__name__}: {e}")
+
+
+def pmap(
+    fn: Callable[[Any], Any],
+    items: Sequence[Any],
+    workers: int = 6,
+    desc: str = "",
+    failed_log: Optional[str] = None,
+    chunksize: int = 1,
+) -> List[Any]:
+    """Map ``fn`` over ``items`` with a process pool; ordered results.
+
+    Items whose ``fn`` raises yield ``None`` in the result list; the failure
+    is logged (and appended to ``failed_log`` when given) and processing
+    continues — the reference's getgraphs.py:57-59 semantics.
+    Degenerates to a serial loop for ``workers <= 1``, tiny inputs, or
+    platforms without fork (avoids fork overhead and keeps tracebacks
+    direct under debuggers).
+    """
+    global _ACTIVE_FN
+    _ACTIVE_FN = fn
+    try:
+        if workers <= 1 or len(items) < 2 or os.name != "posix":
+            results = [_call(item) for item in items]
+        else:
+            with mp.get_context("fork").Pool(workers) as pool:
+                results = pool.map(_call, items, chunksize=chunksize)
+    finally:
+        _ACTIVE_FN = None
+
+    out: List[Any] = []
+    failures = []
+    for r in results:
+        if isinstance(r, tuple) and len(r) == 3 and r[0] == _SENTINEL_ERROR:
+            failures.append((r[1], r[2]))
+            out.append(None)
+        else:
+            out.append(r)
+    if failures:
+        logger.warning("%s: %d/%d items failed", desc or "pmap",
+                       len(failures), len(items))
+        if failed_log:
+            with open(failed_log, "a") as f:
+                for item_repr, err in failures:
+                    f.write(f"{item_repr}\t{err}\n")
+    return out

@@ -1,0 +1,209 @@
+"""ETL stage driver: the preprocess.sh of this framework.
+
+The reference preprocesses in five SLURM-able stages
+(DDFA/scripts/preprocess.sh:1-9 — prepare, getgraphs, dbize(+graphs),
+abstract_dataflow, absdf). Here the same flow is three stages over one
+``workdir``:
+
+  prepare  — load a dataset (bigvul csv / devign json), write one ``.c``
+             file per function plus ``meta.jsonl``;
+  graphs   — run Joern over every function lacking exports (process-
+             parallel via etl/parallel.pmap; failures land in
+             ``failed_joern.txt`` and the row is skipped, getgraphs.py:57-59);
+  export   — parse the Joern JSON, build the train-split abstract-dataflow
+             vocabs, compute line-level labels (removed + dependent-added
+             lines), and write ``examples.jsonl`` (the format
+             ``cli.load_dataset`` and the graph batcher consume) plus
+             ``splits.json``.
+
+CLI: ``python -m deepdfa_tpu.etl.pipeline prepare|graphs|export|all ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepdfa_tpu.core.config import FeatureSpec
+
+logger = logging.getLogger(__name__)
+
+
+def prepare(rows: List[Dict], workdir: str) -> int:
+    """Write functions/<id>.c + meta.jsonl; returns row count."""
+    root = Path(workdir)
+    (root / "functions").mkdir(parents=True, exist_ok=True)
+    with open(root / "meta.jsonl", "w") as f:
+        for row in rows:
+            (root / "functions" / f"{row['id']}.c").write_text(row["before"])
+            f.write(json.dumps({
+                "id": int(row["id"]),
+                "vul": int(row["vul"]),
+                "project": row.get("project", ""),
+                "added": list(row.get("added", [])),
+                "removed": list(row.get("removed", [])),
+                "after": row.get("after", ""),
+            }) + "\n")
+    return len(rows)
+
+
+def _meta(workdir: Path) -> List[Dict]:
+    with open(workdir / "meta.jsonl") as f:
+        return [json.loads(line) for line in f]
+
+
+def run_graphs(workdir: str, workers: int = 6) -> List[Path]:
+    """Joern extraction for every function without exports."""
+    from deepdfa_tpu.etl.joern_session import extract_cpg_batch, joern_available
+
+    root = Path(workdir)
+    pending = [
+        p for p in sorted((root / "functions").glob("*.c"))
+        if not p.with_suffix(".c.nodes.json").exists()
+    ]
+    if not pending:
+        return []
+    if not joern_available():
+        raise RuntimeError(
+            "joern binary not found on PATH; install it or provide "
+            "pre-extracted <id>.c.nodes.json/<id>.c.edges.json files"
+        )
+    # Shard across worker sessions (run_getgraphs.sh job-array semantics);
+    # each worker gets its own Joern workspace keyed by shard index.
+    from deepdfa_tpu.etl.parallel import pmap
+
+    shards = [
+        (i, pending[i::workers]) for i in range(workers) if pending[i::workers]
+    ]
+    done_lists = pmap(
+        lambda job: extract_cpg_batch(
+            job[1], root, worker_id=job[0],
+            failed_log=root / "failed_joern.txt",
+        ),
+        shards,
+        workers=workers,
+        desc="joern",
+        failed_log=str(root / "failed_joern.txt"),
+    )
+    return [p for lst in done_lists if lst for p in lst]
+
+
+def export(
+    workdir: str,
+    feature: Optional[FeatureSpec] = None,
+    gtype: str = "cfg",
+    split_seed: int = 0,
+) -> Dict[str, int]:
+    """Joern JSON -> vocabs -> labeled examples.jsonl + splits.json."""
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.etl.absdf import build_all_vocabs, extract_decl_features
+    from deepdfa_tpu.etl.cpg import load_joern_export
+    from deepdfa_tpu.etl.export import cpg_to_example
+    from deepdfa_tpu.etl.statements import statement_labels
+
+    feature = feature or FeatureSpec()
+    root = Path(workdir)
+    meta = {m["id"]: m for m in _meta(root)}
+
+    cpgs: Dict[int, object] = {}
+    features_by_graph: Dict[int, Dict] = {}
+    for stem in sorted((root / "functions").glob("*.c")):
+        if not stem.with_suffix(".c.nodes.json").exists():
+            continue
+        gid = int(stem.stem)
+        try:
+            cpg = load_joern_export(stem)
+            features = extract_decl_features(cpg)
+        except Exception as exc:  # per-item fault tolerance
+            logger.warning("export: graph %d failed: %s", gid, exc)
+            with open(root / "failed_export.txt", "a") as f:
+                f.write(f"{gid}\t{exc}\n")
+            continue
+        # Only fully-processed graphs enter either table: a partial entry
+        # would KeyError the write loop below and abort the whole stage.
+        cpgs[gid] = cpg
+        features_by_graph[gid] = features
+
+    ordered = [{"id": gid, "project": meta.get(gid, {}).get("project", "")}
+               for gid in sorted(cpgs)]
+    splits = make_splits(ordered, mode="random", seed=split_seed)
+    train_ids = [ordered[i]["id"] for i in splits["train"]]
+    vocabs = build_all_vocabs(features_by_graph, train_ids, feature)
+
+    n_written = 0
+    with open(root / "examples.jsonl", "w") as f:
+        for gid, cpg in sorted(cpgs.items()):
+            m = meta.get(gid, {})
+            line_labels = None
+            if m.get("vul"):
+                # Vulnerable lines: removed by the fix + lines the fix's
+                # added lines depend on (evaluate.py:194-255). Without the
+                # after-graph the dependency half degrades to removed-only.
+                dep_added: List[int] = []
+                line_labels = statement_labels(cpg, m.get("removed", []), dep_added)
+            ex = cpg_to_example(
+                cpg, vocabs, features_by_graph[gid], gid, gtype=gtype,
+                line_labels=line_labels,
+                label=int(m.get("vul", 0)) if m else None,
+            )
+            f.write(json.dumps({
+                "id": ex["id"],
+                "num_nodes": ex["num_nodes"],
+                "senders": np.asarray(ex["senders"]).tolist(),
+                "receivers": np.asarray(ex["receivers"]).tolist(),
+                "vuln": np.asarray(ex["vuln"]).tolist(),
+                "feats": {k: np.asarray(v).tolist() for k, v in ex["feats"].items()},
+                "label": ex["label"],
+            }) + "\n")
+            n_written += 1
+    with open(root / "splits.json", "w") as f:
+        json.dump({k: [ordered[i]["id"] for i in v] for k, v in splits.items()}, f)
+    return {"graphs": len(cpgs), "examples": n_written}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="deepdfa_tpu.etl.pipeline")
+    sub = parser.add_subparsers(dest="stage", required=True)
+
+    p = sub.add_parser("prepare")
+    p.add_argument("--dataset", choices=["bigvul", "devign"], required=True)
+    p.add_argument("--path", required=True)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--sample", type=int, default=None)
+
+    g = sub.add_parser("graphs")
+    g.add_argument("--workdir", required=True)
+    g.add_argument("--workers", type=int, default=6)
+
+    e = sub.add_parser("export")
+    e.add_argument("--workdir", required=True)
+    e.add_argument("--feature", default=None, help="legacy feature name")
+    e.add_argument("--gtype", default="cfg")
+
+    args = parser.parse_args(argv)
+    if args.stage == "prepare":
+        from deepdfa_tpu.etl.datasets import load_bigvul, load_devign
+
+        rows = (
+            load_bigvul(args.path, sample=args.sample)
+            if args.dataset == "bigvul"
+            else load_devign(args.path, sample=args.sample)
+        )
+        print(json.dumps({"prepared": prepare(rows, args.workdir)}))
+    elif args.stage == "graphs":
+        done = run_graphs(args.workdir, args.workers)
+        print(json.dumps({"extracted": len(done)}))
+    elif args.stage == "export":
+        feat = FeatureSpec.parse_legacy(args.feature) if args.feature else None
+        print(json.dumps(export(args.workdir, feat, gtype=args.gtype)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

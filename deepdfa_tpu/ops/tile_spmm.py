@@ -235,13 +235,19 @@ def _spmm_xla(vals, rows, cols, msg, tile, n_row_tiles):
     """Pure-XLA oracle/fallback: gather msg row-tiles, batched matmul,
     segment-sum by row tile."""
     msg_tiles = msg.reshape(n_row_tiles, tile, -1)[cols]
+    # f32 accumulation regardless of input dtype, matching the Pallas
+    # kernel's MXU accumulator so both impls agree bit-for-bit in bf16 too.
     prod = jnp.einsum(
         "krc,kch->krh", vals.astype(msg.dtype), msg_tiles,
-        preferred_element_type=msg.dtype,
-        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+        precision=(
+            jax.lax.Precision.HIGHEST
+            if msg.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT
+        ),
     )
     out = jax.ops.segment_sum(prod, rows, num_segments=n_row_tiles)
-    return out.reshape(n_row_tiles * tile, -1)
+    return out.reshape(n_row_tiles * tile, -1).astype(msg.dtype)
 
 
 def _use_pallas() -> bool:
