@@ -129,7 +129,8 @@ def build_configs(
 # ---------------------------------------------------------------------------
 
 
-def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0):
+def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0,
+                 split_mode: str = "random"):
     """"synthetic[:N]" for the built-in sample generator, or a ``.jsonl``
     of exported graph examples (the etl/export.py ``cpg_to_example``
     format: num_nodes/senders/receivers/vuln/feats/label/id per line)."""
@@ -145,7 +146,7 @@ def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0):
         for i, ex in enumerate(examples):
             ex["label"] = int(np.asarray(ex["vuln"]).max())
             ex["id"] = i
-        splits = make_splits(examples, mode="random", seed=seed)
+        splits = make_splits(examples, mode=split_mode, seed=seed)
         return examples, splits
     if spec.endswith(".jsonl") and os.path.exists(spec):
         examples = []
@@ -160,7 +161,17 @@ def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0):
                 ex.setdefault("id", i)
                 ex.setdefault("label", int(ex["vuln"].max()) if len(ex["vuln"]) else 0)
                 examples.append(ex)
-        splits = make_splits(examples, mode="random", seed=seed)
+        # A sibling splits.json (written by etl.pipeline export) pins the
+        # partition the abstract-dataflow vocab was built on; re-splitting
+        # would leak vocab-defining train examples into test.
+        sibling = os.path.join(os.path.dirname(spec) or ".", "splits.json")
+        if split_mode == "random" and os.path.exists(sibling):
+            with open(sibling) as f:
+                fixed = {int(k): v for k, v in json.load(f).items()}
+            logger.info("using pinned split %s", sibling)
+            splits = make_splits(examples, mode="fixed", fixed=fixed)
+        else:
+            splits = make_splits(examples, mode=split_mode, seed=seed)
         return examples, splits
     raise ValueError(f"unknown dataset spec {spec!r}")
 
@@ -220,7 +231,8 @@ def cmd_fit(args) -> Dict[str, Any]:
     log_path, handler = _setup_run_logging(run_dir)
     with _CrashLog(log_path, handler):
         examples, splits = load_dataset(args.dataset, model_cfg.feature,
-                                        seed=train_cfg.seed)
+                                        seed=train_cfg.seed,
+                                        split_mode=args.split_mode)
         model = FlowGNN(model_cfg)
         mesh = None
         if args.n_devices > 1:
@@ -253,7 +265,8 @@ def cmd_test(args) -> Dict[str, Any]:
     cfgs = build_configs(args.config, args.set)
     model_cfg, data_cfg, train_cfg = cfgs["model"], cfgs["data"], cfgs["train"]
     examples, splits = load_dataset(args.dataset, model_cfg.feature,
-                                    seed=train_cfg.seed)
+                                    seed=train_cfg.seed,
+                                    split_mode=args.split_mode)
     model = FlowGNN(model_cfg)
     subkeys = subkeys_for(model_cfg.feature)
     use_tile = model_cfg.message_impl == "tile"
@@ -319,7 +332,8 @@ def cmd_tune(args) -> Dict[str, Any]:
         "model.n_steps": [3, 5, 7],
     }
     examples, splits = load_dataset(args.dataset, base_model.feature,
-                                    seed=base_train.seed)
+                                    seed=base_train.seed,
+                                    split_mode=args.split_mode)
     results = []
     out_path = os.path.join(args.out_dir, "tune_results.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
@@ -363,6 +377,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.add_argument("--set", action="append", default=[], metavar="S.K=V",
                        help="override any config value")
         p.add_argument("--dataset", default="synthetic:256")
+        p.add_argument("--split-mode", default="random",
+                       choices=["random", "cross-project"],
+                       help="cross-project = the Table 7 protocol")
 
     p_fit = sub.add_parser("fit")
     common(p_fit)

@@ -167,6 +167,12 @@ class TrainConfig:
     # every N epochs (reference config_default.yaml:20-29 semantics).
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 25
+    # Per-step loss finiteness check (the Lightning ``detect_anomaly: true``
+    # of config_default.yaml:40): synchronizes every step when on, so it
+    # costs throughput — a debugging aid, not a production default.
+    detect_anomaly: bool = False
+    # Optional TensorBoard event directory (MyTensorBoardLogger parity).
+    tensorboard_dir: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -46,7 +46,14 @@ def make_splits(
 
     if mode == "cross-project":
         rng = np.random.default_rng(seed)
-        projects = sorted({int(ex.get("project", 0)) for ex in examples})
+        # Project ids may be ints or strings (the Big-Vul CSV carries
+        # names); anything sortable works.
+        projects = sorted({str(ex.get("project", "")) for ex in examples})
+        if len(projects) < 3:
+            raise ValueError(
+                f"cross-project split needs >= 3 distinct projects, "
+                f"got {projects!r}"
+            )
         perm = rng.permutation(len(projects))
         projects = [projects[i] for i in perm]
         n_train = max(1, int(len(projects) * fractions[0]))
@@ -55,7 +62,7 @@ def make_splits(
         val_p = set(projects[n_train : n_train + n_val])
         out = {"train": [], "val": [], "test": []}
         for i, ex in enumerate(examples):
-            p = int(ex.get("project", 0))
+            p = str(ex.get("project", ""))
             key = "train" if p in train_p else ("val" if p in val_p else "test")
             out[key].append(i)
         return {k: np.asarray(v, np.int64) for k, v in out.items()}

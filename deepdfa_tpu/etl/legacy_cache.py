@@ -63,7 +63,14 @@ def load_reference_cache(
         feat_col = [c for c in fdf.columns if c.startswith("_ABS_DATAFLOW")]
         if len(feat_col) != 1:
             raise ValueError(f"{path} has no unique feature column: {list(fdf.columns)}")
-        feats_frames[subkey] = fdf.set_index(["graph_id", "node_id"])[feat_col[0]]
+        # Plain dict keyed by (graph_id, node_id): one vectorized pass here
+        # beats millions of per-node pandas MultiIndex lookups below.
+        feats_frames[subkey] = dict(
+            zip(
+                zip(fdf["graph_id"].to_numpy(), fdf["node_id"].to_numpy()),
+                fdf[feat_col[0]].to_numpy(),
+            )
+        )
 
     out: List[Dict] = []
     edge_groups = dict(tuple(edges.groupby("graph_id")))
@@ -85,10 +92,10 @@ def load_reference_cache(
         node_ids = n["node_id"].to_numpy()
         dgl_ids = n["dgl_id"].to_numpy()
         for subkey in subkeys:
-            series = feats_frames[subkey]
+            table = feats_frames[subkey]
             vals = np.zeros(num_nodes, np.int64)
             for nid, did in zip(node_ids, dgl_ids):
-                vals[did] = int(series.get((graph_id, nid), 0))
+                vals[did] = int(table.get((graph_id, nid), 0))
             feats[subkey] = vals
 
         gid = int(graph_id)

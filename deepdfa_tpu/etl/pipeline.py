@@ -111,35 +111,43 @@ def export(
     root = Path(workdir)
     meta = {m["id"]: m for m in _meta(root)}
 
-    cpgs: Dict[int, object] = {}
+    def fail(gid, exc):
+        logger.warning("export: graph %d failed: %s", gid, exc)
+        with open(root / "failed_export.txt", "a") as f:
+            f.write(f"{gid}\t{exc}\n")
+
+    # Pass 1: decl features only — CPGs are re-parsed in pass 2 so graph
+    # residency stays O(1) at Big-Vul scale (~188k functions).
     features_by_graph: Dict[int, Dict] = {}
+    stems: Dict[int, Path] = {}
     for stem in sorted((root / "functions").glob("*.c")):
         if not stem.with_suffix(".c.nodes.json").exists():
             continue
         gid = int(stem.stem)
         try:
-            cpg = load_joern_export(stem)
-            features = extract_decl_features(cpg)
+            features_by_graph[gid] = extract_decl_features(load_joern_export(stem))
+            stems[gid] = stem
         except Exception as exc:  # per-item fault tolerance
-            logger.warning("export: graph %d failed: %s", gid, exc)
-            with open(root / "failed_export.txt", "a") as f:
-                f.write(f"{gid}\t{exc}\n")
-            continue
-        # Only fully-processed graphs enter either table: a partial entry
-        # would KeyError the write loop below and abort the whole stage.
-        cpgs[gid] = cpg
-        features_by_graph[gid] = features
+            fail(gid, exc)
 
+    # The vocab's defining split IS the split shipped with the data
+    # (splits.json, consumed by cli.load_dataset) — a re-split downstream
+    # would leak vocab-defining train examples into test.
     ordered = [{"id": gid, "project": meta.get(gid, {}).get("project", "")}
-               for gid in sorted(cpgs)]
+               for gid in sorted(stems)]
     splits = make_splits(ordered, mode="random", seed=split_seed)
     train_ids = [ordered[i]["id"] for i in splits["train"]]
     vocabs = build_all_vocabs(features_by_graph, train_ids, feature)
 
     n_written = 0
     with open(root / "examples.jsonl", "w") as f:
-        for gid, cpg in sorted(cpgs.items()):
+        for gid in sorted(stems):
             m = meta.get(gid, {})
+            try:
+                cpg = load_joern_export(stems[gid])
+            except Exception as exc:
+                fail(gid, exc)
+                continue
             line_labels = None
             if m.get("vul"):
                 # Vulnerable lines: removed by the fix + lines the fix's
@@ -160,11 +168,16 @@ def export(
                 "vuln": np.asarray(ex["vuln"]).tolist(),
                 "feats": {k: np.asarray(v).tolist() for k, v in ex["feats"].items()},
                 "label": ex["label"],
+                "project": m.get("project", ""),
             }) + "\n")
             n_written += 1
+    partition = {}
+    for part, idxs in splits.items():
+        for i in idxs:
+            partition[str(ordered[i]["id"])] = part
     with open(root / "splits.json", "w") as f:
-        json.dump({k: [ordered[i]["id"] for i in v] for k, v in splits.items()}, f)
-    return {"graphs": len(cpgs), "examples": n_written}
+        json.dump(partition, f)
+    return {"graphs": len(stems), "examples": n_written}
 
 
 def main(argv=None) -> int:

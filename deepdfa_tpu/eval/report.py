@@ -144,3 +144,25 @@ def test_report(
         with open(os.path.join(out_dir, "report.json"), "w") as f:
             json.dump(report, f, indent=2)
     return report
+
+
+def dbgbench_report(
+    probs,
+    example_bug_ids,
+    threshold: float = 0.5,
+) -> Dict[str, float]:
+    """Bugs-detected metric over a DbgBench-style set (paper Table 8: 8.7/17
+    bugs for DeepDFA; reference --dbgbench paths,
+    unixcoder/linevul_main.py:1530-1555): each example belongs to one known
+    bug, and a bug counts as detected when ANY of its functions is flagged.
+    Returns {"bugs_total", "bugs_detected", "detection_rate"}."""
+    flagged_by_bug: Dict[object, bool] = {}
+    for p, bug in zip(probs, example_bug_ids):
+        flagged_by_bug[bug] = flagged_by_bug.get(bug, False) or (float(p) >= threshold)
+    total = len(flagged_by_bug)
+    detected = sum(flagged_by_bug.values())
+    return {
+        "bugs_total": total,
+        "bugs_detected": detected,
+        "detection_rate": detected / total if total else 0.0,
+    }

@@ -290,6 +290,33 @@ def fit(
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_loss": float("inf")}
     best_state = state
 
+    tb_writer = None
+    if train_cfg.tensorboard_dir:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            tb_writer = SummaryWriter(train_cfg.tensorboard_dir)
+        except ImportError:  # tensorboard is optional
+            logger.warning("tensorboard unavailable; skipping event logging")
+
+    try:
+        return _fit_epochs(
+            model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
+            use_tile, state, train_step, eval_step, labels, history,
+            best_state, checkpointer, tb_writer, log_every,
+        )
+    finally:
+        # close on every exit path: a diverging run (detect_anomaly raise)
+        # is exactly when the buffered loss curve matters
+        if tb_writer is not None:
+            tb_writer.close()
+
+
+def _fit_epochs(
+    model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
+    use_tile, state, train_step, eval_step, labels, history, best_state,
+    checkpointer, tb_writer, log_every,
+):
     for epoch in range(train_cfg.max_epochs):
         # Fresh undersample + reshuffle per epoch (reload_dataloaders_every_
         # n_epochs: 1 semantics).
@@ -311,6 +338,13 @@ def fit(
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
                               data_cfg.batch_size, n_shards, use_tile):
             state, loss, bstats = train_step(state, batch)
+            if train_cfg.detect_anomaly and not np.isfinite(float(loss)):
+                # Lightning detect_anomaly parity: fail at the step that
+                # produced the non-finite loss, with its location.
+                raise FloatingPointError(
+                    f"non-finite loss {float(loss)} at epoch {epoch} "
+                    f"step {n_batches}"
+                )
             loss_sum = loss_sum + loss
             stats = stats + bstats
             n_batches += 1
@@ -334,6 +368,11 @@ def fit(
             "epoch %d train_loss %.4f val_loss %.4f val_f1 %.4f (%.1fs)",
             epoch, record["train_loss"], val.loss, val.metrics["f1"], record["seconds"],
         )
+        if tb_writer is not None:
+            tb_writer.add_scalar("train/loss", record["train_loss"], epoch)
+            tb_writer.add_scalar("val/loss", val.loss, epoch)
+            for k, v in val.metrics.items():
+                tb_writer.add_scalar(f"val/{k}", v, epoch)
         if val.loss < history["best_val_loss"]:
             history["best_val_loss"] = val.loss
             history["best_epoch"] = epoch

@@ -143,3 +143,65 @@ def test_crash_renames_log(tmp_path, monkeypatch):
         main(["fit", "--dataset", "synthetic:8", "--checkpoint-dir", ckpt])
     logs = os.listdir(ckpt)
     assert any(name.endswith(".error") for name in logs), logs
+
+def test_cli_cross_project_split():
+    from deepdfa_tpu.cli import load_dataset
+    from deepdfa_tpu.core.config import FeatureSpec
+
+    examples, splits = load_dataset(
+        "synthetic:64", FeatureSpec(), split_mode="cross-project"
+    )
+    projects = {
+        k: {int(examples[i]["project"]) for i in v} for k, v in splits.items()
+    }
+    assert not (projects["train"] & projects["test"])  # no project spans splits
+
+
+def test_detect_anomaly_flags_nonfinite(tmp_path):
+    import dataclasses
+
+    import numpy as np
+    import pytest as _pytest
+
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, TrainConfig
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    cfg = FlowGNNConfig(hidden_dim=8, n_steps=2)
+    examples = synthetic_bigvul(16, cfg.feature, positive_fraction=0.5, seed=0)
+    for i, ex in enumerate(examples):
+        ex["label"] = int(np.asarray(ex["vuln"]).max())
+        ex["id"] = i
+    splits = make_splits(examples, mode="random", seed=0)
+    # absurd lr forces divergence to nan within the epoch
+    tcfg = TrainConfig(max_epochs=3, learning_rate=1e18, detect_anomaly=True)
+    dcfg = DataConfig(batch_size=8, max_nodes_per_graph=16, max_edges_per_node=4)
+    with _pytest.raises(FloatingPointError, match="non-finite"):
+        fit(FlowGNN(cfg), examples, splits, tcfg, dcfg)
+
+
+def test_tensorboard_logging(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    import numpy as np
+
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, TrainConfig
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    cfg = FlowGNNConfig(hidden_dim=8, n_steps=2)
+    examples = synthetic_bigvul(16, cfg.feature, positive_fraction=0.5, seed=0)
+    for i, ex in enumerate(examples):
+        ex["label"] = int(np.asarray(ex["vuln"]).max())
+        ex["id"] = i
+    splits = make_splits(examples, mode="random", seed=0)
+    tb_dir = str(tmp_path / "tb")
+    tcfg = TrainConfig(max_epochs=1, tensorboard_dir=tb_dir)
+    dcfg = DataConfig(batch_size=8, max_nodes_per_graph=16, max_edges_per_node=4)
+    fit(FlowGNN(cfg), examples, splits, tcfg, dcfg)
+    import os
+
+    assert any(f.startswith("events") for f in os.listdir(tb_dir))

@@ -64,7 +64,15 @@ def test_pipeline_prepare_and_export_roundtrip(tmp_path):
     assert len(examples) == 2
     ex = examples[0]
     assert ex["num_nodes"] > 0 and len(ex["feats"]) == 4
-    assert set(json.load(open(tmp_path / "splits.json"))) == {"train", "val", "test"}
+    assert "project" in ex  # cross-project protocol needs it downstream
+
+    # splits.json pins id -> partition, and load_dataset honors it: the
+    # partition trained on is the one the vocab was built on.
+    partition = json.load(open(tmp_path / "splits.json"))
+    assert set(partition.values()) <= {"train", "val", "test"}
+    for part, idxs in splits.items():
+        for i in idxs:
+            assert partition[str(examples[i]["id"])] == part
 
 
 def test_legacy_cache_loader(tmp_path):

@@ -132,3 +132,14 @@ def test_flowgnn_cost_analysis_smoke():
 
     costs = cost_analysis(lambda b: model.apply(params, b), batch)
     assert costs["flops"] > 0
+
+
+def test_dbgbench_report():
+    from deepdfa_tpu.eval.report import dbgbench_report
+
+    probs = [0.9, 0.1, 0.2, 0.8, 0.3]
+    bugs = ["b1", "b1", "b2", "b3", "b3"]
+    out = dbgbench_report(probs, bugs, threshold=0.5)
+    assert out["bugs_total"] == 3
+    assert out["bugs_detected"] == 2  # b1 (0.9) and b3 (0.8); b2 missed
+    assert out["detection_rate"] == 2 / 3
