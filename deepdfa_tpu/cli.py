@@ -129,6 +129,18 @@ def build_configs(
 # ---------------------------------------------------------------------------
 
 
+def _read_pinned_split(path: str) -> Optional[Dict[int, str]]:
+    """Read a splits.json in either layout: {"<id>": "train", ...} (current)
+    or {"train": [ids], ...} (pre-pinning exports). None when absent."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    if set(doc) <= {"train", "val", "test"}:  # legacy layout
+        return {int(i): part for part, ids in doc.items() for i in ids}
+    return {int(k): v for k, v in doc.items()}
+
+
 def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0,
                  split_mode: str = "random"):
     """"synthetic[:N]" for the built-in sample generator, or a ``.jsonl``
@@ -165,12 +177,19 @@ def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0,
         # partition the abstract-dataflow vocab was built on; re-splitting
         # would leak vocab-defining train examples into test.
         sibling = os.path.join(os.path.dirname(spec) or ".", "splits.json")
-        if split_mode == "random" and os.path.exists(sibling):
-            with open(sibling) as f:
-                fixed = {int(k): v for k, v in json.load(f).items()}
+        fixed = _read_pinned_split(sibling)
+        if split_mode == "random" and fixed is not None:
             logger.info("using pinned split %s", sibling)
             splits = make_splits(examples, mode="fixed", fixed=fixed)
         else:
+            if fixed is not None:
+                logger.warning(
+                    "overriding the pinned split %s with --split-mode=%s: "
+                    "the abstract-dataflow vocab was built on the pinned "
+                    "train partition, so re-splitting risks vocab leakage "
+                    "into test — re-export with the matching --split-mode",
+                    sibling, split_mode,
+                )
             splits = make_splits(examples, mode=split_mode, seed=seed)
         return examples, splits
     raise ValueError(f"unknown dataset spec {spec!r}")

@@ -56,8 +56,11 @@ def make_splits(
             )
         perm = rng.permutation(len(projects))
         projects = [projects[i] for i in perm]
-        n_train = max(1, int(len(projects) * fractions[0]))
-        n_val = max(1, int(len(projects) * fractions[1]))
+        # Every partition keeps >= 1 project: clamp train/val so the test
+        # slice can't go empty at small project counts.
+        n_train = max(1, min(int(len(projects) * fractions[0]), len(projects) - 2))
+        n_val = max(1, min(int(len(projects) * fractions[1]),
+                           len(projects) - n_train - 1))
         train_p = set(projects[:n_train])
         val_p = set(projects[n_train : n_train + n_val])
         out = {"train": [], "val": [], "test": []}

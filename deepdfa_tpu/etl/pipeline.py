@@ -99,6 +99,7 @@ def export(
     feature: Optional[FeatureSpec] = None,
     gtype: str = "cfg",
     split_seed: int = 0,
+    split_mode: str = "random",
 ) -> Dict[str, int]:
     """Joern JSON -> vocabs -> labeled examples.jsonl + splits.json."""
     from deepdfa_tpu.data.splits import make_splits
@@ -135,7 +136,9 @@ def export(
     # would leak vocab-defining train examples into test.
     ordered = [{"id": gid, "project": meta.get(gid, {}).get("project", "")}
                for gid in sorted(stems)]
-    splits = make_splits(ordered, mode="random", seed=split_seed)
+    # split_mode must match the evaluation protocol (cross-project exports
+    # need cross-project vocab splits, or the vocab leaks into test).
+    splits = make_splits(ordered, mode=split_mode, seed=split_seed)
     train_ids = [ordered[i]["id"] for i in splits["train"]]
     vocabs = build_all_vocabs(features_by_graph, train_ids, feature)
 
@@ -198,6 +201,8 @@ def main(argv=None) -> int:
     e.add_argument("--workdir", required=True)
     e.add_argument("--feature", default=None, help="legacy feature name")
     e.add_argument("--gtype", default="cfg")
+    e.add_argument("--split-mode", default="random",
+                   choices=["random", "cross-project"])
 
     args = parser.parse_args(argv)
     if args.stage == "prepare":
@@ -214,7 +219,8 @@ def main(argv=None) -> int:
         print(json.dumps({"extracted": len(done)}))
     elif args.stage == "export":
         feat = FeatureSpec.parse_legacy(args.feature) if args.feature else None
-        print(json.dumps(export(args.workdir, feat, gtype=args.gtype)))
+        print(json.dumps(export(args.workdir, feat, gtype=args.gtype,
+                                split_mode=args.split_mode)))
     return 0
 
 

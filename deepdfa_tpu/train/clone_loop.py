@@ -1,0 +1,160 @@
+"""Clone-detection fine-tuning (reference CodeT5/run_clone.py): pair-
+concatenated source ids -> CloneModel -> CE, AdamW + warmup, best-F1
+tracking. The batching/eval skeleton mirrors gen_loop (fixed [N, 2L]
+arrays, padded tail batches)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from deepdfa_tpu.core.config import TransformerTrainConfig
+from deepdfa_tpu.core.metrics import binary_stats, BinaryStats, compute_metrics
+from deepdfa_tpu.models.t5 import CloneModel
+from deepdfa_tpu.train.text_loop import make_text_optimizer
+
+
+@struct.dataclass
+class CloneTrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    dropout_rng: jnp.ndarray
+
+
+def encode_clone_pairs(
+    pairs, tokenize: Callable, max_source_length: int, pad_id: int = 0,
+    eos_id: int = 2,
+) -> Dict[str, np.ndarray]:
+    """(code1, code2, label) triples -> {"source_ids" [N, 2L], "labels"}.
+    Each half is tokenized/padded to max_source_length with one eos
+    (CodeT5/_utils.py:64-72 ``code1 + code2``)."""
+
+    def fit(text):
+        ids = list(tokenize(text))[: max_source_length - 1] + [eos_id]
+        return ids + [pad_id] * (max_source_length - len(ids))
+
+    n = len(pairs)
+    src = np.zeros((n, 2 * max_source_length), np.int32)
+    labels = np.zeros(n, np.int32)
+    for i, (c1, c2, label) in enumerate(pairs):
+        src[i, :max_source_length] = fit(c1)
+        src[i, max_source_length:] = fit(c2)
+        labels[i] = int(label)
+    return {"source_ids": src, "labels": labels}
+
+
+def clone_loss(model: CloneModel, params, source_ids, labels, example_mask,
+               dropout_rng=None, deterministic: bool = True):
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    logits = model.apply(params, source_ids, deterministic=deterministic,
+                         rngs=rngs)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    m = example_mask.astype(jnp.float32)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0), logits
+
+
+def make_clone_train_step(model: CloneModel, tx, cfg: TransformerTrainConfig):
+    def step(state: CloneTrainState, source_ids, labels, example_mask):
+        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+
+        def loss_fn(params):
+            return clone_loss(model, params, source_ids, labels, example_mask,
+                              dropout_rng=dropout_rng, deterministic=False)
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+        stats = binary_stats(probs, labels.astype(jnp.float32), example_mask)
+        return (
+            CloneTrainState(state.step + 1, params, opt_state, state.dropout_rng),
+            loss,
+            stats,
+        )
+
+    return step
+
+
+def fit_clone(
+    model: CloneModel,
+    train_data: Dict[str, np.ndarray],
+    eval_data: Dict[str, np.ndarray],
+    cfg: TransformerTrainConfig,
+    init_params: Optional[Any] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Train, tracking best eval F1 (run_clone.py keeps checkpoint-best-f1).
+    Returns {"state", "best_f1", "eval_metrics"}."""
+    n = len(train_data["source_ids"])
+    steps_per_epoch = max(-(-n // cfg.batch_size), 1)
+    max_steps = steps_per_epoch * cfg.max_epochs
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    params_rng, dropout_rng = jax.random.split(rng)
+    if init_params is not None:
+        params = init_params
+    else:
+        params = model.init(
+            {"params": params_rng, "dropout": dropout_rng},
+            jnp.asarray(train_data["source_ids"][: cfg.batch_size]),
+        )
+    tx = make_text_optimizer(cfg, max_steps)
+    state = CloneTrainState(jnp.zeros((), jnp.int32), params, tx.init(params),
+                            dropout_rng)
+    step = jax.jit(make_clone_train_step(model, tx, cfg), donate_argnums=(0,))
+    eval_fn = jax.jit(
+        lambda params, s, l, m: clone_loss(model, params, s, l, m)
+    )
+
+    def batches(data, batch_size, order=None):
+        """Padded tail batch with an example mask: no rows dropped, and
+        small datasets still train (the gen_loop._batches contract)."""
+        idx = np.arange(len(data["source_ids"])) if order is None else order
+        for start in range(0, len(idx), batch_size):
+            sel = idx[start : start + batch_size]
+            src, labels = data["source_ids"][sel], data["labels"][sel]
+            n_valid = len(sel)
+            if n_valid < batch_size:
+                pad = batch_size - n_valid
+                src = np.concatenate(
+                    [src, np.zeros((pad, src.shape[1]), src.dtype)]
+                )
+                labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+            mask = np.arange(batch_size) < n_valid
+            yield src, labels, mask
+
+    np_rng = np.random.RandomState(cfg.seed)
+    best_f1, best_state = -1.0, state
+    for epoch in range(cfg.max_epochs):
+        order = np_rng.permutation(n)
+        for src, labels, mask in batches(train_data, cfg.batch_size, order):
+            state, loss, _ = step(
+                state, jnp.asarray(src), jnp.asarray(labels), jnp.asarray(mask)
+            )
+
+        stats = BinaryStats.zeros()
+        for src, labels, mask in batches(eval_data, cfg.eval_batch_size):
+            _, logits = eval_fn(
+                state.params, jnp.asarray(src), jnp.asarray(labels),
+                jnp.asarray(mask),
+            )
+            probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+            stats = stats + binary_stats(
+                probs, jnp.asarray(labels, jnp.float32), jnp.asarray(mask)
+            )
+        metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
+        if log:
+            log(f"epoch {epoch}: eval_f1={metrics['f1']:.4f}")
+        if metrics["f1"] > best_f1:
+            best_f1, best_state = metrics["f1"], state
+
+    return {"state": best_state, "best_f1": best_f1, "eval_metrics": metrics}

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Cross-project generalization protocol (reference scripts/run_cross_project.sh,
+# paper Table 7): no project spans train/test.
+set -e
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli fit --config configs/default.yaml \
+  --split-mode cross-project \
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" "$@"
+python -m deepdfa_tpu.cli test --config configs/default.yaml \
+  --split-mode cross-project \
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" --which best "$@"
