@@ -1,0 +1,43 @@
+"""Environment recipe for a virtual multi-device CPU mesh.
+
+Real multi-chip hardware is unavailable in CI and in the driver environment;
+sharding correctness is validated on XLA's host platform with
+``--xla_force_host_platform_device_count=N`` (same program, same collectives,
+CPU execution). The platform choice must be in the environment *before* the
+interpreter starts: this image's sitecustomize registers the axon TPU PJRT
+plugin at startup, and flipping ``JAX_PLATFORMS`` afterwards stalls the
+process. Every consumer (tests/conftest.py, __graft_entry__.dryrun_multichip)
+therefore re-execs into a fresh interpreter whose environment this one helper
+produces — keep the protocol here, in one place.
+
+This module must stay import-light (no jax): it runs pre-re-exec in
+processes whose platform is still wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, MutableMapping
+
+DEVICE_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def cpu_mesh_env(
+    env: Mapping[str, str], n_devices: int, force_count: bool = True
+) -> MutableMapping[str, str]:
+    """Copy of ``env`` configured for an ``n_devices`` virtual CPU mesh.
+
+    ``force_count=True`` replaces any existing device-count flag (callers
+    that need *exactly* n devices, e.g. the multi-chip dry run);
+    ``force_count=False`` keeps a caller-provided count (tests, where an
+    outer harness may have picked its own).
+    """
+    out = dict(env)
+    out["JAX_PLATFORMS"] = "cpu"
+    out["PALLAS_AXON_POOL_IPS"] = ""  # skip axon TPU plugin registration
+    flags = out.get("XLA_FLAGS", "").split()
+    if force_count:
+        flags = [f for f in flags if DEVICE_COUNT_FLAG not in f]
+    if not any(DEVICE_COUNT_FLAG in f for f in flags):
+        flags.append(f"--{DEVICE_COUNT_FLAG}={n_devices}")
+    out["XLA_FLAGS"] = " ".join(flags)
+    return out

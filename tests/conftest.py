@@ -31,16 +31,14 @@ def _needs_reexec() -> bool:
 def pytest_configure(config):
     if not _needs_reexec():
         return
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from deepdfa_tpu.core.hostmesh import cpu_mesh_env
+
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
-    env = dict(os.environ)
+    env = cpu_mesh_env(os.environ, 8, force_count=False)
     env[_SENTINEL] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon TPU plugin registration
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(
