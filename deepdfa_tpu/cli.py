@@ -136,6 +136,10 @@ def _read_pinned_split(path: str) -> Optional[Dict[int, str]]:
         return None
     with open(path) as f:
         doc = json.load(f)
+    if not doc:
+        # {} would sniff as the legacy layout and pin every partition empty;
+        # treat it as no pinned split.
+        return None
     if set(doc) <= {"train", "val", "test"}:  # legacy layout
         return {int(i): part for part, ids in doc.items() for i in ids}
     return {int(k): v for k, v in doc.items()}
@@ -258,7 +262,8 @@ def cmd_fit(args) -> Dict[str, Any]:
             from deepdfa_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(n_data=args.n_devices)
-        state, history = fit(model, examples, splits, train_cfg, data_cfg, mesh=mesh)
+        state, history = fit(model, examples, splits, train_cfg, data_cfg,
+                             mesh=mesh, resume=getattr(args, "resume", False))
         result = {
             "best_epoch": history["best_epoch"],
             "best_val_loss": history["best_val_loss"],
@@ -289,9 +294,11 @@ def cmd_test(args) -> Dict[str, Any]:
     model = FlowGNN(model_cfg)
     subkeys = subkeys_for(model_cfg.feature)
     use_tile = model_cfg.message_impl == "tile"
+    use_df = model_cfg.label_style.startswith("dataflow_solution")
     example_batch = next(
         _batches(examples, splits["test"][: data_cfg.eval_batch_size], data_cfg,
-                 subkeys, data_cfg.eval_batch_size, build_tile_adj=use_tile)
+                 subkeys, data_cfg.eval_batch_size, build_tile_adj=use_tile,
+                 with_dataflow=use_df)
     )
     state, _ = make_train_state(model, example_batch, train_cfg)
     ckpt = CheckpointManager(args.checkpoint_dir)
@@ -301,7 +308,7 @@ def cmd_test(args) -> Dict[str, Any]:
 
     eval_step = jax.jit(make_eval_step(model, train_cfg))
     res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
-                   build_tile_adj=use_tile)
+                   build_tile_adj=use_tile, with_dataflow=use_df)
     report = {"loss": res.loss, **res.metrics}
     print(json.dumps(report))
     return report
@@ -404,6 +411,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     common(p_fit)
     p_fit.add_argument("--checkpoint-dir", default=None)
     p_fit.add_argument("--n-devices", type=int, default=1)
+    p_fit.add_argument("--resume", action="store_true",
+                       help="continue from the run dir's 'last' checkpoint")
     p_fit.set_defaults(func=cmd_fit)
 
     p_test = sub.add_parser("test")

@@ -79,15 +79,36 @@ def synthetic_bigvul(
                 feats["api"][0] = taint
                 feats["api"][n - 1] = sink
 
+        s_arr = np.asarray(senders, np.int32)
+        r_arr = np.asarray(receivers, np.int32)
+
+        # Dataflow-solution bits: the genuine reachability fixpoint over the
+        # generated CFG (df_in[v] = some definition reaches v's entry,
+        # df_out[v] = df_in[v] or v defines) — kill-free reaching
+        # definitions, so the dataflow_solution_in/out label styles train
+        # against a real flow property of the graph, not noise.
+        is_def = feats[ALL_SUBKEYS[0]] != 0
+        df_in = np.zeros(n, bool)
+        df_out = is_def.copy()
+        for _ in range(n):
+            new_in = df_in.copy()
+            np.logical_or.at(new_in, r_arr, df_out[s_arr])
+            new_out = is_def | new_in
+            if np.array_equal(new_in, df_in) and np.array_equal(new_out, df_out):
+                break
+            df_in, df_out = new_in, new_out
+
         out.append(
             {
                 "id": i,
                 "num_nodes": n,
-                "senders": np.asarray(senders, np.int32),
-                "receivers": np.asarray(receivers, np.int32),
+                "senders": s_arr,
+                "receivers": r_arr,
                 "vuln": node_vuln,
                 "feats": feats,
                 "label": vul,
+                "df_in": df_in.astype(np.int32),
+                "df_out": df_out.astype(np.int32),
                 # project id for cross-project split protocols
                 "project": int(rng.integers(0, 10)),
             }

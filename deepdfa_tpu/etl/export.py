@@ -27,6 +27,7 @@ def cpg_to_example(
     line_labels: Optional[Mapping[int, int]] = None,
     label: Optional[int] = None,
     project: int = 0,
+    dataflow: Optional[Tuple[Mapping[int, int], Mapping[int, int]]] = None,
 ) -> Dict:
     """Export one function graph.
 
@@ -52,7 +53,19 @@ def cpg_to_example(
         subkey: np.asarray(idxs, np.int64)
         for subkey, idxs in node_feature_indices(cpg, features, vocabs).items()
     }
+    extra: Dict = {}
+    if dataflow is not None:
+        # Per-node reaching-definitions solution bits (label styles
+        # dataflow_solution_in/out, base_module.py:83-95), keyed by Joern id.
+        df_in_map, df_out_map = dataflow
+        extra["df_in"] = np.asarray(
+            [int(df_in_map.get(n, 0)) for n in node_ids], np.int32
+        )
+        extra["df_out"] = np.asarray(
+            [int(df_out_map.get(n, 0)) for n in node_ids], np.int32
+        )
     return {
+        **extra,
         "id": graph_id,
         "num_nodes": len(node_ids),
         "senders": senders,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import multiprocessing as mp
 import os
+import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -21,8 +22,12 @@ _SENTINEL_ERROR = "__pmap_error__"
 
 # The mapped function travels to fork()ed workers by memory inheritance,
 # not pickling — so closures and lambdas work (the reference's dfmp
-# requires module-level functions; this lifts that restriction).
+# requires module-level functions; this lifts that restriction). The slot is
+# process-global state, so concurrent pmap calls (threads, or a nested pmap
+# reached from a mapped fn on the serial path) serialize on _ACTIVE_LOCK
+# rather than clobbering each other's function.
 _ACTIVE_FN: Optional[Callable] = None
+_ACTIVE_LOCK = threading.RLock()
 
 
 def _call(item):
@@ -50,15 +55,17 @@ def pmap(
     direct under debuggers).
     """
     global _ACTIVE_FN
-    _ACTIVE_FN = fn
-    try:
-        if workers <= 1 or len(items) < 2 or os.name != "posix":
-            results = [_call(item) for item in items]
-        else:
-            with mp.get_context("fork").Pool(workers) as pool:
-                results = pool.map(_call, items, chunksize=chunksize)
-    finally:
-        _ACTIVE_FN = None
+    with _ACTIVE_LOCK:  # RLock: threads serialize, same-thread nesting enters
+        prev = _ACTIVE_FN  # save/restore so a nested serial pmap doesn't
+        _ACTIVE_FN = fn    # null the outer call's function
+        try:
+            if workers <= 1 or len(items) < 2 or os.name != "posix":
+                results = [_call(item) for item in items]
+            else:
+                with mp.get_context("fork").Pool(workers) as pool:
+                    results = pool.map(_call, items, chunksize=chunksize)
+        finally:
+            _ACTIVE_FN = prev
 
     out: List[Any] = []
     failures = []

@@ -36,12 +36,24 @@ logger = logging.getLogger(__name__)
 
 
 def prepare(rows: List[Dict], workdir: str) -> int:
-    """Write functions/<id>.c + meta.jsonl; returns row count."""
+    """Write functions/<id>.c (+ functions_after/<id>.c for fixed rows) and
+    meta.jsonl; returns row count.
+
+    The after-function files mirror the reference's ``processed/bigvul/
+    after/`` tree (datasets.py:333-335 itempath): the graphs stage extracts
+    a CPG from them too, which the export stage needs to compute
+    dependent-added-line labels (evaluate.py:194-218).
+    """
     root = Path(workdir)
     (root / "functions").mkdir(parents=True, exist_ok=True)
+    (root / "functions_after").mkdir(parents=True, exist_ok=True)
     with open(root / "meta.jsonl", "w") as f:
         for row in rows:
             (root / "functions" / f"{row['id']}.c").write_text(row["before"])
+            if row.get("vul") and row.get("after", "").strip():
+                (root / "functions_after" / f"{row['id']}.c").write_text(
+                    row["after"]
+                )
             f.write(json.dumps({
                 "id": int(row["id"]),
                 "vul": int(row["vul"]),
@@ -64,7 +76,9 @@ def run_graphs(workdir: str, workers: int = 6) -> List[Path]:
 
     root = Path(workdir)
     pending = [
-        p for p in sorted((root / "functions").glob("*.c"))
+        p
+        for d in ("functions", "functions_after")
+        for p in sorted((root / d).glob("*.c"))
         if not p.with_suffix(".c.nodes.json").exists()
     ]
     if not pending:
@@ -94,6 +108,27 @@ def run_graphs(workdir: str, workers: int = 6) -> List[Path]:
     return [p for lst in done_lists if lst for p in lst]
 
 
+def _dataflow_bits(stem: Path, cpg):
+    """Per-node dataflow-solution bits for one function.
+
+    Prefers Joern's own solver output (``<id>.c.dataflow.json``, written by
+    get_dataflow_output.sc) when the graphs stage produced it; otherwise
+    computes the identical fixpoint with the native reaching-definitions
+    solver over the CFG (etl/reaching.py + native/src/reachdef.cpp) — the
+    Joern-free path.
+    """
+    from deepdfa_tpu.etl.reaching import ReachingDefinitions, parse_dataflow_output
+
+    df_path = stem.with_suffix(".c.dataflow.json")
+    if df_path.exists():
+        in_map, out_map = parse_dataflow_output(df_path)
+        return (
+            {n: int(bool(v)) for n, v in in_map.items()},
+            {n: int(bool(v)) for n, v in out_map.items()},
+        )
+    return ReachingDefinitions(cpg).solution_node_bits()
+
+
 def export(
     workdir: str,
     feature: Optional[FeatureSpec] = None,
@@ -106,7 +141,7 @@ def export(
     from deepdfa_tpu.etl.absdf import build_all_vocabs, extract_decl_features
     from deepdfa_tpu.etl.cpg import load_joern_export
     from deepdfa_tpu.etl.export import cpg_to_example
-    from deepdfa_tpu.etl.statements import statement_labels
+    from deepdfa_tpu.etl.statements import dependent_added_lines, statement_labels
 
     feature = feature or FeatureSpec()
     root = Path(workdir)
@@ -153,15 +188,31 @@ def export(
                 continue
             line_labels = None
             if m.get("vul"):
-                # Vulnerable lines: removed by the fix + lines the fix's
-                # added lines depend on (evaluate.py:194-255). Without the
-                # after-graph the dependency half degrades to removed-only.
+                # Vulnerable lines: removed by the fix + lines of the before
+                # function that the fix's added lines depend on
+                # (evaluate.py:194-255). The dependency half needs the
+                # after-function CPG (graphs stage over functions_after/);
+                # when it's missing, labels degrade to removed-only, the
+                # reference's own failure path (evaluate.py:234-236
+                # except -> dep_add_lines = []).
                 dep_added: List[int] = []
+                after_stem = root / "functions_after" / f"{gid}.c"
+                if after_stem.with_suffix(".c.nodes.json").exists():
+                    try:
+                        after_cpg = load_joern_export(after_stem)
+                        dep_added = dependent_added_lines(
+                            cpg, after_cpg, m.get("added", [])
+                        )
+                    except Exception as exc:
+                        logger.warning(
+                            "export: dep-added labels for %d failed: %s", gid, exc
+                        )
                 line_labels = statement_labels(cpg, m.get("removed", []), dep_added)
             ex = cpg_to_example(
                 cpg, vocabs, features_by_graph[gid], gid, gtype=gtype,
                 line_labels=line_labels,
                 label=int(m.get("vul", 0)) if m else None,
+                dataflow=_dataflow_bits(stems[gid], cpg),
             )
             f.write(json.dumps({
                 "id": ex["id"],
@@ -172,6 +223,8 @@ def export(
                 "feats": {k: np.asarray(v).tolist() for k, v in ex["feats"].items()},
                 "label": ex["label"],
                 "project": m.get("project", ""),
+                "df_in": np.asarray(ex["df_in"]).tolist(),
+                "df_out": np.asarray(ex["df_out"]).tolist(),
             }) + "\n")
             n_written += 1
     partition = {}

@@ -207,3 +207,44 @@ class ReachingDefinitions:
             n: sorted(index[d] for d in s) for n, s in in_sets.items()
         }
         return bits, domain
+
+    def solution_node_bits(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """(df_in, df_out): the scalar per-node training bits for the
+        ``dataflow_solution_in/out`` label styles.
+
+        The reference asserts the attached ``_DF_IN`` solution is one 0/1
+        bit per node (main_cli.py:250-254) but ships no attach script; the
+        bit here is defined as "some definition reaches this node's IN/OUT"
+        — non-empty fixpoint set — which is per-node, 0/1, and requires the
+        GNN to simulate gen/kill propagation through the CFG to predict.
+        Non-CFG nodes get 0 (they are outside the flow graph).
+        """
+        in_sets, out_sets = self.solve()
+        df_in = {n: int(bool(s)) for n, s in in_sets.items()}
+        df_out = {n: int(bool(s)) for n, s in out_sets.items()}
+        return df_in, df_out
+
+
+def parse_dataflow_output(path) -> Tuple[Dict[int, list], Dict[int, list]]:
+    """Parse Joern's ``<id>.c.dataflow.json`` into (in_map, out_map).
+
+    Schema from the exporter (DDFA/storage/external/get_dataflow_output.sc:
+    37-55): one entry per method, each with ``solution.in``/``solution.out``
+    mapping node-id strings to lists of reaching-definition node ids.
+    Consumed like the reference's ``get_dataflow_output``
+    (DDFA/sastvd/helpers/datasets.py:780-796): per-method maps merge with a
+    node-disjointness assert, keys to int.
+    """
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    in_map: Dict[int, list] = {}
+    out_map: Dict[int, list] = {}
+    for _, data in doc.items():
+        for src, dst in (("solution.in", in_map), ("solution.out", out_map)):
+            part = data[src]
+            overlap = set(dst) & {int(k) for k in part}
+            assert not overlap, f"solution node sets overlap: {sorted(overlap)[:5]}"
+            dst.update({int(k): v for k, v in part.items()})
+    return in_map, out_map

@@ -60,9 +60,14 @@ def corpus_bleu(
             den[n - 1] += max(1, sum(counts.values()))
     if hyp_len == 0:
         return 0.0
-    log_p = sum(
-        (1.0 / max_n) * math.log(max(num[i], _EPS) / den[i]) for i in range(max_n)
-    )
+    if any(n == 0 for n in num):
+        # The reference's vendored nltk corpus_bleu is unsmoothed
+        # (CodeT5/evaluator/CodeBLEU/bleu.py, Fraction without smoothing):
+        # any zero n-gram overlap zeroes the whole geometric mean. Match it
+        # exactly — a tiny-positive floor here would deviate in the
+        # CodeBLEU composite.
+        return 0.0
+    log_p = sum((1.0 / max_n) * math.log(num[i] / den[i]) for i in range(max_n))
     return _brevity_penalty(ref_len, hyp_len) * math.exp(log_p)
 
 

@@ -55,6 +55,11 @@ class GraphBatch:
     # Optional block-sparse adjacency (ops/tile_spmm.TileAdjacency) for the
     # Pallas MXU message-passing path; None → XLA segment ops.
     tile_adj: Optional[Any] = None
+    # Optional per-node dataflow-solution bits (_DF_IN/_DF_OUT analogues,
+    # reference base_module.py:83-95): int32[max_nodes], built when the
+    # examples carry "df_in"/"df_out" (batch_graphs(with_dataflow=True)).
+    node_df_in: Optional[jnp.ndarray] = None
+    node_df_out: Optional[jnp.ndarray] = None
 
     @property
     def n_graphs(self) -> int:
@@ -127,6 +132,7 @@ def batch_graphs(
     tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
     impl: str = "auto",
+    with_dataflow: bool = False,
 ) -> "GraphBatch":
     """Pack up to ``n_graphs`` graphs into one padded batch (host-side).
 
@@ -217,6 +223,26 @@ def batch_graphs(
             pad_nz=tile_pad_nz,
         )
 
+    df_in = df_out = None
+    if with_dataflow:
+        # Dataflow-solution bits ride outside the native batcher (a plain
+        # offset copy, not worth a C++ path).
+        df_in = np.zeros(max_nodes, np.int32)
+        df_out = np.zeros(max_nodes, np.int32)
+        off = 0
+        for g in graphs:
+            n = int(g["num_nodes"])
+            if "df_in" not in g or "df_out" not in g:
+                raise ValueError(
+                    "with_dataflow=True but example "
+                    f"{g.get('id', '?')} carries no df_in/df_out bits — "
+                    "re-run the ETL export (etl/pipeline.py attaches them) "
+                    "or regenerate synthetic data"
+                )
+            df_in[off : off + n] = np.asarray(g["df_in"], np.int32)
+            df_out[off : off + n] = np.asarray(g["df_out"], np.int32)
+            off += n
+
     return GraphBatch(
         node_feats={k: jnp.asarray(v) for k, v in feats.items()},
         node_vuln=jnp.asarray(vuln),
@@ -228,6 +254,8 @@ def batch_graphs(
         graph_mask=jnp.asarray(graph_mask),
         graph_ids=jnp.asarray(graph_ids),
         tile_adj=tile_adj,
+        node_df_in=jnp.asarray(df_in) if df_in is not None else None,
+        node_df_out=jnp.asarray(df_out) if df_out is not None else None,
     )
 
 
@@ -241,6 +269,7 @@ def batch_iterator(
     build_tile_adj: bool = False,
     tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
+    with_dataflow: bool = False,
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would
     overflow the budget into the next batch (static-shape replacement for
@@ -251,7 +280,7 @@ def batch_iterator(
     nodes = edges = 0
     kw = dict(
         add_self_loops=add_self_loops, build_tile_adj=build_tile_adj,
-        tile=tile, tile_pad_nz=tile_pad_nz,
+        tile=tile, tile_pad_nz=tile_pad_nz, with_dataflow=with_dataflow,
     )
 
     def _cost(g):

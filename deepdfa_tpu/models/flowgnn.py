@@ -20,7 +20,7 @@ width 128 -> skip-concat [ggnn_out, embed] 256 -> attention-pool -> MLP
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
@@ -47,6 +47,7 @@ class GatedGraphStep(nn.Module):
     hidden: int
     dtype: jnp.dtype = jnp.float32
     message_impl: str = "segment"
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, h, batch: GraphBatch):
@@ -56,9 +57,18 @@ class GatedGraphStep(nn.Module):
                 raise ValueError(
                     "message_impl='tile' needs batch_graphs(build_tile_adj=True)"
                 )
-            from deepdfa_tpu.ops.tile_spmm import tile_spmm
+            from deepdfa_tpu.ops.tile_spmm import tile_spmm, tile_spmm_sharded
 
-            agg = tile_spmm(batch.tile_adj, msg)
+            if batch.tile_adj.vals.ndim == 4:
+                # Stacked per-shard adjacency (shard_concat on a dp mesh):
+                # each device runs the kernel on its own tile list.
+                if self.mesh is None:
+                    raise ValueError(
+                        "sharded tile batch needs FlowGNN(config, mesh=mesh)"
+                    )
+                agg = tile_spmm_sharded(batch.tile_adj, msg, self.mesh)
+            else:
+                agg = tile_spmm(batch.tile_adj, msg)
         else:
             gathered = jnp.take(msg, batch.senders, axis=0)
             gathered = jnp.where(batch.edge_mask[:, None], gathered, 0.0)
@@ -98,6 +108,7 @@ class FlowGNN(nn.Module):
     """
 
     config: FlowGNNConfig
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, batch: GraphBatch) -> jnp.ndarray:
@@ -123,6 +134,7 @@ class FlowGNN(nn.Module):
             cfg.ggnn_hidden,
             dtype=dtype,
             message_impl=cfg.message_impl,
+            mesh=self.mesh,
             name="ggnn_step",
         )
         # Weight sharing across steps (one GatedGraphConv applied n_steps

@@ -176,6 +176,94 @@ def build_tile_adjacency(
     )
 
 
+def pad_tiles(adj: TileAdjacency, pad_nz: int) -> TileAdjacency:
+    """Pad the tile lists to a larger budget with inert zero tiles.
+
+    Zero tiles appended on the last row keep ``rows`` sorted and add nothing
+    to the product — the same trick ``_dense_tiles`` uses for its own pad.
+    """
+    n_nz = int(adj.vals.shape[0])
+    if pad_nz == n_nz:
+        return adj
+    if pad_nz < n_nz:
+        raise ValueError(f"pad budget {pad_nz} < {n_nz} existing tiles")
+    pad = pad_nz - n_nz
+    last = adj.n_row_tiles - 1
+
+    def pv(v):
+        return jnp.concatenate([v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+
+    def pi(ix):
+        return jnp.concatenate([ix, jnp.full((pad,), last, ix.dtype)])
+
+    return TileAdjacency(
+        vals=pv(adj.vals), rows=pi(adj.rows), cols=pi(adj.cols),
+        t_vals=pv(adj.t_vals), t_rows=pi(adj.t_rows), t_cols=pi(adj.t_cols),
+        tile=adj.tile, n_row_tiles=adj.n_row_tiles,
+    )
+
+
+def stack_tile_adjacencies(adjs: "list[TileAdjacency]") -> TileAdjacency:
+    """Stack per-shard adjacencies along a leading device axis.
+
+    The result's array leaves are ``[D, n_nz, ...]`` with every shard padded
+    to a common power-of-two tile budget, ready to shard over the mesh's
+    data axis and consume with :func:`tile_spmm_sharded`. Valid because the
+    batch alignment contract (parallel/mesh.py) guarantees no edge crosses a
+    shard boundary: the global adjacency is block-diagonal over shards.
+    """
+    a0 = adjs[0]
+    for a in adjs:
+        if a.tile != a0.tile or a.n_row_tiles != a0.n_row_tiles:
+            raise ValueError("shards must share tile size and row-tile count")
+    nz = _round_up_pow2(max(int(a.vals.shape[0]) for a in adjs))
+    adjs = [pad_tiles(a, nz) for a in adjs]
+
+    def stack(field):
+        return jnp.stack([getattr(a, field) for a in adjs])
+
+    return TileAdjacency(
+        vals=stack("vals"), rows=stack("rows"), cols=stack("cols"),
+        t_vals=stack("t_vals"), t_rows=stack("t_rows"), t_cols=stack("t_cols"),
+        tile=a0.tile, n_row_tiles=a0.n_row_tiles,
+    )
+
+
+def tile_spmm_sharded(
+    adj: TileAdjacency, msg: jnp.ndarray, mesh, impl: str = "auto"
+) -> jnp.ndarray:
+    """``agg = blockdiag(A_d) @ msg`` on a data-sharded mesh.
+
+    ``adj`` is a stacked adjacency (leaves ``[D, ...]``); ``msg`` is the
+    node-flat message array whose leading axis is sharded over ``data``.
+    Each device runs the tile kernel on its own shard's tile list — shard
+    boundaries coincide with graph boundaries, so the product needs no
+    cross-device collectives, and gradients flow through the per-shard
+    custom VJP.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from deepdfa_tpu.parallel.mesh import DATA_AXIS
+
+    adj_spec = TileAdjacency(
+        vals=P(DATA_AXIS), rows=P(DATA_AXIS), cols=P(DATA_AXIS),
+        t_vals=P(DATA_AXIS), t_rows=P(DATA_AXIS), t_cols=P(DATA_AXIS),
+        tile=adj.tile, n_row_tiles=adj.n_row_tiles,
+    )
+
+    def local(a: TileAdjacency, m: jnp.ndarray) -> jnp.ndarray:
+        squeezed = jax.tree_util.tree_map(lambda x: x[0], a)
+        return tile_spmm(squeezed, m, impl)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(adj_spec, P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )(adj, msg)
+
+
 # ---------------------------------------------------------------------------
 # Kernel
 # ---------------------------------------------------------------------------

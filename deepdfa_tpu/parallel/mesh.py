@@ -56,13 +56,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_concat(shards: Sequence[GraphBatch]) -> GraphBatch:
+def shard_concat(shards: Sequence[GraphBatch], base_shard: int = 0) -> GraphBatch:
     """Concatenate D equal-budget per-device batches into one device-aligned
     global batch.
 
     Node/graph indices in shard d are offset by d's cumulative budgets so the
     concatenated arrays form one consistent graph batch whose shard
     boundaries coincide with graph boundaries.
+
+    ``base_shard``: global index of the first shard — a host assembling only
+    its local slice of a multi-controller batch must offset node/graph
+    references by its global position, since the lifted array's indices are
+    global (senders/receivers/node_graph address rows of the full batch).
     """
     d = len(shards)
     b0 = shards[0]
@@ -80,9 +85,19 @@ def shard_concat(shards: Sequence[GraphBatch]) -> GraphBatch:
             parts.append(arr)
         return np.concatenate([np.asarray(p) for p in parts])
 
-    node_off = [i * b0.max_nodes for i in range(d)]
-    graph_off = [i * b0.n_graphs for i in range(d)]
+    node_off = [(base_shard + i) * b0.max_nodes for i in range(d)]
+    graph_off = [(base_shard + i) * b0.n_graphs for i in range(d)]
     import jax.numpy as jnp
+
+    # Per-shard tile adjacencies stack along a leading device axis: the
+    # global adjacency is block-diagonal over shards (no graph crosses a
+    # shard boundary), so each device's kernel runs on its own tile list
+    # under shard_map (ops.tile_spmm.tile_spmm_sharded).
+    tile_adj = None
+    if all(b.tile_adj is not None for b in shards):
+        from deepdfa_tpu.ops.tile_spmm import stack_tile_adjacencies
+
+        tile_adj = stack_tile_adjacencies([b.tile_adj for b in shards])
 
     return GraphBatch(
         node_feats={
@@ -99,11 +114,15 @@ def shard_concat(shards: Sequence[GraphBatch]) -> GraphBatch:
         edge_mask=jnp.asarray(cat("edge_mask")),
         graph_mask=jnp.asarray(cat("graph_mask")),
         graph_ids=jnp.asarray(cat("graph_ids")),
-        # The Pallas tile adjacency is per-device state; a concatenated tile
-        # list would not partition along the data axis, so sharded batches
-        # carry no adjacency and models running on them must use
-        # message_impl="segment" (the model raises otherwise).
-        tile_adj=None,
+        tile_adj=tile_adj,
+        node_df_in=(
+            jnp.asarray(cat("node_df_in"))
+            if all(b.node_df_in is not None for b in shards) else None
+        ),
+        node_df_out=(
+            jnp.asarray(cat("node_df_out"))
+            if all(b.node_df_out is not None for b in shards) else None
+        ),
     )
 
 
@@ -116,13 +135,7 @@ def host_shard_indices(
     every host gets the SAME length — in multi-controller JAX all processes
     must run the same number of jitted steps or the collectives deadlock
     (the reason DistributedSampler pads to equal shards,
-    reference CodeT5/run_defect.py:274-277).
-
-    This is an *IO-sharding building block*, not wired into the training
-    loops: a host feeding a globally-sharded step must assemble arrays with
-    ``jax.make_array_from_process_local_data`` from its local slice, which
-    is a multi-host input-pipeline concern the single-host loops here don't
-    have. No-op on a single host.
+    reference CodeT5/run_defect.py:274-277). No-op on a single host.
     """
     pc = jax.process_count() if process_count is None else process_count
     if pc <= 1:
@@ -130,3 +143,40 @@ def host_shard_indices(
     pi = jax.process_index() if process_index is None else process_index
     per_host = len(indices) // pc  # truncate: equal step counts on all hosts
     return indices[pi::pc][:per_host]
+
+
+def local_shard_slice(
+    n_shards: int,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> slice:
+    """Which of a global batch's ``n_shards`` data shards this host feeds.
+
+    Mesh construction order (``make_mesh`` reshapes ``jax.devices()``, which
+    lists all processes' devices grouped by process index) puts contiguous
+    data-axis blocks on each host, so host ``pi`` owns shards
+    ``[pi*local : (pi+1)*local]``.
+    """
+    pc = jax.process_count() if process_count is None else process_count
+    pi = jax.process_index() if process_index is None else process_index
+    if n_shards % pc:
+        raise ValueError(f"data shards {n_shards} not divisible by hosts {pc}")
+    local = n_shards // pc
+    return slice(pi * local, (pi + 1) * local)
+
+
+def assemble_global_batch(local_batch, mesh: Mesh, sharding=None):
+    """Multi-controller input assembly: lift each host's local batch shard
+    into one global jax.Array per leaf via
+    ``jax.make_array_from_process_local_data`` (the pjit-era replacement for
+    the reference's DistributedSampler feeding per-rank tensors,
+    CodeT5/run_defect.py:274-277). Identity on a single process.
+    """
+    if jax.process_count() == 1:
+        return local_batch
+    sh = sharding or batch_sharding(mesh)
+
+    def lift(x):
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
+
+    return jax.tree_util.tree_map(lift, local_batch)

@@ -26,24 +26,33 @@ class CheckpointManager:
         self.periodic_every = periodic_every
         self._ckpt = ocp.StandardCheckpointer()
         self._meta_path = os.path.join(self.directory, "meta.json")
-        self._meta = {"best_epoch": -1, "best_val_loss": float("inf")}
+        self._meta = {"best_epoch": -1, "best_val_loss": float("inf"),
+                      "last_epoch": -1}
         if os.path.exists(self._meta_path):
             with open(self._meta_path) as f:
-                self._meta = json.load(f)
+                self._meta.update(json.load(f))
 
     def _save(self, name: str, state: Any) -> None:
         path = os.path.join(self.directory, name)
         self._ckpt.save(path, jax.device_get(state), force=True)
         self._ckpt.wait_until_finished()
 
-    def save_best(self, state: Any, epoch: int, val_loss: float) -> None:
-        self._save("best", state)
-        self._meta.update({"best_epoch": epoch, "best_val_loss": val_loss})
+    def _write_meta(self) -> None:
         with open(self._meta_path, "w") as f:
             json.dump(self._meta, f)
 
+    def save_best(self, state: Any, epoch: int, val_loss: float) -> None:
+        self._save("best", state)
+        self._meta.update({"best_epoch": epoch, "best_val_loss": val_loss})
+        self._write_meta()
+
     def save_last(self, state: Any, epoch: int) -> None:
         self._save("last", state)
+        self._meta["last_epoch"] = epoch
+        self._write_meta()
+
+    def has(self, name: str) -> bool:
+        return os.path.isdir(os.path.join(self.directory, name))
 
     def maybe_save_periodic(self, state: Any, epoch: int) -> None:
         if self.periodic_every and (epoch + 1) % self.periodic_every == 0:

@@ -134,6 +134,7 @@ def fit_clone(
 
     np_rng = np.random.RandomState(cfg.seed)
     best_f1, best_state = -1.0, state
+    best_metrics: dict = {}
     for epoch in range(cfg.max_epochs):
         order = np_rng.permutation(n)
         for src, labels, mask in batches(train_data, cfg.batch_size, order):
@@ -155,6 +156,7 @@ def fit_clone(
         if log:
             log(f"epoch {epoch}: eval_f1={metrics['f1']:.4f}")
         if metrics["f1"] > best_f1:
-            best_f1, best_state = metrics["f1"], state
+            best_f1, best_state, best_metrics = metrics["f1"], state, metrics
 
-    return {"state": best_state, "best_f1": best_f1, "eval_metrics": metrics}
+    # eval_metrics describe the returned (best) state, not the last epoch.
+    return {"state": best_state, "best_f1": best_f1, "eval_metrics": best_metrics}

@@ -87,16 +87,33 @@ def make_train_state(
 
 
 def _labels_for(model: FlowGNN, batch: GraphBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(labels, mask) per the configured label style (base_module.py:83-95)."""
+    """(labels, mask) per the configured label style (base_module.py:83-95).
+
+    ``dataflow_solution_in/out`` train against per-node reaching-definitions
+    solution bits (_DF_IN/_DF_OUT, attached by the ETL export from Joern's
+    ``.dataflow.json`` or the native solver — etl/pipeline.py). The "in"
+    style additionally cuts loss/metrics to definition nodes (nonzero
+    abstract-dataflow feature), the ``cut_nodef`` semantics of
+    base_module.py:148-155,175-176.
+    """
     style = model.config.label_style
     if style == "graph":
         return graph_label_from_nodes(batch), batch.graph_mask
     if style == "node":
         return batch.node_vuln.astype(jnp.float32), batch.node_mask
-    raise NotImplementedError(
-        f"label_style {style!r}: dataflow-solution training needs the ETL "
-        "stage that attaches per-node solution bits (not yet wired)"
-    )
+    if style in ("dataflow_solution_in", "dataflow_solution_out"):
+        sol = batch.node_df_in if style.endswith("_in") else batch.node_df_out
+        if sol is None:
+            raise ValueError(
+                f"label_style {style!r} needs batches built with "
+                "with_dataflow=True (examples carrying df_in/df_out bits)"
+            )
+        mask = batch.node_mask
+        if style.endswith("_in"):
+            first_key = next(iter(batch.node_feats))
+            mask = mask & (batch.node_feats[first_key] != 0)
+        return sol.astype(jnp.float32), mask
+    raise NotImplementedError(f"label_style {style!r}")
 
 
 def make_train_step(
@@ -139,6 +156,8 @@ def _batches(
     batch_size: int,
     n_shards: int = 1,
     build_tile_adj: bool = False,
+    with_dataflow: bool = False,
+    host: "Optional[Tuple[int, int]]" = None,
 ) -> Iterable[GraphBatch]:
     """Pack examples into padded batches.
 
@@ -147,21 +166,25 @@ def _batches(
     coincide with graph boundaries — message passing then needs no
     cross-device collectives (the mesh alignment contract in
     ``parallel/mesh.py``). Trailing groups are padded with empty sub-batches.
+
+    ``host=(process_index, process_count)`` (multi-controller JAX): every
+    host runs the same deterministic packing over the same global index
+    sequence, but concatenates and yields only its own slice of each shard
+    group — the caller lifts it to a global array with
+    ``assemble_global_batch``. Packing all groups on all hosts keeps the
+    shard boundaries globally agreed without communication, the same
+    contract as the reference's seeded DistributedSampler
+    (CodeT5/run_defect.py:274-277).
     """
-    from deepdfa_tpu.parallel.mesh import shard_concat
+    from deepdfa_tpu.parallel.mesh import local_shard_slice, shard_concat
 
     chosen = [examples[i] for i in indices]
     per_shard = max(batch_size // n_shards, 1)
     budget_nodes = per_shard * data_cfg.max_nodes_per_graph
     budget_edges = budget_nodes * data_cfg.max_edges_per_node
     if build_tile_adj:
-        if n_shards > 1:
-            # shard_concat constructs the global batch without tile_adj
-            # (per-device tile lists do not partition along the data axis).
-            raise ValueError(
-                "build_tile_adj requires n_shards == 1; use "
-                "message_impl='segment' on a sharded mesh"
-            )
+        # Per-shard node budget must be a tile multiple; shard_concat stacks
+        # the per-shard tile lists along a device axis for the sharded kernel.
         from deepdfa_tpu.ops.tile_spmm import align_to_tile
 
         budget_nodes = align_to_tile(budget_nodes)
@@ -170,21 +193,29 @@ def _batches(
     # bucket-ladder compromise as the node/edge budgets), not one per batch.
     sub_iter = batch_iterator(
         chosen, per_shard, budget_nodes, budget_edges, subkeys,
-        build_tile_adj=build_tile_adj,
+        build_tile_adj=build_tile_adj, with_dataflow=with_dataflow,
     )
     if n_shards == 1:
         yield from sub_iter
         return
-    empty = batch_graphs([], per_shard, budget_nodes, budget_edges, subkeys)
+    empty = batch_graphs(
+        [], per_shard, budget_nodes, budget_edges, subkeys,
+        build_tile_adj=build_tile_adj, with_dataflow=with_dataflow,
+    )
+    sel = (
+        local_shard_slice(n_shards, host[0], host[1]) if host is not None
+        else slice(None)
+    )
+    base = sel.start or 0
     group: List[GraphBatch] = []
     for sub in sub_iter:
         group.append(sub)
         if len(group) == n_shards:
-            yield shard_concat(group)
+            yield shard_concat(group[sel], base_shard=base)
             group = []
     if group:
         group.extend([empty] * (n_shards - len(group)))
-        yield shard_concat(group)
+        yield shard_concat(group[sel], base_shard=base)
 
 
 def evaluate(
@@ -196,15 +227,31 @@ def evaluate(
     subkeys,
     n_shards: int = 1,
     build_tile_adj: bool = False,
+    with_dataflow: bool = False,
+    host: "Optional[Tuple[int, int]]" = None,
+    mesh=None,
 ) -> EvalResult:
+    """``host``/``mesh``: multi-controller mode — each host feeds its local
+    shard slice, lifted to global arrays. Per-example probability/label
+    dumps are skipped there (globally-sharded outputs are not fully
+    addressable from one host); the scalar metrics remain exact."""
+    from deepdfa_tpu.parallel.mesh import assemble_global_batch
+
     total_loss, n_batches = 0.0, 0
     stats = BinaryStats.zeros()
     probs_all, labels_all, ids_all = [], [], []
     for batch in _batches(
         examples, indices, data_cfg, subkeys, data_cfg.eval_batch_size, n_shards,
-        build_tile_adj,
+        build_tile_adj, with_dataflow, host,
     ):
+        if host is not None:
+            batch = assemble_global_batch(batch, mesh)
         loss, probs, labels, mask = eval_step(state, batch)
+        if host is not None:
+            stats = stats + binary_stats(probs, labels, mask)
+            total_loss += float(loss)
+            n_batches += 1
+            continue
         m = np.asarray(mask)
         probs_all.append(np.asarray(probs)[m])
         labels_all.append(np.asarray(labels)[m])
@@ -240,27 +287,34 @@ def fit(
     mesh=None,
     checkpointer=None,
     log_every: int = 50,
+    resume: bool = False,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train to ``max_epochs``, tracking the best state by val loss.
 
     Returns (best_state, history). ``mesh``: optional Mesh; inputs get
     data-axis sharding, params are replicated, XLA handles the rest.
+    ``resume=True`` continues from the checkpointer's ``last`` snapshot
+    (params + opt_state + epoch counter — resume_from_checkpoint,
+    reference config_default.yaml:39); a no-op when no snapshot exists.
     """
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
     use_tile = model.config.message_impl == "tile"
-    if use_tile and n_shards > 1:
-        # shard_concat carries no tile adjacency (per-device tile lists do
-        # not partition along the data axis, parallel/mesh.py).
-        raise ValueError(
-            "message_impl='tile' is single-shard only; use "
-            "message_impl='segment' on a sharded mesh"
-        )
+    use_df = model.config.label_style.startswith("dataflow_solution")
+    # Multi-controller: every process runs this same loop; each feeds its
+    # local slice of every global batch (host_shard contract, mesh.py).
+    host = (jax.process_index(), jax.process_count()) if jax.process_count() > 1 else None
+    if host is not None and mesh is None:
+        raise ValueError("multi-process fit needs an explicit global mesh")
+    if mesh is not None and model.mesh is not mesh:
+        # The sharded tile kernel runs under shard_map and needs the mesh.
+        model = model.clone(mesh=mesh)
     example_batch = next(
         _batches(examples, splits["train"][:data_cfg.batch_size], data_cfg, subkeys,
-                 data_cfg.batch_size, n_shards, use_tile)
+                 data_cfg.batch_size, n_shards, use_tile, use_df)
     )
     state, tx = make_train_state(model, example_batch, train_cfg)
+    del example_batch
 
     if checkpointer is None and train_cfg.checkpoint_dir:
         from deepdfa_tpu.train.checkpoint import CheckpointManager
@@ -289,6 +343,25 @@ def fit(
     labels = [int(ex["label"]) for ex in examples]
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_loss": float("inf")}
     best_state = state
+    start_epoch = 0
+    if resume and checkpointer is not None and checkpointer.has("last"):
+        meta = checkpointer.best_meta
+        state = checkpointer.restore("last", state)
+        if "last_epoch" not in meta or int(meta["last_epoch"]) < 0:
+            logger.warning(
+                "resume: checkpoint dir has a 'last' snapshot but no "
+                "last_epoch in meta.json (written by an older version?) — "
+                "restarting the epoch schedule at 0 on top of the restored "
+                "weights"
+            )
+        start_epoch = int(meta.get("last_epoch", -1)) + 1
+        history["best_epoch"] = int(meta.get("best_epoch", -1))
+        history["best_val_loss"] = float(meta.get("best_val_loss", float("inf")))
+        best_state = (
+            checkpointer.restore("best", state) if checkpointer.has("best") else state
+        )
+        logger.info("resuming from epoch %d (best val_loss %.4f @ epoch %d)",
+                    start_epoch, history["best_val_loss"], history["best_epoch"])
 
     tb_writer = None
     if train_cfg.tensorboard_dir:
@@ -302,8 +375,9 @@ def fit(
     try:
         return _fit_epochs(
             model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
-            use_tile, state, train_step, eval_step, labels, history,
-            best_state, checkpointer, tb_writer, log_every,
+            use_tile, use_df, state, train_step, eval_step, labels, history,
+            best_state, checkpointer, tb_writer, log_every, start_epoch,
+            host, mesh,
         )
     finally:
         # close on every exit path: a diverging run (detect_anomaly raise)
@@ -314,10 +388,12 @@ def fit(
 
 def _fit_epochs(
     model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
-    use_tile, state, train_step, eval_step, labels, history, best_state,
-    checkpointer, tb_writer, log_every,
+    use_tile, use_df, state, train_step, eval_step, labels, history, best_state,
+    checkpointer, tb_writer, log_every, start_epoch=0, host=None, mesh=None,
 ):
-    for epoch in range(train_cfg.max_epochs):
+    from deepdfa_tpu.parallel.mesh import assemble_global_batch
+
+    for epoch in range(start_epoch, train_cfg.max_epochs):
         # Fresh undersample + reshuffle per epoch (reload_dataloaders_every_
         # n_epochs: 1 semantics).
         train_idx = splits["train"]
@@ -336,7 +412,10 @@ def _fit_epochs(
         loss_sum = jnp.zeros(())
         n_batches = 0
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
-                              data_cfg.batch_size, n_shards, use_tile):
+                              data_cfg.batch_size, n_shards, use_tile, use_df,
+                              host):
+            if host is not None:
+                batch = assemble_global_batch(batch, mesh)
             state, loss, bstats = train_step(state, batch)
             if train_cfg.detect_anomaly and not np.isfinite(float(loss)):
                 # Lightning detect_anomaly parity: fail at the step that
@@ -354,7 +433,7 @@ def _fit_epochs(
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
         val = evaluate(eval_step, state, examples, splits["val"], data_cfg,
-                       subkeys, n_shards, use_tile)
+                       subkeys, n_shards, use_tile, use_df, host, mesh)
         record = {
             "epoch": epoch,
             "train_loss": epoch_loss / max(n_batches, 1),

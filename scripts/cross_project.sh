@@ -1,11 +1,15 @@
 #!/bin/bash
 # Cross-project generalization protocol (reference scripts/run_cross_project.sh,
 # paper Table 7): no project spans train/test.
+#
+# Extra args: FIT_ARGS apply to the fit step, TEST_ARGS to the test step,
+# "$@" to both (must be valid for both subcommands).
 set -e
 cd "$(dirname "$0")/.."
 python -m deepdfa_tpu.cli fit --config configs/default.yaml \
   --split-mode cross-project \
-  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" "$@"
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" ${FIT_ARGS:-} "$@"
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --split-mode cross-project \
-  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" --which best "$@"
+  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" --which best \
+  ${TEST_ARGS:-} "$@"

@@ -29,6 +29,9 @@ def _needs_reexec() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute tests (subprocess fan-out, e2e fits)"
+    )
     if not _needs_reexec():
         return
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -20,9 +20,19 @@ def test_corpus_bleu_perfect_and_disjoint():
 
 def test_corpus_bleu_partial_ordering():
     ref = [["the cat sat on the mat".split()]]
-    close = corpus_bleu(ref, ["the cat sat on a mat".split()])
-    far = corpus_bleu(ref, ["a dog stood under a rug".split()])
+    close = corpus_bleu(ref, ["the cat sat on the rug".split()])
+    far = corpus_bleu(ref, ["the cat sat on a rug".split()])
     assert 0 < far < close < 1
+
+
+def test_corpus_bleu_unsmoothed_zero_overlap():
+    """Reference parity: the vendored nltk corpus_bleu is unsmoothed, so a
+    corpus with zero n-gram overlap at any order scores exactly 0.0 (no
+    tiny-positive floor)."""
+    ref = [["the cat sat on the mat".split()]]
+    assert corpus_bleu(ref, ["a dog stood under a rug".split()]) == 0.0
+    # zero 4-gram overlap alone also zeroes the unsmoothed geometric mean
+    assert corpus_bleu(ref, ["mat the on cat sat the".split()]) == 0.0
 
 
 def test_weighted_recall_boosts_keywords():

@@ -132,3 +132,123 @@ def test_load_mutated(tmp_path):
     assert "diff" not in out[0]
     flip = load_mutated(rows, str(path), "mut_flip")
     assert flip[0]["before"] == "src1"
+
+def test_export_attaches_dataflow_solution_bits(tmp_path):
+    """Export computes per-node reaching-definitions bits with the native
+    solver when Joern's .dataflow.json is absent. Hand-computed fixpoint on
+    the fixture CFG (joern_fixture.py): defs at 10 (x=1), 30 (x+=a),
+    40 (x=strlen); 30/40 kill x@10."""
+    _write_workdir(tmp_path, ids=(5,))
+    export(str(tmp_path), FeatureSpec())
+    ex = json.loads((tmp_path / "examples.jsonl").read_text().splitlines()[0])
+
+    from deepdfa_tpu.etl.cpg import load_joern_export
+
+    cpg = load_joern_export(tmp_path / "functions" / "5.c")
+    node_ids = sorted(cpg.nodes)
+    df_in = dict(zip(node_ids, ex["df_in"]))
+    df_out = dict(zip(node_ids, ex["df_out"]))
+
+    # No definition reaches the first assignment's entry; its own def leaves.
+    assert df_in[10] == 0 and df_out[10] == 1
+    # Everything downstream of x=1 has a reaching definition.
+    for nid in (20, 30, 40, 50):
+        assert df_in[nid] == 1, nid
+        assert df_out[nid] == 1, nid
+    # Non-CFG nodes (identifiers/literals) carry no solution.
+    assert df_in[11] == 0 and df_out[12] == 0
+
+
+def test_export_prefers_joern_dataflow_json(tmp_path):
+    """When the graphs stage produced <id>.c.dataflow.json, export uses
+    Joern's own solution rather than re-solving."""
+    _write_workdir(tmp_path, ids=(5,))
+    fabricated = {
+        "f": {
+            "solution.in": {"20": [10]},
+            "solution.out": {"20": [10], "30": [30]},
+            "problem.gen": {}, "problem.kill": {},
+        }
+    }
+    (tmp_path / "functions" / "5.c.dataflow.json").write_text(json.dumps(fabricated))
+    export(str(tmp_path), FeatureSpec())
+    ex = json.loads((tmp_path / "examples.jsonl").read_text().splitlines()[0])
+
+    from deepdfa_tpu.etl.cpg import load_joern_export
+
+    cpg = load_joern_export(tmp_path / "functions" / "5.c")
+    node_ids = sorted(cpg.nodes)
+    df_in = dict(zip(node_ids, ex["df_in"]))
+    df_out = dict(zip(node_ids, ex["df_out"]))
+    assert df_in == {n: int(n == 20) for n in node_ids}
+    assert {n for n, v in df_out.items() if v} == {20, 30}
+
+
+def test_parse_dataflow_output_disjointness():
+    from deepdfa_tpu.etl.reaching import parse_dataflow_output
+    import tempfile, os
+
+    doc = {
+        "f": {"solution.in": {"1": [2]}, "solution.out": {"1": [2]}},
+        "g": {"solution.in": {"1": [3]}, "solution.out": {"5": []}},
+    }
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "x.dataflow.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(AssertionError, match="overlap"):
+            parse_dataflow_output(p)
+
+
+def test_export_dep_added_line_labels(tmp_path):
+    """With an after-function CPG present, vulnerable-line labels include
+    lines the fix's added lines depend on (evaluate.py:194-218), not just
+    removed lines. Fixture: added line 4 (x += a) depends on line 2
+    (REACHING_DEF 10->30) and line 3 (CDG 20->30)."""
+    rows = [{
+        "id": 9, "vul": 1, "project": "p0",
+        "before": "int f(int a) { ... }",
+        "added": [4], "removed": [8],
+        "after": "int f(int a) { fixed }",
+    }]
+    prepare(rows, str(tmp_path))
+    for d in ("functions", "functions_after"):
+        base = tmp_path / d / "9.c"
+        assert base.exists(), d
+        base.with_suffix(".c.nodes.json").write_text(json.dumps(NODES))
+        base.with_suffix(".c.edges.json").write_text(json.dumps(EDGES))
+
+    export(str(tmp_path), FeatureSpec())
+    ex = json.loads((tmp_path / "examples.jsonl").read_text().splitlines()[0])
+
+    from deepdfa_tpu.etl.cpg import load_joern_export
+
+    cpg = load_joern_export(tmp_path / "functions" / "9.c")
+    node_ids = sorted(cpg.nodes)
+    vuln_by_line = {}
+    for nid, bit in zip(node_ids, ex["vuln"]):
+        line = cpg.nodes[nid].line_number
+        if line >= 0:
+            vuln_by_line[line] = max(vuln_by_line.get(line, 0), bit)
+    # removed line 8 plus dependent-added lines 2 and 3.
+    assert vuln_by_line[8] == 1
+    assert vuln_by_line[2] == 1 and vuln_by_line[3] == 1
+    # the non-dependent branch lines stay clean
+    assert vuln_by_line[4] == 0 and vuln_by_line[6] == 0
+
+
+def test_export_without_after_graph_degrades_to_removed_only(tmp_path):
+    _write_workdir(tmp_path, ids=(5, 7))  # id 7 is vul, no after export
+    export(str(tmp_path), FeatureSpec())
+    lines = (tmp_path / "examples.jsonl").read_text().splitlines()
+    ex7 = [json.loads(l) for l in lines if json.loads(l)["id"] == 7][0]
+
+    from deepdfa_tpu.etl.cpg import load_joern_export
+
+    cpg = load_joern_export(tmp_path / "functions" / "7.c")
+    node_ids = sorted(cpg.nodes)
+    vuln_lines = {
+        cpg.nodes[nid].line_number
+        for nid, bit in zip(node_ids, ex7["vuln"]) if bit
+    }
+    assert vuln_lines == {3}  # removed=[3] only

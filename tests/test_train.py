@@ -7,6 +7,7 @@ from deepdfa_tpu.core.config import (
     FeatureSpec,
     FlowGNNConfig,
     TrainConfig,
+    subkeys_for,
 )
 from deepdfa_tpu.data import make_splits, synthetic_bigvul
 from deepdfa_tpu.data.sampling import epoch_indices
@@ -120,3 +121,102 @@ def test_checkpoint_roundtrip(tmp_path):
     keys = set(enc["params"].keys())
     assert "pooling" not in keys and "_head" not in keys
     assert any(k.startswith("embed_") for k in keys)
+
+
+def test_labels_for_dataflow_styles():
+    """dataflow_solution_out labels every real node; _in cuts loss/metrics to
+    definition nodes (cut_nodef, reference base_module.py:148-155,175-176)."""
+    from deepdfa_tpu.graphs.batch import batch_graphs
+    from deepdfa_tpu.train.loop import _labels_for
+
+    ex = synthetic_bigvul(4, SMALL.feature, positive_fraction=0.5, seed=0)
+    batch = batch_graphs(
+        ex, 4, 256, 1024, subkeys_for(SMALL.feature), with_dataflow=True
+    )
+    out_model = FlowGNN(
+        FlowGNNConfig(feature=SMALL.feature, label_style="dataflow_solution_out")
+    )
+    labels, mask = _labels_for(out_model, batch)
+    np.testing.assert_array_equal(np.asarray(mask), np.asarray(batch.node_mask))
+    np.testing.assert_array_equal(
+        np.asarray(labels), np.asarray(batch.node_df_out).astype(np.float32)
+    )
+
+    in_model = FlowGNN(
+        FlowGNNConfig(feature=SMALL.feature, label_style="dataflow_solution_in")
+    )
+    labels, mask = _labels_for(in_model, batch)
+    first = next(iter(batch.node_feats))
+    want_mask = np.asarray(batch.node_mask) & (np.asarray(batch.node_feats[first]) != 0)
+    np.testing.assert_array_equal(np.asarray(mask), want_mask)
+
+    # Batches without the bits fail loudly.
+    plain = batch_graphs(ex, 4, 256, 1024, subkeys_for(SMALL.feature))
+    with pytest.raises(ValueError, match="with_dataflow"):
+        _labels_for(out_model, plain)
+
+
+def test_fit_learns_dataflow_solution():
+    """End-to-end 'simulate the DFA': training on dataflow_solution_out bits
+    (a real reachability fixpoint on the synthetic CFGs) drives loss down and
+    separates the classes."""
+    from deepdfa_tpu.train.loop import fit
+
+    feature = SMALL.feature
+    cfg = FlowGNNConfig(
+        feature=feature, hidden_dim=8, n_steps=4, num_output_layers=2,
+        label_style="dataflow_solution_out",
+    )
+    data = DataConfig(
+        batch_size=16, eval_batch_size=16, max_nodes_per_graph=64,
+        max_edges_per_node=4, undersample_factor=None,
+    )
+    ex = synthetic_bigvul(200, feature, positive_fraction=0.5, seed=1)
+    splits = make_splits(ex, "random", seed=0)
+    tc = TrainConfig(max_epochs=6, learning_rate=3e-3, seed=0)
+    best, hist = fit(FlowGNN(cfg), ex, splits, tc, data)
+    losses = [e["train_loss"] for e in hist["epochs"]]
+    assert losses[-1] < losses[0] * 0.6, losses
+
+    eval_step = jax.jit(make_eval_step(FlowGNN(cfg), tc))
+    test = evaluate(
+        eval_step, best, ex, splits["test"], data, subkeys_for(feature),
+        with_dataflow=True,
+    )
+    assert test.metrics["f1"] > 0.9, test.metrics
+
+
+def test_fit_resume_matches_uninterrupted(tmp_path):
+    """Interrupted fit resumed from the 'last' checkpoint equals one
+    uninterrupted fit on the same seed (resume_from_checkpoint,
+    reference config_default.yaml:39)."""
+    from flax.traverse_util import flatten_dict
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.loop import fit
+
+    ex = synthetic_bigvul(120, SMALL.feature, positive_fraction=0.5, seed=1)
+    splits = make_splits(ex, "random", seed=0)
+
+    def run(ckpt_dir, epochs, resume=False):
+        cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0,
+                          checkpoint_dir=str(ckpt_dir))
+        return fit(FlowGNN(SMALL), ex, splits, cfg, DATA, resume=resume)
+
+    full_state, full_hist = run(tmp_path / "full", 4)
+
+    part_state, part_hist = run(tmp_path / "part", 2)
+    res_state, res_hist = run(tmp_path / "part", 4, resume=True)
+
+    # Resumed run covers exactly epochs 2..3 and its records match the
+    # uninterrupted run's tail.
+    assert [e["epoch"] for e in res_hist["epochs"]] == [2, 3]
+    for got, want in zip(res_hist["epochs"], full_hist["epochs"][2:]):
+        np.testing.assert_allclose(got["train_loss"], want["train_loss"],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got["val_loss"], want["val_loss"], rtol=1e-5)
+
+    flat_full = flatten_dict(jax.device_get(full_state.params))
+    flat_res = flatten_dict(jax.device_get(res_state.params))
+    for k in flat_full:
+        np.testing.assert_allclose(flat_res[k], flat_full[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=str(k))
