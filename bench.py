@@ -35,6 +35,26 @@ import jax
 import numpy as np
 
 
+def _timed(call, warmup: int, calls: int, trials: int = 3) -> float:
+    """Best-of-trials wall seconds for ``calls`` dispatches of ``call``.
+
+    ``call()`` dispatches one (chained) step and returns an output array;
+    the clock stops at a jax.device_get of the final output — the one
+    barrier the tunneled backend honors (module docstring).
+    """
+    for _ in range(warmup):
+        out = call()
+    jax.device_get(out)
+    dt = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            out = call()
+        jax.device_get(out)
+        dt = min(dt, time.perf_counter() - t0)
+    return dt
+
+
 def bench_deepdfa() -> float:
     from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, TrainConfig
     from deepdfa_tpu.models.flowgnn import FlowGNN
@@ -63,18 +83,13 @@ def bench_deepdfa() -> float:
     # through the tunnel per call.
     step = jax.jit(multi, donate_argnums=(0,))
 
-    for _ in range(2):  # compile + warmup (reference skips 3 warmup batches)
+    def call():
+        nonlocal state
         state, loss, _ = step(state, batch)
-    jax.device_get(loss)
+        return loss
 
     calls = 100  # 1000 steps
-    dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(calls):
-            state, loss, _ = step(state, batch)
-        jax.device_get(loss)  # the real barrier
-        dt = min(dt, time.perf_counter() - t0)
+    dt = _timed(call, warmup=3, calls=calls)
     return calls * K * data_cfg.batch_size / dt
 
 
@@ -138,20 +153,15 @@ def bench_combined_train(batch_size: int = 16) -> float:
         jnp.asarray(batch.example_mask),
         batch.graphs,
     )
-    for _ in range(3):
+    def call():
+        nonlocal state
         state, loss, _ = step(state, *args)
-    jax.device_get(loss)
+        return loss
 
     # ~81 ms device time per step dwarfs the ~4 ms dispatch; no unroll
     # needed. Donated-state chaining serializes the steps.
     n_steps = 60
-    dt = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            state, loss, _ = step(state, *args)
-        jax.device_get(loss)
-        dt = min(dt, time.perf_counter() - t0)
+    dt = _timed(call, warmup=3, calls=n_steps, trials=2)
     return n_steps * batch_size / dt
 
 
@@ -178,17 +188,14 @@ def bench_combined_infer(batch_size: int = 16) -> float:
 
     ids = jnp.asarray(batch.input_ids)
     prev = jnp.zeros((), jnp.float32)
-    for _ in range(3):
-        out, prev = infer(params, ids, batch.graphs, prev)
-    jax.device_get(out)
 
-    n_steps, dt = 30, float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            out, prev = infer(params, ids, batch.graphs, prev)
-        jax.device_get(out)
-        dt = min(dt, time.perf_counter() - t0)
+    def call():
+        nonlocal prev
+        out, prev = infer(params, ids, batch.graphs, prev)
+        return out
+
+    n_steps = 30
+    dt = _timed(call, warmup=3, calls=n_steps)
     return dt / (n_steps * batch_size) * 1000.0  # ms/example
 
 

@@ -208,11 +208,19 @@ def export(
                             "export: dep-added labels for %d failed: %s", gid, exc
                         )
                 line_labels = statement_labels(cpg, m.get("removed", []), dep_added)
+            try:
+                dataflow = _dataflow_bits(stems[gid], cpg)
+            except Exception as exc:
+                # Same per-item posture as every other export step: a
+                # malformed .dataflow.json or solver failure must not abort
+                # a multi-hour export — degrade to all-zero solution bits.
+                logger.warning("export: dataflow bits for %d failed: %s", gid, exc)
+                dataflow = ({}, {})
             ex = cpg_to_example(
                 cpg, vocabs, features_by_graph[gid], gid, gtype=gtype,
                 line_labels=line_labels,
                 label=int(m.get("vul", 0)) if m else None,
-                dataflow=_dataflow_bits(stems[gid], cpg),
+                dataflow=dataflow,
             )
             f.write(json.dumps({
                 "id": ex["id"],

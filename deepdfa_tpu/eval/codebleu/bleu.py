@@ -17,7 +17,23 @@ import math
 from collections import Counter
 from typing import Dict, List, Sequence, Tuple
 
-_EPS = 1e-12
+# Chen & Cherry method1 epsilon — the reference's vendored nltk smooths
+# zero-count precisions with SmoothingFunction().method1 by default
+# (CodeT5/evaluator/CodeBLEU/bleu.py:190-199,475-484) and returns 0 outright
+# when there are no unigram matches (:186-188).
+_METHOD1_EPS = 0.1
+
+
+def _nltk_geomean(num, den, max_n: int) -> float:
+    """exp(mean log p_n) with the reference's exact zero handling."""
+    if num[0] == 0:
+        return 0.0
+    log_p = sum(
+        (1.0 / max_n)
+        * math.log((n if n != 0 else _METHOD1_EPS) / d)
+        for n, d in zip(num, den)
+    )
+    return math.exp(log_p)
 
 
 def ngrams(tokens: Sequence[str], n: int):
@@ -60,15 +76,7 @@ def corpus_bleu(
             den[n - 1] += max(1, sum(counts.values()))
     if hyp_len == 0:
         return 0.0
-    if any(n == 0 for n in num):
-        # The reference's vendored nltk corpus_bleu is unsmoothed
-        # (CodeT5/evaluator/CodeBLEU/bleu.py, Fraction without smoothing):
-        # any zero n-gram overlap zeroes the whole geometric mean. Match it
-        # exactly — a tiny-positive floor here would deviate in the
-        # CodeBLEU composite.
-        return 0.0
-    log_p = sum((1.0 / max_n) * math.log(num[i] / den[i]) for i in range(max_n))
-    return _brevity_penalty(ref_len, hyp_len) * math.exp(log_p)
+    return _brevity_penalty(ref_len, hyp_len) * _nltk_geomean(num, den, max_n)
 
 
 def corpus_weighted_recall(
@@ -84,7 +92,12 @@ def corpus_weighted_recall(
     ref_len = hyp_len = 0
     for refs, hyp in zip(list_of_references, hypotheses):
         hyp_len += len(hyp)
-        ref_len += _closest_ref_length([r for r, _ in refs], len(hyp))
+        # Replicated reference quirk: its closest_ref_length receives the
+        # (tokens, weights) PAIRS, so every "reference length" is
+        # len(pair) == 2 (weighted_ngram_match.py:270-286) and the brevity
+        # penalty is effectively 1. Kept bug-for-bug — the CodeBLEU
+        # composite must reproduce the reference's numbers.
+        ref_len += 2
         for n in range(1, max_n + 1):
             counts = Counter(ngrams(hyp, n))
             for ref, weights in refs:
@@ -103,8 +116,4 @@ def corpus_weighted_recall(
                     den[n - 1] += max(1, sum(ref_counts.values()))
     if hyp_len == 0:
         return 0.0
-    log_p = sum(
-        (1.0 / max_n) * math.log(max(num[i], _EPS) / max(den[i], 1.0))
-        for i in range(max_n)
-    )
-    return _brevity_penalty(ref_len, hyp_len) * math.exp(log_p)
+    return _brevity_penalty(ref_len, hyp_len) * _nltk_geomean(num, den, max_n)

@@ -15,7 +15,7 @@ abstract assert boolean break byte case catch char class const continue
 default do double else enum extends final finally float for goto if
 implements import instanceof int interface long native new package private
 protected public return short static strictfp super switch synchronized this
-throw throws transient try void volatile while true false null
+throw throws transient try void volatile while
 """
 
 _C_SHARP = """
@@ -26,6 +26,9 @@ lock long namespace new null object operator out override params private
 protected public readonly ref return sbyte sealed short sizeof stackalloc
 static string struct switch this throw true try typeof uint ulong unchecked
 unsafe ushort using virtual void volatile while
+add alias ascending async await by descending dynamic equals from get global
+group into join let nameof notnull on orderby partial remove select set
+unmanaged value var when where yield
 """
 
 _PYTHON = """

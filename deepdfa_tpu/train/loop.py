@@ -306,6 +306,14 @@ def fit(
     host = (jax.process_index(), jax.process_count()) if jax.process_count() > 1 else None
     if host is not None and mesh is None:
         raise ValueError("multi-process fit needs an explicit global mesh")
+    if host is not None and use_tile:
+        # Per-host tile stacks pad to each host's own pow2 bucket, so hosts
+        # can hand assemble_global_batch conflicting local shapes; until the
+        # nz budget is coordinated across hosts this path is unsupported.
+        raise NotImplementedError(
+            "message_impl='tile' is not supported in multi-controller runs "
+            "yet; use message_impl='segment'"
+        )
     if mesh is not None and model.mesh is not mesh:
         # The sharded tile kernel runs under shard_map and needs the mesh.
         model = model.clone(mesh=mesh)

@@ -25,14 +25,71 @@ def test_corpus_bleu_partial_ordering():
     assert 0 < far < close < 1
 
 
-def test_corpus_bleu_unsmoothed_zero_overlap():
-    """Reference parity: the vendored nltk corpus_bleu is unsmoothed, so a
-    corpus with zero n-gram overlap at any order scores exactly 0.0 (no
-    tiny-positive floor)."""
+def test_corpus_bleu_reference_semantics_zero_unigrams():
+    """Reference parity (vendored nltk + SmoothingFunction().method1): zero
+    unigram overlap returns exactly 0; zero counts at higher orders are
+    smoothed with epsilon=0.1, not zeroed and not floored at 1e-12
+    (CodeT5/evaluator/CodeBLEU/bleu.py:186-199,475-484)."""
     ref = [["the cat sat on the mat".split()]]
     assert corpus_bleu(ref, ["a dog stood under a rug".split()]) == 0.0
-    # zero 4-gram overlap alone also zeroes the unsmoothed geometric mean
-    assert corpus_bleu(ref, ["mat the on cat sat the".split()]) == 0.0
+    # unigrams overlap but no 4-grams: smoothed, small but well above 1e-12
+    shuffled = corpus_bleu(ref, ["mat the on cat sat the".split()])
+    assert 0.01 < shuffled < 0.5
+
+
+# Golden values computed by RUNNING the reference implementation
+# (CodeT5/evaluator/CodeBLEU/{bleu,weighted_ngram_match}.py) on this corpus
+# with java keyword weights (1.0 keyword / 0.2 other, calc_code_bleu.py
+# make_weights). Our reimplementation must match to 1e-12.
+GOLDEN_REFS = [
+    "public int add ( int a , int b ) { return a + b ; }",
+    "if ( x > 0 ) { y = x * 2 ; } else { y = 0 ; }",
+    "for ( int i = 0 ; i < n ; i ++ ) { sum += arr [ i ] ; }",
+    "return value == null ? defaultValue : value ;",
+]
+GOLDEN_HYPS = [
+    "public int add ( int a , int b ) { return b + a ; }",
+    "if ( x > 0 ) { y = 2 * x ; } else { y = 1 ; }",
+    "for ( int j = 0 ; j < n ; j ++ ) { sum += arr [ j ] ; }",
+    "return value ;",
+]
+GOLDEN_NGRAM = 0.5603990901097523
+GOLDEN_WEIGHTED = 0.569400742580772
+GOLDEN_SINGLES_NGRAM = [
+    0.7529586373193689, 0.6627953568839928, 0.4607295657761677,
+    0.04279677428117006,
+]
+GOLDEN_SINGLES_WEIGHTED = [
+    0.7529586373193689, 0.6650691307797905, 0.46686375513999506,
+    0.0752421768074461,
+]
+
+
+def _java_weighted_refs(refs):
+    from deepdfa_tpu.eval.codebleu.keywords import KEYWORDS
+
+    kw = KEYWORDS["java"]
+    return [
+        [(r.split(), {t: 1.0 if t in kw else 0.2 for t in r.split()})]
+        for r in refs
+    ]
+
+
+def test_corpus_bleu_matches_reference_golden():
+    got = corpus_bleu([[r.split()] for r in GOLDEN_REFS],
+                      [h.split() for h in GOLDEN_HYPS])
+    assert abs(got - GOLDEN_NGRAM) < 1e-12
+    for r, h, want in zip(GOLDEN_REFS, GOLDEN_HYPS, GOLDEN_SINGLES_NGRAM):
+        assert abs(corpus_bleu([[r.split()]], [h.split()]) - want) < 1e-12
+
+
+def test_weighted_recall_matches_reference_golden():
+    got = corpus_weighted_recall(_java_weighted_refs(GOLDEN_REFS),
+                                 [h.split() for h in GOLDEN_HYPS])
+    assert abs(got - GOLDEN_WEIGHTED) < 1e-12
+    for r, h, want in zip(GOLDEN_REFS, GOLDEN_HYPS, GOLDEN_SINGLES_WEIGHTED):
+        got1 = corpus_weighted_recall(_java_weighted_refs([r]), [h.split()])
+        assert abs(got1 - want) < 1e-12
 
 
 def test_weighted_recall_boosts_keywords():

@@ -76,9 +76,48 @@ def test_integrated_gradients_completeness_direction():
     scores = integrated_gradients_token_scores(
         Linear(), None, ids, embed_fn, steps=50
     )
-    expected = np.abs(np.asarray((embed_fn(ids) * w).sum(-1)))
+    expected = np.asarray((embed_fn(ids) * w).sum(-1))
     expected = expected / np.linalg.norm(expected, axis=-1, keepdims=True)
     np.testing.assert_allclose(scores, expected, atol=1e-4)
+
+
+def test_deeplift_family_exact_on_linear_model():
+    """On a linear model every gradient×Δinput method equals IG exactly:
+    signed w·e_t, L2-normalized (summarize_attributions keeps sign,
+    linevul_main.py:945-948)."""
+    from deepdfa_tpu.eval.localization import (
+        deeplift_shap_token_scores,
+        deeplift_token_scores,
+        gradient_shap_token_scores,
+    )
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(8))
+
+    class Linear:
+        def apply(self, params, input_ids, input_embeds=None):
+            out = (input_embeds * w).sum(axis=(1, 2))
+            return jnp.stack([jnp.zeros_like(out), out], axis=1)
+
+    ids = jnp.asarray(rng.randint(0, 16, size=(1, 5)))
+    table = jnp.asarray(rng.randn(16, 8))
+    embed_fn = lambda i: table[i]
+    expected = np.asarray((embed_fn(ids) * w).sum(-1))
+    expected = expected / np.linalg.norm(expected, axis=-1, keepdims=True)
+
+    dl = deeplift_token_scores(Linear(), None, ids, embed_fn)
+    np.testing.assert_allclose(dl, expected, atol=1e-5)
+
+    # 16 zero baselines, the reference's own configuration
+    zeros = jnp.zeros((16, 5, 8))
+    dls = deeplift_shap_token_scores(Linear(), None, ids, embed_fn, baselines=zeros)
+    np.testing.assert_allclose(dls, expected, atol=1e-5)
+
+    gs = gradient_shap_token_scores(Linear(), None, ids, embed_fn, n_samples=4)
+    np.testing.assert_allclose(gs, expected, atol=1e-5)
+
+    # scores are signed: a negative-contribution token stays negative
+    assert (dl < 0).any() or (dl > 0).all()
 
 
 def test_line_scores_grouping_and_flaw_marking():

@@ -1,0 +1,181 @@
+"""Real-shape parity rehearsal on a miniature reference-format cache.
+
+Builds CSVs in the exact dbize.py schema (DDFA/sastvd/scripts/dbize.py:75-76
+nodes/edges; dbize_absdf.py:21-45 nodes_feat_*), including the extra Joern
+attribute columns the reference writes, and drives the full consumer chain:
+``legacy_cache -> batch -> fit -> evaluate -> test_report``. The assertions
+pin the metric semantics that decide F1 parity on Big-Vul (BASELINE.md):
+graph label = max vuln over REAL nodes only, padding never inflates metric
+counts, and the reported F1 equals a hand/sklearn recomputation over exactly
+the test examples.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepdfa_tpu.core.config import DataConfig, FeatureSpec, FlowGNNConfig, TrainConfig, subkeys_for
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.etl.legacy_cache import load_reference_cache
+from deepdfa_tpu.graphs.batch import batch_graphs, graph_label_from_nodes
+
+FEATURE = FeatureSpec(limit_all=30, limit_subkeys=30)
+
+# Joern node kinds for realistic _label/name/code columns.
+_KINDS = [
+    ("CALL", "<operator>.assignment", "x = a"),
+    ("CALL", "strlen", "strlen(s)"),
+    ("IDENTIFIER", "x", "x"),
+    ("LITERAL", "0", "0"),
+    ("RETURN", "return", "return x"),
+]
+
+
+def write_reference_cache(examples, root, feature):
+    """Serialize example dicts into the dbize.py CSV schema.
+
+    Node rows carry the reference's full column set (dgl_id, _label, name,
+    code, lineNumber, node_id, vuln, graph_id); graph_ids and node_ids are
+    non-contiguous like real Big-Vul exports. Returns {graph_id: example}.
+    """
+    pd = pytest.importorskip("pandas")
+    by_gid = {}
+    node_rows, edge_rows = [], []
+    feat_rows = {k: [] for k in subkeys_for(feature)}
+    for ex in examples:
+        gid = 1000 + 7 * int(ex["id"])  # non-contiguous graph ids
+        by_gid[gid] = ex
+        n = int(ex["num_nodes"])
+        node_ids = 100000 + 13 * np.arange(n) + gid  # joern-scale ids
+        for d in range(n):
+            kind = _KINDS[d % len(_KINDS)]
+            node_rows.append({
+                "dgl_id": d,
+                "_label": kind[0],
+                "name": kind[1],
+                "code": kind[2],
+                "lineNumber": d + 1,
+                "node_id": int(node_ids[d]),
+                "vuln": int(ex["vuln"][d]),
+                "graph_id": gid,
+            })
+            for subkey in feat_rows:
+                feat_rows[subkey].append({
+                    "graph_id": gid,
+                    "node_id": int(node_ids[d]),
+                    f"_ABS_DATAFLOW_{subkey}_all_limitall_"
+                    f"{feature.limit_all}_limitsubkeys_"
+                    f"{feature.limit_subkeys}": int(ex["feats"][subkey][d]),
+                })
+        for s, r in zip(ex["senders"], ex["receivers"]):
+            edge_rows.append({
+                "graph_id": gid, "innode": int(s), "outnode": int(r),
+                "etype": "CFG",
+            })
+    pd.DataFrame(node_rows).to_csv(root / "nodes.csv")
+    pd.DataFrame(edge_rows).to_csv(root / "edges.csv")
+    for subkey, rows in feat_rows.items():
+        name = (
+            f"_ABS_DATAFLOW_{subkey}_all_limitall_{feature.limit_all}"
+            f"_limitsubkeys_{feature.limit_subkeys}"
+        )
+        pd.DataFrame(rows).to_csv(root / f"nodes_feat_{name}_fixed.csv")
+    return by_gid
+
+
+def test_reference_cache_roundtrip_exact(tmp_path):
+    """Loader output equals the source examples field-for-field."""
+    examples = synthetic_bigvul(12, FEATURE, positive_fraction=0.5, seed=3)
+    by_gid = write_reference_cache(examples, tmp_path, FEATURE)
+    loaded = load_reference_cache(str(tmp_path), FEATURE)
+    assert {e["id"] for e in loaded} == set(by_gid)
+    for got in loaded:
+        src = by_gid[got["id"]]
+        assert got["num_nodes"] == src["num_nodes"]
+        np.testing.assert_array_equal(got["senders"], src["senders"])
+        np.testing.assert_array_equal(got["receivers"], src["receivers"])
+        np.testing.assert_array_equal(got["vuln"], src["vuln"])
+        for k in subkeys_for(FEATURE):
+            np.testing.assert_array_equal(got["feats"][k], src["feats"][k])
+        # graph label = max vuln over real nodes (base_module.py:87-88)
+        assert got["label"] == int(np.asarray(src["vuln"]).max(initial=0))
+
+
+def test_graph_label_masks_out_padding():
+    """A padded batch reproduces per-graph max-over-REAL-nodes labels; empty
+    slots are excluded by graph_mask, not counted as negatives."""
+    examples = synthetic_bigvul(3, FEATURE, positive_fraction=0.5, seed=5)
+    batch = batch_graphs(examples, 8, 256, 1024, subkeys_for(FEATURE))
+    labels = np.asarray(graph_label_from_nodes(batch))
+    mask = np.asarray(batch.graph_mask)
+    want = [int(np.asarray(e["vuln"]).max(initial=0)) for e in examples]
+    np.testing.assert_array_equal(labels[:3], want)
+    assert mask.sum() == 3 and not mask[3:].any()
+
+
+@pytest.mark.slow
+def test_cache_to_report_metric_semantics(tmp_path):
+    """fit + evaluate + test_report over the miniature cache: probabilities
+    cover exactly the real test examples, labels match the source graph
+    labels, and every reported metric equals a hand recomputation."""
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.eval.report import test_report
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import evaluate, fit, make_eval_step
+
+    examples = synthetic_bigvul(320, FEATURE, positive_fraction=0.5, seed=7)
+    by_gid = write_reference_cache(examples, tmp_path, FEATURE)
+    loaded = load_reference_cache(str(tmp_path), FEATURE)
+    loaded.sort(key=lambda e: e["id"])
+    splits = make_splits(loaded, "random", seed=0)
+
+    cfg = FlowGNNConfig(feature=FEATURE, hidden_dim=8, n_steps=4,
+                        num_output_layers=2)
+    data = DataConfig(batch_size=16, eval_batch_size=16,
+                      max_nodes_per_graph=64, max_edges_per_node=4,
+                      undersample_factor=1.0)
+    tc = TrainConfig(max_epochs=16, learning_rate=2e-3, seed=0)
+    best, hist = fit(FlowGNN(cfg), loaded, splits, tc, data)
+
+    eval_step = jax.jit(make_eval_step(FlowGNN(cfg), tc))
+    res = evaluate(eval_step, best, loaded, splits["test"], data,
+                   subkeys_for(FEATURE))
+
+    # 1. Exactly one probability per real test example — padding slots from
+    # the 16-wide eval batches never leak into the metric stream.
+    test_ids = [loaded[i]["id"] for i in splits["test"]]
+    assert len(res.probs) == len(test_ids)
+    assert sorted(res.graph_ids.tolist()) == sorted(test_ids)
+
+    # 2. Labels carried through evaluation equal the source graph labels.
+    want_label = {g: int(np.asarray(by_gid[g]["vuln"]).max(initial=0))
+                  for g in test_ids}
+    for g, lab in zip(res.graph_ids.tolist(), res.labels.tolist()):
+        assert int(lab) == want_label[g], g
+
+    # 3. Reported metrics equal a hand recomputation at threshold 0.5.
+    pred = (res.probs >= 0.5).astype(int)
+    lab = res.labels.astype(int)
+    tp = int(((pred == 1) & (lab == 1)).sum())
+    fp = int(((pred == 1) & (lab == 0)).sum())
+    fn = int(((pred == 0) & (lab == 1)).sum())
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+    np.testing.assert_allclose(res.metrics["precision"], precision, atol=1e-6)
+    np.testing.assert_allclose(res.metrics["recall"], recall, atol=1e-6)
+    np.testing.assert_allclose(res.metrics["f1"], f1, atol=1e-6)
+    assert res.metrics["f1"] > 0.85  # the planted signal is learnable
+
+    # 4. test_report agrees and its support counts the real examples.
+    report = test_report(res.probs, res.labels, out_dir=str(tmp_path / "rep"))
+    assert report["confusion"]["tp"] == tp
+    assert report["confusion"]["fp"] == fp
+    assert report["confusion"]["fn"] == fn
+    cr = report["classification_report"]
+    supports = {k: v["support"] for k, v in cr.items() if isinstance(v, dict)
+                and "support" in v}
+    assert sum(supports.get(k, 0) for k in ("0", "0.0", "negative")) + \
+        sum(supports.get(k, 0) for k in ("1", "1.0", "positive")) == len(test_ids)
+    assert (tmp_path / "rep" / "pr.csv").exists()

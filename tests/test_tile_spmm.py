@@ -178,3 +178,20 @@ def test_fit_tile_on_mesh_matches_segment():
         _, hist = fit(FlowGNN(cfg), ex, splits, tc, data, mesh=mesh)
         losses[impl] = [e["train_loss"] for e in hist["epochs"]]
     np.testing.assert_allclose(losses["tile"], losses["segment"], rtol=2e-3, atol=2e-4)
+
+
+def test_tiles_stay_bf16_resident_when_exact():
+    """Adjacency values are small integer multiplicities — stored bf16
+    (exact up to 256, half the HBM traffic); huge multiplicities fall back
+    to f32."""
+    rng = np.random.default_rng(0)
+    s, r, mask, max_nodes = _random_graph_batch(rng, 40, 120, 8)
+    adj = build_tile_adjacency(s, r, mask, max_nodes, tile=8)
+    assert adj.vals.dtype == jnp.bfloat16
+    assert adj.t_vals.dtype == jnp.bfloat16
+
+    # 300 parallel copies of one edge exceed bf16's exact-integer range.
+    s2 = np.zeros(300, np.int64)
+    r2 = np.ones(300, np.int64)
+    adj2 = build_tile_adjacency(s2, r2, np.ones(300, bool), 8, tile=8)
+    assert adj2.vals.dtype == jnp.float32
