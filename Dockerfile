@@ -1,0 +1,27 @@
+# CPU/TPU-host container for deepdfa_tpu (the reference ships a CUDA
+# container; TPU runtimes mount the accelerator via the host's libtpu, so
+# the image itself is hardware-agnostic python).
+#
+# Build:  docker build -t deepdfa-tpu .
+# Run:    docker run --rm -it --privileged deepdfa-tpu  (privileged for TPU)
+FROM python:3.12-slim
+
+RUN apt-get update -y && apt-get install -y --no-install-recommends \
+        curl git build-essential cmake ninja-build \
+        openjdk-17-jdk-headless unzip \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /deepdfa_tpu
+COPY . .
+
+# jax[tpu] pulls libtpu on TPU VMs; plain jax runs the CPU tests.
+RUN pip install --no-cache-dir \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax orbax-checkpoint chex einops numpy pandas pyyaml pytest
+
+# Joern for the ETL graphs stage (optional at runtime; the export stage
+# degrades to the native reaching-def solver without it).
+RUN bash scripts/install_joern.sh && ln -s /deepdfa_tpu/joern/joern/joern /usr/local/bin/joern
+
+ENV PYTHONPATH=/deepdfa_tpu
+CMD ["python", "-m", "pytest", "tests/", "-q"]

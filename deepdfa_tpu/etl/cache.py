@@ -1,0 +1,214 @@
+"""Dataset caching and per-id validity checks.
+
+Parity with the reference's two caching layers:
+
+- **Minimal parquet cache** (DDFA/sastvd/helpers/datasets.py:219-268): the
+  expensive Big-Vul prepare (comment stripping, per-row git diff, quality
+  filters) persists its minimal-column result so later runs load in seconds.
+  Here :func:`minimal_cache` wraps any row loader with a parquet file keyed
+  by source path/mtime/size + sample cap (gzip parquet like the reference;
+  gzip JSONL fallback when no parquet engine is available).
+
+- **Per-id validity cache** (datasets.py:295-330 ``check_validity`` +
+  ``:386-399`` cached filter): whether a function's Joern exports parse,
+  carry line numbers, and contain dataflow edges — checked once per id and
+  remembered in a CSV so re-runs of the export stage skip known-bad graphs
+  without re-parsing them.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Minimal row cache
+# ---------------------------------------------------------------------------
+
+
+def _source_key(src: Path) -> str:
+    st = src.stat()
+    return f"{st.st_mtime_ns}:{st.st_size}"
+
+
+def minimal_cache(
+    src_path: str | Path,
+    loader: Callable[[], List[Dict]],
+    cache_dir: Optional[str | Path] = None,
+    tag: str = "minimal",
+    sample: Optional[int] = None,
+) -> List[Dict]:
+    """Load rows through a persistent cache.
+
+    ``loader`` runs only when no fresh cache exists; the cache is invalid
+    whenever the source file's mtime/size changed (the reference caches by
+    bare filename and can serve stale data — keying on mtime+size here).
+    """
+    src = Path(src_path)
+    root = Path(cache_dir) if cache_dir else src.parent / ".deepdfa_cache"
+    root.mkdir(parents=True, exist_ok=True)
+    sample_text = f"_sample{sample}" if sample is not None else ""
+    base = root / f"{src.stem}_{tag}{sample_text}"
+    meta_path = base.with_suffix(".key")
+    key = _source_key(src)
+
+    if meta_path.exists() and meta_path.read_text() == key:
+        rows = _read_cache(base)
+        if rows is not None:
+            logger.info("cache hit: %s (%d rows)", base, len(rows))
+            return rows
+
+    rows = loader()
+    _write_cache(base, rows)
+    meta_path.write_text(key)
+    return rows
+
+
+def _write_cache(base: Path, rows: List[Dict]) -> None:
+    # Whichever format we write, drop the other: a stale sibling from an
+    # earlier run must not be served under the refreshed key (_read_cache
+    # prefers parquet).
+    try:
+        import pandas as pd
+
+        pd.DataFrame(_encode(rows)).to_parquet(
+            base.with_suffix(".parquet"), index=False, compression="gzip"
+        )
+        base.with_suffix(".jsonl.gz").unlink(missing_ok=True)
+    except Exception as exc:  # no parquet engine -> gzip jsonl
+        logger.info("parquet cache unavailable (%s); using jsonl.gz", exc)
+        import gzip
+
+        with gzip.open(base.with_suffix(".jsonl.gz"), "wt") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        base.with_suffix(".parquet").unlink(missing_ok=True)
+
+
+def _read_cache(base: Path) -> Optional[List[Dict]]:
+    pq = base.with_suffix(".parquet")
+    jl = base.with_suffix(".jsonl.gz")
+    try:
+        if pq.exists():
+            import pandas as pd
+
+            return _decode(pd.read_parquet(pq).to_dict("records"))
+        if jl.exists():
+            import gzip
+
+            with gzip.open(jl, "rt") as f:
+                return [json.loads(line) for line in f]
+    except Exception as exc:
+        logger.warning("cache read failed (%s); rebuilding", exc)
+    return None
+
+
+# List-valued fields (added/removed line numbers) ride JSON-encoded inside
+# the parquet columns — the reference uses fastparquet object_encoding=json
+# for the same reason (datasets.py:263-266).
+_LIST_FIELDS = ("added", "removed")
+
+
+def _encode(rows: List[Dict]) -> List[Dict]:
+    out = []
+    for row in rows:
+        row = dict(row)
+        for k in _LIST_FIELDS:
+            if k in row:
+                row[k] = json.dumps(list(row[k]))
+        out.append(row)
+    return out
+
+
+def _decode(rows: List[Dict]) -> List[Dict]:
+    for row in rows:
+        for k in _LIST_FIELDS:
+            if k in row and isinstance(row[k], str):
+                row[k] = json.loads(row[k])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Per-id validity
+# ---------------------------------------------------------------------------
+
+
+def check_validity(
+    stem: str | Path,
+    require_line_number: bool = False,
+    require_dataflow: bool = False,
+) -> bool:
+    """check_validity parity (datasets.py:295-330): exports parse, at least
+    one node carries a lineNumber (warn / fail per flag), and the edge set
+    contains dataflow (REACHING_DEF or CDG) edges (warn / fail per flag)."""
+    stem = Path(stem)
+    try:
+        with open(stem.with_suffix(".c.nodes.json")) as f:
+            nodes = json.load(f)
+        if not any("lineNumber" in n for n in nodes):
+            logger.warning("valid (%s): no line number", stem)
+            if require_line_number:
+                return False
+        with open(stem.with_suffix(".c.edges.json")) as f:
+            edges = json.load(f)
+        etypes = {e[2] for e in edges if len(e) > 2}
+        if "REACHING_DEF" not in etypes and "CDG" not in etypes:
+            logger.warning("valid (%s): no dataflow", stem)
+            if require_dataflow:
+                return False
+    except Exception as exc:
+        logger.warning("valid (%s): %s", stem, exc)
+        return False
+    return True
+
+
+class ValidityCache:
+    """CSV-backed per-id validity memo (the reference caches the check
+    results per dataset and filters with them, datasets.py:386-399).
+
+    Each verdict is keyed on the export's mtime/size: regenerating a
+    once-corrupt export invalidates the memo instead of excluding the graph
+    forever (the reference's bare-id cache has exactly that staleness bug).
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._known: Dict[int, tuple] = {}  # gid -> (export_key, valid)
+        if self.path.exists():
+            with open(self.path, newline="") as f:
+                for rec in csv.DictReader(f):
+                    self._known[int(rec["id"])] = (
+                        rec.get("key", ""), rec["valid"] == "1"
+                    )
+
+    @staticmethod
+    def _export_key(stem: Path) -> str:
+        nodes = stem.with_suffix(".c.nodes.json")
+        try:
+            return _source_key(nodes)
+        except OSError:
+            return "missing"
+
+    def is_valid(self, gid: int, stem: str | Path, **flags) -> bool:
+        key = self._export_key(Path(stem))
+        cached = self._known.get(gid)
+        if cached is None or cached[0] != key:
+            valid = check_validity(stem, **flags)
+            self._known[gid] = (key, valid)
+            self._append(gid, key, valid)
+        return self._known[gid][1]
+
+    def _append(self, gid: int, key: str, valid: bool) -> None:
+        new = not self.path.exists()
+        with open(self.path, "a", newline="") as f:
+            w = csv.writer(f)
+            if new:
+                w.writerow(["id", "key", "valid"])
+            w.writerow([gid, key, int(valid)])

@@ -82,12 +82,28 @@ def load_bigvul(
     csv_path: str | Path,
     sample: Optional[int] = None,
     id_column: str = "",
+    cache: bool = True,
+    cache_dir: Optional[str | Path] = None,
 ) -> List[Dict]:
     """Load the MSR_data_cleaned.csv Big-Vul dump into minimal rows.
 
     ``sample``: cap row count (the reference's 100+100 subset is built
     separately, sample_MSR_data.py; here a simple head-count cap).
+    ``cache``: persist the minimal rows next to the source (parquet minimal
+    cache, reference datasets.py:219-268) so re-runs skip the comment
+    stripping + per-row diffing.
     """
+    if cache:
+        from deepdfa_tpu.etl.cache import minimal_cache
+
+        return minimal_cache(
+            csv_path,
+            lambda: load_bigvul(csv_path, sample, id_column, cache=False),
+            cache_dir=cache_dir,
+            # id_column changes the rows' ids; it must key the cache entry.
+            tag=f"bigvul_{id_column}" if id_column else "bigvul",
+            sample=sample,
+        )
     csv.field_size_limit(sys.maxsize)
     out: List[Dict] = []
     with open(csv_path, newline="") as f:
@@ -113,10 +129,23 @@ def load_bigvul(
 
 
 def load_devign(
-    json_path: str | Path, sample: Optional[int] = None
+    json_path: str | Path,
+    sample: Optional[int] = None,
+    cache: bool = True,
+    cache_dir: Optional[str | Path] = None,
 ) -> List[Dict]:
     """Devign function.json: [{project, commit_id, target, func}, ...]
     (datasets.py:36-102; no before/after pair, so no diff labels)."""
+    if cache:
+        from deepdfa_tpu.etl.cache import minimal_cache
+
+        return minimal_cache(
+            json_path,
+            lambda: load_devign(json_path, sample, cache=False),
+            cache_dir=cache_dir,
+            tag="devign",
+            sample=sample,
+        )
     with open(json_path) as f:
         records = json.load(f)
     out: List[Dict] = []

@@ -154,12 +154,20 @@ def export(
 
     # Pass 1: decl features only — CPGs are re-parsed in pass 2 so graph
     # residency stays O(1) at Big-Vul scale (~188k functions).
+    from deepdfa_tpu.etl.cache import ValidityCache
+
+    validity = ValidityCache(root / "valid_cache.csv")
     features_by_graph: Dict[int, Dict] = {}
     stems: Dict[int, Path] = {}
     for stem in sorted((root / "functions").glob("*.c")):
         if not stem.with_suffix(".c.nodes.json").exists():
             continue
         gid = int(stem.stem)
+        # Per-id validity memo (datasets.py:295-330,386-399): known-bad
+        # exports skip on re-runs without re-parsing.
+        if not validity.is_valid(gid, stem):
+            fail(gid, "invalid joern export (valid_cache)")
+            continue
         try:
             features_by_graph[gid] = extract_decl_features(load_joern_export(stem))
             stems[gid] = stem

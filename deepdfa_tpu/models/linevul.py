@@ -55,7 +55,8 @@ class LineVul(nn.Module):
 
     encoder_config: EncoderConfig
     graph_config: Optional[FlowGNNConfig] = None
-    mesh: object = None  # required when encoder_config.attention_impl == "ring"
+    mesh: object = None  # needed for attention_impl == "ring" and for
+    # sharded tile graph batches (stacked adjacency under shard_map)
 
     @nn.compact
     def __call__(
@@ -83,7 +84,7 @@ class LineVul(nn.Module):
             assert graphs is not None, "combined model needs a GraphBatch"
             enc_cfg = self.graph_config
             assert enc_cfg.encoder_mode, "graph_config must set encoder_mode"
-            graph_embed = FlowGNN(enc_cfg, name="flowgnn")(graphs)
+            graph_embed = FlowGNN(enc_cfg, mesh=self.mesh, name="flowgnn")(graphs)
 
         logits = ClassificationHead(
             self.encoder_config.hidden_size,

@@ -237,7 +237,9 @@ def evaluate(
     addressable from one host); the scalar metrics remain exact."""
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
-    total_loss, n_batches = 0.0, 0
+    # Loss accumulates on device and transfers once at the end — a
+    # float(loss) per batch would serialize host and device.
+    loss_sum, n_batches = jnp.zeros(()), 0
     stats = BinaryStats.zeros()
     probs_all, labels_all, ids_all = [], [], []
     for batch in _batches(
@@ -249,7 +251,7 @@ def evaluate(
         loss, probs, labels, mask = eval_step(state, batch)
         if host is not None:
             stats = stats + binary_stats(probs, labels, mask)
-            total_loss += float(loss)
+            loss_sum = loss_sum + loss
             n_batches += 1
             continue
         m = np.asarray(mask)
@@ -263,14 +265,14 @@ def evaluate(
         else:
             ids_all.append(gids[np.asarray(batch.node_graph)][m])
         stats = stats + binary_stats(probs, labels, mask)
-        total_loss += float(loss)
+        loss_sum = loss_sum + loss
         n_batches += 1
     probs_np = np.concatenate(probs_all) if probs_all else np.zeros(0)
     labels_np = np.concatenate(labels_all) if labels_all else np.zeros(0)
     ids_np = np.concatenate(ids_all) if ids_all else np.zeros(0, np.int64)
     metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
     return EvalResult(
-        loss=total_loss / max(n_batches, 1),
+        loss=float(loss_sum) / max(n_batches, 1),
         metrics=metrics,
         probs=probs_np,
         labels=labels_np,
@@ -394,6 +396,17 @@ def fit(
             tb_writer.close()
 
 
+def _check_anomaly(train_cfg, bad_step, epoch: int) -> None:
+    """Lightning detect_anomaly parity: fail at (the first) step that
+    produced a non-finite loss, identified by the device-accumulated index."""
+    if train_cfg.detect_anomaly:
+        first = int(bad_step)
+        if first >= 0:
+            raise FloatingPointError(
+                f"non-finite loss at epoch {epoch} step {first}"
+            )
+
+
 def _fit_epochs(
     model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
     use_tile, use_df, state, train_step, eval_step, labels, history, best_state,
@@ -418,6 +431,12 @@ def _fit_epochs(
         # Loss accumulates on-device; transferring once per epoch (and per
         # log line) keeps host dispatch running ahead of device execution.
         loss_sum = jnp.zeros(())
+        # detect_anomaly without a per-step host sync: the first offending
+        # step index accumulates ON DEVICE (eager jnp ops dispatch async)
+        # and is read back once per epoch/log window — a float(loss) here
+        # would serialize host and device every step, the pattern that
+        # kills 10-hour transformer runs.
+        bad_step = jnp.asarray(-1, jnp.int32)
         n_batches = 0
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
                               data_cfg.batch_size, n_shards, use_tile, use_df,
@@ -425,18 +444,17 @@ def _fit_epochs(
             if host is not None:
                 batch = assemble_global_batch(batch, mesh)
             state, loss, bstats = train_step(state, batch)
-            if train_cfg.detect_anomaly and not np.isfinite(float(loss)):
-                # Lightning detect_anomaly parity: fail at the step that
-                # produced the non-finite loss, with its location.
-                raise FloatingPointError(
-                    f"non-finite loss {float(loss)} at epoch {epoch} "
-                    f"step {n_batches}"
+            if train_cfg.detect_anomaly:
+                bad_step = jnp.where(
+                    (bad_step < 0) & ~jnp.isfinite(loss), n_batches, bad_step
                 )
             loss_sum = loss_sum + loss
             stats = stats + bstats
             n_batches += 1
             if n_batches % log_every == 0:
+                _check_anomaly(train_cfg, bad_step, epoch)
                 logger.info("epoch %d step %d loss %.4f", epoch, n_batches, float(loss))
+        _check_anomaly(train_cfg, bad_step, epoch)
         epoch_loss = float(loss_sum)
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 

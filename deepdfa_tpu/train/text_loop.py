@@ -81,6 +81,7 @@ def text_graph_batches(
     shuffle_rng: Optional[np.random.Generator] = None,
     pad_id: int = 1,
     build_tile_adj: bool = False,
+    n_shards: int = 1,
 ) -> Iterable[TextBatch]:
     """Fixed-size text batches, each pre-joined with its graphs.
 
@@ -89,7 +90,17 @@ def text_graph_batches(
     Rows with no parsed graph stay in the batch but are masked out
     (``keep_idx`` semantics). The final short batch is padded with masked
     rows to keep shapes static.
+
+    ``n_shards > 1``: the graph batch is assembled from per-device
+    sub-batches via ``shard_concat`` so text row i's graph lives on the same
+    data-axis shard as row i — graphs shard with the text instead of riding
+    replicated, and the GNN stays collective-free (the mesh alignment
+    contract in parallel/mesh.py). Each shard has its own node/edge budget
+    (global budget / n_shards); a graph that overflows its shard masks its
+    row like a missing graph.
     """
+    if batch_size % n_shards:
+        raise ValueError(f"batch_size {batch_size} % n_shards {n_shards} != 0")
     order = np.array(indices)
     if shuffle_rng is not None:
         order = shuffle_rng.permutation(order)
@@ -107,32 +118,45 @@ def text_graph_batches(
             budget = graph_budget or {}
             max_nodes = budget.get("max_nodes", batch_size * 64)
             max_edges = budget.get("max_edges", batch_size * 64 * 4)
-            slot_graphs = []
-            nodes_used = edges_used = 0
+            rows_per_shard = batch_size // n_shards
+            shard_nodes = max_nodes // n_shards
+            shard_edges = max_edges // n_shards
+            shard_slots = [[] for _ in range(n_shards)]
+            used = [[0, 0] for _ in range(n_shards)]
             for row, ex_id in enumerate(index):
                 g = graphs_by_id.get(int(ex_id))
                 if g is None:
                     mask[row] = False  # keep_idx semantics: no graph, no loss
                     continue
+                d = row // rows_per_shard
                 n = int(g["num_nodes"])
                 e = len(g["senders"]) + n  # + self loops
-                if nodes_used + n > max_nodes or edges_used + e > max_edges:
+                if used[d][0] + n > shard_nodes or used[d][1] + e > shard_edges:
                     # Shuffling regroups batches each epoch, so a budget that
                     # held before can overflow now; degrade like a missing
                     # graph instead of aborting training.
                     logger.warning(
                         "graph for example %d dropped: batch over budget "
-                        "(%d+%d/%d nodes)", int(ex_id), nodes_used, n, max_nodes
+                        "(%d+%d/%d nodes)", int(ex_id), used[d][0], n, shard_nodes
                     )
                     mask[row] = False
                     continue
-                nodes_used += n
-                edges_used += e
-                slot_graphs.append((row, g))
-            gbatch = _slotted_graph_batch(
-                slot_graphs, batch_size, max_nodes, max_edges, subkeys,
-                build_tile_adj,
-            )
+                used[d][0] += n
+                used[d][1] += e
+                shard_slots[d].append((row - d * rows_per_shard, g))
+            subs = [
+                _slotted_graph_batch(
+                    shard_slots[d], rows_per_shard, shard_nodes, shard_edges,
+                    subkeys, build_tile_adj,
+                )
+                for d in range(n_shards)
+            ]
+            if n_shards == 1:
+                gbatch = subs[0]
+            else:
+                from deepdfa_tpu.parallel.mesh import shard_concat
+
+                gbatch = shard_concat(subs)
         yield TextBatch(ids, labels, mask, index, gbatch)
 
 
@@ -270,7 +294,7 @@ def _run_step(step_fn, state, batch: TextBatch):
 def evaluate_text(
     eval_step, state, data, indices, cfg: TransformerTrainConfig,
     graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
-    build_tile_adj: bool = False,
+    build_tile_adj: bool = False, n_shards: int = 1,
 ):
     stats = BinaryStats.zeros()
     total_loss, n = 0.0, 0
@@ -278,7 +302,7 @@ def evaluate_text(
     num_missing = 0
     for batch in text_graph_batches(
         data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget,
-        pad_id=pad_id, build_tile_adj=build_tile_adj,
+        pad_id=pad_id, build_tile_adj=build_tile_adj, n_shards=n_shards,
     ):
         loss, probs = _run_step(eval_step, state, batch)
         m = batch.example_mask
@@ -326,16 +350,18 @@ def fit_text(
         model.graph_config is not None
         and model.graph_config.message_impl == "tile"
     )
-    if build_tile_adj and mesh is not None:
-        raise ValueError(
-            "message_impl='tile' is single-shard only; use "
-            "message_impl='segment' on a sharded mesh"
-        )
+    from deepdfa_tpu.parallel.mesh import DATA_AXIS
+
+    n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    if mesh is not None and model.mesh is not mesh:
+        # Sharded graph batches run the tile kernel under shard_map and the
+        # ring-attention path also needs the mesh on the model.
+        model = model.clone(mesh=mesh)
     example = next(
         text_graph_batches(
             data, splits["train"][: cfg.batch_size], cfg.batch_size,
             graphs_by_id, subkeys, graph_budget, pad_id=pad_id,
-            build_tile_adj=build_tile_adj,
+            build_tile_adj=build_tile_adj, n_shards=n_shards,
         )
     )
     state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
@@ -366,7 +392,7 @@ def fit_text(
         for batch in text_graph_batches(
             data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
             graph_budget, shuffle_rng=rng, pad_id=pad_id,
-            build_tile_adj=build_tile_adj,
+            build_tile_adj=build_tile_adj, n_shards=n_shards,
         ):
             num_missing += int((batch.index >= 0).sum() - batch.example_mask.sum())
             state, loss, bstats = _run_step(train_step, state, batch)
@@ -377,6 +403,7 @@ def fit_text(
         val = evaluate_text(
             eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
             graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
+            n_shards=n_shards,
         )
         record = {
             "epoch": epoch,

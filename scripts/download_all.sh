@@ -1,0 +1,25 @@
+#!/bin/bash
+# Fetch the paper's public data archives (reference scripts/download_all.sh;
+# same Figshare objects). Run from the repo root. The ETL consumes either
+#   - the raw CSV via `python -m deepdfa_tpu.etl.pipeline prepare --dataset bigvul`
+#   - or the preprocessed reference cache directly via
+#     deepdfa_tpu.etl.legacy_cache.load_reference_cache (no Joern needed).
+set -e
+mkdir -p data
+
+# Raw Big-Vul dataset (MSR_data_cleaned.csv)
+curl -Lo data/MSR_data_cleaned.zip 'https://figshare.com/ndownloader/files/43990908'
+unzip -o data/MSR_data_cleaned.zip -d data/
+
+# LineVul split of Big-Vul (text training CSVs + linevul_splits.csv)
+curl -Lo data/MSR_LineVul.zip 'https://figshare.com/ndownloader/files/43991823'
+unzip -o data/MSR_LineVul.zip -d data/MSR
+
+# Reference-preprocessed graph cache (nodes/edges/nodes_feat CSVs — the
+# format legacy_cache reads)
+curl -Lo data/preprocessed_data.zip 'https://figshare.com/ndownloader/files/43991910'
+unzip -o data/preprocessed_data.zip -d data/
+
+# Joern CFG exports for the before-functions
+curl -Lo data/before.zip 'https://figshare.com/ndownloader/files/43916550'
+unzip -o data/before.zip -d data/processed/bigvul

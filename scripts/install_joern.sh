@@ -1,0 +1,12 @@
+#!/bin/bash
+# Install Joern v1.1.107 (the version the paper's artifact pins,
+# reference scripts/install_joern.sh) into ./joern/. The ETL graphs stage
+# (deepdfa_tpu/etl/joern_session.py) looks for `joern` on PATH; add
+# $PWD/joern/joern to PATH or symlink it after install.
+# Requires: JDK 11+, curl. Joern is CPU/JVM-side only — no TPU involvement.
+set -e
+mkdir -p joern
+cd joern
+curl -L "https://github.com/joernio/joern/releases/latest/download/joern-install.sh" -o joern-install.sh
+chmod u+x joern-install.sh
+printf "y\n$PWD/joern\nn\nv1.1.107\n" | ./joern-install.sh --interactive --without-plugins

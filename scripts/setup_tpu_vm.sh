@@ -1,0 +1,24 @@
+#!/bin/bash
+# Provision a Cloud TPU VM for deepdfa_tpu (replaces the reference's
+# SLURM/Singularity story, scripts/sbatch.sh + Dockerfile — TPU fleets are
+# provisioned per-VM, not via a cluster scheduler).
+#
+# Usage: bash scripts/setup_tpu_vm.sh [v5litepod-8]
+# Prereqs: gcloud configured with a project/zone that has TPU quota.
+set -e
+ACCEL="${1:-v5litepod-8}"
+NAME="${TPU_NAME:-deepdfa-tpu}"
+ZONE="${TPU_ZONE:-us-central1-a}"
+
+gcloud compute tpus tpu-vm create "$NAME" \
+  --zone "$ZONE" --accelerator-type "$ACCEL" \
+  --version "${TPU_RUNTIME:-tpu-ubuntu2204-base}"
+
+gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --command '
+  sudo apt-get update -y && sudo apt-get install -y git openjdk-17-jdk-headless
+  pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+  pip install flax optax orbax-checkpoint chex einops pandas pyyaml pytest
+'
+echo "TPU VM $NAME ready. Copy the repo and run: python -m pytest tests/ -q"
+echo "Multi-host slices: run the same command on every worker; deepdfa_tpu"
+echo "training loops detect jax.process_count()>1 and shard input per host."

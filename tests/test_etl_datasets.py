@@ -60,3 +60,79 @@ def test_load_devign(tmp_path):
     assert len(out) == 2
     assert out[0]["vul"] == 1 and "//" not in out[0]["before"]
     assert out[1]["project"] == "ffmpeg"
+
+
+def test_minimal_cache_roundtrip_and_invalidation(tmp_path):
+    """Parquet minimal cache (reference datasets.py:219-268): second load
+    serves the cache without the loader; source modification invalidates."""
+    from deepdfa_tpu.etl.cache import minimal_cache
+
+    src = tmp_path / "data.csv"
+    src.write_text("x\n1\n")
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return [{"id": 1, "before": "int f;", "added": [1, 2], "removed": []}]
+
+    rows1 = minimal_cache(src, loader, tag="t")
+    rows2 = minimal_cache(src, loader, tag="t")
+    assert len(calls) == 1  # second load came from the cache
+    assert rows1 == rows2
+    assert rows2[0]["added"] == [1, 2]  # list fields survive the roundtrip
+
+    import os, time
+    time.sleep(0.01)
+    src.write_text("x\n2\n")  # mtime/size change invalidates
+    minimal_cache(src, loader, tag="t")
+    assert len(calls) == 2
+
+
+def test_load_bigvul_uses_cache(tmp_path):
+    import csv as _csv
+    from deepdfa_tpu.etl.datasets import load_bigvul
+
+    p = tmp_path / "msr.csv"
+    with open(p, "w", newline="") as f:
+        w = _csv.DictWriter(f, fieldnames=["vul", "project", "func_before", "func_after"])
+        w.writeheader()
+        w.writerow({"vul": 0, "project": "p", "func_before": "int f() { return 0; }",
+                    "func_after": ""})
+    rows1 = load_bigvul(p, cache_dir=tmp_path / "c")
+    assert (tmp_path / "c").exists() and any((tmp_path / "c").iterdir())
+    rows2 = load_bigvul(p, cache_dir=tmp_path / "c")
+    assert [r["id"] for r in rows1] == [r["id"] for r in rows2]
+    assert rows1[0]["before"] == rows2[0]["before"]
+
+
+def test_validity_cache(tmp_path):
+    """check_validity parity: unparseable exports are invalid; missing
+    dataflow edges warn (or fail with the flag); results memoize to CSV."""
+    import json as _json
+    from joern_fixture import EDGES, NODES
+    from deepdfa_tpu.etl.cache import ValidityCache, check_validity
+
+    good = tmp_path / "1.c"
+    good.with_suffix(".c.nodes.json").write_text(_json.dumps(NODES))
+    good.with_suffix(".c.edges.json").write_text(_json.dumps(EDGES))
+    assert check_validity(good)
+
+    bad = tmp_path / "2.c"
+    bad.with_suffix(".c.nodes.json").write_text("{not json")
+    assert not check_validity(bad)
+
+    nodf = tmp_path / "3.c"
+    nodf.with_suffix(".c.nodes.json").write_text(_json.dumps(NODES))
+    nodf.with_suffix(".c.edges.json").write_text(
+        _json.dumps([[10, 1, "CFG", ""]])
+    )
+    assert check_validity(nodf)  # warn only by default
+    assert not check_validity(nodf, require_dataflow=True)
+
+    vc = ValidityCache(tmp_path / "valid.csv")
+    assert vc.is_valid(1, good) and not vc.is_valid(2, bad)
+    # a fresh instance reads the memo instead of re-checking
+    bad.with_suffix(".c.nodes.json").unlink()
+    vc2 = ValidityCache(tmp_path / "valid.csv")
+    assert not vc2.is_valid(2, bad)
+    assert vc2.is_valid(1, good)

@@ -1,0 +1,58 @@
+"""Experiment launcher (deepdfa_tpu/exp.py) — the run_exp.py model-zoo
+sweep surface (reference CodeT5/sh/run_exp.py:1-167)."""
+
+import json
+
+import pytest
+
+from deepdfa_tpu.exp import ExpConfig, get_sub_tasks, resolve, run_experiment
+
+
+def test_resolve_matches_reference_table():
+    """Spot checks against get_args_by_task_model (run_exp.py:19-97)."""
+    c = resolve("defect", "none", "codet5_base")
+    assert (c.source_length, c.target_length, c.epochs, c.patience) == (512, 3, 10, 2)
+    assert c.batch_size == 32 and c.learning_rate == pytest.approx(2e-5)
+
+    c = resolve("summarize", "ruby", "codet5_small")
+    assert c.batch_size == 64 and c.learning_rate == pytest.approx(5e-5)
+
+    c = resolve("refine", "small", "codet5_small")
+    assert (c.source_length, c.target_length, c.batch_size) == (130, 120, 64)
+    c = resolve("refine", "medium", "codet5_base")
+    assert (c.source_length, c.target_length) == (240, 240)
+
+    c = resolve("clone", "none", "codebert")
+    assert c.batch_size == 16
+    c = resolve("clone", "none", "codet5_base")
+    assert c.batch_size == 10
+    c = resolve("concode", "none", "codet5_large")
+    assert c.batch_size == 8 and c.learning_rate == pytest.approx(1e-4)
+
+
+def test_sub_tasks():
+    assert "ruby" in get_sub_tasks("summarize")
+    assert get_sub_tasks("defect") == ["none"]
+    assert get_sub_tasks("translate") == ["java-cs", "cs-java"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("task,tag", [
+    ("defect", "codet5_base"),
+    ("defect", "codebert"),
+    ("clone", "codet5_base"),
+    ("summarize", "codet5_small"),
+    ("multi_task", "codet5_small"),
+])
+def test_run_experiment_smoke(tmp_path, task, tag):
+    sub = get_sub_tasks(task)[0]
+    cfg = resolve(task, sub, tag)
+    result = run_experiment(
+        cfg, data="synthetic", res_dir=str(tmp_path / "res"),
+        model_dir=str(tmp_path / "models"),
+        summary_dir=str(tmp_path / "tb"), tiny=True,
+        overrides={"max_epochs": 1, "batch_size": 8, "eval_batch_size": 8},
+    )
+    assert result["config"]["task"] == task
+    res_fn = tmp_path / "res" / f"{task}_{sub}_{tag}" / "result.json"
+    assert json.loads(res_fn.read_text())["config"]["model_tag"] == tag

@@ -155,3 +155,54 @@ def test_fit_combined_learns():
     test = evaluate_text(eval_step, best, data, splits["test"], cfg, graphs, sk, budget)
     assert test["metrics"]["f1"] > 0.7, (test["metrics"], history["epochs"][-1])
     assert test["num_missing"] == 0
+
+
+def test_combined_sharded_graphs_match_single_device():
+    """Graphs shard with the text rows on the dp mesh (per-device sub-batches
+    via shard_concat); losses must match the unsharded run for both message
+    impls (the combined path's sharded-graph input pipeline)."""
+    import jax
+
+    from deepdfa_tpu.core.config import (
+        FeatureSpec,
+        FlowGNNConfig,
+        TransformerTrainConfig,
+        subkeys_for,
+    )
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.train.text_loop import fit_text
+
+    feat = FeatureSpec(limit_all=20)
+    mesh = make_mesh(n_data=jax.device_count())
+
+    def run(mesh_arg, impl):
+        gcfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
+                             encoder_mode=True, message_impl=impl)
+        enc = EncoderConfig.tiny()
+        model = LineVul(enc, graph_config=gcfg)
+        graphs = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
+        rng = np.random.RandomState(0)
+        data = {
+            "input_ids": rng.randint(2, enc.vocab_size, size=(32, 16)).astype(np.int32),
+            "labels": rng.randint(0, 2, size=32).astype(np.int32),
+            "index": np.arange(32),
+        }
+        splits = {"train": np.arange(24), "val": np.arange(24, 32)}
+        _, hist = fit_text(
+            model, data, splits,
+            TransformerTrainConfig(max_epochs=1, batch_size=8, eval_batch_size=8),
+            graphs_by_id={i: g for i, g in enumerate(graphs)},
+            subkeys=subkeys_for(feat),
+            graph_budget={"max_nodes": 1024, "max_edges": 4096},
+            mesh=mesh_arg,
+        )
+        return [e["train_loss"] for e in hist["epochs"]]
+
+    for impl in ("segment", "tile"):
+        np.testing.assert_allclose(
+            run(None, impl), run(mesh, impl), rtol=5e-3, atol=5e-4,
+            err_msg=impl,
+        )
