@@ -12,8 +12,8 @@ RUN apt-get update -y && apt-get install -y --no-install-recommends \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /deepdfa_tpu
-COPY . .
 
+# Dependencies before COPY so source edits don't bust this layer.
 # jax[tpu] pulls libtpu on TPU VMs; plain jax runs the CPU tests.
 RUN pip install --no-cache-dir \
         "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
@@ -21,7 +21,10 @@ RUN pip install --no-cache-dir \
 
 # Joern for the ETL graphs stage (optional at runtime; the export stage
 # degrades to the native reaching-def solver without it).
+COPY scripts/install_joern.sh scripts/install_joern.sh
 RUN bash scripts/install_joern.sh && ln -s /deepdfa_tpu/joern/joern/joern /usr/local/bin/joern
+
+COPY . .
 
 ENV PYTHONPATH=/deepdfa_tpu
 CMD ["python", "-m", "pytest", "tests/", "-q"]

@@ -55,8 +55,11 @@ def minimal_cache(
     root = Path(cache_dir) if cache_dir else src.parent / ".deepdfa_cache"
     root.mkdir(parents=True, exist_ok=True)
     sample_text = f"_sample{sample}" if sample is not None else ""
+    # Suffixes append by string concat: with_suffix() would truncate dotted
+    # stems ("data.v2_bigvul_sample100" -> "data.key") and collapse distinct
+    # cache entries into one file.
     base = root / f"{src.stem}_{tag}{sample_text}"
-    meta_path = base.with_suffix(".key")
+    meta_path = _sib(base, ".key")
     key = _source_key(src)
 
     if meta_path.exists() and meta_path.read_text() == key:
@@ -71,6 +74,10 @@ def minimal_cache(
     return rows
 
 
+def _sib(base: Path, suffix: str) -> Path:
+    return base.parent / (base.name + suffix)
+
+
 def _write_cache(base: Path, rows: List[Dict]) -> None:
     # Whichever format we write, drop the other: a stale sibling from an
     # earlier run must not be served under the refreshed key (_read_cache
@@ -79,22 +86,22 @@ def _write_cache(base: Path, rows: List[Dict]) -> None:
         import pandas as pd
 
         pd.DataFrame(_encode(rows)).to_parquet(
-            base.with_suffix(".parquet"), index=False, compression="gzip"
+            _sib(base, ".parquet"), index=False, compression="gzip"
         )
-        base.with_suffix(".jsonl.gz").unlink(missing_ok=True)
+        _sib(base, ".jsonl.gz").unlink(missing_ok=True)
     except Exception as exc:  # no parquet engine -> gzip jsonl
         logger.info("parquet cache unavailable (%s); using jsonl.gz", exc)
         import gzip
 
-        with gzip.open(base.with_suffix(".jsonl.gz"), "wt") as f:
+        with gzip.open(_sib(base, ".jsonl.gz"), "wt") as f:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
-        base.with_suffix(".parquet").unlink(missing_ok=True)
+        _sib(base, ".parquet").unlink(missing_ok=True)
 
 
 def _read_cache(base: Path) -> Optional[List[Dict]]:
-    pq = base.with_suffix(".parquet")
-    jl = base.with_suffix(".jsonl.gz")
+    pq = _sib(base, ".parquet")
+    jl = _sib(base, ".jsonl.gz")
     try:
         if pq.exists():
             import pandas as pd
@@ -190,11 +197,15 @@ class ValidityCache:
 
     @staticmethod
     def _export_key(stem: Path) -> str:
-        nodes = stem.with_suffix(".c.nodes.json")
-        try:
-            return _source_key(nodes)
-        except OSError:
-            return "missing"
+        # Key on BOTH export files — check_validity reads both, and a
+        # regenerated edges.json alone must invalidate a cached verdict.
+        parts = []
+        for suffix in (".c.nodes.json", ".c.edges.json"):
+            try:
+                parts.append(_source_key(stem.with_suffix(suffix)))
+            except OSError:
+                parts.append("missing")
+        return "|".join(parts)
 
     def is_valid(self, gid: int, stem: str | Path, **flags) -> bool:
         key = self._export_key(Path(stem))

@@ -47,7 +47,6 @@ class ExpConfig:
     target_length: int
     patience: int
     epochs: int
-    warmup_steps: int = 1000
     seed: int = 0
 
 
@@ -152,8 +151,6 @@ def run_experiment(
     cfg: ExpConfig,
     data: str = "synthetic",
     res_dir: str = "results",
-    model_dir: str = "saved_models",
-    summary_dir: str = "tensorboard",
     tiny: bool = False,
     overrides: Optional[Dict] = None,
 ) -> Dict:
@@ -166,9 +163,6 @@ def run_experiment(
 
     run_name = f"{cfg.task}_{cfg.sub_task}_{cfg.model_tag}"
     os.makedirs(os.path.join(res_dir, run_name), exist_ok=True)
-    # model_dir/summary_dir mirror the reference's layout flags; they fill
-    # when the dispatched trainer is configured to checkpoint/log there.
-    del model_dir, summary_dir
 
     tcfg = TransformerTrainConfig(
         batch_size=cfg.batch_size,
@@ -241,9 +235,9 @@ def _run_defect(cfg, tcfg, data, tiny):
     rng = np.random.RandomState(cfg.seed)
     n, seq = 64, 16
     if cfg.model_tag.startswith("codet5"):
-        from deepdfa_tpu.models.t5 import DefectModel, T5Config
+        from deepdfa_tpu.models.t5 import DefectModel
 
-        t5cfg = T5Config.tiny() if tiny else getattr(T5Config, cfg.model_tag)()
+        t5cfg = _t5_config(cfg.model_tag, tiny)
         model = DefectModel(t5cfg)
         vocab, pad_id = t5cfg.vocab_size, t5cfg.pad_token_id
         ids = rng.randint(3, vocab, size=(n, seq)).astype(np.int32)
@@ -330,8 +324,6 @@ def main(argv=None) -> int:
     parser.add_argument("--model_tag", choices=MODEL_TAGS, default="codet5_base")
     parser.add_argument("--data", default="synthetic")
     parser.add_argument("--res_dir", default="results")
-    parser.add_argument("--model_dir", default="saved_models")
-    parser.add_argument("--summary_dir", default="tensorboard")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--tiny", action="store_true",
                         help="tiny model shapes (smoke tests)")
@@ -345,8 +337,8 @@ def main(argv=None) -> int:
     cfg = resolve(args.task, args.sub_task, args.model_tag, seed=args.seed)
     overrides = {"max_epochs": args.epochs} if args.epochs else None
     result = run_experiment(
-        cfg, data=args.data, res_dir=args.res_dir, model_dir=args.model_dir,
-        summary_dir=args.summary_dir, tiny=args.tiny, overrides=overrides,
+        cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,
+        overrides=overrides,
     )
     print(json.dumps(result))
     return 0

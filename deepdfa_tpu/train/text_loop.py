@@ -353,6 +353,12 @@ def fit_text(
     from deepdfa_tpu.parallel.mesh import DATA_AXIS
 
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    if cfg.batch_size % n_shards or cfg.eval_batch_size % n_shards:
+        # Fail before training, not at the first eval after a full epoch.
+        raise ValueError(
+            f"batch_size {cfg.batch_size} and eval_batch_size "
+            f"{cfg.eval_batch_size} must divide by the data-axis size {n_shards}"
+        )
     if mesh is not None and model.mesh is not mesh:
         # Sharded graph batches run the tile kernel under shard_map and the
         # ring-attention path also needs the mesh on the model.

@@ -48,9 +48,7 @@ def test_run_experiment_smoke(tmp_path, task, tag):
     sub = get_sub_tasks(task)[0]
     cfg = resolve(task, sub, tag)
     result = run_experiment(
-        cfg, data="synthetic", res_dir=str(tmp_path / "res"),
-        model_dir=str(tmp_path / "models"),
-        summary_dir=str(tmp_path / "tb"), tiny=True,
+        cfg, data="synthetic", res_dir=str(tmp_path / "res"), tiny=True,
         overrides={"max_epochs": 1, "batch_size": 8, "eval_batch_size": 8},
     )
     assert result["config"]["task"] == task
