@@ -126,6 +126,37 @@ def shard_concat(shards: Sequence[GraphBatch], base_shard: int = 0) -> GraphBatc
     )
 
 
+def jit_dp_step(
+    step_fn,
+    mesh: Mesh,
+    n_batch_args: int,
+    n_out: int,
+    batch_sizes: Sequence[int] = (),
+    donate=(0,),
+):
+    """jit a ``(state, *batch_args) -> (state_or_scalar, ...)`` step
+    data-parallel over the mesh: batch args shard on the data axis, state
+    and outputs replicate, GSPMD inserts the gradient all-reduce. The one
+    place the dp-jit recipe lives — the text/gen/clone trainers all use it.
+
+    ``batch_sizes``: any batch sizes that must divide the data-axis extent
+    (validated up front, not at the first sharded call).
+    """
+    d = int(mesh.shape[DATA_AXIS])
+    for bs in batch_sizes:
+        if bs % d:
+            raise ValueError(
+                f"batch size {bs} must divide the data-axis size {d}"
+            )
+    rep, dsh = replicated(mesh), batch_sharding(mesh)
+    return jax.jit(
+        step_fn,
+        donate_argnums=donate,
+        in_shardings=(rep,) + (dsh,) * n_batch_args,
+        out_shardings=(rep,) * n_out,
+    )
+
+
 def host_shard_indices(
     indices,
     process_index: Optional[int] = None,

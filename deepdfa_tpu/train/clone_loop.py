@@ -91,6 +91,7 @@ def fit_clone(
     cfg: TransformerTrainConfig,
     init_params: Optional[Any] = None,
     log: Optional[Callable[[str], None]] = None,
+    mesh=None,
 ) -> Dict[str, Any]:
     """Train, tracking best eval F1 (run_clone.py keeps checkpoint-best-f1).
     Returns {"state", "best_f1", "eval_metrics"}."""
@@ -110,7 +111,16 @@ def fit_clone(
     tx = make_text_optimizer(cfg, max_steps)
     state = CloneTrainState(jnp.zeros((), jnp.int32), params, tx.init(params),
                             dropout_rng)
-    step = jax.jit(make_clone_train_step(model, tx, cfg), donate_argnums=(0,))
+    if mesh is None:
+        step = jax.jit(make_clone_train_step(model, tx, cfg), donate_argnums=(0,))
+    else:
+        # dp over the mesh's data axis (the DataParallel analog for the
+        # clone task, reference run_clone.py).
+        from deepdfa_tpu.parallel.mesh import jit_dp_step
+
+        step = jit_dp_step(make_clone_train_step(model, tx, cfg), mesh,
+                           n_batch_args=3, n_out=3,
+                           batch_sizes=(cfg.batch_size,))
     eval_fn = jax.jit(
         lambda params, s, l, m: clone_loss(model, params, s, l, m)
     )

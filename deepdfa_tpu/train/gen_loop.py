@@ -192,9 +192,14 @@ def fit_gen(
     beam_size: int = 1,
     init_params: Optional[Any] = None,
     log: Optional[Callable[[str], None]] = None,
+    mesh=None,
 ) -> Dict[str, Any]:
     """Mini run_gen: train, per-epoch eval loss, final generation metric.
-    Returns {"state", "eval_loss", "exact_match"}."""
+    Returns {"state", "eval_loss", "exact_match"}.
+
+    ``mesh``: optional data-parallel mesh — batches shard over the data
+    axis, params replicate, GSPMD all-reduces the grads (the jit analog of
+    the reference's DataParallel over the gen tasks)."""
     n = len(train_data["source_ids"])
     steps_per_epoch = -(-n // cfg.batch_size)  # ceil: small sets still train
     max_steps = steps_per_epoch * cfg.max_epochs
@@ -206,7 +211,7 @@ def fit_gen(
         max_steps,
         init_params=init_params,
     )
-    step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
+    step = _jit_gen_step(make_gen_train_step(model, tx, cfg), mesh, cfg)
     pad_id = model.cfg.pad_token_id
     rng = np.random.RandomState(cfg.seed)
     for epoch in range(cfg.max_epochs):
@@ -221,6 +226,15 @@ def fit_gen(
 
     ev = evaluate_gen(model, state, eval_data, cfg, max_target_length, beam_size)
     return {"state": state, **ev}
+
+
+def _jit_gen_step(step_fn, mesh, cfg):
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    from deepdfa_tpu.parallel.mesh import jit_dp_step
+
+    return jit_dp_step(step_fn, mesh, n_batch_args=2, n_out=2,
+                       batch_sizes=(cfg.batch_size,))
 
 
 def task_sampling_probs(sizes: Dict[str, int], alpha: float = 0.7) -> Dict[str, float]:

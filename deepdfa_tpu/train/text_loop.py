@@ -45,6 +45,9 @@ class TextBatch:
     example_mask: np.ndarray
     index: np.ndarray
     graphs: Optional[GraphBatch]
+    # Rows with an example but no usable graph, counted over the GLOBAL
+    # batch before any host slicing (keep_idx accounting, num_missing).
+    n_missing: int = 0
 
 
 def make_schedule(cfg: TransformerTrainConfig, max_steps: int) -> optax.Schedule:
@@ -82,6 +85,7 @@ def text_graph_batches(
     pad_id: int = 1,
     build_tile_adj: bool = False,
     n_shards: int = 1,
+    host: Optional[Tuple[int, int]] = None,
 ) -> Iterable[TextBatch]:
     """Fixed-size text batches, each pre-joined with its graphs.
 
@@ -98,6 +102,13 @@ def text_graph_batches(
     contract in parallel/mesh.py). Each shard has its own node/edge budget
     (global budget / n_shards); a graph that overflows its shard masks its
     row like a missing graph.
+
+    ``host=(process_index, process_count)`` (multi-controller): every host
+    runs the same deterministic packing but yields only its local slice of
+    each batch (rows AND the matching graph shards, with node references at
+    their global offsets); the caller lifts the slices to global arrays
+    with ``assemble_global_batch`` — the _batches/host contract of
+    train/loop.py, the DistributedSampler replacement.
     """
     if batch_size % n_shards:
         raise ValueError(f"batch_size {batch_size} % n_shards {n_shards} != 0")
@@ -144,20 +155,40 @@ def text_graph_batches(
                 used[d][0] += n
                 used[d][1] += e
                 shard_slots[d].append((row - d * rows_per_shard, g))
-            subs = [
-                _slotted_graph_batch(
-                    shard_slots[d], rows_per_shard, shard_nodes, shard_edges,
+            if n_shards == 1:
+                gbatch = _slotted_graph_batch(
+                    shard_slots[0], rows_per_shard, shard_nodes, shard_edges,
                     subkeys, build_tile_adj,
                 )
-                for d in range(n_shards)
-            ]
-            if n_shards == 1:
-                gbatch = subs[0]
             else:
-                from deepdfa_tpu.parallel.mesh import shard_concat
+                from deepdfa_tpu.parallel.mesh import (
+                    local_shard_slice,
+                    shard_concat,
+                )
 
-                gbatch = shard_concat(subs)
-        yield TextBatch(ids, labels, mask, index, gbatch)
+                sel_sh = (
+                    local_shard_slice(n_shards, host[0], host[1])
+                    if host is not None else slice(None, n_shards)
+                )
+                # The slot/budget bookkeeping above already fixed the
+                # packing globally; each host materializes only its own
+                # shards.
+                subs = [
+                    _slotted_graph_batch(
+                        shard_slots[d], rows_per_shard, shard_nodes,
+                        shard_edges, subkeys, build_tile_adj,
+                    )
+                    for d in range(*sel_sh.indices(n_shards))
+                ]
+                gbatch = shard_concat(subs, base_shard=sel_sh.start or 0)
+        n_missing = int((index >= 0).sum() - mask.sum())
+        if host is not None:
+            pi, pc = host
+            rows_local = batch_size // pc
+            row_sel = slice(pi * rows_local, (pi + 1) * rows_local)
+            ids, labels = ids[row_sel], labels[row_sel]
+            mask, index = mask[row_sel], index[row_sel]
+        yield TextBatch(ids, labels, mask, index, gbatch, n_missing)
 
 
 def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
@@ -291,11 +322,33 @@ def _run_step(step_fn, state, batch: TextBatch):
     )
 
 
+def _assemble_text(batch: TextBatch, mesh) -> TextBatch:
+    """Multi-controller: lift each host's local batch slice onto the global
+    mesh (jax.make_array_from_process_local_data — parallel/mesh.py)."""
+    from deepdfa_tpu.parallel.mesh import assemble_global_batch, batch_sharding
+
+    sh = batch_sharding(mesh)
+    lift = lambda x: assemble_global_batch(jnp.asarray(x), mesh, sharding=sh)
+    return TextBatch(
+        input_ids=lift(np.asarray(batch.input_ids)),
+        labels=lift(np.asarray(batch.labels)),
+        example_mask=lift(np.asarray(batch.example_mask)),
+        index=batch.index,  # host bookkeeping only
+        graphs=(
+            assemble_global_batch(batch.graphs, mesh) if batch.graphs is not None
+            else None
+        ),
+    )
+
+
 def evaluate_text(
     eval_step, state, data, indices, cfg: TransformerTrainConfig,
     graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
-    build_tile_adj: bool = False, n_shards: int = 1,
+    build_tile_adj: bool = False, n_shards: int = 1, host=None, mesh=None,
 ):
+    """``host``/``mesh``: multi-controller mode — per-example prob dumps are
+    skipped (globally-sharded outputs are not fully addressable from one
+    host); the scalar metrics remain exact."""
     stats = BinaryStats.zeros()
     total_loss, n = 0.0, 0
     probs_all, labels_all, index_all = [], [], []
@@ -303,10 +356,22 @@ def evaluate_text(
     for batch in text_graph_batches(
         data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget,
         pad_id=pad_id, build_tile_adj=build_tile_adj, n_shards=n_shards,
+        host=host,
     ):
+        num_missing += batch.n_missing
+        if host is not None:
+            batch = _assemble_text(batch, mesh)
+            loss, probs = _run_step(eval_step, state, batch)
+            stats = stats + binary_stats(
+                jnp.asarray(probs),
+                jnp.asarray(batch.labels, jnp.float32),
+                jnp.asarray(batch.example_mask),
+            )
+            total_loss += float(loss)
+            n += 1
+            continue
         loss, probs = _run_step(eval_step, state, batch)
         m = batch.example_mask
-        num_missing += int((batch.index >= 0).sum() - m.sum())
         stats = stats + binary_stats(
             jnp.asarray(probs), jnp.asarray(batch.labels, jnp.float32), jnp.asarray(m)
         )
@@ -353,6 +418,17 @@ def fit_text(
     from deepdfa_tpu.parallel.mesh import DATA_AXIS
 
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    host = (jax.process_index(), jax.process_count()) if jax.process_count() > 1 else None
+    if host is not None and mesh is None:
+        raise ValueError("multi-process fit_text needs an explicit global mesh")
+    if host is not None and build_tile_adj:
+        # Per-host tile stacks pad to each host's own pow2 nz bucket, so
+        # hosts can hand assemble_global_batch conflicting local shapes
+        # (same restriction as train/loop.py).
+        raise NotImplementedError(
+            "message_impl='tile' is not supported in multi-controller runs "
+            "yet; use message_impl='segment'"
+        )
     if cfg.batch_size % n_shards or cfg.eval_batch_size % n_shards:
         # Fail before training, not at the first eval after a full epoch.
         raise ValueError(
@@ -367,20 +443,21 @@ def fit_text(
         text_graph_batches(
             data, splits["train"][: cfg.batch_size], cfg.batch_size,
             graphs_by_id, subkeys, graph_budget, pad_id=pad_id,
-            build_tile_adj=build_tile_adj, n_shards=n_shards,
+            build_tile_adj=build_tile_adj, n_shards=n_shards, host=host,
         )
     )
+    if host is not None:
+        example = _assemble_text(example, mesh)
     state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
     train_step = make_text_train_step(model, tx, cfg)
     eval_step = make_text_eval_step(model)
     if mesh is not None:
-        rep = replicated(mesh)
-        bsh = batch_sharding(mesh)
-        shard_args = (rep, bsh, bsh, bsh, bsh)
-        train_step = jax.jit(train_step, in_shardings=shard_args,
-                             out_shardings=(rep, rep, rep))
-        eval_step = jax.jit(eval_step, in_shardings=shard_args,
-                            out_shardings=(rep, rep))
+        from deepdfa_tpu.parallel.mesh import jit_dp_step
+
+        train_step = jit_dp_step(train_step, mesh, n_batch_args=4, n_out=3,
+                                 donate=())
+        eval_step = jit_dp_step(eval_step, mesh, n_batch_args=4, n_out=2,
+                                donate=())
     else:
         train_step = jax.jit(train_step)
         eval_step = jax.jit(eval_step)
@@ -398,9 +475,11 @@ def fit_text(
         for batch in text_graph_batches(
             data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
             graph_budget, shuffle_rng=rng, pad_id=pad_id,
-            build_tile_adj=build_tile_adj, n_shards=n_shards,
+            build_tile_adj=build_tile_adj, n_shards=n_shards, host=host,
         ):
-            num_missing += int((batch.index >= 0).sum() - batch.example_mask.sum())
+            num_missing += batch.n_missing
+            if host is not None:
+                batch = _assemble_text(batch, mesh)
             state, loss, bstats = _run_step(train_step, state, batch)
             loss_sum = loss_sum + loss
             stats = stats + bstats
@@ -409,7 +488,7 @@ def fit_text(
         val = evaluate_text(
             eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
             graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
-            n_shards=n_shards,
+            n_shards=n_shards, host=host, mesh=mesh,
         )
         record = {
             "epoch": epoch,

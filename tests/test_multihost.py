@@ -137,3 +137,129 @@ def test_two_process_training_matches_single_host(tmp_path):
         np.testing.assert_allclose(results[0][key], results[1][key], rtol=1e-6)
         np.testing.assert_allclose(results[0][key], want[key], rtol=1e-4,
                                    err_msg=key)
+
+
+TEXT_WORKER = textwrap.dedent(
+    """
+    import sys, json
+    import jax
+    import numpy as np
+
+    pi, pc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=pc, process_id=pi)
+    from deepdfa_tpu.core.config import (FeatureSpec, FlowGNNConfig,
+                                         TransformerTrainConfig, subkeys_for)
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.train.text_loop import fit_text
+    from jax.flatten_util import ravel_pytree
+
+    feat = FeatureSpec(limit_all=20)
+    gcfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
+                         encoder_mode=True)
+    enc = EncoderConfig.tiny()
+    model = LineVul(enc, graph_config=gcfg)
+    graphs = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
+    rng = np.random.RandomState(0)
+    data = {
+        "input_ids": rng.randint(2, enc.vocab_size, size=(32, 16)).astype(np.int32),
+        "labels": rng.randint(0, 2, size=32).astype(np.int32),
+        "index": np.arange(32),
+    }
+    splits = {"train": np.arange(24), "val": np.arange(24, 32)}
+    mesh = make_mesh(n_data=jax.device_count())
+    best, hist = fit_text(
+        model, data, splits,
+        TransformerTrainConfig(max_epochs=1, batch_size=8, eval_batch_size=8),
+        graphs_by_id={i: g for i, g in enumerate(graphs)},
+        subkeys=subkeys_for(feat),
+        graph_budget={"max_nodes": 1024, "max_edges": 4096}, mesh=mesh,
+    )
+    flat, _ = ravel_pytree(jax.device_get(best.params))
+    print("RESULT " + json.dumps({
+        "pi": pi,
+        "train_loss": hist["epochs"][0]["train_loss"],
+        "val_f1": hist["epochs"][0]["val_metrics"]["f1"],
+        "psum": float(np.asarray(flat).sum()),
+    }))
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_combined_text_matches_single_host(tmp_path):
+    """Multi-controller fit_text (combined DeepDFA+LineVul): two real
+    processes feeding local shard slices must reproduce the single-host
+    run's loss/metrics/params on the same data."""
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from deepdfa_tpu.core.config import (FeatureSpec, FlowGNNConfig,
+                                         TransformerTrainConfig, subkeys_for)
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.train.text_loop import fit_text
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+
+    feat = FeatureSpec(limit_all=20)
+    gcfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
+                         encoder_mode=True)
+    enc = EncoderConfig.tiny()
+    graphs = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
+    rng = np.random.RandomState(0)
+    data = {
+        "input_ids": rng.randint(2, enc.vocab_size, size=(32, 16)).astype(np.int32),
+        "labels": rng.randint(0, 2, size=32).astype(np.int32),
+        "index": np.arange(32),
+    }
+    splits = {"train": np.arange(24), "val": np.arange(24, 32)}
+    best, hist = fit_text(
+        LineVul(enc, graph_config=gcfg), data, splits,
+        TransformerTrainConfig(max_epochs=1, batch_size=8, eval_batch_size=8),
+        graphs_by_id={i: g for i, g in enumerate(graphs)},
+        subkeys=subkeys_for(feat),
+        graph_budget={"max_nodes": 1024, "max_edges": 4096},
+        mesh=make_mesh(n_data=8),
+    )
+    flat, _ = ravel_pytree(jax.device_get(best.params))
+    want = {
+        "train_loss": hist["epochs"][0]["train_loss"],
+        "val_f1": hist["epochs"][0]["val_metrics"]["f1"],
+        "psum": float(np.asarray(flat).sum()),
+    }
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(TEXT_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pi), "2", port],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pi in range(2)
+    ]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    results = []
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, out[-2000:]
+        results.append(json.loads(line[0][len("RESULT "):]))
+
+    for key in ("train_loss", "val_f1", "psum"):
+        np.testing.assert_allclose(results[0][key], results[1][key], rtol=1e-6)
+        np.testing.assert_allclose(results[0][key], want[key], rtol=1e-4,
+                                   err_msg=key)
