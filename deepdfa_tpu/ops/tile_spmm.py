@@ -131,6 +131,62 @@ def _round_up_pow2(n: int) -> int:
     return p
 
 
+def tile_nz_budget(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    max_nodes: int,
+    tile: int = DEFAULT_TILE,
+) -> int:
+    """The pow2 tile budget :func:`build_tile_adjacency` picks for these
+    (real) edges — without materializing any dense tiles.
+
+    Multi-controller input pipelines use this to agree on remote shards'
+    stacked shapes from their edge lists alone: each host builds dense
+    tiles only for its own shards but must pad them to the global maximum
+    budget.
+    """
+    n_tiles = max_nodes // tile
+    s = np.asarray(senders, np.int64)
+    r = np.asarray(receivers, np.int64)
+    nz = len(np.unique((r // tile) * n_tiles + (s // tile)))
+    nz = max(nz, n_tiles)
+    return _round_up_pow2(nz + n_tiles)
+
+
+def tile_vals_dtype(senders: np.ndarray, receivers: np.ndarray) -> jnp.dtype:
+    """The dtype :func:`build_tile_adjacency` picks for these (real) edges,
+    from the edge lists alone.
+
+    Tile values are edge multiplicities; they stay bf16-resident when every
+    multiplicity is exactly representable (≤ 256 — the same rule as the
+    builder's own ``tile_dtype`` check over the dense tiles, which see those
+    multiplicities as their maxima). A and Aᵀ share multiplicities, so one
+    check covers both. Multi-controller hosts use this to agree on remote
+    shards' leaf dtypes without materializing them.
+    """
+    s = np.asarray(senders, np.int64)
+    r = np.asarray(receivers, np.int64)
+    if len(s) == 0:
+        return jnp.bfloat16
+    key = r * (int(s.max()) + 1) + s
+    _, counts = np.unique(key, return_counts=True)
+    return jnp.bfloat16 if counts.max() <= 256 else jnp.float32
+
+
+def combine_tile_stats(stats) -> "tuple[int, jnp.dtype]":
+    """Fold per-shard ``(pad_nz, vals_dtype)`` stats into the globally-agreed
+    stack budget and dtype: max budget, f32 if ANY shard needs it (upcasts
+    only — never a lossy bf16 force). The one reduction both multi-controller
+    input pipelines (train/loop.py, train/text_loop.py) apply."""
+    nz = max(n for n, _ in stats)
+    dt = (
+        jnp.float32
+        if any(d == jnp.float32 for _, d in stats)
+        else jnp.bfloat16
+    )
+    return nz, dt
+
+
 def build_tile_adjacency(
     senders: np.ndarray,
     receivers: np.ndarray,
@@ -152,29 +208,19 @@ def build_tile_adjacency(
     r = np.asarray(receivers)[np.asarray(edge_mask)].astype(np.int64)
     data = np.ones(len(s), np.float32)
 
-    # Tiles stay bf16-resident when exact: values are edge multiplicities
-    # (small integers, exactly representable in bf16 up to 256), and halving
-    # the adjacency's HBM traffic speeds the kernel ~4-5% in BOTH model
-    # dtypes (the kernel casts to the message dtype in-VMEM either way).
-    def tile_dtype(*arrs):
-        return (
-            jnp.bfloat16
-            if all(a.max(initial=0.0) <= 256.0 for a in arrs)
-            else jnp.float32
-        )
-
     # Worst-case nonzero tile count (before filler/padding) to size budgets.
     if pad_nz is None:
-        tr, tc = r // tile, s // tile
-        nz = len(np.unique(tr * n_tiles + tc))
-        nz = max(nz, n_tiles)  # filler guarantees one tile per row
-        pad_nz = _round_up_pow2(nz + n_tiles)  # headroom for filler rows
+        pad_nz = tile_nz_budget(s, r, max_nodes, tile)
 
     vals, rows, cols = _dense_tiles(r, s, data, tile, n_tiles, pad_nz)
     # Aᵀ[s, r] = A[r, s]: swapping the (row, col) roles of each edge when
     # building tiles yields the transposed adjacency directly.
     t_vals, t_rows, t_cols = _dense_tiles(s, r, data, tile, n_tiles, pad_nz)
-    dt = tile_dtype(vals, t_vals)
+    # Tiles stay bf16-resident when exact (halves the adjacency's HBM
+    # traffic, ~4-5% kernel speedup in both model dtypes); the rule lives in
+    # tile_vals_dtype so multi-controller hosts predicting remote shards'
+    # dtypes share the builder's source of truth.
+    dt = tile_vals_dtype(s, r)
 
     return TileAdjacency(
         vals=jnp.asarray(vals, dt),
@@ -185,6 +231,12 @@ def build_tile_adjacency(
         t_cols=jnp.asarray(t_cols),
         tile=tile,
         n_row_tiles=n_tiles,
+    )
+
+
+def cast_tiles(adj: TileAdjacency, dtype: jnp.dtype) -> TileAdjacency:
+    return adj.replace(
+        vals=adj.vals.astype(dtype), t_vals=adj.t_vals.astype(dtype)
     )
 
 
@@ -215,7 +267,11 @@ def pad_tiles(adj: TileAdjacency, pad_nz: int) -> TileAdjacency:
     )
 
 
-def stack_tile_adjacencies(adjs: "list[TileAdjacency]") -> TileAdjacency:
+def stack_tile_adjacencies(
+    adjs: "list[TileAdjacency]",
+    pad_nz: Optional[int] = None,
+    force_dtype: Optional[jnp.dtype] = None,
+) -> TileAdjacency:
     """Stack per-shard adjacencies along a leading device axis.
 
     The result's array leaves are ``[D, n_nz, ...]`` with every shard padded
@@ -223,13 +279,35 @@ def stack_tile_adjacencies(adjs: "list[TileAdjacency]") -> TileAdjacency:
     data axis and consume with :func:`tile_spmm_sharded`. Valid because the
     batch alignment contract (parallel/mesh.py) guarantees no edge crosses a
     shard boundary: the global adjacency is block-diagonal over shards.
+
+    ``pad_nz``: explicit common budget. Multi-controller callers pass the
+    global maximum over ALL shards of the batch (every host packs the full
+    shard-group deterministically, so the maximum is locally computable)
+    — hosts stacking only their local slice must still agree on the padded
+    shape or ``assemble_global_batch`` hands XLA conflicting leaves.
+
+    ``force_dtype``: cast vals/t_vals before stacking. Multi-controller
+    callers pass the globally-agreed dtype (f32 if ANY shard needs it,
+    per :func:`tile_vals_dtype`) — per-shard bf16/f32 choices otherwise
+    diverge across hosts the same way shapes would. Upcasts only; a bf16
+    force on an f32 shard would lose exactness and is refused.
     """
     a0 = adjs[0]
     for a in adjs:
         if a.tile != a0.tile or a.n_row_tiles != a0.n_row_tiles:
             raise ValueError("shards must share tile size and row-tile count")
-    nz = _round_up_pow2(max(int(a.vals.shape[0]) for a in adjs))
+    nz_max = max(int(a.vals.shape[0]) for a in adjs)
+    nz = _round_up_pow2(nz_max) if pad_nz is None else pad_nz
+    if nz < nz_max:
+        raise ValueError(f"pad_nz {nz} < largest shard tile count {nz_max}")
     adjs = [pad_tiles(a, nz) for a in adjs]
+    if force_dtype is not None:
+        if any(
+            a.vals.dtype == jnp.float32 and force_dtype == jnp.bfloat16
+            for a in adjs
+        ):
+            raise ValueError("refusing lossy f32 -> bf16 tile downcast")
+        adjs = [cast_tiles(a, force_dtype) for a in adjs]
 
     def stack(field):
         return jnp.stack([getattr(a, field) for a in adjs])

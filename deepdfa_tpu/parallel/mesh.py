@@ -56,7 +56,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_concat(shards: Sequence[GraphBatch], base_shard: int = 0) -> GraphBatch:
+def shard_concat(
+    shards: Sequence[GraphBatch],
+    base_shard: int = 0,
+    tile_nz: Optional[int] = None,
+    tile_dtype=None,
+) -> GraphBatch:
     """Concatenate D equal-budget per-device batches into one device-aligned
     global batch.
 
@@ -68,6 +73,11 @@ def shard_concat(shards: Sequence[GraphBatch], base_shard: int = 0) -> GraphBatc
     its local slice of a multi-controller batch must offset node/graph
     references by its global position, since the lifted array's indices are
     global (senders/receivers/node_graph address rows of the full batch).
+
+    ``tile_nz``/``tile_dtype``: common tile budget and vals dtype for the
+    stacked adjacency; multi-controller callers pass the global maximum /
+    globally-agreed dtype over all shards so every host's local stack
+    shares one leaf shape AND dtype.
     """
     d = len(shards)
     b0 = shards[0]
@@ -97,7 +107,10 @@ def shard_concat(shards: Sequence[GraphBatch], base_shard: int = 0) -> GraphBatc
     if all(b.tile_adj is not None for b in shards):
         from deepdfa_tpu.ops.tile_spmm import stack_tile_adjacencies
 
-        tile_adj = stack_tile_adjacencies([b.tile_adj for b in shards])
+        tile_adj = stack_tile_adjacencies(
+            [b.tile_adj for b in shards], pad_nz=tile_nz,
+            force_dtype=tile_dtype,
+        )
 
     return GraphBatch(
         node_feats={

@@ -188,34 +188,69 @@ def _batches(
         from deepdfa_tpu.ops.tile_spmm import align_to_tile
 
         budget_nodes = align_to_tile(budget_nodes)
+    # Multi-controller tile batches: every host packs the full shard group,
+    # but dense tiles are only materialized for the LOCAL shards — remote
+    # shards contribute just their pow2 budget and vals dtype, computed from
+    # edge lists alone, so all hosts stack to one agreed leaf shape+dtype.
+    build_dense = build_tile_adj and host is None
     # Tile counts pad to powers of two inside build_tile_adjacency, so the
     # jitted step sees a handful of distinct adjacency shapes (the same
     # bucket-ladder compromise as the node/edge budgets), not one per batch.
     sub_iter = batch_iterator(
         chosen, per_shard, budget_nodes, budget_edges, subkeys,
-        build_tile_adj=build_tile_adj, with_dataflow=with_dataflow,
+        build_tile_adj=build_dense, with_dataflow=with_dataflow,
     )
     if n_shards == 1:
         yield from sub_iter
         return
     empty = batch_graphs(
         [], per_shard, budget_nodes, budget_edges, subkeys,
-        build_tile_adj=build_tile_adj, with_dataflow=with_dataflow,
+        build_tile_adj=build_dense, with_dataflow=with_dataflow,
     )
     sel = (
         local_shard_slice(n_shards, host[0], host[1]) if host is not None
         else slice(None)
     )
     base = sel.start or 0
+
+    def emit(group: List[GraphBatch]) -> GraphBatch:
+        if not build_tile_adj or host is None:
+            return shard_concat(group[sel], base_shard=base)
+        from deepdfa_tpu.ops.tile_spmm import (
+            build_tile_adjacency,
+            combine_tile_stats,
+            tile_nz_budget,
+            tile_vals_dtype,
+        )
+
+        def stat(b: GraphBatch):
+            m = np.asarray(b.edge_mask)
+            s, r = np.asarray(b.senders)[m], np.asarray(b.receivers)[m]
+            return tile_nz_budget(s, r, b.max_nodes), tile_vals_dtype(s, r)
+
+        tile_nz, tile_dt = combine_tile_stats([stat(b) for b in group])
+        local = [
+            b.replace(
+                tile_adj=build_tile_adjacency(
+                    np.asarray(b.senders), np.asarray(b.receivers),
+                    np.asarray(b.edge_mask), b.max_nodes, pad_nz=tile_nz,
+                )
+            )
+            for b in group[sel]
+        ]
+        return shard_concat(
+            local, base_shard=base, tile_nz=tile_nz, tile_dtype=tile_dt
+        )
+
     group: List[GraphBatch] = []
     for sub in sub_iter:
         group.append(sub)
         if len(group) == n_shards:
-            yield shard_concat(group[sel], base_shard=base)
+            yield emit(group)
             group = []
     if group:
         group.extend([empty] * (n_shards - len(group)))
-        yield shard_concat(group[sel], base_shard=base)
+        yield emit(group)
 
 
 def evaluate(
@@ -308,22 +343,19 @@ def fit(
     host = (jax.process_index(), jax.process_count()) if jax.process_count() > 1 else None
     if host is not None and mesh is None:
         raise ValueError("multi-process fit needs an explicit global mesh")
-    if host is not None and use_tile:
-        # Per-host tile stacks pad to each host's own pow2 bucket, so hosts
-        # can hand assemble_global_batch conflicting local shapes; until the
-        # nz budget is coordinated across hosts this path is unsupported.
-        raise NotImplementedError(
-            "message_impl='tile' is not supported in multi-controller runs "
-            "yet; use message_impl='segment'"
-        )
     if mesh is not None and model.mesh is not mesh:
         # The sharded tile kernel runs under shard_map and needs the mesh.
         model = model.clone(mesh=mesh)
+    # Param shapes don't depend on the batch partitioning, so init on a
+    # single-shard batch with a mesh-free model: the sharded tile kernel
+    # (shard_map over a possibly multi-host mesh) must not trace over a
+    # host-local batch slice, and the smaller init compiles faster.
     example_batch = next(
         _batches(examples, splits["train"][:data_cfg.batch_size], data_cfg, subkeys,
-                 data_cfg.batch_size, n_shards, use_tile, use_df)
+                 max(data_cfg.batch_size // n_shards, 1), 1, use_tile, use_df)
     )
-    state, tx = make_train_state(model, example_batch, train_cfg)
+    init_model = model.clone(mesh=None) if model.mesh is not None else model
+    state, tx = make_train_state(init_model, example_batch, train_cfg)
     del example_batch
 
     if checkpointer is None and train_cfg.checkpoint_dir:

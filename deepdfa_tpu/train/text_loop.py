@@ -180,7 +180,22 @@ def text_graph_batches(
                     )
                     for d in range(*sel_sh.indices(n_shards))
                 ]
-                gbatch = shard_concat(subs, base_shard=sel_sh.start or 0)
+                tile_nz = tile_dt = None
+                if host is not None and build_tile_adj:
+                    # The pow2 tile budget and vals dtype depend on every
+                    # shard's edge layout; compute them from edge lists
+                    # alone (no dense tiles for remote shards) so all hosts
+                    # stack their local slices to one agreed shape+dtype.
+                    from deepdfa_tpu.ops.tile_spmm import combine_tile_stats
+
+                    tile_nz, tile_dt = combine_tile_stats([
+                        _shard_tile_stats(shard_slots[d], shard_nodes)
+                        for d in range(n_shards)
+                    ])
+                gbatch = shard_concat(
+                    subs, base_shard=sel_sh.start or 0, tile_nz=tile_nz,
+                    tile_dtype=tile_dt,
+                )
         n_missing = int((index >= 0).sum() - mask.sum())
         if host is not None:
             pi, pc = host
@@ -189,6 +204,38 @@ def text_graph_batches(
             ids, labels = ids[row_sel], labels[row_sel]
             mask, index = mask[row_sel], index[row_sel]
         yield TextBatch(ids, labels, mask, index, gbatch, n_missing)
+
+
+def _shard_tile_stats(slot_graphs, max_nodes: int):
+    """(pow2 tile budget, vals dtype) a shard's adjacency will carry, from
+    edge lists alone.
+
+    Replicates just enough of ``batch_graphs``' layout (contiguous packing
+    in slot order + per-graph self loops, graphs/batch.py:189-214) to know
+    which adjacency tiles are nonzero and whether multiplicities stay
+    bf16-exact — parity with the materialized batch is pinned by
+    ``test_shard_tile_stats_match_built_batch``.
+    """
+    from deepdfa_tpu.ops.tile_spmm import (
+        align_to_tile,
+        tile_nz_budget,
+        tile_vals_dtype,
+    )
+
+    senders, receivers, off = [], [], 0
+    for _, g in slot_graphs:
+        n = int(g["num_nodes"])
+        loops = np.arange(off, off + n, dtype=np.int64)
+        senders += [np.asarray(g["senders"], np.int64) + off, loops]
+        receivers += [np.asarray(g["receivers"], np.int64) + off, loops]
+        off += n
+    z = np.zeros(0, np.int64)
+    s = np.concatenate(senders) if senders else z
+    r = np.concatenate(receivers) if receivers else z
+    return (
+        tile_nz_budget(s, r, align_to_tile(max_nodes)),
+        tile_vals_dtype(s, r),
+    )
 
 
 def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
@@ -421,14 +468,6 @@ def fit_text(
     host = (jax.process_index(), jax.process_count()) if jax.process_count() > 1 else None
     if host is not None and mesh is None:
         raise ValueError("multi-process fit_text needs an explicit global mesh")
-    if host is not None and build_tile_adj:
-        # Per-host tile stacks pad to each host's own pow2 nz bucket, so
-        # hosts can hand assemble_global_batch conflicting local shapes
-        # (same restriction as train/loop.py).
-        raise NotImplementedError(
-            "message_impl='tile' is not supported in multi-controller runs "
-            "yet; use message_impl='segment'"
-        )
     if cfg.batch_size % n_shards or cfg.eval_batch_size % n_shards:
         # Fail before training, not at the first eval after a full epoch.
         raise ValueError(

@@ -29,6 +29,7 @@ WORKER = textwrap.dedent(
     import numpy as np
 
     pi, pc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    message_impl = sys.argv[4]
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=pc, process_id=pi)
     from deepdfa_tpu.core.config import (DataConfig, FeatureSpec,
@@ -41,7 +42,7 @@ WORKER = textwrap.dedent(
 
     feat = FeatureSpec(limit_all=20)
     cfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
-                        num_output_layers=2)
+                        num_output_layers=2, message_impl=message_impl)
     data = DataConfig(batch_size=16, eval_batch_size=16,
                       max_nodes_per_graph=64, max_edges_per_node=4,
                       undersample_factor=1.0)
@@ -71,7 +72,8 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_training_matches_single_host(tmp_path):
+@pytest.mark.parametrize("message_impl", ["segment", "tile"])
+def test_two_process_training_matches_single_host(tmp_path, message_impl):
     # Single-host reference on the devices this test process already has.
     import jax
     from jax.flatten_util import ravel_pytree
@@ -88,7 +90,7 @@ def test_two_process_training_matches_single_host(tmp_path):
 
     feat = FeatureSpec(limit_all=20)
     cfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
-                        num_output_layers=2)
+                        num_output_layers=2, message_impl=message_impl)
     data = DataConfig(batch_size=16, eval_batch_size=16,
                       max_nodes_per_graph=64, max_edges_per_node=4,
                       undersample_factor=1.0)
@@ -115,7 +117,7 @@ def test_two_process_training_matches_single_host(tmp_path):
     port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(pi), "2", port],
+            [sys.executable, str(worker), str(pi), "2", port, message_impl],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -146,6 +148,7 @@ TEXT_WORKER = textwrap.dedent(
     import numpy as np
 
     pi, pc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    message_impl = sys.argv[4]
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=pc, process_id=pi)
     from deepdfa_tpu.core.config import (FeatureSpec, FlowGNNConfig,
@@ -159,7 +162,7 @@ TEXT_WORKER = textwrap.dedent(
 
     feat = FeatureSpec(limit_all=20)
     gcfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
-                         encoder_mode=True)
+                         encoder_mode=True, message_impl=message_impl)
     enc = EncoderConfig.tiny()
     model = LineVul(enc, graph_config=gcfg)
     graphs = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
@@ -190,7 +193,8 @@ TEXT_WORKER = textwrap.dedent(
 
 
 @pytest.mark.slow
-def test_two_process_combined_text_matches_single_host(tmp_path):
+@pytest.mark.parametrize("message_impl", ["segment", "tile"])
+def test_two_process_combined_text_matches_single_host(tmp_path, message_impl):
     """Multi-controller fit_text (combined DeepDFA+LineVul): two real
     processes feeding local shard slices must reproduce the single-host
     run's loss/metrics/params on the same data."""
@@ -210,7 +214,7 @@ def test_two_process_combined_text_matches_single_host(tmp_path):
 
     feat = FeatureSpec(limit_all=20)
     gcfg = FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2,
-                         encoder_mode=True)
+                         encoder_mode=True, message_impl=message_impl)
     enc = EncoderConfig.tiny()
     graphs = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
     rng = np.random.RandomState(0)
@@ -245,7 +249,7 @@ def test_two_process_combined_text_matches_single_host(tmp_path):
     port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(pi), "2", port],
+            [sys.executable, str(worker), str(pi), "2", port, message_impl],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )

@@ -122,3 +122,28 @@ def test_text_loop_tile_batches():
         )
     )
     assert batches and batches[0].graphs.tile_adj is not None
+
+def test_shard_tile_stats_match_built_batch():
+    """The edge-list-only budget/dtype formulas must agree with the tile
+    stack the materialized shard actually carries (multi-controller hosts
+    rely on this to agree on remote shards' leaf shapes+dtypes without
+    building them)."""
+    from deepdfa_tpu.train.text_loop import (
+        _shard_tile_stats,
+        _slotted_graph_batch,
+    )
+
+    subkeys = subkeys_for(FEATURE)
+    graphs = synthetic_bigvul(6, FEATURE, positive_fraction=0.5, seed=2)
+    for slot_graphs in (
+        [],
+        [(0, graphs[0])],
+        [(i, g) for i, g in enumerate(graphs[:3])],
+        [(i, g) for i, g in enumerate(graphs)],
+    ):
+        built = _slotted_graph_batch(
+            slot_graphs, max(len(slot_graphs), 1), 256, 4096, subkeys, True
+        )
+        nz, dt = _shard_tile_stats(slot_graphs, 256)
+        assert int(built.tile_adj.vals.shape[0]) == nz, len(slot_graphs)
+        assert built.tile_adj.vals.dtype == dt, len(slot_graphs)
