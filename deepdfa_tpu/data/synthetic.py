@@ -58,11 +58,14 @@ def synthetic_bigvul(
         feats = {
             k: rng.integers(4, vocab, size=n).astype(np.int64) for k in ALL_SUBKEYS
         }
-        # ~40% of nodes are non-definitions (index 0), a few UNKNOWN (1).
+        # ~40% of nodes are non-definitions (index 0 on EVERY subkey — the
+        # zero set is a per-node property shared across subkeys, asserted
+        # at export, etl/export.py); a few definitions are UNKNOWN (1),
+        # per-subkey like real out-of-vocab hashes.
         nondef = rng.random(n) < 0.4
         for k in ALL_SUBKEYS:
             feats[k][nondef] = 0
-            feats[k][rng.random(n) < 0.05] = 1
+            feats[k][(rng.random(n) < 0.05) & ~nondef] = 1
 
         node_vuln = np.zeros(n, np.int32)
         if vul:
@@ -79,6 +82,15 @@ def synthetic_bigvul(
                 feats["api"][0] = taint
                 feats["api"][n - 1] = sink
 
+        # Planting can promote a zeroed node to a definition on "api" alone;
+        # restore the shared-zero-set invariant: a node nonzero on ANY
+        # subkey is a definition, so its other subkeys read UNKNOWN (1).
+        is_def = np.zeros(n, bool)
+        for k in ALL_SUBKEYS:
+            is_def |= feats[k] != 0
+        for k in ALL_SUBKEYS:
+            feats[k][is_def & (feats[k] == 0)] = 1
+
         s_arr = np.asarray(senders, np.int32)
         r_arr = np.asarray(receivers, np.int32)
 
@@ -87,7 +99,6 @@ def synthetic_bigvul(
         # df_out[v] = df_in[v] or v defines) — kill-free reaching
         # definitions, so the dataflow_solution_in/out label styles train
         # against a real flow property of the graph, not noise.
-        is_def = feats[ALL_SUBKEYS[0]] != 0
         df_in = np.zeros(n, bool)
         df_out = is_def.copy()
         for _ in range(n):

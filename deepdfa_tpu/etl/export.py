@@ -53,6 +53,15 @@ def cpg_to_example(
         subkey: np.asarray(idxs, np.int64)
         for subkey, idxs in node_feature_indices(cpg, features, vocabs).items()
     }
+    # Index 0 means "not a definition" — a per-NODE property, so every
+    # subkey must agree on the zero set (the cut_nodef mask and the
+    # input_dim=limit_all+2 layout both rest on this; dbize_absdf.py:35-43).
+    zero_sets = [f == 0 for f in feats.values()]
+    if not all(np.array_equal(zero_sets[0], z) for z in zero_sets[1:]):
+        # ValueError, not assert: this must fail loudly under python -O too.
+        raise ValueError(
+            f"subkeys disagree on the non-definition node set (graph {graph_id})"
+        )
     extra: Dict = {}
     if dataflow is not None:
         # Per-node reaching-definitions solution bits (label styles

@@ -122,6 +122,18 @@ def _dataflow_bits(stem: Path, cpg):
     df_path = stem.with_suffix(".c.dataflow.json")
     if df_path.exists():
         in_map, out_map = parse_dataflow_output(df_path)
+        # The training bit is "any definition reaches this node", so the
+        # values must be the exporter's list-of-definition-ids
+        # (get_dataflow_output.sc:37-55). Pin the format: a scalar or dict
+        # would binarize by truthiness and silently corrupt the labels.
+        for m in (in_map, out_map):
+            for v in m.values():
+                if not isinstance(v, list):
+                    # ValueError, not assert: must fail under python -O too.
+                    raise ValueError(
+                        f"dataflow.json value is {type(v).__name__}, "
+                        "expected the exporter's list of definition ids"
+                    )
         return (
             {n: int(bool(v)) for n, v in in_map.items()},
             {n: int(bool(v)) for n, v in out_map.items()},

@@ -170,25 +170,6 @@ def jit_dp_step(
     )
 
 
-def host_shard_indices(
-    indices,
-    process_index: Optional[int] = None,
-    process_count: Optional[int] = None,
-):
-    """Per-host strided slice of an epoch's example indices, truncated so
-    every host gets the SAME length — in multi-controller JAX all processes
-    must run the same number of jitted steps or the collectives deadlock
-    (the reason DistributedSampler pads to equal shards,
-    reference CodeT5/run_defect.py:274-277). No-op on a single host.
-    """
-    pc = jax.process_count() if process_count is None else process_count
-    if pc <= 1:
-        return indices
-    pi = jax.process_index() if process_index is None else process_index
-    per_host = len(indices) // pc  # truncate: equal step counts on all hosts
-    return indices[pi::pc][:per_host]
-
-
 def local_shard_slice(
     n_shards: int,
     process_index: Optional[int] = None,

@@ -179,3 +179,128 @@ def test_cache_to_report_metric_semantics(tmp_path):
     assert sum(supports.get(k, 0) for k in ("0", "0.0", "negative")) + \
         sum(supports.get(k, 0) for k in ("1", "1.0", "positive")) == len(test_ids)
     assert (tmp_path / "rep" / "pr.csv").exists()
+
+
+@pytest.mark.slow
+def test_combined_cache_to_report_keep_idx_semantics(tmp_path):
+    """Combined DeepDFA+LineVul rehearsal over the miniature dbize cache:
+    text rows whose graphs are missing from the cache (or overflow the
+    batch's node budget) must be masked out of loss/metrics and counted in
+    ``num_missing`` — the reference's keep_idx accounting
+    (LineVul/linevul/linevul_main.py:189-197, dataset.py:63-76) — while the
+    surviving rows' probabilities/labels flow through to the report
+    unchanged."""
+    from deepdfa_tpu.core.config import TransformerTrainConfig
+    from deepdfa_tpu.eval.report import test_report
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.train.text_loop import (
+        evaluate_text,
+        fit_text,
+        make_text_eval_step,
+    )
+
+    examples = synthetic_bigvul(64, FEATURE, positive_fraction=0.5, seed=11)
+    by_gid = write_reference_cache(examples, tmp_path, FEATURE)
+    loaded = load_reference_cache(str(tmp_path), FEATURE)
+    graphs_by_id = {e["id"]: e for e in loaded}
+    all_gids = sorted(graphs_by_id)
+
+    # Deliberately unparsed functions: present as text rows, absent from the
+    # graph cache (the reference's missing_ids.txt population).
+    missing = {all_gids[3], all_gids[17], all_gids[29], all_gids[41], all_gids[53]}
+    for gid in missing:
+        del graphs_by_id[gid]
+
+    # One cached graph too large for the eval batch's node budget — our
+    # static-shape analogue of a miss: dropped at batch time, counted in
+    # num_missing exactly like an absent graph.
+    big_gid = max(all_gids) + 1
+    n_big = 600
+    rng = np.random.default_rng(23)
+    graphs_by_id[big_gid] = {
+        "id": big_gid,
+        "num_nodes": n_big,
+        "senders": np.arange(n_big - 1),
+        "receivers": np.arange(1, n_big),
+        "vuln": np.zeros(n_big, np.int32),
+        "feats": {k: rng.integers(0, FEATURE.limit_all, n_big)
+                  for k in subkeys_for(FEATURE)},
+    }
+    row_gids = all_gids + [big_gid]  # 65 text rows, one per function
+
+    enc = EncoderConfig.tiny()
+    labels = np.array(
+        [int(np.asarray(by_gid[g]["vuln"]).max(initial=0)) if g in by_gid else 0
+         for g in row_gids], np.int32,
+    )
+    data = {
+        "input_ids": rng.integers(2, enc.vocab_size, size=(65, 16)).astype(np.int32),
+        "labels": labels,
+        "index": np.asarray(row_gids, np.int64),
+    }
+    # Manual splits so the missing/overflow rows land where the assertions
+    # expect them: big graph in test, missing ids spread across all splits.
+    splits = {
+        "train": np.arange(40),
+        "val": np.arange(40, 52),
+        "test": np.arange(52, 65),
+    }
+    gcfg = FlowGNNConfig(feature=FEATURE, hidden_dim=4, n_steps=2,
+                         encoder_mode=True)
+    model = LineVul(enc, graph_config=gcfg)
+    cfg = TransformerTrainConfig(max_epochs=2, batch_size=8, eval_batch_size=8)
+    budget = {"max_nodes": 512, "max_edges": 4096}
+    best, hist = fit_text(
+        model, data, splits, cfg, graphs_by_id=graphs_by_id,
+        subkeys=subkeys_for(FEATURE), graph_budget=budget,
+    )
+
+    # 1. Per-epoch num_missing over the train rows equals the hand count
+    # (shuffling regroups batches but cannot change which rows lack graphs;
+    # no train graph can overflow a fresh 512-node budget).
+    train_missing = sum(1 for i in splits["train"] if row_gids[i] in missing)
+    assert train_missing == 3
+    for rec in hist["epochs"]:
+        assert rec["num_missing"] == train_missing
+
+    # 2. Test-split evaluation: missing + overflowing graphs are masked and
+    # counted; probabilities cover exactly the surviving rows.
+    eval_step = jax.jit(make_text_eval_step(model))
+    res = evaluate_text(
+        eval_step, best, data, splits["test"], cfg,
+        graphs_by_id=graphs_by_id, subkeys=subkeys_for(FEATURE),
+        graph_budget=budget,
+    )
+    test_gids = [row_gids[i] for i in splits["test"]]
+    test_missing = {g for g in test_gids if g in missing}
+    assert len(test_missing) == 1
+    assert res["num_missing"] == len(test_missing) + 1  # + the overflow
+    kept = [g for g in test_gids if g not in test_missing and g != big_gid]
+    assert sorted(res["index"].tolist()) == sorted(kept)
+    assert len(res["probs"]) == len(kept)
+
+    # 3. Labels carried through evaluation equal the source graph labels.
+    want = {g: int(np.asarray(by_gid[g]["vuln"]).max(initial=0)) for g in kept}
+    for g, lab in zip(res["index"].tolist(), res["labels"].tolist()):
+        assert int(lab) == want[g], g
+
+    # 4. Reported metrics equal a hand recomputation over the kept rows only.
+    pred = (res["probs"] >= 0.5).astype(int)
+    lab = res["labels"].astype(int)
+    tp = int(((pred == 1) & (lab == 1)).sum())
+    fp = int(((pred == 1) & (lab == 0)).sum())
+    fn = int(((pred == 0) & (lab == 1)).sum())
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+    np.testing.assert_allclose(res["metrics"]["precision"], precision, atol=1e-6)
+    np.testing.assert_allclose(res["metrics"]["recall"], recall, atol=1e-6)
+    np.testing.assert_allclose(res["metrics"]["f1"], f1, atol=1e-6)
+
+    # 5. test_report consumes the kept rows 1:1.
+    report = test_report(res["probs"], res["labels"],
+                         out_dir=str(tmp_path / "rep"))
+    assert report["confusion"]["tp"] == tp
+    assert report["confusion"]["fp"] == fp
+    assert report["confusion"]["fn"] == fn

@@ -69,19 +69,3 @@ def test_tp_composes_with_dp_batch_sharding():
     logits = jax.jit(lambda p, x: model.apply(p, x))(sharded, ids_sharded)
     ref = model.apply(params, ids)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=1e-4)
-
-def test_host_shard_indices_equal_disjoint():
-    from deepdfa_tpu.parallel.mesh import host_shard_indices
-
-    idx = np.arange(103)
-    shards = [
-        host_shard_indices(idx, process_index=i, process_count=4)
-        for i in range(4)
-    ]
-    # equal length on every host (multi-controller step counts must match;
-    # the tail that doesn't divide evenly is dropped, like a non-padding
-    # DistributedSampler) and disjoint
-    assert {len(s) for s in shards} == {103 // 4}
-    joined = np.concatenate(shards)
-    assert len(np.unique(joined)) == len(joined)
-    assert host_shard_indices(idx, process_index=0, process_count=1) is idx

@@ -146,9 +146,17 @@ def test_labels_for_dataflow_styles():
         FlowGNNConfig(feature=SMALL.feature, label_style="dataflow_solution_in")
     )
     labels, mask = _labels_for(in_model, batch)
-    first = next(iter(batch.node_feats))
-    want_mask = np.asarray(batch.node_mask) & (np.asarray(batch.node_feats[first]) != 0)
+    # Definition nodes: nonzero on any subkey (equivalently on every subkey
+    # — the export asserts the zero set is shared, etl/export.py).
+    is_def = np.zeros(batch.max_nodes, bool)
+    for f in batch.node_feats.values():
+        is_def |= np.asarray(f) != 0
+    want_mask = np.asarray(batch.node_mask) & is_def
     np.testing.assert_array_equal(np.asarray(mask), want_mask)
+    first = next(iter(batch.node_feats))
+    np.testing.assert_array_equal(
+        want_mask, np.asarray(batch.node_mask) & (np.asarray(batch.node_feats[first]) != 0)
+    )
 
     # Batches without the bits fail loudly.
     plain = batch_graphs(ex, 4, 256, 1024, subkeys_for(SMALL.feature))
