@@ -310,6 +310,45 @@ def cmd_test(args) -> Dict[str, Any]:
     res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
                    build_tile_adj=use_tile, with_dataflow=use_df)
     report = {"loss": res.loss, **res.metrics}
+
+    if getattr(args, "profile", False) or getattr(args, "time", False):
+        # run_profiling.sh parity: re-run the test batches under the
+        # FLOPs/latency instruments (base_module.py:238-291) and aggregate
+        # like scripts/report_profiling.py:18-66.
+        from deepdfa_tpu.eval.profiling import ProfileRecorder, profile_eval
+        from deepdfa_tpu.eval.report import aggregate_profile, aggregate_time
+
+        out_dir = args.profile_dir or args.checkpoint_dir
+        os.makedirs(out_dir, exist_ok=True)
+        profile_path = (
+            os.path.join(out_dir, "profiledata.jsonl") if args.profile else None
+        )
+        time_path = os.path.join(out_dir, "timedata.jsonl") if args.time else None
+        for p in (profile_path, time_path):
+            if p and os.path.exists(p):
+                os.remove(p)  # fresh run, not an append to a stale one
+        batches = list(
+            _batches(examples, splits["test"], data_cfg, subkeys,
+                     data_cfg.eval_batch_size, build_tile_adj=use_tile,
+                     with_dataflow=use_df)
+        )
+        recorder = ProfileRecorder(profile_path, time_path)
+        summary = profile_eval(
+            lambda b: eval_step(state, b),
+            batches,
+            state.params,
+            lambda b: int(np.asarray(b.graph_mask).sum()),
+            recorder,
+            # warmup-3 protocol (base_module.py:240-243), but always keep at
+            # least one measured step on tiny test sets
+            n_warmup=min(3, max(len(batches) - 1, 0)),
+        )
+        report["profiling"] = summary
+        if profile_path:
+            report["profiling"].update(aggregate_profile(profile_path))
+        if time_path:
+            report["profiling"].update(aggregate_time(time_path))
+
     print(json.dumps(report))
     return report
 
@@ -419,6 +458,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     common(p_test)
     p_test.add_argument("--checkpoint-dir", required=True)
     p_test.add_argument("--which", default="best", help="best | last | epoch_N")
+    # The reference's profiling flow (scripts/run_profiling.sh ->
+    # --model.profile/--model.time, base_module.py:238-291): per-step
+    # FLOPs/latency JSONL plus an aggregated Table-5-style summary.
+    p_test.add_argument("--profile", action="store_true",
+                        help="record per-step FLOPs/MACs to profiledata.jsonl")
+    p_test.add_argument("--time", action="store_true",
+                        help="record per-step latency to timedata.jsonl")
+    p_test.add_argument("--profile-dir", default=None,
+                        help="where the JSONL records land (default: "
+                             "checkpoint dir)")
     p_test.set_defaults(func=cmd_test)
 
     p_an = sub.add_parser("analyze")

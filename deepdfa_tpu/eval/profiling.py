@@ -157,6 +157,10 @@ def profile_eval(
         # One jit wrapper serves both the cost analysis and the timed runs,
         # so the model compiles exactly once.
         costs = _costs_of_compiled(jstep.lower(batches[0]).compile())
+        # The AOT lower/compile above does NOT seed jit's dispatch cache:
+        # execute once untimed so the first measured step never includes
+        # compilation (matters when n_warmup is 0 on tiny test sets).
+        jax.block_until_ready(jstep(batches[0]))
     else:
         costs = {"flops": 0.0, "macs": 0.0}
     total_time, measured = 0.0, 0

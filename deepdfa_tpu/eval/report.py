@@ -146,6 +146,26 @@ def test_report(
     return report
 
 
+def main(argv=None) -> Dict[str, float]:
+    """``python -m deepdfa_tpu.eval.report <profiledata.jsonl>
+    <timedata.jsonl>`` — the reference's scripts/report_profiling.py:18-66
+    aggregation: GFLOPs/GMACs and ms per example (paper Table 5). Missing
+    files are skipped so profile-only or time-only runs both report."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="deepdfa_tpu.eval.report")
+    ap.add_argument("profiledata", nargs="?", default="profiledata.jsonl")
+    ap.add_argument("timedata", nargs="?", default="timedata.jsonl")
+    args = ap.parse_args(argv)
+    out: Dict[str, float] = {}
+    if os.path.exists(args.profiledata):
+        out.update(aggregate_profile(args.profiledata))
+    if os.path.exists(args.timedata):
+        out.update(aggregate_time(args.timedata))
+    print(json.dumps(out))
+    return out
+
+
 def dbgbench_report(
     probs,
     example_bug_ids,
@@ -166,3 +186,7 @@ def dbgbench_report(
         "bugs_detected": detected,
         "detection_rate": detected / total if total else 0.0,
     }
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Table-5 profiling flow (reference DDFA/scripts/run_profiling.sh:3-8):
+# evaluate a checkpoint with the FLOPs + latency instruments, then aggregate
+# the per-step JSONL records into GFLOPs/GMACs and ms-per-example
+# (scripts/report_profiling.py:18-66 semantics).
+#
+# usage: run_profiling.sh <checkpoint-dir> [extra cli args...]
+set -e
+cd "$(dirname "$0")/.."
+CKPT=${1:?usage: run_profiling.sh <checkpoint-dir> [extra cli args...]}
+shift || true
+python -m deepdfa_tpu.cli test --config configs/default.yaml \
+  --checkpoint-dir "$CKPT" --which best --profile --time "$@"
+python -m deepdfa_tpu.eval.report "$CKPT/profiledata.jsonl" "$CKPT/timedata.jsonl"

@@ -205,3 +205,75 @@ def test_tensorboard_logging(tmp_path):
     import os
 
     assert any(f.startswith("events") for f in os.listdir(tb_dir))
+
+
+def test_cli_test_profile_and_time(tmp_path, capsys):
+    """`cli test --profile --time` writes the per-step JSONL records and the
+    aggregated Table-5-style summary (run_profiling.sh flow, reference
+    base_module.py:238-291 + report_profiling.py:18-66)."""
+    ckpt = str(tmp_path / "run")
+    sets = [
+        "--set", "train.max_epochs=1",
+        "--set", "data.batch_size=16",
+        "--set", "data.eval_batch_size=8",
+        "--set", "model.hidden_dim=8",
+        "--set", "model.n_steps=2",
+    ]
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        main(["fit", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt, *sets])
+        capsys.readouterr()
+        main(["test", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt,
+              "--profile", "--time", *sets])
+    finally:
+        os.chdir(cwd)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    prof = out["profiling"]
+    assert prof["flops_per_batch"] > 0
+    assert prof["gflops_per_example"] > 0
+    assert prof["gmacs_per_example"] == pytest.approx(prof["gflops_per_example"] / 2)
+    assert prof["ms_per_example"] > 0
+    assert prof["params"] > 0
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def test_cli_profile_jsonl_records(tmp_path, capsys):
+    """Record shapes match the reference's profiledata/timedata rows
+    (base_module.py:282-291), and the module-level aggregator CLI reads
+    them back."""
+    ckpt = str(tmp_path / "run")
+    sets = [
+        "--set", "train.max_epochs=1",
+        "--set", "data.batch_size=16",
+        "--set", "data.eval_batch_size=8",
+        "--set", "model.hidden_dim=8",
+        "--set", "model.n_steps=2",
+    ]
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        main(["fit", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt, *sets])
+        main(["test", "--dataset", "synthetic:48", "--checkpoint-dir", ckpt,
+              "--profile", "--time", "--profile-dir", str(tmp_path / "prof"),
+              *sets])
+    finally:
+        os.chdir(cwd)
+    prof_recs = _read_jsonl(tmp_path / "prof" / "profiledata.jsonl")
+    time_recs = _read_jsonl(tmp_path / "prof" / "timedata.jsonl")
+    assert prof_recs and time_recs
+    assert set(prof_recs[0]) == {"step", "flops", "params", "macs", "batch_size"}
+    assert set(time_recs[0]) == {"step", "duration", "batch_size"}
+
+    from deepdfa_tpu.eval.report import main as report_main
+
+    capsys.readouterr()
+    agg = report_main([
+        str(tmp_path / "prof" / "profiledata.jsonl"),
+        str(tmp_path / "prof" / "timedata.jsonl"),
+    ])
+    assert agg["gflops_per_example"] > 0 and agg["ms_per_example"] > 0
