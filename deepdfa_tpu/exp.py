@@ -153,6 +153,7 @@ def run_experiment(
     res_dir: str = "results",
     tiny: bool = False,
     overrides: Optional[Dict] = None,
+    pretrained: Optional[str] = None,
 ) -> Dict:
     """Run one experiment end to end; returns the result record written to
     ``<res_dir>/<task>_<sub_task>_<model_tag>/result.json`` (res_fn,
@@ -176,16 +177,25 @@ def run_experiment(
         tcfg = dataclasses.replace(tcfg, **{k: v})
 
     t0 = time.time()
+    if pretrained and cfg.task in ("clone", "multi_task"):
+        # Refuse rather than silently train from random init while the
+        # result record claims a pretrained fine-tune.
+        raise NotImplementedError(
+            f"--pretrained is not wired for task {cfg.task!r} yet "
+            "(supported: defect and the generation family)"
+        )
     if cfg.task == "defect":
-        result = _run_defect(cfg, tcfg, data, tiny)
+        result = _run_defect(cfg, tcfg, data, tiny, pretrained)
     elif cfg.task == "clone":
         result = _run_clone(cfg, tcfg, data, tiny)
     elif cfg.task == "multi_task":
         result = _run_multitask(cfg, tcfg, data, tiny)
     else:  # generation family: summarize / translate / refine / concode
-        result = _run_gen(cfg, tcfg, data, tiny)
+        result = _run_gen(cfg, tcfg, data, tiny, pretrained)
     result["seconds"] = round(time.time() - t0, 2)
     result["config"] = dataclasses.asdict(cfg)
+    if pretrained:
+        result["pretrained"] = pretrained
 
     res_fn = os.path.join(res_dir, run_name, "result.json")
     with open(res_fn, "w") as f:
@@ -211,22 +221,71 @@ def _toy_gen_data(n, vocab, src_len, trg_len, seed):
     return {"source_ids": src, "target_ids": tgt}
 
 
-def _run_gen(cfg, tcfg, data, tiny):
+def _load_pretrained_for(cfg, pretrained: str):
+    """(model-ready config, nested init_params) for a model tag + HF dir.
+
+    Nesting matches each model's submodule layout: DefectModel holds its
+    stack under "t5", LineVul under "roberta", RobertaSeq2Seq under
+    "encoder" (+ the tied "shared" table); the trainers graft the subtree
+    onto a fresh init (text_loop._merge_params).
+    """
+    from deepdfa_tpu.models.pretrained import load_pretrained
+
+    kind, mcfg, conv = load_pretrained(pretrained)
+    want = "t5" if cfg.model_tag.startswith("codet5") else "roberta"
+    if kind != want:
+        raise ValueError(
+            f"model_tag {cfg.model_tag!r} needs a {want} checkpoint, "
+            f"{pretrained} holds {kind}"
+        )
+    return kind, mcfg, conv
+
+
+def _run_gen(cfg, tcfg, data, tiny, pretrained=None):
     from deepdfa_tpu.train.gen_loop import fit_gen
 
     _require_synthetic(data)
-    model = build_model(cfg, tiny=tiny, generation=True)
+    init_params = None
+    if pretrained:
+        kind, mcfg, conv = _load_pretrained_for(cfg, pretrained)
+        if kind == "t5":
+            from deepdfa_tpu.models.t5 import T5Model
+
+            model = T5Model(mcfg)
+            init_params = conv  # T5Model IS the converted tree
+        else:
+            from deepdfa_tpu.models.seq2seq import RobertaSeq2Seq, Seq2SeqConfig
+
+            model = RobertaSeq2Seq(Seq2SeqConfig(encoder=mcfg))
+            # The seq2seq encoder is fed input_embeds from the shared table
+            # (tie_weights, models.py:212-217), so it never creates a
+            # word_embeddings param — that table seeds "shared" instead,
+            # and the rest of the encoder subtree grafts as-is.
+            enc_tree = dict(conv["params"])
+            word = enc_tree.pop("word_embeddings")
+            init_params = {"params": {
+                "encoder": enc_tree,
+                "shared": {"embedding": word["embedding"]},
+            }}
+    else:
+        model = build_model(cfg, tiny=tiny, generation=True)
     vocab = model.cfg.vocab_size
     train = _toy_gen_data(64, vocab, cfg.source_length, cfg.target_length, cfg.seed)
     evald = _toy_gen_data(16, vocab, cfg.source_length, cfg.target_length, cfg.seed + 1)
-    out = fit_gen(model, train, evald, tcfg, max_target_length=8)
+    out = fit_gen(model, train, evald, tcfg, max_target_length=8,
+                  init_params=init_params)
     return {"eval_loss": float(out["eval_loss"]),
             "exact_match": float(out["exact_match"])}
 
 
-def _run_defect(cfg, tcfg, data, tiny):
+def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
     """Defect classification — DefectModel (eos-pooled T5) for codet5 tags,
-    encoder classifier otherwise; both train through fit_text."""
+    encoder classifier otherwise; both train through fit_text.
+
+    ``pretrained``: HF checkpoint dir; the converted stack grafts onto the
+    fresh init (the reference's from_pretrained flow, run_defect.py:155-158,
+    linevul_main.py:605-621) — the task head always trains from scratch.
+    """
     import numpy as np
 
     from deepdfa_tpu.train.text_loop import fit_text
@@ -234,10 +293,15 @@ def _run_defect(cfg, tcfg, data, tiny):
     _require_synthetic(data)
     rng = np.random.RandomState(cfg.seed)
     n, seq = 64, 16
+    init_params = None
     if cfg.model_tag.startswith("codet5"):
         from deepdfa_tpu.models.t5 import DefectModel
 
-        t5cfg = _t5_config(cfg.model_tag, tiny)
+        if pretrained:
+            _, t5cfg, conv = _load_pretrained_for(cfg, pretrained)
+            init_params = {"params": {"t5": conv["params"]}}
+        else:
+            t5cfg = _t5_config(cfg.model_tag, tiny)
         model = DefectModel(t5cfg)
         vocab, pad_id = t5cfg.vocab_size, t5cfg.pad_token_id
         ids = rng.randint(3, vocab, size=(n, seq)).astype(np.int32)
@@ -246,7 +310,11 @@ def _run_defect(cfg, tcfg, data, tiny):
         from deepdfa_tpu.models.linevul import LineVul
         from deepdfa_tpu.models.transformer import EncoderConfig
 
-        enc = EncoderConfig.tiny() if tiny else EncoderConfig()
+        if pretrained:
+            _, enc, conv = _load_pretrained_for(cfg, pretrained)
+            init_params = {"params": {"roberta": conv["params"]}}
+        else:
+            enc = EncoderConfig.tiny() if tiny else EncoderConfig()
         model = LineVul(enc)
         vocab, pad_id = enc.vocab_size, enc.pad_token_id
         ids = rng.randint(2, vocab, size=(n, seq)).astype(np.int32)
@@ -257,7 +325,8 @@ def _run_defect(cfg, tcfg, data, tiny):
     }
     splits = {"train": np.arange(int(n * 0.8)),
               "val": np.arange(int(n * 0.8), n)}
-    _, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id)
+    _, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id,
+                       init_params=init_params)
     return {"best_val_f1": hist["best_val_f1"],
             "best_epoch": hist["best_epoch"]}
 
@@ -329,6 +398,9 @@ def main(argv=None) -> int:
                         help="tiny model shapes (smoke tests)")
     parser.add_argument("--epochs", type=int, default=None,
                         help="override the task table's epoch count")
+    parser.add_argument("--pretrained", default=None,
+                        help="HF checkpoint dir to fine-tune from "
+                             "(from_pretrained parity, run_defect.py:155-158)")
     args = parser.parse_args(argv)
 
     if args.sub_task not in get_sub_tasks(args.task):
@@ -338,7 +410,7 @@ def main(argv=None) -> int:
     overrides = {"max_epochs": args.epochs} if args.epochs else None
     result = run_experiment(
         cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,
-        overrides=overrides,
+        overrides=overrides, pretrained=args.pretrained,
     )
     print(json.dumps(result))
     return 0
