@@ -94,7 +94,21 @@ def fit_clone(
     mesh=None,
 ) -> Dict[str, Any]:
     """Train, tracking best eval F1 (run_clone.py keeps checkpoint-best-f1).
-    Returns {"state", "best_f1", "eval_metrics"}."""
+    Returns {"state", "best_f1", "eval_metrics"}.
+
+    Multi-controller: hosts run the same deterministic batch sequence and
+    feed local row slices (the _batches/host contract of train/loop.py);
+    eval logits replicate, so best-F1 tracking agrees on every host."""
+    from deepdfa_tpu.train.gen_loop import (
+        _check_host_batch_sizes,
+        _host_of,
+        _lift_rows,
+    )
+
+    host = _host_of()
+    if host is not None and mesh is None:
+        raise ValueError("multi-process fit_clone needs an explicit global mesh")
+    _check_host_batch_sizes(cfg, host)
     n = len(train_data["source_ids"])
     steps_per_epoch = max(-(-n // cfg.batch_size), 1)
     max_steps = steps_per_epoch * cfg.max_epochs
@@ -121,9 +135,22 @@ def fit_clone(
         step = jit_dp_step(make_clone_train_step(model, tx, cfg), mesh,
                            n_batch_args=3, n_out=3,
                            batch_sizes=(cfg.batch_size,))
-    eval_fn = jax.jit(
-        lambda params, s, l, m: clone_loss(model, params, s, l, m)
-    )
+    def eval_forward(params, s, l, m):
+        loss, logits = clone_loss(model, params, s, l, m)
+        # softmax on device, inside the jitted program — the host should
+        # only ever see the final probs (one transfer, replicated).
+        return loss, jax.nn.softmax(logits, axis=-1)[:, 1]
+
+    if mesh is None:
+        eval_fn = jax.jit(eval_forward)
+    else:
+        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+
+        rep, dsh = replicated(mesh), batch_sharding(mesh)
+        eval_fn = jax.jit(
+            eval_forward,
+            in_shardings=(rep, dsh, dsh, dsh), out_shardings=(rep, rep),
+        )
 
     def batches(data, batch_size, order=None):
         """Padded tail batch with an example mask: no rows dropped, and
@@ -149,18 +176,21 @@ def fit_clone(
         order = np_rng.permutation(n)
         for src, labels, mask in batches(train_data, cfg.batch_size, order):
             state, loss, _ = step(
-                state, jnp.asarray(src), jnp.asarray(labels), jnp.asarray(mask)
+                state, _lift_rows(src, mesh, host), _lift_rows(labels, mesh, host),
+                _lift_rows(mask, mesh, host),
             )
 
         stats = BinaryStats.zeros()
         for src, labels, mask in batches(eval_data, cfg.eval_batch_size):
-            _, logits = eval_fn(
-                state.params, jnp.asarray(src), jnp.asarray(labels),
-                jnp.asarray(mask),
+            _, probs = eval_fn(
+                state.params, _lift_rows(src, mesh, host),
+                _lift_rows(labels, mesh, host), _lift_rows(mask, mesh, host),
             )
-            probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+            # probs replicate; stats from host-side global labels/mask are
+            # identical on every host.
             stats = stats + binary_stats(
-                probs, jnp.asarray(labels, jnp.float32), jnp.asarray(mask)
+                jnp.asarray(np.asarray(probs)), jnp.asarray(labels, jnp.float32),
+                jnp.asarray(mask),
             )
         metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
         if log:

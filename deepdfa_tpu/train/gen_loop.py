@@ -66,14 +66,18 @@ def make_gen_train_state(
 ) -> Tuple[GenTrainState, optax.GradientTransformation]:
     rng = jax.random.PRNGKey(cfg.seed)
     params_rng, dropout_rng = jax.random.split(rng)
+    params = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        jnp.asarray(example_src),
+        shift_right(jnp.asarray(example_tgt), model.cfg.decoder_start_token_id),
+    )
     if init_params is not None:
-        params = init_params
-    else:
-        params = model.init(
-            {"params": params_rng, "dropout": dropout_rng},
-            jnp.asarray(example_src),
-            shift_right(jnp.asarray(example_tgt), model.cfg.decoder_start_token_id),
-        )
+        # Graft (don't replace): pretrained trees may cover only a subtree
+        # (e.g. the RoBERTa encoder under a fresh decoder) — text_loop's
+        # merge validates every override key/shape against the fresh init.
+        from deepdfa_tpu.train.text_loop import _merge_params
+
+        params = _merge_params(params, init_params)
     tx = make_gen_optimizer(cfg, max_steps)
     return (
         GenTrainState(jnp.zeros((), jnp.int32), params, tx.init(params), dropout_rng),
@@ -127,6 +131,46 @@ def _batches(data: Dict[str, np.ndarray], batch_size: int, rng=None,
         yield src, tgt, n_valid
 
 
+def _host_of() -> Optional[Tuple[int, int]]:
+    """(process_index, process_count) in multi-controller runs, else None —
+    the _batches/host contract of train/loop.py extended to the gen/clone
+    trainers (reference DDP covered its generation trainer,
+    CodeT5/run_defect.py:274-277)."""
+    return (
+        (jax.process_index(), jax.process_count())
+        if jax.process_count() > 1 else None
+    )
+
+
+def _lift_rows(arr: np.ndarray, mesh, host):
+    """Slice this host's rows of a deterministic global batch and lift them
+    onto the mesh (identity on a single host)."""
+    if host is None:
+        return jnp.asarray(arr)
+    from deepdfa_tpu.parallel.mesh import assemble_global_batch
+
+    pi, pc = host
+    if arr.shape[0] % pc:
+        # Truncating would silently drop examples from every batch; the
+        # trainers validate batch sizes up front, this is the backstop.
+        raise ValueError(f"batch rows {arr.shape[0]} % hosts {pc} != 0")
+    rows = arr.shape[0] // pc
+    return assemble_global_batch(arr[pi * rows : (pi + 1) * rows], mesh)
+
+
+def _check_host_batch_sizes(cfg: TransformerTrainConfig, host) -> None:
+    """Fail before training, not at the first lifted batch (the fit_text
+    guard, text_loop.py): every global batch splits evenly across hosts."""
+    if host is None:
+        return
+    pc = host[1]
+    if cfg.batch_size % pc or cfg.eval_batch_size % pc:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} and eval_batch_size "
+            f"{cfg.eval_batch_size} must divide by the process count {pc}"
+        )
+
+
 def exact_match(pred: np.ndarray, target: np.ndarray, pad_id: int, eos_id: int) -> float:
     """Fraction of rows whose generated tokens (up to eos) equal the
     reference target tokens (up to eos)."""
@@ -153,22 +197,37 @@ def evaluate_gen(
     cfg: TransformerTrainConfig,
     max_target_length: int = 32,
     beam_size: int = 1,
+    mesh=None,
+    host=None,
 ) -> Dict[str, float]:
     """Eval loss over padded batches + generation exact-match (shared by
-    fit_gen and fit_gen_multitask)."""
+    fit_gen and fit_gen_multitask).
+
+    ``mesh``/``host``: dp sharding / multi-controller feeding. Outputs
+    replicate, so predictions and metrics are identical on every host."""
     pad_id = model.cfg.pad_token_id
-    eval_loss_fn = jax.jit(lambda params, s, t: seq2seq_loss(model, params, s, t))
-    gen = jax.jit(
-        lambda params, src: generate(
-            model, params, src, max_len=max_target_length, beam_size=beam_size
-        )
+    loss_fn = lambda params, s, t: seq2seq_loss(model, params, s, t)
+    gen_fn = lambda params, src: generate(
+        model, params, src, max_len=max_target_length, beam_size=beam_size
     )
+    if mesh is not None:
+        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+
+        rep, dsh = replicated(mesh), batch_sharding(mesh)
+        eval_loss_fn = jax.jit(loss_fn, in_shardings=(rep, dsh, dsh),
+                               out_shardings=rep)
+        gen = jax.jit(gen_fn, in_shardings=(rep, dsh), out_shardings=rep)
+    else:
+        eval_loss_fn = jax.jit(loss_fn)
+        gen = jax.jit(gen_fn)
     losses, preds = [], []
     for s, t, n_valid in _batches(
         eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
     ):
-        losses.append(float(eval_loss_fn(state.params, jnp.asarray(s), jnp.asarray(t))))
-        preds.append(np.asarray(gen(state.params, jnp.asarray(s)))[:n_valid])
+        s_dev = _lift_rows(s, mesh, host)
+        t_dev = _lift_rows(t, mesh, host)
+        losses.append(float(eval_loss_fn(state.params, s_dev, t_dev)))
+        preds.append(np.asarray(gen(state.params, s_dev))[:n_valid])
     pred = (
         np.concatenate(preds)
         if preds
@@ -199,7 +258,15 @@ def fit_gen(
 
     ``mesh``: optional data-parallel mesh — batches shard over the data
     axis, params replicate, GSPMD all-reduces the grads (the jit analog of
-    the reference's DataParallel over the gen tasks)."""
+    the reference's DataParallel over the gen tasks). Multi-controller
+    (jax.process_count() > 1): every host runs the same deterministic batch
+    sequence and feeds its local row slice — the _batches/host contract of
+    train/loop.py, replacing DistributedSampler
+    (CodeT5/run_defect.py:274-277)."""
+    host = _host_of()
+    if host is not None and mesh is None:
+        raise ValueError("multi-process fit_gen needs an explicit global mesh")
+    _check_host_batch_sizes(cfg, host)
     n = len(train_data["source_ids"])
     steps_per_epoch = -(-n // cfg.batch_size)  # ceil: small sets still train
     max_steps = steps_per_epoch * cfg.max_epochs
@@ -219,12 +286,15 @@ def fit_gen(
         for src, tgt, _ in _batches(
             train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
         ):
-            state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
+            state, loss = step(
+                state, _lift_rows(src, mesh, host), _lift_rows(tgt, mesh, host)
+            )
             losses.append(loss)
         if log:
             log(f"epoch {epoch}: train_loss={float(np.mean(jax.device_get(losses))):.4f}")
 
-    ev = evaluate_gen(model, state, eval_data, cfg, max_target_length, beam_size)
+    ev = evaluate_gen(model, state, eval_data, cfg, max_target_length, beam_size,
+                      mesh=mesh, host=host)
     return {"state": state, **ev}
 
 

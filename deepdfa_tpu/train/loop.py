@@ -110,8 +110,15 @@ def _labels_for(model: FlowGNN, batch: GraphBatch) -> Tuple[jnp.ndarray, jnp.nda
             )
         mask = batch.node_mask
         if style.endswith("_in"):
-            first_key = next(iter(batch.node_feats))
-            mask = mask & (batch.node_feats[first_key] != 0)
+            # cut_nodef (base_module.py:148-155): loss/metrics only on
+            # definition nodes, i.e. nonzero abstract-dataflow index. Our
+            # export asserts all subkeys share the zero set (etl/export.py);
+            # OR-ing over subkeys keeps the cut correct even for external
+            # caches that never ran that assert.
+            is_def = jnp.zeros_like(mask)
+            for f in batch.node_feats.values():
+                is_def = is_def | (f != 0)
+            mask = mask & is_def
         return sol.astype(jnp.float32), mask
     raise NotImplementedError(f"label_style {style!r}")
 
@@ -158,6 +165,7 @@ def _batches(
     build_tile_adj: bool = False,
     with_dataflow: bool = False,
     host: "Optional[Tuple[int, int]]" = None,
+    with_global_meta: bool = False,
 ) -> Iterable[GraphBatch]:
     """Pack examples into padded batches.
 
@@ -175,6 +183,12 @@ def _batches(
     shard boundaries globally agreed without communication, the same
     contract as the reference's seeded DistributedSampler
     (CodeT5/run_defect.py:274-277).
+
+    ``with_global_meta``: yield ``(local_batch, meta)`` where ``meta`` holds
+    host-side numpy copies of the FULL group's bookkeeping
+    (graph_ids/graph_mask/node_graph/node_mask) — per-example evaluation
+    outputs are replicated across hosts, but their id stream lives on the
+    input side, which each host only feeds a slice of.
     """
     from deepdfa_tpu.parallel.mesh import local_shard_slice, shard_concat
 
@@ -201,7 +215,18 @@ def _batches(
         build_tile_adj=build_dense, with_dataflow=with_dataflow,
     )
     if n_shards == 1:
-        yield from sub_iter
+        # with_global_meta is a multi-controller (n_shards > 1) concern;
+        # honor it anyway so callers don't need to branch.
+        for sub in sub_iter:
+            if with_global_meta:
+                yield sub, {
+                    "graph_ids": np.asarray(sub.graph_ids),
+                    "graph_mask": np.asarray(sub.graph_mask),
+                    "node_mask": np.asarray(sub.node_mask),
+                    "node_graph": np.asarray(sub.node_graph),
+                }
+            else:
+                yield sub
         return
     empty = batch_graphs(
         [], per_shard, budget_nodes, budget_edges, subkeys,
@@ -213,7 +238,25 @@ def _batches(
     )
     base = sel.start or 0
 
-    def emit(group: List[GraphBatch]) -> GraphBatch:
+    def group_meta(group: List[GraphBatch]) -> Dict[str, np.ndarray]:
+        g0 = group[0]
+        return {
+            "graph_ids": np.concatenate(
+                [np.asarray(b.graph_ids) for b in group]
+            ),
+            "graph_mask": np.concatenate(
+                [np.asarray(b.graph_mask) for b in group]
+            ),
+            "node_mask": np.concatenate(
+                [np.asarray(b.node_mask) for b in group]
+            ),
+            "node_graph": np.concatenate(
+                [np.asarray(b.node_graph) + i * g0.n_graphs
+                 for i, b in enumerate(group)]
+            ),
+        }
+
+    def concat(group: List[GraphBatch]) -> GraphBatch:
         if not build_tile_adj or host is None:
             return shard_concat(group[sel], base_shard=base)
         from deepdfa_tpu.ops.tile_spmm import (
@@ -242,6 +285,10 @@ def _batches(
             local, base_shard=base, tile_nz=tile_nz, tile_dtype=tile_dt
         )
 
+    def emit(group: List[GraphBatch]):
+        batch = concat(group)
+        return (batch, group_meta(group)) if with_global_meta else batch
+
     group: List[GraphBatch] = []
     for sub in sub_iter:
         group.append(sub)
@@ -267,9 +314,12 @@ def evaluate(
     mesh=None,
 ) -> EvalResult:
     """``host``/``mesh``: multi-controller mode — each host feeds its local
-    shard slice, lifted to global arrays. Per-example probability/label
-    dumps are skipped there (globally-sharded outputs are not fully
-    addressable from one host); the scalar metrics remain exact."""
+    shard slice, lifted to global arrays. The jitted eval outputs replicate
+    across hosts (out_shardings), so per-example probs/labels come straight
+    off the device on every host; the id stream (an input each host only
+    feeds a slice of) rides the packer's host-side global meta. Every host
+    therefore returns the same full EvalResult — PR curves,
+    export_predictions, and the DbgBench protocol work on a pod."""
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
     # Loss accumulates on device and transfers once at the end — a
@@ -277,28 +327,31 @@ def evaluate(
     loss_sum, n_batches = jnp.zeros(()), 0
     stats = BinaryStats.zeros()
     probs_all, labels_all, ids_all = [], [], []
-    for batch in _batches(
+    for item in _batches(
         examples, indices, data_cfg, subkeys, data_cfg.eval_batch_size, n_shards,
-        build_tile_adj, with_dataflow, host,
+        build_tile_adj, with_dataflow, host, with_global_meta=host is not None,
     ):
         if host is not None:
+            batch, gmeta = item
             batch = assemble_global_batch(batch, mesh)
+        else:
+            batch = item
+            gmeta = {
+                "graph_ids": np.asarray(batch.graph_ids),
+                "graph_mask": np.asarray(batch.graph_mask),
+                "node_graph": np.asarray(batch.node_graph),
+            }
         loss, probs, labels, mask = eval_step(state, batch)
-        if host is not None:
-            stats = stats + binary_stats(probs, labels, mask)
-            loss_sum = loss_sum + loss
-            n_batches += 1
-            continue
         m = np.asarray(mask)
         probs_all.append(np.asarray(probs)[m])
         labels_all.append(np.asarray(labels)[m])
         # ids aligned 1:1 with probs: per-graph for graph labels, the owning
         # graph's id for per-node labels.
-        gids = np.asarray(batch.graph_ids)
-        if m.shape == np.asarray(batch.graph_mask).shape:
+        gids = gmeta["graph_ids"]
+        if m.shape == gmeta["graph_mask"].shape:
             ids_all.append(gids[m])
         else:
-            ids_all.append(gids[np.asarray(batch.node_graph)][m])
+            ids_all.append(gids[gmeta["node_graph"]][m])
         stats = stats + binary_stats(probs, labels, mask)
         loss_sum = loss_sum + loss
         n_batches += 1

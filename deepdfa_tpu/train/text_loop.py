@@ -48,6 +48,10 @@ class TextBatch:
     # Rows with an example but no usable graph, counted over the GLOBAL
     # batch before any host slicing (keep_idx accounting, num_missing).
     n_missing: int = 0
+    # Multi-controller: host-side numpy copies of the FULL batch's
+    # labels/mask/index (taken before row slicing). Eval outputs replicate
+    # across hosts, so these are all that's needed for per-example dumps.
+    global_meta: Optional[Dict[str, np.ndarray]] = None
 
 
 def make_schedule(cfg: TransformerTrainConfig, max_steps: int) -> optax.Schedule:
@@ -197,13 +201,15 @@ def text_graph_batches(
                     tile_dtype=tile_dt,
                 )
         n_missing = int((index >= 0).sum() - mask.sum())
+        gmeta = None
         if host is not None:
+            gmeta = {"labels": labels, "mask": mask, "index": index}
             pi, pc = host
             rows_local = batch_size // pc
             row_sel = slice(pi * rows_local, (pi + 1) * rows_local)
             ids, labels = ids[row_sel], labels[row_sel]
             mask, index = mask[row_sel], index[row_sel]
-        yield TextBatch(ids, labels, mask, index, gbatch, n_missing)
+        yield TextBatch(ids, labels, mask, index, gbatch, n_missing, gmeta)
 
 
 def _shard_tile_stats(slot_graphs, max_nodes: int):
@@ -385,6 +391,8 @@ def _assemble_text(batch: TextBatch, mesh) -> TextBatch:
             assemble_global_batch(batch.graphs, mesh) if batch.graphs is not None
             else None
         ),
+        n_missing=batch.n_missing,
+        global_meta=batch.global_meta,
     )
 
 
@@ -393,9 +401,10 @@ def evaluate_text(
     graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
     build_tile_adj: bool = False, n_shards: int = 1, host=None, mesh=None,
 ):
-    """``host``/``mesh``: multi-controller mode — per-example prob dumps are
-    skipped (globally-sharded outputs are not fully addressable from one
-    host); the scalar metrics remain exact."""
+    """``host``/``mesh``: multi-controller mode — the jitted eval outputs
+    replicate across hosts, and the batch carries host-side global
+    labels/mask/index, so every host returns the same full per-example
+    dump (PR CSVs, export_predictions, DbgBench all work on a pod)."""
     stats = BinaryStats.zeros()
     total_loss, n = 0.0, 0
     probs_all, labels_all, index_all = [], [], []
@@ -407,24 +416,20 @@ def evaluate_text(
     ):
         num_missing += batch.n_missing
         if host is not None:
+            gm = batch.global_meta
+            labels_np, m, index_np = gm["labels"], gm["mask"], gm["index"]
             batch = _assemble_text(batch, mesh)
-            loss, probs = _run_step(eval_step, state, batch)
-            stats = stats + binary_stats(
-                jnp.asarray(probs),
-                jnp.asarray(batch.labels, jnp.float32),
-                jnp.asarray(batch.example_mask),
-            )
-            total_loss += float(loss)
-            n += 1
-            continue
+        else:
+            labels_np, m, index_np = batch.labels, batch.example_mask, batch.index
         loss, probs = _run_step(eval_step, state, batch)
-        m = batch.example_mask
+        # probs is replicated output in host mode: addressable everywhere.
+        p = np.asarray(probs)
         stats = stats + binary_stats(
-            jnp.asarray(probs), jnp.asarray(batch.labels, jnp.float32), jnp.asarray(m)
+            jnp.asarray(p), jnp.asarray(labels_np, jnp.float32), jnp.asarray(m)
         )
-        probs_all.append(np.asarray(probs)[m])
-        labels_all.append(batch.labels[m])
-        index_all.append(batch.index[m])
+        probs_all.append(p[m])
+        labels_all.append(labels_np[m])
+        index_all.append(index_np[m])
         total_loss += float(loss)
         n += 1
     metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
