@@ -188,19 +188,42 @@ def parse(code: str, lang: str = "java") -> Node:
     return node
 
 
-def iter_statements(root: Node):
-    """Yield every stmt node's flat token list (tokens inside nested
-    parens/brackets included; nested blocks are their own statements)."""
+def _is_inline_group(n: Node) -> bool:
+    """Expression-grouping parens/brackets (at most one stmt inside) inline
+    into the enclosing statement; statement-holding groups (a for-header's
+    ``( init ; cond ; update )``) are separate statements."""
+    if n.kind not in ("parens", "brackets"):
+        return False
+    return sum(
+        1 for c in n.children if isinstance(c, Node) and c.kind == "stmt"
+    ) <= 1
 
-    def flat(n: Union[Node, Token]):
+
+def iter_statements(root: Node):
+    """Yield every logical statement's flat token list, each exactly once.
+
+    - Expression parens/brackets inline into their enclosing statement
+      (``x = ( a + b )`` is ONE statement with rhs ids a, b).
+    - Statement-holding parens (for-headers) and blocks are excluded from
+      the enclosing flat and yielded as their own statements — flattening a
+      for-header into one pseudo-assignment would fabricate edges, and
+      yielding paren contents both inline and standalone would double-count
+      under the metric's multiset matching.
+    - SOURCE order (pre-order): dataflow normalization renames variables in
+      first-appearance order (dataflow_match.py:132-148), so the statement
+      stream's order is part of the metric's semantics.
+    """
+
+    def flat(n: Union[Node, Token], excluded: List[Node]):
         if isinstance(n, Token):
             return [n]
-        if n.kind == "block":
-            return []
-        out = []
-        for c in n.children:
-            out.extend(flat(c))
-        return out
+        if n.kind == "stmt" or _is_inline_group(n):
+            out = []
+            for c in n.children:
+                out.extend(flat(c, excluded))
+            return out
+        excluded.append(n)  # block or statement-holding group
+        return []
 
     stack = [root]
     while stack:
@@ -208,5 +231,14 @@ def iter_statements(root: Node):
         if isinstance(n, Token):
             continue
         if n.kind == "stmt":
-            yield flat(n)
-        stack.extend(c for c in n.children if isinstance(c, Node))
+            excluded: List[Node] = []
+            toks = flat(n, excluded)
+            yield toks
+            # Descend only into the parts excluded from this statement's
+            # flat view (blocks, multi-stmt parens) — anything inlined is
+            # already accounted for.
+            stack.extend(reversed(excluded))
+        else:
+            stack.extend(
+                reversed([c for c in n.children if isinstance(c, Node)])
+            )

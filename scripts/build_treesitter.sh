@@ -1,0 +1,36 @@
+#!/bin/bash
+# Build the tree-sitter grammar bundle the reference's CodeBLEU
+# syntax/dataflow components parse with (CodeT5/evaluator/CodeBLEU/parser/
+# build.sh:1-8 -> build.py:1-21 -> my-languages.so).
+#
+# The build image has neither network access nor the tree_sitter package,
+# so the framework ships a self-contained parser
+# (deepdfa_tpu/eval/codebleu/parser.py) whose syntax/dataflow semantics are
+# pinned by hand-verified goldens (tests/test_codebleu.py) and a written
+# divergence contract (deepdfa_tpu/eval/codebleu/DIVERGENCES.md). Run this
+# script in an environment with git+pip to produce the real grammar bundle;
+# wiring it in is then a parser swap behind the same metric surface.
+set -e
+cd "$(dirname "$0")/.."
+DEST=${1:-deepdfa_tpu/eval/codebleu/ts}
+python -c "import tree_sitter" 2>/dev/null || {
+  echo "error: pip install tree_sitter first" >&2; exit 1; }
+mkdir -p "$DEST"
+cd "$DEST"
+# The reference's grammar list (build.sh) plus c/cpp, which Big-Vul code
+# actually is (the reference parses C through the java grammar's C-family
+# tolerance; having the real grammars available is strictly better).
+LANGS="go javascript python php java ruby c-sharp c cpp"
+for lang in $LANGS; do
+  [ -d "tree-sitter-$lang" ] || \
+    git clone --depth 1 "https://github.com/tree-sitter/tree-sitter-$lang"
+done
+python - <<'PY'
+from tree_sitter import Language
+
+langs = ["go", "javascript", "python", "php", "java", "ruby", "c-sharp",
+         "c", "cpp"]
+Language.build_library("my-languages.so",
+                       [f"tree-sitter-{l}" for l in langs])
+print("built my-languages.so")
+PY

@@ -178,3 +178,117 @@ def test_from_files(tmp_path):
     hyp.write_text(f"{JAVA}\nreturn 1 + 2 ;\n")
     out = get_codebleu_from_files([str(ref)], str(hyp), "java")
     assert out["codebleu"] == pytest.approx(1.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Hand-verified goldens for the substitute parser's syntax/dataflow
+# components. tree-sitter is unavailable in this environment
+# (DIVERGENCES.md), so these expected values are derived BY HAND from the
+# documented parse/extraction rules — each count is written out in the test
+# so a future change to the parser must re-derive, not just re-record.
+# ---------------------------------------------------------------------------
+
+
+def test_syntax_match_hand_golden_c():
+    """ref parses to exactly 6 internal subtrees:
+      1 (program (stmt if (parens (stmt id > num)) (block (stmt id = id))))
+      2 (stmt if (parens ...) (block ...))
+      3 (parens (stmt id > num))
+      4 (stmt id > num)
+      5 (block (stmt id = id))
+      6 (stmt id = id)
+    A structurally-identical hypothesis matches all 6; a bare assignment
+    matches only subtree 6 -> 1/6."""
+    from deepdfa_tpu.eval.codebleu.syntax import corpus_syntax_match
+
+    ref = "if ( a > 0 ) { b = a ; }"
+    assert len(all_subtree_sexps(parse(ref, "c"))) == 6
+    same_shape = "if ( z > 9 ) { q = z ; }"
+    assert corpus_syntax_match([[ref]], [same_shape], "c") == pytest.approx(1.0)
+    assert corpus_syntax_match([[ref]], ["b = a ;"], "c") == pytest.approx(1 / 6)
+
+
+def test_syntax_match_hand_golden_python():
+    """ref parses to exactly 4 internal subtrees:
+      1 (program (stmt def id ( id ) : (block (stmt return id))))
+      2 (stmt def id ( id ) : (block (stmt return id)))
+      3 (block (stmt return id))
+      4 (stmt return id)
+    The bare 'return a' hypothesis contains only subtree 4 -> 1/4."""
+    from deepdfa_tpu.eval.codebleu.syntax import corpus_syntax_match
+
+    ref = "def f(a):\n    return a\n"
+    assert len(all_subtree_sexps(parse(ref, "python"))) == 4
+    same_shape = "def g(z):\n    return z\n"
+    assert corpus_syntax_match([[ref]], [same_shape], "python") == pytest.approx(1.0)
+    assert corpus_syntax_match([[ref]], ["return a\n"], "python") == pytest.approx(1 / 4)
+
+
+def test_dataflow_match_hand_golden_c():
+    """ref's 3 edges, normalized in first-appearance order with parents
+    before targets (dataflow_match.py:132-148):
+      int x = a ;      -> (var_1, comesFrom,     (var_0,))        a=0 x=1
+      int y = x + b ;  -> (var_3, computedFrom,  (var_1, var_2))  b=2 y=3
+      x = y ;          -> (var_1, comesFrom,     (var_3,))
+    The hypothesis normalizes to exactly the first two edges -> 2/3."""
+    from deepdfa_tpu.eval.codebleu.dataflow import corpus_dataflow_match
+
+    ref = "int x = a ; int y = x + b ; x = y ;"
+    assert normalize_dataflow(extract_dataflow(ref, "c")) == [
+        ("var_1", "comesFrom", ("var_0",)),
+        ("var_3", "computedFrom", ("var_1", "var_2")),
+        ("var_1", "comesFrom", ("var_3",)),
+    ]
+    hyp = "int p = q ; int r = p + s ;"
+    assert corpus_dataflow_match([[ref]], [hyp], "c") == pytest.approx(2 / 3)
+
+
+def test_dataflow_match_hand_golden_python():
+    """ref edges: (var_1 comesFrom (var_0,)) and
+    (var_2 computedFrom (var_1, var_0)); the hypothesis's second edge
+    normalizes to (var_2 computedFrom (var_1, var_1)) — same relationship,
+    different parent pattern — so only the first edge matches -> 1/2."""
+    from deepdfa_tpu.eval.codebleu.dataflow import corpus_dataflow_match
+
+    ref = "y = x\nz = y + x\n"
+    assert normalize_dataflow(extract_dataflow(ref, "python")) == [
+        ("var_1", "comesFrom", ("var_0",)),
+        ("var_2", "computedFrom", ("var_1", "var_0")),
+    ]
+    hyp = "b = a\nc = b * b\n"
+    assert corpus_dataflow_match([[ref]], [hyp], "python") == pytest.approx(1 / 2)
+
+
+def test_dataflow_match_multiset_semantics_hand_golden():
+    """The reference removes each matched candidate edge from the pool
+    (dataflow_match.py:63-70): a reference with the same edge TWICE against
+    a hypothesis holding it once scores 1/2, not 1."""
+    from deepdfa_tpu.eval.codebleu.dataflow import corpus_dataflow_match
+
+    ref = "a = b ; a = b ;"
+    hyp = "t = u ;"
+    assert corpus_dataflow_match([[ref]], [hyp], "java") == pytest.approx(1 / 2)
+
+
+def test_dataflow_no_double_count_nested_parens():
+    """A paren-nested assignment is ONE statement — inline tokens only,
+    never also yielded standalone (double edges would deflate the multiset
+    match: ref with the edge N times vs a hyp with it once scores 1/N)."""
+    edges = extract_dataflow("while ( ( c = next ) ) { }", "c")
+    assert edges == [("c", "comesFrom", ("next",))]
+
+
+def test_dataflow_for_header_statements_split():
+    """A for-header's ( init ; cond ; update ) holds three separate
+    statements: flattening it into one pseudo-assignment would fabricate
+    an edge like (i, computedFrom, (i, n, i)). Expected edges, in source
+    order: init's (i, comesFrom, ()), update's (i, computedFrom, (i,)),
+    then the body's (sum, computedFrom, (sum, i))."""
+    edges = extract_dataflow(
+        "for ( i = 0 ; i < n ; i ++ ) { sum += i ; }", "c"
+    )
+    assert edges == [
+        ("i", "comesFrom", ()),
+        ("i", "computedFrom", ("i",)),
+        ("sum", "computedFrom", ("sum", "i")),
+    ]
