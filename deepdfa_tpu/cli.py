@@ -91,13 +91,22 @@ def build_configs(
             deep_update(merged[section], values or {})
 
     # Injected tune params apply before explicit --set: the command line
-    # always wins.
+    # always wins. Order: nni service < DEEPDFA_TUNE_PARAMS env < --set
+    # (the reference mutates the parsed config from nni.get_next_parameter,
+    # main_cli.py:110-121).
+    from deepdfa_tpu.train.tune import nni_next_parameters
+
+    injected: List[str] = []
+    nni_params = nni_next_parameters()
+    if nni_params:
+        injected += [f"{dotted}={value}" for dotted, value in nni_params.items()]
     env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
     if env_params:
-        overrides = [
+        injected += [
             f"{dotted}={value}"
             for dotted, value in json.loads(env_params).items()
-        ] + list(overrides)
+        ]
+    overrides = injected + list(overrides)
     for item in overrides:
         dotted, _, value = item.partition("=")
         section, _, key = dotted.partition(".")
@@ -243,6 +252,7 @@ class _CrashLog:
 def cmd_fit(args) -> Dict[str, Any]:
     from deepdfa_tpu.models.flowgnn import FlowGNN
     from deepdfa_tpu.train.loop import fit
+    from deepdfa_tpu.train.tune import TrialReporter
 
     cfgs = build_configs(args.config, args.set)
     model_cfg, data_cfg = cfgs["model"], cfgs["data"]
@@ -262,14 +272,29 @@ def cmd_fit(args) -> Dict[str, Any]:
             from deepdfa_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(n_data=args.n_devices)
+        # Under a real NNI trial the reporter streams per-epoch val F1 and
+        # the final best (base_module.py:346, main_cli.py:184); otherwise
+        # both calls are no-ops.
+        reporter = TrialReporter()
+
+        def report_epoch(epoch, record):
+            reporter.intermediate(record["val_metrics"].get("f1", 0.0))
+            return False  # reporting only; the service decides terminations
+
+        on_epoch = report_epoch if reporter.attached else None
         state, history = fit(model, examples, splits, train_cfg, data_cfg,
-                             mesh=mesh, resume=getattr(args, "resume", False))
+                             mesh=mesh, resume=getattr(args, "resume", False),
+                             on_epoch_end=on_epoch)
         result = {
             "best_epoch": history["best_epoch"],
             "best_val_loss": history["best_val_loss"],
             "final_val_metrics": history["epochs"][-1]["val_metrics"]
             if history["epochs"] else {},
         }
+        if reporter.attached and history["epochs"]:
+            reporter.final(max(
+                e["val_metrics"].get("f1", 0.0) for e in history["epochs"]
+            ))
         with open(os.path.join(run_dir, "history.json"), "w") as f:
             json.dump(history, f, indent=1)
         print(json.dumps(result))
@@ -383,9 +408,15 @@ def cmd_analyze(args) -> Dict[str, Any]:
 def cmd_tune(args) -> Dict[str, Any]:
     """Random hyperparameter search (the NNI replacement): samples the
     published search space (paper Table 2 context), runs short fits, ranks
-    by best val F1, writes tune_results.jsonl."""
+    by best val F1, writes tune_results.jsonl.
+
+    Per-epoch val F1 feeds a median-stop assessor (NNI's early-termination
+    rule, train/tune.py): once enough trials completed, a trial whose best
+    F1 trails the median of completed running-averages is cut short — its
+    record carries ``epochs_run`` < epochs_per_trial."""
     from deepdfa_tpu.models.flowgnn import FlowGNN
     from deepdfa_tpu.train.loop import fit
+    from deepdfa_tpu.train.tune import MedianStopAssessor
 
     cfgs = build_configs(args.config, args.set)
     base_model, base_data, base_train = cfgs["model"], cfgs["data"], cfgs["train"]
@@ -403,6 +434,7 @@ def cmd_tune(args) -> Dict[str, Any]:
     out_path = os.path.join(args.out_dir, "tune_results.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
     open(out_path, "w").close()  # fresh file per run: no stale trials
+    assessor = MedianStopAssessor(warmup_steps=args.assessor_warmup)
     for trial in range(args.trials):
         pick = {k: v[rng.randint(len(v))] for k, v in space.items()}
         model_cfg = dataclasses.replace(
@@ -416,17 +448,29 @@ def cmd_tune(args) -> Dict[str, Any]:
             weight_decay=float(pick["train.weight_decay"]),
             max_epochs=args.epochs_per_trial,
         )
-        _, history = fit(FlowGNN(model_cfg), examples, splits, train_cfg, base_data)
+
+        def on_epoch(epoch, record, trial=trial):
+            assessor.report(trial, record["val_metrics"].get("f1", 0.0))
+            return assessor.should_stop(trial)
+
+        _, history = fit(FlowGNN(model_cfg), examples, splits, train_cfg,
+                         base_data, on_epoch_end=on_epoch)
+        assessor.complete(trial)
         best_f1 = max(
             (e["val_metrics"].get("f1", 0.0) for e in history["epochs"]),
             default=0.0,
         )
         record = {"trial": trial, "params": pick, "best_val_f1": best_f1,
-                  "best_val_loss": history["best_val_loss"]}
+                  "best_val_loss": history["best_val_loss"],
+                  "epochs_run": len(history["epochs"]),
+                  "early_stopped": bool(history.get("early_stopped", False))}
         results.append(record)
         with open(out_path, "a") as f:
             f.write(json.dumps(record) + "\n")
-        logger.info("trial %d: f1=%.4f %s", trial, best_f1, pick)
+        logger.info("trial %d: f1=%.4f epochs=%d%s %s", trial, best_f1,
+                    record["epochs_run"],
+                    " (assessor-stopped)" if record["early_stopped"] else "",
+                    pick)
     best = max(results, key=lambda r: r["best_val_f1"])
     print(json.dumps(best))
     return best
@@ -479,6 +523,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.add_argument("--trials", type=int, default=8)
     p_tune.add_argument("--epochs-per-trial", type=int, default=3)
     p_tune.add_argument("--out-dir", default="runs/tune")
+    p_tune.add_argument("--assessor-warmup", type=int, default=1,
+                        help="epochs before the median-stop assessor may "
+                             "terminate a trial (NNI start_step; with the "
+                             "3-epoch trial default, 1 leaves epochs 2-3 "
+                             "cuttable)")
     p_tune.set_defaults(func=cmd_tune)
 
     args = parser.parse_args(argv)

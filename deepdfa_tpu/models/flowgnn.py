@@ -130,7 +130,11 @@ class FlowGNN(nn.Module):
         # DGL's GatedGraphConv no zero-padding of the input is needed.
         h = feat_embed
 
-        step = GatedGraphStep(
+        # remat: recompute step activations in the backward instead of
+        # saving them — the step is HBM-bound, so this is faster on TPU
+        # (~7% at the published shape) and lighter on memory.
+        step_cls = nn.remat(GatedGraphStep) if cfg.remat_steps else GatedGraphStep
+        step = step_cls(
             cfg.ggnn_hidden,
             dtype=dtype,
             message_impl=cfg.message_impl,

@@ -378,6 +378,7 @@ def fit(
     checkpointer=None,
     log_every: int = 50,
     resume: bool = False,
+    on_epoch_end: Optional[Callable[[int, Dict[str, Any]], bool]] = None,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train to ``max_epochs``, tracking the best state by val loss.
 
@@ -386,6 +387,12 @@ def fit(
     ``resume=True`` continues from the checkpointer's ``last`` snapshot
     (params + opt_state + epoch counter — resume_from_checkpoint,
     reference config_default.yaml:39); a no-op when no snapshot exists.
+
+    ``on_epoch_end(epoch, record) -> bool``: called after each epoch's
+    validation with the history record; returning True stops training
+    (history gains ``early_stopped``). The hook behind intermediate-result
+    reporting and assessor-driven trial termination (the reference's NNI
+    protocol, base_module.py:346 + main_cli.py:110-121).
     """
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
@@ -472,7 +479,7 @@ def fit(
             model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
             use_tile, use_df, state, train_step, eval_step, labels, history,
             best_state, checkpointer, tb_writer, log_every, start_epoch,
-            host, mesh,
+            host, mesh, on_epoch_end,
         )
     finally:
         # close on every exit path: a diverging run (detect_anomaly raise)
@@ -496,6 +503,7 @@ def _fit_epochs(
     model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
     use_tile, use_df, state, train_step, eval_step, labels, history, best_state,
     checkpointer, tb_writer, log_every, start_epoch=0, host=None, mesh=None,
+    on_epoch_end=None,
 ):
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
@@ -572,5 +580,14 @@ def _fit_epochs(
         if checkpointer is not None:
             checkpointer.save_last(state, epoch)
             checkpointer.maybe_save_periodic(state, epoch)
+        if (
+            on_epoch_end is not None
+            and on_epoch_end(epoch, record)
+            and epoch < train_cfg.max_epochs - 1  # stopping after the last
+            # epoch saves nothing and would mislabel a full run as cut short
+        ):
+            history["early_stopped"] = True
+            logger.info("assessor stopped the run at epoch %d", epoch)
+            break
 
     return best_state, history

@@ -1,0 +1,96 @@
+"""Hyperparameter-search service protocol: reporting + assessor.
+
+The reference integrates NNI three ways (all reproduced here):
+- parameter injection into the parsed config
+  (DDFA/code_gnn/main_cli.py:110-121 — here ``DEEPDFA_TUNE_PARAMS`` env
+  injection in cli.build_configs, plus ``nni.get_next_parameter`` when the
+  real service is attached),
+- per-epoch intermediate val-F1 reports (base_module.py:346),
+- a final-result report after fit (main_cli.py:184),
+with NNI's assessor terminating hopeless trials from the intermediate
+stream. The service is not in this image, so :class:`MedianStopAssessor`
+implements the same early-termination rule in-process for the built-in
+random-search tuner, and :class:`TrialReporter` bridges to the real ``nni``
+package when it is importable.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class MedianStopAssessor:
+    """NNI medianstop semantics: terminate a trial at step E when its best
+    intermediate result so far is below the median of the *running averages*
+    (over steps 0..E) of all completed trials.
+
+    ``warmup_steps``: never stop before this many reports (NNI
+    ``start_step``). ``min_trials``: the median is meaningless over too few
+    completed curves. Values are higher-is-better (val F1).
+    """
+
+    def __init__(self, warmup_steps: int = 2, min_trials: int = 3):
+        self.warmup_steps = warmup_steps
+        self.min_trials = min_trials
+        self._running: Dict[object, List[float]] = {}
+        self._completed: List[List[float]] = []
+
+    def report(self, trial_id, value: float) -> None:
+        self._running.setdefault(trial_id, []).append(float(value))
+
+    def complete(self, trial_id) -> None:
+        curve = self._running.pop(trial_id, None)
+        if curve:
+            self._completed.append(curve)
+
+    def should_stop(self, trial_id) -> bool:
+        curve = self._running.get(trial_id, [])
+        step = len(curve)  # reports so far (1-based step count)
+        if step <= self.warmup_steps or len(self._completed) < self.min_trials:
+            return False
+        avgs = [
+            statistics.mean(c[: min(step, len(c))]) for c in self._completed
+        ]
+        return max(curve) < statistics.median(avgs)
+
+
+class TrialReporter:
+    """Intermediate/final result reporting, bridged to the real ``nni``
+    package when the process runs under an NNI trial, else a no-op sink
+    (the in-process tuner reads the assessor directly)."""
+
+    def __init__(self):
+        try:
+            import nni  # not in this image; present under a real service
+
+            self._nni = nni
+        except ImportError:
+            self._nni = None
+
+    @property
+    def attached(self) -> bool:
+        return self._nni is not None
+
+    def intermediate(self, value: float) -> None:
+        if self._nni is not None:
+            self._nni.report_intermediate_result(float(value))
+
+    def final(self, value: float) -> None:
+        if self._nni is not None:
+            self._nni.report_final_result(float(value))
+
+
+def nni_next_parameters() -> Optional[Dict]:
+    """``nni.get_next_parameter()`` when attached (main_cli.py:110-121);
+    None otherwise — callers fall back to DEEPDFA_TUNE_PARAMS/env."""
+    try:
+        import nni
+
+        params = nni.get_next_parameter()
+        return dict(params) if params else None
+    except ImportError:
+        return None

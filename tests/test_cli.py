@@ -277,3 +277,71 @@ def test_cli_profile_jsonl_records(tmp_path, capsys):
         str(tmp_path / "prof" / "timedata.jsonl"),
     ])
     assert agg["gflops_per_example"] > 0 and agg["ms_per_example"] > 0
+
+
+def test_median_stop_assessor_semantics():
+    """NNI medianstop: a trial stops when its best-so-far falls below the
+    median of completed trials' running averages at the same step — never
+    during warmup, never before min_trials curves completed."""
+    from deepdfa_tpu.train.tune import MedianStopAssessor
+
+    a = MedianStopAssessor(warmup_steps=1, min_trials=2)
+    # two completed curves: averages at step 2 are 0.5 and 0.7 -> median 0.6
+    for tid, curve in [("t0", [0.4, 0.6]), ("t1", [0.6, 0.8])]:
+        for v in curve:
+            a.report(tid, v)
+        a.complete(tid)
+    # bad trial: best 0.2 < 0.6 -> stopped once past warmup
+    a.report("bad", 0.1)
+    assert not a.should_stop("bad")  # warmup (1 report)
+    a.report("bad", 0.2)
+    assert a.should_stop("bad")
+    # good trial: best 0.9 >= median -> continues
+    a.report("good", 0.3)
+    a.report("good", 0.9)
+    assert not a.should_stop("good")
+
+
+def test_fit_on_epoch_end_early_stop():
+    """Returning True from the hook stops training and marks the history
+    (the assessor-driven trial-termination path)."""
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+    from deepdfa_tpu.core.config import (DataConfig, FeatureSpec,
+                                         FlowGNNConfig, TrainConfig)
+
+    feat = FeatureSpec(limit_all=20)
+    ex = synthetic_bigvul(32, feat, positive_fraction=0.5, seed=0)
+    for i, e in enumerate(ex):
+        e["label"] = int(np.asarray(e["vuln"]).max())
+        e["id"] = i
+    splits = make_splits(ex, "random", seed=0)
+    seen = []
+    _, hist = fit(
+        FlowGNN(FlowGNNConfig(feature=feat, hidden_dim=8, n_steps=2)),
+        ex, splits, TrainConfig(max_epochs=5),
+        DataConfig(batch_size=16, eval_batch_size=16,
+                   max_nodes_per_graph=64, max_edges_per_node=4),
+        on_epoch_end=lambda e, rec: (seen.append(e), e >= 1)[1],
+    )
+    assert seen == [0, 1]
+    assert len(hist["epochs"]) == 2
+    assert hist["early_stopped"] is True
+
+
+def test_cli_tune_records_assessor_fields(tmp_path):
+    out = str(tmp_path / "tune")
+    main([
+        "tune", "--dataset", "synthetic:32", "--trials", "2",
+        "--epochs-per-trial", "1", "--out-dir", out,
+        "--set", "model.hidden_dim=8", "--set", "model.n_steps=2",
+        "--set", "data.batch_size=16", "--set", "data.eval_batch_size=16",
+    ])
+    recs = [json.loads(l) for l in
+            open(os.path.join(out, "tune_results.jsonl"))]
+    assert len(recs) == 2
+    for r in recs:
+        assert r["epochs_run"] == 1
+        assert r["early_stopped"] is False
