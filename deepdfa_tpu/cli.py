@@ -69,9 +69,14 @@ def _coerce(value: str, field_type: Any):
 
 
 def build_configs(
-    config_files: List[str], overrides: List[str]
+    config_files: List[str], overrides: List[str],
+    inject_service_params: bool = False,
 ) -> Dict[str, Any]:
-    """Layered YAML + key=value overrides -> {"model", "data", "train"}."""
+    """Layered YAML + key=value overrides -> {"model", "data", "train"}.
+
+    ``inject_service_params``: also pull one parameter set from an attached
+    NNI service (nni.get_next_parameter is one-call-per-trial, so only the
+    trial entrypoint — cmd_fit — may set this)."""
     import yaml
 
     def deep_update(dst: Dict, src: Dict) -> None:
@@ -94,12 +99,15 @@ def build_configs(
     # always wins. Order: nni service < DEEPDFA_TUNE_PARAMS env < --set
     # (the reference mutates the parsed config from nni.get_next_parameter,
     # main_cli.py:110-121).
-    from deepdfa_tpu.train.tune import nni_next_parameters
-
     injected: List[str] = []
-    nni_params = nni_next_parameters()
-    if nni_params:
-        injected += [f"{dotted}={value}" for dotted, value in nni_params.items()]
+    if inject_service_params:
+        from deepdfa_tpu.train.tune import nni_next_parameters
+
+        nni_params = nni_next_parameters()
+        if nni_params:
+            injected += [
+                f"{dotted}={value}" for dotted, value in nni_params.items()
+            ]
     env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
     if env_params:
         injected += [
@@ -254,7 +262,7 @@ def cmd_fit(args) -> Dict[str, Any]:
     from deepdfa_tpu.train.loop import fit
     from deepdfa_tpu.train.tune import TrialReporter
 
-    cfgs = build_configs(args.config, args.set)
+    cfgs = build_configs(args.config, args.set, inject_service_params=True)
     model_cfg, data_cfg = cfgs["model"], cfgs["data"]
     train_cfg = cfgs["train"]
     # One run directory for checkpoints, log, and history: CLI flag beats

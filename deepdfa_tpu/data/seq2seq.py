@@ -101,12 +101,56 @@ def read_clone_examples(
     return out
 
 
+def read_defect_examples(path: str, limit: Optional[int] = None):
+    """Defect JSONL ``{idx, code|func, target}`` — the schema our export
+    writes (etl/export.py export_codet5_defect_jsonl) and the reference
+    reads (CodeT5/_utils.py read_defect_examples; ``func`` in the published
+    dumps). Returns (codes, labels, indices)."""
+    codes: List[str] = []
+    labels: List[int] = []
+    indices: List[int] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            if limit is not None and i >= limit:
+                break
+            js = json.loads(line)
+            codes.append(" ".join(str(js.get("code", js.get("func", ""))).split()))
+            labels.append(int(js["target"]))
+            indices.append(int(js.get("idx", i)))
+    return codes, labels, indices
+
+
 READERS: Dict[str, Callable] = {
     "summarize": read_summarize_examples,
     "translate": read_pair_examples,
     "refine": read_pair_examples,
     "concode": read_concode_examples,
 }
+
+_SPLIT_NAMES = {"train": "train", "dev": "valid", "test": "test"}
+
+
+def get_filenames(data_root: str, task: str, sub_task: str, split: str) -> str:
+    """The reference's dataset layout (CodeT5/utils.py get_filenames),
+    with the defect task rooted under ``{root}/defect`` (the reference
+    hardcodes an author-machine path there)."""
+    name = _SPLIT_NAMES.get(split, split)
+    if task == "concode":
+        return f"{data_root}/concode/{'dev' if split == 'dev' else split}.json"
+    if task == "summarize":
+        return f"{data_root}/summarize/{sub_task}/{name}.jsonl"
+    if task == "refine":
+        d = f"{data_root}/refine/{sub_task}"
+        return f"{d}/{name}.buggy-fixed.buggy,{d}/{name}.buggy-fixed.fixed"
+    if task == "translate":
+        d = f"{data_root}/translate"
+        a, b = ("cs", "java") if sub_task == "cs-java" else ("java", "cs")
+        return (f"{d}/{name}.java-cs.txt.{a},{d}/{name}.java-cs.txt.{b}")
+    if task == "clone":
+        return f"{data_root}/clone/{name}.txt"
+    if task == "defect":
+        return f"{data_root}/defect/{name}.jsonl"
+    raise ValueError(f"unknown task {task!r}")
 
 
 def encode_examples(

@@ -10,9 +10,12 @@ python -m deepdfa_tpu.cli fit --config configs/default.yaml \
   --dataset "$DATASET" --set train.max_epochs="${EPOCHS:-5}" \
   --checkpoint-dir runs/perf_deepdfa
 
-echo "== DeepDFA test =="
+echo "== DeepDFA test (with Table-5 profiling) =="
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
-  --dataset "$DATASET" --checkpoint-dir runs/perf_deepdfa --which best
+  --dataset "$DATASET" --checkpoint-dir runs/perf_deepdfa --which best \
+  --profile --time
+python -m deepdfa_tpu.eval.report runs/perf_deepdfa/profiledata.jsonl \
+  runs/perf_deepdfa/timedata.jsonl
 
 echo "== bench =="
 python bench.py

@@ -173,3 +173,18 @@ def test_exp_gen_finetunes_from_pretrained_roberta(tiny_roberta_dir, tmp_path,
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["pretrained"] == path
     assert np.isfinite(out["eval_loss"])
+
+
+def test_pretrained_with_dataset_dir_rejected(tiny_t5_dir, tmp_path):
+    """Hashing-tokenizer ids don't match a checkpoint's vocabulary: the
+    launcher refuses the combination instead of training from scrambled
+    embeddings while recording a pretrained fine-tune."""
+    from deepdfa_tpu.exp import resolve, run_experiment
+
+    path, _ = tiny_t5_dir
+    with pytest.raises(NotImplementedError, match="tokenizer"):
+        run_experiment(
+            resolve("defect", "none", "codet5_small"),
+            data=str(tmp_path), res_dir=str(tmp_path / "res"), tiny=True,
+            pretrained=path,
+        )
