@@ -92,6 +92,82 @@ class HashingT5Tokenizer(HashingCodeTokenizer):
     _n_special = 3
 
 
+class BPETokenizerAdapter:
+    """A trained ``tokenizers`` tokenizer behind the hashing tokenizers'
+    protocol (tokenize / convert_tokens_to_ids + special-token ids), so real
+    BPE assets (etl/tokenizer_train.py output, or HF tokenizer.json) slot
+    into encode_function / encode_function_t5 / seq2seq.encode_examples
+    unchanged."""
+
+    def __init__(self, tok):
+        self._tok = tok
+        self.vocab_size = int(tok.get_vocab_size())
+
+        def tid(*names, default):
+            for n in names:
+                i = tok.token_to_id(n)
+                if i is not None:
+                    return int(i)
+            return default
+
+        # codet5/roberta special-token conventions (SPECIAL_TOKENS in
+        # etl/tokenizer_train.py; HF codebert/codet5 assets use the same).
+        self.pad_token_id = tid("<pad>", "[PAD]", default=0)
+        self.bos_token_id = self.cls_token_id = tid("<s>", "[CLS]", default=1)
+        self.eos_token_id = self.sep_token_id = tid("</s>", "[SEP]", default=2)
+
+    def tokenize(self, text: str) -> List[str]:
+        # No template specials: the encoders add <s>/</s> themselves
+        # (encode_function*, seq2seq.encode_examples expect raw tokens) —
+        # HF tokenizer.json assets ship post-processors that would
+        # otherwise duplicate them.
+        return self._tok.encode(str(text), add_special_tokens=False).tokens
+
+    def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
+        return [int(self._tok.token_to_id(t)) for t in tokens]
+
+
+def load_bpe_tokenizer(path: str) -> BPETokenizerAdapter:
+    """Load trained tokenizer assets: a ``tokenizer.json`` file, a directory
+    containing one, or a directory with the ``<prefix>-vocab.json`` +
+    ``<prefix>-merges.txt`` pair that etl/tokenizer_train.train_bpe writes
+    (the salesforce/codet5 asset layout)."""
+    import os
+
+    from tokenizers import ByteLevelBPETokenizer, Tokenizer
+
+    if os.path.isfile(path):
+        return BPETokenizerAdapter(Tokenizer.from_file(path))
+    tj = os.path.join(path, "tokenizer.json")
+    if os.path.exists(tj):
+        return BPETokenizerAdapter(Tokenizer.from_file(tj))
+    import glob
+
+    # Pair vocab/merges by shared prefix — a directory holding assets for
+    # two tokenizers must not silently mix one's vocab with the other's
+    # merges (ByteLevelBPETokenizer would load the mismatch without error).
+    def prefix(p, suffix):
+        return os.path.basename(p)[: -len(suffix)]
+
+    vocabs = {prefix(p, "vocab.json"): p
+              for p in glob.glob(os.path.join(path, "*vocab.json"))}
+    merges = {prefix(p, "merges.txt"): p
+              for p in glob.glob(os.path.join(path, "*merges.txt"))}
+    pairs = sorted(set(vocabs) & set(merges))
+    if len(pairs) > 1:
+        raise ValueError(
+            f"ambiguous tokenizer assets under {path!r}: prefixes {pairs}"
+        )
+    if pairs:
+        return BPETokenizerAdapter(
+            ByteLevelBPETokenizer(vocabs[pairs[0]], merges[pairs[0]])
+        )
+    raise FileNotFoundError(
+        f"no tokenizer assets under {path!r} (want tokenizer.json or a "
+        "matching *vocab.json + *merges.txt pair)"
+    )
+
+
 def encode_dataset(
     examples: Sequence[Mapping],
     tokenizer,

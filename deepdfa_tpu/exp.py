@@ -154,6 +154,7 @@ def run_experiment(
     tiny: bool = False,
     overrides: Optional[Dict] = None,
     pretrained: Optional[str] = None,
+    tokenizer: Optional[str] = None,
 ) -> Dict:
     """Run one experiment end to end; returns the result record written to
     ``<res_dir>/<task>_<sub_task>_<model_tag>/result.json`` (res_fn,
@@ -184,31 +185,45 @@ def run_experiment(
             f"--pretrained is not wired for task {cfg.task!r} yet "
             "(supported: defect and the generation family)"
         )
-    if pretrained and data != "synthetic":
-        # Dataset directories encode with the hashing tokenizer, whose ids
-        # bear no relation to the BPE vocabulary a checkpoint's embeddings
-        # were trained on — fine-tuning would start from scrambled
-        # embeddings while the record claims a pretrained run. Real-data
-        # fine-tuning needs the checkpoint's tokenizer assets wired into
-        # the encoders first.
+    if pretrained and data != "synthetic" and tokenizer is None:
+        # Without real tokenizer assets, dataset directories encode with
+        # the hashing tokenizer, whose ids bear no relation to the BPE
+        # vocabulary a checkpoint's embeddings were trained on —
+        # fine-tuning would start from scrambled embeddings while the
+        # record claims a pretrained run. Pass --tokenizer with the
+        # checkpoint's assets to combine them.
         raise NotImplementedError(
             "--pretrained with --data <dir> needs the checkpoint's BPE "
-            "tokenizer (the hashing fallback's ids don't match the "
-            "checkpoint vocabulary); synthetic data exercises the "
-            "pretrained plumbing, real data awaits tokenizer assets"
+            "tokenizer (--tokenizer <assets>); the hashing fallback's ids "
+            "don't match the checkpoint vocabulary"
         )
+    tok = None
+    if tokenizer is not None:
+        if data == "synthetic" or cfg.task == "multi_task":
+            # Synthetic data is random ids and multi_task never threads the
+            # tokenizer — recording one the run never used would misstate
+            # how the data was encoded.
+            raise ValueError(
+                "--tokenizer only applies to --data <dir> runs of the "
+                "single tasks; it has no effect here"
+            )
+        from deepdfa_tpu.data.text import load_bpe_tokenizer
+
+        tok = load_bpe_tokenizer(tokenizer)
     if cfg.task == "defect":
-        result = _run_defect(cfg, tcfg, data, tiny, pretrained)
+        result = _run_defect(cfg, tcfg, data, tiny, pretrained, tok)
     elif cfg.task == "clone":
-        result = _run_clone(cfg, tcfg, data, tiny)
+        result = _run_clone(cfg, tcfg, data, tiny, tok)
     elif cfg.task == "multi_task":
         result = _run_multitask(cfg, tcfg, data, tiny)
     else:  # generation family: summarize / translate / refine / concode
-        result = _run_gen(cfg, tcfg, data, tiny, pretrained)
+        result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok)
     result["seconds"] = round(time.time() - t0, 2)
     result["config"] = dataclasses.asdict(cfg)
     if pretrained:
         result["pretrained"] = pretrained
+    if tokenizer:
+        result["tokenizer"] = tokenizer
 
     res_fn = os.path.join(res_dir, run_name, "result.json")
     with open(res_fn, "w") as f:
@@ -220,12 +235,36 @@ def _tokenize_fn(tok):
     return lambda s: tok.convert_tokens_to_ids(tok.tokenize(s))
 
 
+def _check_tok_vocab(tok, vocab: int, pad_id=None, eos_id=None) -> None:
+    """Tokenizer/model compatibility: ids must fit the embedding table AND
+    the special-token conventions must agree — rows are padded with the
+    tokenizer's pad id but masked with the model config's, and the T5
+    classifier pools at the config's eos id, so a convention mismatch
+    (e.g. roberta assets with a codet5 model) trains silently wrong."""
+    if tok is None:
+        return
+    if tok.vocab_size > vocab:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds the model's "
+            f"embedding table ({vocab}) — ids would index out of bounds"
+        )
+    if pad_id is not None and tok.pad_token_id != pad_id:
+        raise ValueError(
+            f"tokenizer pad id {tok.pad_token_id} != model pad id {pad_id}"
+        )
+    if eos_id is not None and tok.eos_token_id != eos_id:
+        raise ValueError(
+            f"tokenizer eos id {tok.eos_token_id} != model eos id {eos_id}"
+        )
+
+
 def _gen_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
-                       pad_id: int, eos_id: int):
+                       pad_id: int, eos_id: int, tok=None):
     """(train, dev) arrays from a CodeT5-format dataset directory
-    (the reference's layout, CodeT5/utils.py get_filenames), encoded with
-    the hashing tokenizer — vocab assets are not redistributable here;
-    etl/tokenizer_train.py produces a real BPE to swap in."""
+    (the reference's layout, CodeT5/utils.py get_filenames). ``tok``:
+    trained BPE assets (--tokenizer); defaults to the hashing tokenizer —
+    vocab assets are not redistributable here; etl/tokenizer_train.py
+    produces a real BPE to swap in."""
     from deepdfa_tpu.data.seq2seq import (
         READERS,
         encode_examples,
@@ -233,7 +272,9 @@ def _gen_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
     )
     from deepdfa_tpu.data.text import HashingT5Tokenizer
 
-    tok = HashingT5Tokenizer(vocab)
+    _check_tok_vocab(tok, vocab, pad_id=pad_id, eos_id=eos_id)
+    if tok is None:
+        tok = HashingT5Tokenizer(vocab)
     out = []
     for split in ("train", "dev"):
         ex = READERS[cfg.task](
@@ -277,7 +318,7 @@ def _load_pretrained_for(cfg, pretrained: str):
     return kind, mcfg, conv
 
 
-def _run_gen(cfg, tcfg, data, tiny, pretrained=None):
+def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None):
     from deepdfa_tpu.train.gen_loop import fit_gen
 
     init_params = None
@@ -312,7 +353,7 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None):
     else:
         train, evald = _gen_data_from_dir(
             cfg, data, vocab, model.cfg.pad_token_id,
-            getattr(model.cfg, "eos_token_id", 2),
+            getattr(model.cfg, "eos_token_id", 2), tok=tok,
         )
         max_tgt = cfg.target_length
     out = fit_gen(model, train, evald, tcfg, max_target_length=max_tgt,
@@ -321,7 +362,7 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None):
             "exact_match": float(out["exact_match"])}
 
 
-def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
+def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None):
     """Defect classification — DefectModel (eos-pooled T5) for codet5 tags,
     encoder classifier otherwise; both train through fit_text.
 
@@ -346,6 +387,9 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
             t5cfg = _t5_config(cfg.model_tag, tiny)
         model = DefectModel(t5cfg)
         vocab, pad_id, style = t5cfg.vocab_size, t5cfg.pad_token_id, "t5"
+        # The T5 classifier pools at the config's eos id, so the tokenizer
+        # must agree on it (checked in _defect_data_from_dir).
+        eos_id = t5cfg.eos_token_id
         ids = rng.randint(3, vocab, size=(n, seq)).astype(np.int32)
         ids[:, -1] = t5cfg.eos_token_id  # single-eos invariant (_utils.py:34)
     else:
@@ -359,6 +403,7 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
             enc = EncoderConfig.tiny() if tiny else EncoderConfig()
         model = LineVul(enc)
         vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
+        eos_id = None  # the encoder classifier pools at [CLS], not eos
         ids = rng.randint(2, vocab, size=(n, seq)).astype(np.int32)
     if data == "synthetic":
         data_d = {
@@ -369,7 +414,8 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
         splits = {"train": np.arange(int(n * 0.8)),
                   "val": np.arange(int(n * 0.8), n)}
     else:
-        data_d, splits = _defect_data_from_dir(cfg, data, vocab, style)
+        data_d, splits = _defect_data_from_dir(cfg, data, vocab, style, tok,
+                                               pad_id=pad_id, eos_id=eos_id)
     _, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id,
                        init_params=init_params)
     return {"best_val_f1": hist["best_val_f1"],
@@ -377,7 +423,7 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None):
 
 
 def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
-                          style: str):
+                          style: str, tok=None, pad_id=None, eos_id=None):
     """Defect train/valid JSONL ({idx, code|func, target} — the schema our
     export writes and the reference reads) into one fit_text data dict with
     train/val split indices."""
@@ -390,7 +436,10 @@ def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
         encode_dataset,
     )
 
-    tok = (HashingT5Tokenizer if style == "t5" else HashingCodeTokenizer)(vocab)
+    _check_tok_vocab(tok, vocab, pad_id=pad_id, eos_id=eos_id)
+    if tok is None:
+        tok = (HashingT5Tokenizer if style == "t5"
+               else HashingCodeTokenizer)(vocab)
     parts = []
     for split in ("train", "dev"):
         codes, labels, idx = read_defect_examples(
@@ -409,7 +458,7 @@ def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
                     "val": np.arange(n_train, n_train + n_dev)}
 
 
-def _run_clone(cfg, tcfg, data, tiny):
+def _run_clone(cfg, tcfg, data, tiny, tok=None):
     if data == "synthetic":
         return _fit_clone_synthetic(cfg, tcfg, tiny)
 
@@ -420,7 +469,10 @@ def _run_clone(cfg, tcfg, data, tiny):
 
     tag = cfg.model_tag if cfg.model_tag.startswith("codet5") else "codet5_base"
     t5cfg = _t5_config(tag, tiny)
-    tok = HashingT5Tokenizer(t5cfg.vocab_size)
+    _check_tok_vocab(tok, t5cfg.vocab_size, pad_id=t5cfg.pad_token_id,
+                     eos_id=t5cfg.eos_token_id)
+    if tok is None:
+        tok = HashingT5Tokenizer(t5cfg.vocab_size)
     # BigCloneBench layout: {root}/clone/{train,valid}.txt index +
     # {root}/clone/data.jsonl code table (CodeT5/utils.py, _utils.py:283-305).
     code_table = os.path.join(data, "clone", "data.jsonl")
@@ -511,6 +563,11 @@ def main(argv=None) -> int:
     parser.add_argument("--pretrained", default=None,
                         help="HF checkpoint dir to fine-tune from "
                              "(from_pretrained parity, run_defect.py:155-158)")
+    parser.add_argument("--tokenizer", default=None,
+                        help="trained tokenizer assets (tokenizer.json or "
+                             "the vocab/merges pair etl/tokenizer_train.py "
+                             "writes) for --data encoding; required to "
+                             "combine --pretrained with --data")
     args = parser.parse_args(argv)
 
     if args.sub_task not in get_sub_tasks(args.task):
@@ -521,6 +578,7 @@ def main(argv=None) -> int:
     result = run_experiment(
         cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,
         overrides=overrides, pretrained=args.pretrained,
+        tokenizer=args.tokenizer,
     )
     print(json.dumps(result))
     return 0

@@ -131,3 +131,113 @@ def test_exp_clone_from_dataset_dir(tmp_path):
         overrides={"max_epochs": 1, "batch_size": 3, "eval_batch_size": 3},
     )
     assert 0.0 <= result["best_f1"] <= 1.0
+
+
+def _train_tiny_bpe(tmp_path, vocab=300):
+    from deepdfa_tpu.etl.tokenizer_train import train_bpe
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "int main ( ) { return 0 ; }\n"
+        "def f ( x ) : return x + 1\n"
+        "var a = b + c ;\n" * 20
+    )
+    out = tmp_path / "bpe"
+    train_bpe([str(corpus)], str(out), vocab_size=vocab, min_frequency=1)
+    return str(out)
+
+
+def test_bpe_tokenizer_adapter_roundtrip(tmp_path):
+    """Trained assets load through both layouts and the adapter exposes the
+    hashing tokenizers' protocol with in-vocab ids."""
+    from deepdfa_tpu.data.text import load_bpe_tokenizer
+
+    path = _train_tiny_bpe(tmp_path)
+    tok = load_bpe_tokenizer(path)
+    ids = tok.convert_tokens_to_ids(tok.tokenize("int main ( ) { return 0 ; }"))
+    assert ids and all(0 <= i < tok.vocab_size for i in ids)
+    assert tok.pad_token_id != tok.eos_token_id
+
+
+def test_exp_tokenizer_vocab_guard(tmp_path):
+    """A tokenizer whose vocab exceeds the model's embedding table is
+    refused (ids would index out of bounds)."""
+    _write_codet5_dir(tmp_path)
+    bpe = _train_tiny_bpe(tmp_path)  # vocab 300 > tiny model's 128
+    cfg = resolve("defect", "none", "codet5_small")
+    with pytest.raises(ValueError, match="vocab"):
+        run_experiment(
+            cfg, data=str(tmp_path), res_dir=str(tmp_path / "res"),
+            tiny=True, tokenizer=bpe,
+            overrides={"max_epochs": 1, "batch_size": 4, "eval_batch_size": 4},
+        )
+
+
+def test_exp_pretrained_with_data_and_tokenizer(tmp_path):
+    """The combination the NotImplementedError points at: a checkpoint plus
+    its tokenizer assets fine-tunes on a real dataset directory."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    _write_codet5_dir(tmp_path)
+    bpe = _train_tiny_bpe(tmp_path, vocab=300)
+    # pad/eos must match the BPE assets' conventions (<pad>=0, </s>=2,
+    # SPECIAL_TOKENS in etl/tokenizer_train.py) — run_experiment's
+    # compatibility check refuses mismatched conventions.
+    hf_cfg = transformers.T5Config(
+        vocab_size=300, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        feed_forward_proj="relu", decoder_start_token_id=0,
+        pad_token_id=0, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    ckpt = tmp_path / "ckpt"
+    transformers.T5ForConditionalGeneration(hf_cfg).save_pretrained(ckpt)
+
+    cfg = resolve("defect", "none", "codet5_small")
+    result = run_experiment(
+        cfg, data=str(tmp_path), res_dir=str(tmp_path / "res"),
+        pretrained=str(ckpt), tokenizer=bpe,
+        overrides={"max_epochs": 1, "batch_size": 4, "eval_batch_size": 4},
+    )
+    assert result["pretrained"] == str(ckpt)
+    assert result["tokenizer"] == bpe
+    assert 0.0 <= result["best_val_f1"] <= 1.0
+
+
+def test_exp_pretrained_with_data_needs_tokenizer(tmp_path):
+    _write_codet5_dir(tmp_path)
+    cfg = resolve("defect", "none", "codet5_small")
+    with pytest.raises(NotImplementedError, match="tokenizer"):
+        run_experiment(
+            cfg, data=str(tmp_path), res_dir=str(tmp_path / "res"),
+            tiny=True, pretrained="/nonexistent",
+        )
+
+
+def test_exp_tokenizer_convention_mismatch_rejected(tmp_path):
+    """Matching vocab SIZE is not enough: a tokenizer whose pad/eos ids
+    disagree with the model config would pad rows the mask treats as real
+    tokens — refused up front."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    _write_codet5_dir(tmp_path)
+    bpe = _train_tiny_bpe(tmp_path, vocab=300)  # pad=0, eos=2
+    hf_cfg = transformers.T5Config(
+        vocab_size=300, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        feed_forward_proj="relu", decoder_start_token_id=0,
+        pad_token_id=0, eos_token_id=1,  # eos disagrees with the assets
+    )
+    torch.manual_seed(0)
+    ckpt = tmp_path / "ckpt_badeos"
+    transformers.T5ForConditionalGeneration(hf_cfg).save_pretrained(ckpt)
+    with pytest.raises(ValueError, match="eos id"):
+        run_experiment(
+            resolve("defect", "none", "codet5_small"),
+            data=str(tmp_path), res_dir=str(tmp_path / "res"),
+            pretrained=str(ckpt), tokenizer=bpe,
+            overrides={"max_epochs": 1, "batch_size": 4,
+                       "eval_batch_size": 4},
+        )
