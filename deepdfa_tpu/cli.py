@@ -386,6 +386,320 @@ def cmd_test(args) -> Dict[str, Any]:
     return report
 
 
+# ---------------------------------------------------------------------------
+# Combined DeepDFA+transformer training (fit-text / test-text)
+# ---------------------------------------------------------------------------
+
+
+def _text_model_and_tokenizer(args, combined: bool, graph_cfg):
+    """(model, tokenizer, pad_id, style, descriptor) for fit-text/test-text.
+
+    Mirrors the reference's model assembly: ``--model linevul`` is
+    linevul_main.py:576-621 (RoBERTa classifier + optional FlowGNN encoder),
+    ``--model codet5`` is run_defect.py:208-246 (DefectModel + optional
+    FlowGNN)."""
+    from deepdfa_tpu.data.text import HashingCodeTokenizer, HashingT5Tokenizer
+
+    gcfg = graph_cfg if combined else None
+    if args.model == "codet5":
+        from deepdfa_tpu.models.t5 import DefectModel, T5Config
+
+        t5cfg = T5Config.tiny() if args.tiny else T5Config.codet5_base()
+        model = DefectModel(t5cfg, graph_config=gcfg)
+        vocab, pad_id, style = t5cfg.vocab_size, t5cfg.pad_token_id, "t5"
+        eos_id = t5cfg.eos_token_id
+        tok_cls = HashingT5Tokenizer
+    else:
+        from deepdfa_tpu.models.linevul import LineVul
+        from deepdfa_tpu.models.transformer import EncoderConfig
+
+        enc = EncoderConfig.tiny() if args.tiny else EncoderConfig()
+        model = LineVul(enc, graph_config=gcfg)
+        vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
+        eos_id = None
+        tok_cls = HashingCodeTokenizer
+    if getattr(args, "tokenizer", None):
+        from deepdfa_tpu.data.text import check_tok_vocab, load_bpe_tokenizer
+
+        tok = load_bpe_tokenizer(args.tokenizer)
+        check_tok_vocab(tok, vocab, pad_id=pad_id, eos_id=eos_id)
+    else:
+        tok = tok_cls(vocab)
+    return model, tok, pad_id, style
+
+
+def _restore_ddfa_encoder(ckpt_dir: str, which: str) -> Dict[str, Any]:
+    """DDFA checkpoint -> init_params for the combined model's ``flowgnn``
+    submodule (main_cli.py:136-144: load the trained graph model, strip
+    head/pooling, graft into the encoder slot)."""
+    import orbax.checkpoint as ocp
+
+    from deepdfa_tpu.train.checkpoint import load_encoder_params
+
+    path = os.path.join(os.path.abspath(ckpt_dir), which)
+    restored = ocp.StandardCheckpointer().restore(path)
+    kept = load_encoder_params(restored["params"])
+    return {"params": {"flowgnn": kept["params"]}}
+
+
+def cmd_fit_text(args) -> Dict[str, Any]:
+    """Train LineVul/CodeT5-defect, optionally combined with the FlowGNN
+    encoder — the reference's one-command combined training
+    (msr_train_combined.sh → linevul_main.py:421-668, run_defect.py:160-246),
+    with ``--ddfa-checkpoint``/``--freeze-graph`` covering the pretrained
+    graph-encoder flow (main_cli.py:136-144)."""
+    import dataclasses as _dc
+
+    from deepdfa_tpu.core.config import TransformerTrainConfig
+    from deepdfa_tpu.data.combined import load_combined_dataset
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.text_loop import (
+        evaluate_text,
+        fit_text,
+        make_text_eval_step,
+    )
+
+    cfgs = build_configs(args.config, args.set)
+    graph_cfg = _dc.replace(cfgs["model"], encoder_mode=True,
+                            label_style="graph")
+    combined = args.graphs is not None
+    run_dir = args.checkpoint_dir
+    log_path, handler = _setup_run_logging(run_dir)
+    with _CrashLog(log_path, handler):
+        tcfg = TransformerTrainConfig(
+            learning_rate=args.learning_rate,
+            max_epochs=args.epochs,
+            batch_size=args.batch_size,
+            eval_batch_size=args.eval_batch_size or args.batch_size,
+            block_size=args.block_size,
+            seed=args.seed,
+        )
+        model, tok, pad_id, style = _text_model_and_tokenizer(
+            args, combined, graph_cfg
+        )
+        data, splits, graphs_by_id = load_combined_dataset(
+            args.dataset, graph_cfg.feature, tok, tcfg.block_size,
+            style=style, graphs=args.graphs, seed=args.seed,
+            split_mode=args.split_mode,
+        )
+        subkeys = subkeys_for(graph_cfg.feature) if combined else None
+        budget = None
+        if combined:
+            from deepdfa_tpu.data.combined import graph_join_and_budget
+
+            graphs_by_id, budget = graph_join_and_budget(
+                list(graphs_by_id.values()),
+                max(tcfg.batch_size, tcfg.eval_batch_size),
+                max_nodes=args.max_nodes, max_edges=args.max_edges,
+            )
+        init_params = None
+        if args.ddfa_checkpoint:
+            if not combined:
+                raise ValueError("--ddfa-checkpoint needs --graphs (the "
+                                 "encoder slot only exists combined)")
+            init_params = _restore_ddfa_encoder(args.ddfa_checkpoint,
+                                                args.which)
+        if args.freeze_graph and not args.ddfa_checkpoint:
+            raise ValueError(
+                "--freeze-graph without --ddfa-checkpoint would freeze a "
+                "random-init encoder (the reference freezes a LOADED one, "
+                "main_cli.py:136-144)"
+            )
+        mesh = None
+        if args.n_devices > 1:
+            from deepdfa_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(n_data=args.n_devices)
+        best_state, history = fit_text(
+            model, data, splits, tcfg, graphs_by_id=graphs_by_id,
+            subkeys=subkeys, graph_budget=budget, init_params=init_params,
+            mesh=mesh, pad_id=pad_id,
+            freeze_submodules=("flowgnn",) if args.freeze_graph else (),
+        )
+        ckpt = CheckpointManager(run_dir)
+        # Params only: the eval-time restore must not depend on the
+        # optimizer tree, whose structure changes with --freeze-graph.
+        ckpt.save_best({"params": best_state.params}, history["best_epoch"],
+                       -history["best_val_f1"])
+        descriptor = {
+            "model": args.model,
+            "tiny": args.tiny,
+            "combined": combined,
+            "block_size": tcfg.block_size,
+            "dataset": args.dataset,
+            "split_mode": args.split_mode,
+            "graphs": args.graphs,
+            "tokenizer": args.tokenizer,
+            "batch_size": max(tcfg.batch_size, tcfg.eval_batch_size),
+            "graph_budget": budget,
+            "graph_config": _dc.asdict(graph_cfg),
+            "seed": args.seed,
+        }
+        with open(os.path.join(run_dir, "model.json"), "w") as f:
+            json.dump(descriptor, f, indent=1)
+        result: Dict[str, Any] = {
+            "best_epoch": history["best_epoch"],
+            "best_val_f1": history["best_val_f1"],
+        }
+        if not args.no_test and len(splits.get("test", ())):
+            import jax
+
+            eval_step = jax.jit(make_text_eval_step(model))
+            test = evaluate_text(
+                eval_step, best_state, data, splits["test"], tcfg,
+                graphs_by_id, subkeys, budget, pad_id=pad_id,
+            )
+            result["test"] = {"loss": test["loss"], **test["metrics"],
+                              "num_missing": test["num_missing"]}
+            _dump_predictions(run_dir, test)
+        with open(os.path.join(run_dir, "history.json"), "w") as f:
+            json.dump(history, f, indent=1)
+        print(json.dumps(result))
+        return result
+
+
+def _dump_predictions(run_dir: str, eval_out: Dict[str, Any],
+                      name: str = "predictions.csv") -> None:
+    """Per-example prediction dump (the reference writes predictions.txt of
+    ``index\\tprob`` rows after --do_test, linevul_main.py:968-987)."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, name)
+    with open(path, "w") as f:
+        f.write("index,prob,label\n")
+        for i, p, l in zip(eval_out["index"], eval_out["probs"],
+                           eval_out["labels"]):
+            f.write(f"{int(i)},{float(p):.6f},{int(l)}\n")
+
+
+def cmd_test_text(args) -> Dict[str, Any]:
+    """Evaluate (and optionally profile) a fit-text checkpoint on the test
+    split — the --do_test-only flow plus the profiling instruments."""
+    import jax
+
+    from deepdfa_tpu.core.config import (
+        FeatureSpec,
+        FlowGNNConfig,
+        TransformerTrainConfig,
+    )
+    from deepdfa_tpu.data.combined import load_combined_dataset
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.text_loop import (
+        evaluate_text,
+        make_text_eval_step,
+        make_text_train_state,
+        text_graph_batches,
+    )
+
+    with open(os.path.join(args.checkpoint_dir, "model.json")) as f:
+        desc = json.load(f)
+    gdict = dict(desc["graph_config"])
+    gdict["feature"] = FeatureSpec(**gdict["feature"])
+    graph_cfg = FlowGNNConfig(**gdict)
+    ns = argparse.Namespace(
+        model=desc["model"], tiny=desc["tiny"],
+        tokenizer=args.tokenizer or desc.get("tokenizer"),
+    )
+    combined = desc["combined"]
+    model, tok, pad_id, style = _text_model_and_tokenizer(ns, combined,
+                                                          graph_cfg)
+    tcfg = TransformerTrainConfig(
+        block_size=desc["block_size"],
+        eval_batch_size=args.eval_batch_size,
+        batch_size=args.eval_batch_size,
+        seed=desc["seed"],
+    )
+    dataset = args.dataset or desc["dataset"]
+    graphs = (args.graphs or desc["graphs"]) if combined else None
+    data, splits, graphs_by_id = load_combined_dataset(
+        dataset, graph_cfg.feature, tok, tcfg.block_size, style=style,
+        graphs=graphs, seed=desc["seed"],
+        # The recorded split protocol: re-splitting differently would leak
+        # fit-time train examples into the reported test metric.
+        split_mode=desc.get("split_mode", "random"),
+    )
+    subkeys = subkeys_for(graph_cfg.feature) if combined else None
+    budget = desc["graph_budget"]
+    source_override = bool(args.dataset or args.graphs)
+    if combined and (source_override
+                     or args.eval_batch_size > desc.get("batch_size", 0)):
+        # The fit-time budget was sized for the fit-time graphs and batch
+        # size; a swapped graph source or bigger eval batch packs more than
+        # it covers. Re-derive so no test graph is dropped (keeping any
+        # larger recorded budget when the source is unchanged).
+        from deepdfa_tpu.data.combined import graph_join_and_budget
+
+        graphs_by_id, rebudget = graph_join_and_budget(
+            list(graphs_by_id.values()),
+            max(desc.get("batch_size", 0), args.eval_batch_size),
+        )
+        budget = (rebudget if source_override
+                  else {k: max(budget[k], rebudget[k]) for k in budget})
+    split_used = "test" if len(splits.get("test", ())) else "val"
+    indices = splits[split_used]
+    example = next(
+        text_graph_batches(data, indices[: tcfg.eval_batch_size],
+                           tcfg.eval_batch_size, graphs_by_id, subkeys,
+                           budget, pad_id=pad_id)
+    )
+    state, _ = make_text_train_state(model, example, tcfg, max_steps=1)
+    restored = CheckpointManager(args.checkpoint_dir).restore(
+        args.which, {"params": state.params}
+    )
+    state = state.replace(params=restored["params"])
+    eval_step = jax.jit(make_text_eval_step(model))
+    res = evaluate_text(eval_step, state, data, indices, tcfg, graphs_by_id,
+                        subkeys, budget, pad_id=pad_id)
+    report: Dict[str, Any] = {"loss": res["loss"], **res["metrics"],
+                              "num_missing": res["num_missing"],
+                              "split": split_used}
+    # Distinct filename: must not clobber the fit-time test predictions
+    # (this run may cover an overridden dataset or the val fallback).
+    _dump_predictions(args.profile_dir or args.checkpoint_dir, res,
+                      name="test_predictions.csv")
+
+    if args.profile or args.time:
+        from deepdfa_tpu.eval.profiling import ProfileRecorder, profile_eval
+        from deepdfa_tpu.eval.report import aggregate_profile, aggregate_time
+
+        out_dir = args.profile_dir or args.checkpoint_dir
+        os.makedirs(out_dir, exist_ok=True)
+        profile_path = (
+            os.path.join(out_dir, "profiledata.jsonl") if args.profile else None
+        )
+        time_path = os.path.join(out_dir, "timedata.jsonl") if args.time else None
+        for p in (profile_path, time_path):
+            if p and os.path.exists(p):
+                os.remove(p)
+        # profile_eval jits over the batch, so hand it pytrees: (ids,
+        # labels, mask, graphs) tuples instead of the host-side TextBatch.
+        # The text arrays stay numpy until each dispatch — materializing
+        # the whole test set on device would OOM real-sized splits.
+        batches = [
+            (np.asarray(b.input_ids), np.asarray(b.labels),
+             np.asarray(b.example_mask), b.graphs)
+            for b in text_graph_batches(data, indices, tcfg.eval_batch_size,
+                                        graphs_by_id, subkeys, budget,
+                                        pad_id=pad_id)
+        ]
+        recorder = ProfileRecorder(profile_path, time_path)
+        summary = profile_eval(
+            lambda b: eval_step(state, *b),
+            batches,
+            state.params,
+            lambda b: int(np.asarray(b[2]).sum()),
+            recorder,
+            n_warmup=min(3, max(len(batches) - 1, 0)),
+        )
+        report["profiling"] = summary
+        if profile_path:
+            report["profiling"].update(aggregate_profile(profile_path))
+        if time_path:
+            report["profiling"].update(aggregate_time(time_path))
+
+    print(json.dumps(report))
+    return report
+
+
 def cmd_analyze(args) -> Dict[str, Any]:
     """Feature coverage: share of definition nodes whose abstract-dataflow
     index is known vs UNKNOWN (index 1) vs not-a-definition (index 0) —
@@ -521,6 +835,60 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="where the JSONL records land (default: "
                              "checkpoint dir)")
     p_test.set_defaults(func=cmd_test)
+
+    # Combined DeepDFA+transformer training: the msr_train_combined.sh /
+    # run_defect.py --flowgnn_* surface.
+    p_ft = sub.add_parser(
+        "fit-text", help="train LineVul/CodeT5-defect, optionally combined "
+                         "with the FlowGNN graph encoder")
+    common(p_ft)
+    p_ft.add_argument("--model", choices=["linevul", "codet5"],
+                      default="linevul")
+    p_ft.add_argument("--graphs", default=None,
+                      help="graph source: synthetic | dbize cache dir | "
+                           "etl export .jsonl (omit for text-only)")
+    p_ft.add_argument("--checkpoint-dir", required=True)
+    p_ft.add_argument("--ddfa-checkpoint", default=None,
+                      help="DDFA (cli fit) run dir; its graph encoder is "
+                           "loaded into the combined model")
+    p_ft.add_argument("--which", default="best",
+                      help="which DDFA checkpoint to load (best|last|epoch_N)")
+    p_ft.add_argument("--freeze-graph", action="store_true",
+                      help="freeze the loaded graph encoder (main_cli.py "
+                           "--freeze_graph)")
+    p_ft.add_argument("--tiny", action="store_true",
+                      help="tiny encoder shapes (smoke tests)")
+    p_ft.add_argument("--tokenizer", default=None,
+                      help="trained BPE assets (defaults to the hashing "
+                           "tokenizer)")
+    p_ft.add_argument("--epochs", type=int, default=10)
+    p_ft.add_argument("--batch-size", type=int, default=16)
+    p_ft.add_argument("--eval-batch-size", type=int, default=None)
+    p_ft.add_argument("--learning-rate", type=float, default=2e-5)
+    p_ft.add_argument("--block-size", type=int, default=512)
+    p_ft.add_argument("--seed", type=int, default=1)
+    p_ft.add_argument("--n-devices", type=int, default=1)
+    p_ft.add_argument("--max-nodes", type=int, default=None,
+                      help="graph batch node budget (default: sized from "
+                           "the data)")
+    p_ft.add_argument("--max-edges", type=int, default=None)
+    p_ft.add_argument("--no-test", action="store_true",
+                      help="skip the post-training test-split evaluation")
+    p_ft.set_defaults(func=cmd_fit_text)
+
+    p_tt = sub.add_parser(
+        "test-text", help="evaluate/profile a fit-text checkpoint")
+    p_tt.add_argument("--checkpoint-dir", required=True)
+    p_tt.add_argument("--which", default="best")
+    p_tt.add_argument("--dataset", default=None,
+                      help="override the dataset recorded at fit time")
+    p_tt.add_argument("--graphs", default=None)
+    p_tt.add_argument("--tokenizer", default=None)
+    p_tt.add_argument("--eval-batch-size", type=int, default=16)
+    p_tt.add_argument("--profile", action="store_true")
+    p_tt.add_argument("--time", action="store_true")
+    p_tt.add_argument("--profile-dir", default=None)
+    p_tt.set_defaults(func=cmd_test_text)
 
     p_an = sub.add_parser("analyze")
     common(p_an)

@@ -1,0 +1,199 @@
+"""Data assembly for the combined DeepDFA+transformer models.
+
+The reference trains the combined model from two inputs joined by example
+id: a text dataset (MSR-style CSVs with ``processed_func``/``func`` code and
+``target`` labels, the row index being the example id —
+LineVul/linevul/linevul_main.py:55-91) and the DDFA graph cache
+(BigVulDatasetLineVDDataModule over the dbize CSVs, linevul_main.py:421-475 /
+CodeT5/run_defect.py:160-205). This module loads either side from any of the
+framework's sources and hands ``fit_text`` its ``(data, splits,
+graphs_by_id)`` triple.
+
+Graph sources (``load_graph_source``):
+  - ``synthetic[:N]``          generated sample graphs (ids 0..N-1)
+  - ``<dir with nodes.csv>``   the reference pipeline's dbize cache
+                               (etl/legacy_cache.py)
+  - ``<file.jsonl>``           this framework's etl export format
+
+Text sources (``load_combined_dataset``):
+  - ``synthetic[:N]``          C-like functions rendered from the graphs
+                               (data/text.py synthetic_function_text)
+  - ``<dir with train.csv>``   train/val/test CSVs in the MSR layout;
+                               the CSV partition is the fixed split
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from deepdfa_tpu.core.config import FeatureSpec, subkeys_for
+
+
+def read_examples_jsonl(path: str) -> List[Dict]:
+    """Graph examples in the etl export format (one JSON object per line
+    with num_nodes/senders/receivers/vuln/feats[/label/id])."""
+    examples = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            ex = json.loads(line)
+            for key in ("senders", "receivers", "vuln"):
+                ex[key] = np.asarray(ex[key], np.int32)
+            ex["feats"] = {
+                k: np.asarray(v, np.int32) for k, v in ex["feats"].items()
+            }
+            ex.setdefault("id", i)
+            ex.setdefault(
+                "label", int(ex["vuln"].max()) if len(ex["vuln"]) else 0
+            )
+            examples.append(ex)
+    return examples
+
+
+def load_graph_source(
+    spec: str, feature: FeatureSpec, seed: int = 0
+) -> List[Dict]:
+    """Graph examples from a spec string (see module docstring)."""
+    if spec.startswith("synthetic"):
+        from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+        n = int(spec.split(":")[1]) if ":" in spec else 256
+        examples = synthetic_bigvul(n, feature, positive_fraction=0.5,
+                                    seed=seed)
+        for i, ex in enumerate(examples):
+            ex["label"] = int(np.asarray(ex["vuln"]).max())
+            ex["id"] = i
+        return examples
+    if spec.endswith(".jsonl") and os.path.exists(spec):
+        return read_examples_jsonl(spec)
+    if os.path.isdir(spec) and (
+        os.path.exists(os.path.join(spec, "nodes.csv"))
+        or os.path.exists(os.path.join(spec, "nodes_sample.csv"))
+    ):
+        from deepdfa_tpu.etl.legacy_cache import load_reference_cache
+
+        sample = not os.path.exists(os.path.join(spec, "nodes.csv"))
+        return load_reference_cache(spec, feature, sample=sample)
+    raise ValueError(
+        f"unknown graph source {spec!r} (want synthetic[:N], an etl export "
+        ".jsonl, or a dbize cache directory holding nodes.csv)"
+    )
+
+
+def _read_text_csvs(data_dir: str) -> Tuple[List[Dict], Dict[str, List[int]]]:
+    """MSR-layout train/val/test CSVs -> (rows, positions-per-split).
+
+    Column handling mirrors the reference loader (linevul_main.py:64-91):
+    code from ``processed_func`` falling back to ``func``, labels from
+    ``target``, example ids from the frame index.
+    """
+    import pandas as pd
+
+    rows: List[Dict] = []
+    split_pos: Dict[str, List[int]] = {}
+    for split, name in (("train", "train.csv"), ("val", "val.csv"),
+                        ("test", "test.csv")):
+        path = os.path.join(data_dir, name)
+        if not os.path.exists(path):
+            if split == "test":  # test.csv optional: fit-only directories
+                split_pos[split] = []
+                continue
+            raise FileNotFoundError(f"{path} (MSR layout needs {name})")
+        df = pd.read_csv(path, index_col=0)
+        func_key = "processed_func" if "processed_func" in df.columns else "func"
+        pos = []
+        for code, label, idx in zip(df[func_key].tolist(),
+                                    df["target"].astype(int).tolist(),
+                                    df.index.astype(int).tolist()):
+            pos.append(len(rows))
+            rows.append({"code": code, "label": label, "id": idx})
+        split_pos[split] = pos
+    return rows, split_pos
+
+
+def graph_join_and_budget(
+    gexamples: List[Dict], batch_size: int,
+    max_nodes: Optional[int] = None, max_edges: Optional[int] = None,
+) -> Tuple[Dict[int, Dict], Dict[str, int]]:
+    """(graphs_by_id, per-batch node/edge budget) for the combined join.
+
+    The budget doubles the order-preserving ``pad_budget_for`` bound:
+    shuffling regroups batches each epoch, so the exact bound can be
+    exceeded — headroom beats dropping graphs mid-training. Explicit
+    ``max_nodes``/``max_edges`` override the sizing.
+    """
+    from deepdfa_tpu.graphs.batch import pad_budget_for
+
+    graphs_by_id = {int(g["id"]): g for g in gexamples}
+    if max_nodes and max_edges:
+        return graphs_by_id, {"max_nodes": max_nodes, "max_edges": max_edges}
+    ordered = [graphs_by_id[k] for k in sorted(graphs_by_id)]
+    b = pad_budget_for(ordered, batch_size)
+    return graphs_by_id, {
+        "max_nodes": max_nodes or 2 * b["max_nodes"],
+        "max_edges": max_edges or 2 * b["max_edges"],
+    }
+
+
+def load_combined_dataset(
+    dataset: str,
+    feature: FeatureSpec,
+    tokenizer,
+    block_size: int,
+    style: str = "roberta",
+    graphs: Optional[str] = None,
+    seed: int = 0,
+    split_mode: str = "random",
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
+           Optional[Mapping[int, Mapping]]]:
+    """(data, splits, graphs_by_id) for ``fit_text``.
+
+    ``dataset``: ``synthetic[:N]`` (text rendered from generated graphs) or
+    a directory of MSR CSVs. ``graphs``: graph source spec; defaults to the
+    same synthetic graphs for synthetic text, None (text-only) otherwise.
+    """
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.data.text import attach_synthetic_text, encode_dataset
+
+    graphs_by_id = None
+    if dataset.startswith("synthetic"):
+        if graphs is not None and not graphs.startswith("synthetic"):
+            # Synthetic text is rendered FROM the generated graphs; a
+            # foreign graph cache would join on unrelated ids.
+            raise ValueError(
+                "synthetic text pairs only with its own synthetic graphs "
+                "(pass --graphs synthetic or drop it)"
+            )
+        gexamples = load_graph_source(dataset, feature, seed=seed)
+        if graphs is not None:
+            graphs_by_id = {int(g["id"]): g for g in gexamples}
+        rows = attach_synthetic_text(
+            [dict(g) for g in gexamples], seed=seed
+        )
+        splits_ids = make_splits(rows, mode=split_mode, seed=seed)
+        data = encode_dataset(rows, tokenizer, block_size=block_size,
+                              style=style)
+        return data, splits_ids, graphs_by_id
+    if os.path.isdir(dataset):
+        if graphs is not None and graphs.startswith("synthetic"):
+            # Positional synthetic ids (0..N-1) vs the CSVs' arbitrary idx
+            # ids: rows would join to unrelated graphs or mask out.
+            raise ValueError(
+                "a CSV dataset needs its own graph cache (dbize dir or etl "
+                ".jsonl); synthetic graphs join by positional id only"
+            )
+        rows, split_pos = _read_text_csvs(dataset)
+        data = encode_dataset(rows, tokenizer, block_size=block_size,
+                              style=style)
+        splits = {k: np.asarray(v, np.int64) for k, v in split_pos.items()}
+        if graphs is not None:
+            gexamples = load_graph_source(graphs, feature, seed=seed)
+            graphs_by_id = {int(g["id"]): g for g in gexamples}
+        return data, splits, graphs_by_id
+    raise ValueError(
+        f"unknown dataset {dataset!r} (want synthetic[:N] or a directory "
+        "holding train.csv/val.csv[/test.csv])"
+    )

@@ -127,6 +127,29 @@ class BPETokenizerAdapter:
         return [int(self._tok.token_to_id(t)) for t in tokens]
 
 
+def check_tok_vocab(tok, vocab: int, pad_id=None, eos_id=None) -> None:
+    """Tokenizer/model compatibility: ids must fit the embedding table AND
+    the special-token conventions must agree — rows are padded with the
+    tokenizer's pad id but masked with the model config's, and the T5
+    classifier pools at the config's eos id, so a convention mismatch
+    (e.g. roberta assets with a codet5 model) trains silently wrong."""
+    if tok is None:
+        return
+    if tok.vocab_size > vocab:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds the model's "
+            f"embedding table ({vocab}) — ids would index out of bounds"
+        )
+    if pad_id is not None and tok.pad_token_id != pad_id:
+        raise ValueError(
+            f"tokenizer pad id {tok.pad_token_id} != model pad id {pad_id}"
+        )
+    if eos_id is not None and tok.eos_token_id != eos_id:
+        raise ValueError(
+            f"tokenizer eos id {tok.eos_token_id} != model eos id {eos_id}"
+        )
+
+
 def load_bpe_tokenizer(path: str) -> BPETokenizerAdapter:
     """Load trained tokenizer assets: a ``tokenizer.json`` file, a directory
     containing one, or a directory with the ``<prefix>-vocab.json`` +

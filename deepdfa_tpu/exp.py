@@ -155,6 +155,7 @@ def run_experiment(
     overrides: Optional[Dict] = None,
     pretrained: Optional[str] = None,
     tokenizer: Optional[str] = None,
+    flowgnn: Optional[str] = None,
 ) -> Dict:
     """Run one experiment end to end; returns the result record written to
     ``<res_dir>/<task>_<sub_task>_<model_tag>/result.json`` (res_fn,
@@ -210,8 +211,13 @@ def run_experiment(
         from deepdfa_tpu.data.text import load_bpe_tokenizer
 
         tok = load_bpe_tokenizer(tokenizer)
+    if flowgnn and cfg.task != "defect":
+        # The reference threads flowgnn_model only through the defect runner
+        # (run_exp.py:7-16 → run_defect.py:160-246).
+        raise ValueError("--flowgnn only applies to --task defect")
     if cfg.task == "defect":
-        result = _run_defect(cfg, tcfg, data, tiny, pretrained, tok)
+        result = _run_defect(cfg, tcfg, data, tiny, pretrained, tok,
+                             flowgnn=flowgnn)
     elif cfg.task == "clone":
         result = _run_clone(cfg, tcfg, data, tiny, tok)
     elif cfg.task == "multi_task":
@@ -224,6 +230,8 @@ def run_experiment(
         result["pretrained"] = pretrained
     if tokenizer:
         result["tokenizer"] = tokenizer
+    if flowgnn:
+        result["flowgnn"] = flowgnn
 
     res_fn = os.path.join(res_dir, run_name, "result.json")
     with open(res_fn, "w") as f:
@@ -235,27 +243,7 @@ def _tokenize_fn(tok):
     return lambda s: tok.convert_tokens_to_ids(tok.tokenize(s))
 
 
-def _check_tok_vocab(tok, vocab: int, pad_id=None, eos_id=None) -> None:
-    """Tokenizer/model compatibility: ids must fit the embedding table AND
-    the special-token conventions must agree — rows are padded with the
-    tokenizer's pad id but masked with the model config's, and the T5
-    classifier pools at the config's eos id, so a convention mismatch
-    (e.g. roberta assets with a codet5 model) trains silently wrong."""
-    if tok is None:
-        return
-    if tok.vocab_size > vocab:
-        raise ValueError(
-            f"tokenizer vocab {tok.vocab_size} exceeds the model's "
-            f"embedding table ({vocab}) — ids would index out of bounds"
-        )
-    if pad_id is not None and tok.pad_token_id != pad_id:
-        raise ValueError(
-            f"tokenizer pad id {tok.pad_token_id} != model pad id {pad_id}"
-        )
-    if eos_id is not None and tok.eos_token_id != eos_id:
-        raise ValueError(
-            f"tokenizer eos id {tok.eos_token_id} != model eos id {eos_id}"
-        )
+from deepdfa_tpu.data.text import check_tok_vocab as _check_tok_vocab
 
 
 def _gen_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
@@ -362,18 +350,36 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None):
             "exact_match": float(out["exact_match"])}
 
 
-def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None):
+def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
+                flowgnn=None):
     """Defect classification — DefectModel (eos-pooled T5) for codet5 tags,
     encoder classifier otherwise; both train through fit_text.
 
     ``pretrained``: HF checkpoint dir; the converted stack grafts onto the
     fresh init (the reference's from_pretrained flow, run_defect.py:155-158,
     linevul_main.py:605-621) — the task head always trains from scratch.
+
+    ``flowgnn``: graph source spec — activates the DeepDFA-combined model
+    the way ``--flowgnn_data``/``--flowgnn_model`` do in the reference
+    (run_defect.py:160-246): graphs join text rows by example id, rows
+    whose graph is missing are masked.
     """
     import numpy as np
 
     from deepdfa_tpu.train.text_loop import fit_text
 
+    gcfg = None
+    if flowgnn:
+        from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+
+        feature = (FeatureSpec(limit_all=20, limit_subkeys=20) if tiny
+                   else FeatureSpec())
+        gcfg = FlowGNNConfig(
+            feature=feature, encoder_mode=True, label_style="graph",
+            **({"hidden_dim": 4, "n_steps": 2} if tiny else
+               # run_defect.py:215-217 hardcodes hidden 32 / 5 steps.
+               {"hidden_dim": 32, "n_steps": 5}),
+        )
     rng = np.random.RandomState(cfg.seed)
     n, seq = 64, 16
     init_params = None
@@ -385,7 +391,7 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None):
             init_params = {"params": {"t5": conv["params"]}}
         else:
             t5cfg = _t5_config(cfg.model_tag, tiny)
-        model = DefectModel(t5cfg)
+        model = DefectModel(t5cfg, graph_config=gcfg)
         vocab, pad_id, style = t5cfg.vocab_size, t5cfg.pad_token_id, "t5"
         # The T5 classifier pools at the config's eos id, so the tokenizer
         # must agree on it (checked in _defect_data_from_dir).
@@ -401,7 +407,7 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None):
             init_params = {"params": {"roberta": conv["params"]}}
         else:
             enc = EncoderConfig.tiny() if tiny else EncoderConfig()
-        model = LineVul(enc)
+        model = LineVul(enc, graph_config=gcfg)
         vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
         eos_id = None  # the encoder classifier pools at [CLS], not eos
         ids = rng.randint(2, vocab, size=(n, seq)).astype(np.int32)
@@ -416,8 +422,32 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None):
     else:
         data_d, splits = _defect_data_from_dir(cfg, data, vocab, style, tok,
                                                pad_id=pad_id, eos_id=eos_id)
+    graphs_by_id = subkeys = budget = None
+    if flowgnn:
+        from deepdfa_tpu.core.config import subkeys_for
+        from deepdfa_tpu.data.combined import (
+            graph_join_and_budget,
+            load_graph_source,
+        )
+
+        if flowgnn == "synthetic" and data != "synthetic":
+            # Synthetic graph ids are positional (0..N-1); a real dataset's
+            # idx ids would join to nothing and every row would train
+            # masked.
+            raise ValueError(
+                "--flowgnn synthetic only pairs with --data synthetic; "
+                "point --flowgnn at the dataset's graph cache"
+            )
+        spec = (f"synthetic:{len(data_d['labels'])}" if flowgnn == "synthetic"
+                else flowgnn)
+        gexamples = load_graph_source(spec, gcfg.feature, seed=cfg.seed)
+        subkeys = subkeys_for(gcfg.feature)
+        graphs_by_id, budget = graph_join_and_budget(
+            gexamples, max(tcfg.batch_size, tcfg.eval_batch_size)
+        )
     _, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id,
-                       init_params=init_params)
+                       init_params=init_params, graphs_by_id=graphs_by_id,
+                       subkeys=subkeys, graph_budget=budget)
     return {"best_val_f1": hist["best_val_f1"],
             "best_epoch": hist["best_epoch"]}
 
@@ -568,6 +598,11 @@ def main(argv=None) -> int:
                              "the vocab/merges pair etl/tokenizer_train.py "
                              "writes) for --data encoding; required to "
                              "combine --pretrained with --data")
+    parser.add_argument("--flowgnn", default=None,
+                        help="graph source (synthetic | dbize cache dir | "
+                             "etl export .jsonl) activating the DeepDFA-"
+                             "combined defect model (run_defect.py "
+                             "--flowgnn_data/--flowgnn_model)")
     args = parser.parse_args(argv)
 
     if args.sub_task not in get_sub_tasks(args.task):
@@ -578,7 +613,7 @@ def main(argv=None) -> int:
     result = run_experiment(
         cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,
         overrides=overrides, pretrained=args.pretrained,
-        tokenizer=args.tokenizer,
+        tokenizer=args.tokenizer, flowgnn=args.flowgnn,
     )
     print(json.dumps(result))
     return 0

@@ -66,9 +66,10 @@ def make_schedule(cfg: TransformerTrainConfig, max_steps: int) -> optax.Schedule
 
 
 def make_text_optimizer(
-    cfg: TransformerTrainConfig, max_steps: int
+    cfg: TransformerTrainConfig, max_steps: int,
+    freeze_submodules: Tuple[str, ...] = (),
 ) -> optax.GradientTransformation:
-    return optax.chain(
+    tx = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
         optax.adamw(
             make_schedule(cfg, max_steps),
@@ -76,6 +77,29 @@ def make_text_optimizer(
             weight_decay=cfg.weight_decay,
         ),
     )
+    if freeze_submodules:
+        # --freeze_graph semantics (reference main_cli.py:136-144 /
+        # linevul_main.py:595-602 set requires_grad=False on the loaded
+        # encoder): masked zero-updates keep the frozen subtree at its
+        # loaded values while the trainable side keeps full clip+adamw —
+        # the global-norm clip then sees only trainable grads, matching
+        # torch's clip over parameters-with-grad.
+        import flax
+
+        frozen = set(freeze_submodules)
+
+        def labels(params):
+            flat = flax.traverse_util.flatten_dict(params)
+            lab = {
+                k: "frozen" if any(p in frozen for p in k[:2]) else "train"
+                for k in flat
+            }
+            return flax.traverse_util.unflatten_dict(lab)
+
+        tx = optax.multi_transform(
+            {"train": tx, "frozen": optax.set_to_zero()}, labels
+        )
+    return tx
 
 
 def text_graph_batches(
@@ -291,6 +315,7 @@ def make_text_train_state(
     cfg: TransformerTrainConfig,
     max_steps: int,
     init_params: Optional[Any] = None,
+    freeze_submodules: Tuple[str, ...] = (),
 ) -> Tuple[TextTrainState, optax.GradientTransformation]:
     rng = jax.random.PRNGKey(cfg.seed)
     params_rng, dropout_rng = jax.random.split(rng)
@@ -302,7 +327,7 @@ def make_text_train_state(
     )
     if init_params is not None:
         params = _merge_params(params, init_params)
-    tx = make_text_optimizer(cfg, max_steps)
+    tx = make_text_optimizer(cfg, max_steps, freeze_submodules)
     return TextTrainState(jnp.zeros((), jnp.int32), params, tx.init(params), dropout_rng), tx
 
 
@@ -456,8 +481,15 @@ def fit_text(
     init_params: Optional[Any] = None,
     mesh=None,
     pad_id: int = 1,
+    freeze_submodules: Tuple[str, ...] = (),
 ) -> Tuple[TextTrainState, Dict[str, Any]]:
-    """Fine-tune, keeping the best state by val F1 (linevul_main.py:217-242)."""
+    """Fine-tune, keeping the best state by val F1 (linevul_main.py:217-242).
+
+    ``freeze_submodules``: top-level param subtrees (e.g. ``("flowgnn",)``)
+    held at their init/loaded values via masked zero-updates — the
+    ``--freeze_graph`` flow where a pretrained DDFA encoder is loaded with
+    ``load_encoder_params`` and only the text side trains
+    (main_cli.py:136-144)."""
     # ceil: the padded partial batch is a real optimizer step, and the LR
     # schedule must cover it (the reference sizes by len(train_dataloader)).
     steps_per_epoch = max(-(-len(splits["train"]) // cfg.batch_size), 1)
@@ -492,7 +524,8 @@ def fit_text(
     )
     if host is not None:
         example = _assemble_text(example, mesh)
-    state, tx = make_text_train_state(model, example, cfg, max_steps, init_params)
+    state, tx = make_text_train_state(model, example, cfg, max_steps, init_params,
+                                      freeze_submodules=freeze_submodules)
     train_step = make_text_train_step(model, tx, cfg)
     eval_step = make_text_eval_step(model)
     if mesh is not None:

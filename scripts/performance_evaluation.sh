@@ -1,6 +1,7 @@
 #!/bin/bash
 # Three-model evaluation pipeline (reference scripts/performance_evaluation.sh):
-# DeepDFA alone, then the combined transformer variants, then profiling.
+# DeepDFA alone, the combined DeepDFA+LineVul model (its encoder loaded from
+# the DeepDFA run), combined profiling, then the bench.
 set -e
 cd "$(dirname "$0")/.."
 DATASET="${DATASET:-synthetic:256}"
@@ -16,6 +17,18 @@ python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --profile --time
 python -m deepdfa_tpu.eval.report runs/perf_deepdfa/profiledata.jsonl \
   runs/perf_deepdfa/timedata.jsonl
+
+echo "== DeepDFA+LineVul combined (msr_train_combined.sh flow) =="
+python -m deepdfa_tpu.cli fit-text --config configs/default.yaml \
+  --model linevul --dataset "$DATASET" --graphs synthetic \
+  --epochs "${EPOCHS:-5}" --checkpoint-dir runs/perf_combined \
+  --ddfa-checkpoint runs/perf_deepdfa
+
+echo "== combined test (with profiling) =="
+python -m deepdfa_tpu.cli test-text --checkpoint-dir runs/perf_combined \
+  --which best --profile --time
+python -m deepdfa_tpu.eval.report runs/perf_combined/profiledata.jsonl \
+  runs/perf_combined/timedata.jsonl
 
 echo "== bench =="
 python bench.py

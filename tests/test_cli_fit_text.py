@@ -1,0 +1,199 @@
+"""The combined-training launch surface: ``cli fit-text`` / ``test-text``.
+
+Drives the commands themselves (the msr_train_combined.sh →
+linevul_main.py:421-668 and run_defect.py:160-246 user surface), including
+the pretrained-DDFA-encoder load + freeze flow (main_cli.py:136-144).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.cli import main
+
+TINY_GRAPH = [
+    "--set", "model.hidden_dim=4",
+    "--set", "model.n_steps=2",
+    "--set", "model.feature=_ABS_DATAFLOW_datatype_all_limitall_20_limitsubkeys_20",
+]
+
+
+def _last_json(capsys):
+    lines = [l for l in capsys.readouterr().out.strip().splitlines()
+             if l.startswith("{")]
+    return json.loads(lines[-1])
+
+
+def test_fit_text_combined_roundtrip(tmp_path, capsys):
+    run = str(tmp_path / "combined")
+    main([
+        "fit-text", "--model", "linevul", "--dataset", "synthetic:48",
+        "--graphs", "synthetic", "--tiny", "--epochs", "2",
+        "--batch-size", "8", "--block-size", "64",
+        "--checkpoint-dir", run, *TINY_GRAPH,
+    ])
+    result = _last_json(capsys)
+    assert "test" in result and "f1" in result["test"]
+    assert result["test"]["num_missing"] == 0
+    for artifact in ("model.json", "history.json", "predictions.csv", "best"):
+        assert os.path.exists(os.path.join(run, artifact)), artifact
+    with open(os.path.join(run, "predictions.csv")) as f:
+        rows = f.read().strip().splitlines()
+    assert rows[0] == "index,prob,label"
+    assert len(rows) > 1
+
+    # test-text restores the checkpoint and reproduces the test-split loss.
+    main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8"])
+    report = _last_json(capsys)
+    assert report["loss"] == pytest.approx(result["test"]["loss"], rel=1e-5)
+    assert report["f1"] == pytest.approx(result["test"]["f1"], rel=1e-5)
+
+
+def test_fit_text_ddfa_load_and_freeze(tmp_path, capsys):
+    """--ddfa-checkpoint grafts the trained GNN encoder into the combined
+    model; --freeze-graph must keep it bit-identical through training."""
+    import orbax.checkpoint as ocp
+
+    from deepdfa_tpu.train.checkpoint import load_encoder_params
+
+    gnn = str(tmp_path / "gnn")
+    main([
+        "fit", "--dataset", "synthetic:48", "--checkpoint-dir", gnn,
+        "--set", "train.max_epochs=1", "--set", "data.batch_size=16",
+        "--set", "data.eval_batch_size=16", *TINY_GRAPH,
+    ])
+    run = str(tmp_path / "combined")
+    main([
+        "fit-text", "--model", "linevul", "--dataset", "synthetic:48",
+        "--graphs", "synthetic", "--tiny", "--epochs", "2",
+        "--batch-size", "8", "--block-size", "64",
+        "--checkpoint-dir", run, "--ddfa-checkpoint", gnn, "--freeze-graph",
+        *TINY_GRAPH,
+    ])
+    _last_json(capsys)
+
+    ckpt = ocp.StandardCheckpointer()
+    ddfa = ckpt.restore(os.path.join(gnn, "best"))
+    encoder = load_encoder_params(ddfa["params"])["params"]
+    best = ckpt.restore(os.path.join(run, "best"))
+    trained = best["params"]["params"]["flowgnn"]
+    flat_want, flat_got = {}, {}
+
+    def flatten(tree, out, prefix=()):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                flatten(v, out, prefix + (k,))
+            else:
+                out[prefix + (k,)] = v
+
+    flatten(encoder, flat_want)
+    flatten(trained, flat_got)
+    # The checkpoint seeds everything but the pooling/head subtrees, which
+    # the combined encoder re-creates fresh (main_cli.py:141 strips them);
+    # every loaded tensor must come through training bit-identical.
+    assert set(flat_want) < set(flat_got)
+    assert all(k[0] == "pooling" for k in set(flat_got) - set(flat_want))
+    for k in flat_want:
+        np.testing.assert_array_equal(flat_want[k], flat_got[k], err_msg=str(k))
+
+
+def test_fit_text_freeze_requires_checkpoint(tmp_path):
+    with pytest.raises(ValueError, match="freeze"):
+        main([
+            "fit-text", "--dataset", "synthetic:16", "--graphs", "synthetic",
+            "--tiny", "--epochs", "1", "--batch-size", "8",
+            "--block-size", "32", "--checkpoint-dir", str(tmp_path / "x"),
+            "--freeze-graph", *TINY_GRAPH,
+        ])
+
+
+@pytest.mark.slow
+def test_fit_text_codet5_combined(tmp_path, capsys):
+    """run_defect.py --flowgnn_* parity: the CodeT5 defect model trains
+    combined from the same command."""
+    run = str(tmp_path / "codet5")
+    main([
+        "fit-text", "--model", "codet5", "--dataset", "synthetic:32",
+        "--graphs", "synthetic", "--tiny", "--epochs", "1",
+        "--batch-size", "8", "--block-size", "32",
+        "--checkpoint-dir", run, *TINY_GRAPH,
+    ])
+    result = _last_json(capsys)
+    assert "test" in result
+    assert os.path.exists(os.path.join(run, "best"))
+
+
+def test_load_combined_dataset_csv_join(tmp_path):
+    """MSR-layout CSVs + a graph jsonl join by example id; the CSV
+    partition is the fixed split (linevul_main.py:55-91 schema)."""
+    import pandas as pd
+
+    from deepdfa_tpu.core.config import FeatureSpec
+    from deepdfa_tpu.data.combined import load_combined_dataset
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.data.text import HashingCodeTokenizer
+
+    feature = FeatureSpec(limit_all=20, limit_subkeys=20)
+    graphs = synthetic_bigvul(12, feature, positive_fraction=0.5, seed=0)
+    for i, g in enumerate(graphs):
+        g["id"] = 100 + i  # ids are arbitrary, not positional
+        g["label"] = int(np.asarray(g["vuln"]).max())
+    jsonl = tmp_path / "graphs.jsonl"
+    with open(jsonl, "w") as f:
+        for g in graphs:
+            f.write(json.dumps({
+                "id": g["id"], "num_nodes": int(g["num_nodes"]),
+                "senders": np.asarray(g["senders"]).tolist(),
+                "receivers": np.asarray(g["receivers"]).tolist(),
+                "vuln": np.asarray(g["vuln"]).tolist(),
+                "feats": {k: np.asarray(v).tolist()
+                          for k, v in g["feats"].items()},
+            }) + "\n")
+
+    def csv(name, ids):
+        pd.DataFrame(
+            {"processed_func": [f"int f{i}() {{}}" for i in ids],
+             "target": [i % 2 for i in ids]},
+            index=ids,
+        ).to_csv(tmp_path / name)
+
+    csv("train.csv", [100, 101, 102, 103, 104, 105, 106, 107])
+    csv("val.csv", [108, 109])
+    csv("test.csv", [110, 111, 999])  # 999 has no graph
+
+    data, splits, graphs_by_id = load_combined_dataset(
+        str(tmp_path), feature, HashingCodeTokenizer(512), block_size=32,
+        graphs=str(jsonl),
+    )
+    assert len(splits["train"]) == 8
+    assert len(splits["val"]) == 2
+    assert len(splits["test"]) == 3
+    assert data["index"][splits["test"]].tolist() == [110, 111, 999]
+    assert set(graphs_by_id) == set(range(100, 112))
+    assert 999 not in graphs_by_id  # will be masked as missing at batch time
+
+
+def test_make_text_optimizer_freeze_zeroes_updates():
+    import jax.numpy as jnp
+    import optax
+
+    from deepdfa_tpu.core.config import TransformerTrainConfig
+    from deepdfa_tpu.train.text_loop import make_text_optimizer
+
+    params = {"params": {"flowgnn": {"w": jnp.ones(3)},
+                         "roberta": {"w": jnp.ones(3)}}}
+    tx = make_text_optimizer(TransformerTrainConfig(), 10,
+                             freeze_submodules=("flowgnn",))
+    opt_state = tx.init(params)
+    grads = {"params": {"flowgnn": {"w": jnp.full(3, 2.0)},
+                        "roberta": {"w": jnp.full(3, 2.0)}}}
+    new = params
+    for _ in range(3):  # step past the zero-LR start of warmup
+        updates, opt_state = tx.update(grads, opt_state, new)
+        new = optax.apply_updates(new, updates)
+    np.testing.assert_array_equal(
+        np.asarray(new["params"]["flowgnn"]["w"]), np.ones(3)
+    )
+    assert not np.allclose(np.asarray(new["params"]["roberta"]["w"]), 1.0)

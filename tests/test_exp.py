@@ -123,6 +123,26 @@ def test_exp_defect_from_dataset_dir(tmp_path):
     assert 0.0 <= result["best_val_f1"] <= 1.0
 
 
+def test_exp_defect_flowgnn_combined(tmp_path):
+    """--flowgnn activates the DeepDFA-combined defect model
+    (run_defect.py:160-246 --flowgnn_data/--flowgnn_model parity)."""
+    cfg = resolve("defect", "none", "codet5_small")
+    result = run_experiment(
+        cfg, data="synthetic", res_dir=str(tmp_path / "res"), tiny=True,
+        overrides={"max_epochs": 1, "batch_size": 8, "eval_batch_size": 8},
+        flowgnn="synthetic",
+    )
+    assert result["flowgnn"] == "synthetic"
+    assert 0.0 <= result["best_val_f1"] <= 1.0
+
+
+def test_exp_flowgnn_rejected_off_defect(tmp_path):
+    cfg = resolve("summarize", "python", "codet5_small")
+    with pytest.raises(ValueError, match="flowgnn"):
+        run_experiment(cfg, data="synthetic", res_dir=str(tmp_path / "res"),
+                       tiny=True, flowgnn="synthetic")
+
+
 def test_exp_clone_from_dataset_dir(tmp_path):
     _write_codet5_dir(tmp_path)
     cfg = resolve("clone", "none", "codet5_small")
