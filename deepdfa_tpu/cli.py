@@ -459,6 +459,15 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         make_text_eval_step,
     )
 
+    for item in args.set:
+        if not item.startswith("model."):
+            # fit-text's trainer settings come from its own flags
+            # (--epochs/--batch-size/...); silently ignoring a train./data.
+            # --set would train something other than what was asked.
+            raise ValueError(
+                f"fit-text --set only configures the graph encoder "
+                f"(model.*); use the native flags instead of {item!r}"
+            )
     cfgs = build_configs(args.config, args.set)
     graph_cfg = _dc.replace(cfgs["model"], encoder_mode=True,
                             label_style="graph")

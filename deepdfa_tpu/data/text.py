@@ -115,6 +115,7 @@ class BPETokenizerAdapter:
         self.pad_token_id = tid("<pad>", "[PAD]", default=0)
         self.bos_token_id = self.cls_token_id = tid("<s>", "[CLS]", default=1)
         self.eos_token_id = self.sep_token_id = tid("</s>", "[SEP]", default=2)
+        self.unk_token_id = tid("<unk>", "[UNK]", default=None)
 
     def tokenize(self, text: str) -> List[str]:
         # No template specials: the encoders add <s>/</s> themselves
@@ -124,7 +125,27 @@ class BPETokenizerAdapter:
         return self._tok.encode(str(text), add_special_tokens=False).tokens
 
     def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
-        return [int(self._tok.token_to_id(t)) for t in tokens]
+        out = []
+        for t in tokens:
+            i = self._tok.token_to_id(t)
+            if i is None:
+                # Tokens from any source other than this tokenizer's own
+                # tokenize() (or assets missing an unk entry) must not die
+                # with a bare int(None) TypeError.
+                if self.unk_token_id is None:
+                    raise ValueError(
+                        f"token {t!r} is not in the vocabulary and the "
+                        "assets define no unk token"
+                    )
+                i = self.unk_token_id
+            out.append(int(i))
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        """Ids -> text (the reference evals decode predictions for
+        BLEU/CodeBLEU, run_gen.py:115)."""
+        return self._tok.decode(list(int(i) for i in ids),
+                                skip_special_tokens=True)
 
 
 def check_tok_vocab(tok, vocab: int, pad_id=None, eos_id=None) -> None:

@@ -1,0 +1,150 @@
+"""The two sentence/corpus BLEU variants the reference's generation trainer
+evaluates with (CodeT5/run_gen.py:148-154):
+
+- ``smooth_bleu_score``: per-example smoothed BLEU-4 averaged over the dev
+  set — the CodeXGLUE summarization metric (evaluator/smooth_bleu.py:
+  computeMaps + bleuFromMaps over splitPuncts'd lowercase text, each
+  example scored by the MOSES ``score_cooked`` math with +1 smoothing on
+  orders 2-4 and the soft ``min(0, 1-(r+1)/(h+1))`` brevity penalty).
+- ``nmt_bleu``: corpus BLEU-4 with Lin & Och (2004) +1/+1 smoothing and
+  ``exp(1-1/ratio)`` brevity penalty — the tensorflow-nmt ``compute_bleu``
+  behind ``evaluator/bleu.py:_bleu`` used for translate/refine/concode.
+
+Both are re-derived from the published algorithms; parity is pinned by
+hand-computed goldens in tests/test_codebleu.py.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+from typing import List, Sequence
+
+_MIN = sys.float_info.min
+
+# mteval-v11a tokenization (smooth_bleu.py:31-45): join hyphenated line
+# breaks, split out punctuation, isolate periods/commas not flanked by
+# digits, split digit-dash.
+_NORM1 = [(re.compile(p), r) for p, r in (
+    (r"<skipped>", ""),
+    (r"-\n", ""),
+    (r"\n", " "),
+)]
+_NORM2 = [(re.compile(p), r) for p, r in (
+    (r"([\{-\~\[-\` -\&\(-\+\:-\@\/])", r" \1 "),
+    (r"([^0-9])([\.,])", r"\1 \2 "),
+    (r"([\.,])([^0-9])", r" \1 \2"),
+    (r"([0-9])(-)", r"\1 \2 "),
+)]
+_UNESCAPE = [("&quot;", '"'), ("&amp;", "&"), ("&lt;", "<"), ("&gt;", ">")]
+
+
+def split_puncts(line: str) -> str:
+    """computeMaps' pre-tokenization (smooth_bleu.py:160-161)."""
+    return " ".join(re.findall(r"[\w]+|[^\s\w]", line))
+
+
+def mteval_tokenize(s: str) -> List[str]:
+    """``normalize`` (smooth_bleu.py:48-64): NIST mteval-v11a lowercased
+    tokenization."""
+    for pattern, replace in _NORM1:
+        s = pattern.sub(replace, s)
+    for entity, char in _UNESCAPE:
+        s = s.replace(entity, char)
+    s = f" {s} ".lower()
+    for pattern, replace in _NORM2:
+        s = pattern.sub(replace, s)
+    return s.split()
+
+
+def sentence_smooth_bleu(refs: Sequence[str], hyp: str, max_n: int = 4) -> float:
+    """One segment's smoothed BLEU (smooth_bleu.py ``bleu(refs, cand)[0]``):
+    +1 smoothing on orders >= 2, shortest-reference effective length, and
+    the MOSES soft brevity penalty."""
+    ref_tokens = [mteval_tokenize(r) for r in refs]
+    hyp_tokens = mteval_tokenize(hyp)
+
+    max_counts = {}
+    for ref in ref_tokens:
+        for n in range(1, max_n + 1):
+            counts = {}
+            for i in range(len(ref) - n + 1):
+                ng = tuple(ref[i:i + n])
+                counts[ng] = counts.get(ng, 0) + 1
+            for ng, c in counts.items():
+                max_counts[ng] = max(max_counts.get(ng, 0), c)
+
+    log_bleu = 0.0
+    for n in range(1, max_n + 1):
+        guess = max(len(hyp_tokens) - n + 1, 0)
+        counts = {}
+        for i in range(len(hyp_tokens) - n + 1):
+            ng = tuple(hyp_tokens[i:i + n])
+            counts[ng] = counts.get(ng, 0) + 1
+        correct = sum(min(c, max_counts.get(ng, 0)) for ng, c in counts.items())
+        add = 1 if n > 1 else 0
+        log_bleu += math.log(correct + add + _MIN) - math.log(guess + add + _MIN)
+    log_bleu /= max_n
+
+    ref_len = min((len(r) for r in ref_tokens), default=0)
+    log_bleu += min(0.0, 1 - (ref_len + 1) / (len(hyp_tokens) + 1))
+    return math.exp(log_bleu)
+
+
+def smooth_bleu_score(golds: Sequence[str], preds: Sequence[str]) -> float:
+    """Dev-set score (bleuFromMaps semantics): mean per-example smoothed
+    BLEU x 100 over positionally-aligned (gold, pred) pairs, each side
+    first ``splitPuncts``'d and lowercased (computeMaps)."""
+    if not golds:
+        return 0.0
+    total = sum(
+        sentence_smooth_bleu([split_puncts(g.strip().lower())],
+                             split_puncts(p.strip().lower()))
+        for g, p in zip(golds, preds)
+    )
+    return total * 100.0 / len(golds)
+
+
+def nmt_bleu(
+    references: Sequence[Sequence[Sequence[str]]],
+    hypotheses: Sequence[Sequence[str]],
+    max_n: int = 4,
+) -> float:
+    """Corpus BLEU with +1/+1 smoothing on every order (``compute_bleu``
+    with smooth=True, x100 rounded to 2 — the ``_bleu`` file metric)."""
+    matches = [0] * max_n
+    possible = [0] * max_n
+    ref_len = hyp_len = 0
+    for refs, hyp in zip(references, hypotheses):
+        ref_len += min((len(r) for r in refs), default=0)
+        hyp_len += len(hyp)
+        merged = {}
+        for ref in refs:
+            counts = {}
+            for n in range(1, max_n + 1):
+                for i in range(len(ref) - n + 1):
+                    ng = tuple(ref[i:i + n])
+                    counts[ng] = counts.get(ng, 0) + 1
+            for ng, c in counts.items():
+                merged[ng] = max(merged.get(ng, 0), c)
+        counts = {}
+        for n in range(1, max_n + 1):
+            for i in range(len(hyp) - n + 1):
+                ng = tuple(hyp[i:i + n])
+                counts[ng] = counts.get(ng, 0) + 1
+            if len(hyp) - n + 1 > 0:
+                possible[n - 1] += len(hyp) - n + 1
+        for ng, c in counts.items():
+            matches[len(ng) - 1] += min(c, merged.get(ng, 0))
+
+    precisions = [(m + 1.0) / (p + 1.0) for m, p in zip(matches, possible)]
+    geo_mean = (
+        math.exp(sum(math.log(p) for p in precisions) / max_n)
+        if min(precisions) > 0 else 0.0
+    )
+    if ref_len == 0:
+        return 0.0
+    ratio = hyp_len / ref_len
+    bp = 1.0 if ratio > 1.0 else math.exp(1 - 1.0 / max(ratio, 1e-12))
+    return round(100 * geo_mean * bp, 2)

@@ -223,7 +223,8 @@ def run_experiment(
     elif cfg.task == "multi_task":
         result = _run_multitask(cfg, tcfg, data, tiny)
     else:  # generation family: summarize / translate / refine / concode
-        result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok)
+        result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok,
+                          out_dir=os.path.join(res_dir, run_name))
     result["seconds"] = round(time.time() - t0, 2)
     result["config"] = dataclasses.asdict(cfg)
     if pretrained:
@@ -306,7 +307,7 @@ def _load_pretrained_for(cfg, pretrained: str):
     return kind, mcfg, conv
 
 
-def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None):
+def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
     from deepdfa_tpu.train.gen_loop import fit_gen
 
     init_params = None
@@ -344,10 +345,23 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None):
             getattr(model.cfg, "eos_token_id", 2), tok=tok,
         )
         max_tgt = cfg.target_length
+    # BLEU scores over decoded text when the tokenizer can decode (real BPE
+    # assets); over token ids otherwise. CodeBLEU (the concode metric,
+    # run_gen.py:152-154) additionally needs parseable source text.
+    decode_fn = getattr(tok, "decode", None) if tok is not None else None
     out = fit_gen(model, train, evald, tcfg, max_target_length=max_tgt,
-                  init_params=init_params)
-    return {"eval_loss": float(out["eval_loss"]),
-            "exact_match": float(out["exact_match"])}
+                  init_params=init_params, task=cfg.task,
+                  decode_fn=decode_fn, output_dir=out_dir,
+                  codebleu_lang="java" if (cfg.task == "concode"
+                                           and decode_fn) else None)
+    result = {"eval_loss": float(out["eval_loss"]),
+              "exact_match": float(out["exact_match"]),
+              "bleu": float(out["bleu"]),
+              "bleu_em": float(out["bleu_em"]),
+              "best_epoch": int(out["best_epoch"])}
+    if "codebleu" in out:
+        result["codebleu"] = float(out["codebleu"])
+    return result
 
 
 def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
@@ -430,7 +444,7 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
             load_graph_source,
         )
 
-        if flowgnn == "synthetic" and data != "synthetic":
+        if flowgnn.startswith("synthetic") and data != "synthetic":
             # Synthetic graph ids are positional (0..N-1); a real dataset's
             # idx ids would join to nothing and every row would train
             # masked.
@@ -438,8 +452,8 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
                 "--flowgnn synthetic only pairs with --data synthetic; "
                 "point --flowgnn at the dataset's graph cache"
             )
-        spec = (f"synthetic:{len(data_d['labels'])}" if flowgnn == "synthetic"
-                else flowgnn)
+        spec = (f"synthetic:{len(data_d['labels'])}"
+                if flowgnn.startswith("synthetic") else flowgnn)
         gexamples = load_graph_source(spec, gcfg.feature, seed=cfg.seed)
         subkeys = subkeys_for(gcfg.feature)
         graphs_by_id, budget = graph_join_and_budget(

@@ -171,23 +171,81 @@ def _check_host_batch_sizes(cfg: TransformerTrainConfig, host) -> None:
         )
 
 
+def strip_ids(row, pad_id: int, eos_id: int) -> list:
+    """Token ids up to the first eos, pads removed (the ``skip_special_
+    tokens`` slice of the reference's decode, run_gen.py:115)."""
+    out = []
+    for t in row:
+        if t == eos_id:
+            break
+        if t != pad_id:
+            out.append(int(t))
+    return out
+
+
 def exact_match(pred: np.ndarray, target: np.ndarray, pad_id: int, eos_id: int) -> float:
     """Fraction of rows whose generated tokens (up to eos) equal the
     reference target tokens (up to eos)."""
-
-    def strip(row):
-        out = []
-        for t in row:
-            if t == eos_id:
-                break
-            if t != pad_id:
-                out.append(int(t))
-        return out
-
     hits = sum(
-        strip(p) == strip(t) for p, t in zip(pred, target)
+        strip_ids(p, pad_id, eos_id) == strip_ids(t, pad_id, eos_id)
+        for p, t in zip(pred, target)
     )
     return hits / max(len(pred), 1)
+
+
+def _ids_to_text(rows, pad_id: int, eos_id: int, decode_fn=None) -> list:
+    """Decode id rows for the BLEU pipeline. Without a real (invertible)
+    tokenizer the ids themselves become the tokens — n-gram overlap in id
+    space is the same quantity the reference computes over subword text."""
+    stripped = [strip_ids(r, pad_id, eos_id) for r in rows]
+    if decode_fn is not None:
+        return [decode_fn(ids) for ids in stripped]
+    return [" ".join(str(t) for t in ids) for ids in stripped]
+
+
+def bleu_for_task(task: str, gold_texts, pred_texts) -> float:
+    """The dev BLEU the reference selects on (run_gen.py:148-154):
+    summarize scores per-example smoothed BLEU via the CodeXGLUE maps,
+    every other generation task the corpus nmt ``_bleu``."""
+    from deepdfa_tpu.eval.codebleu.smooth_bleu import (
+        nmt_bleu,
+        smooth_bleu_score,
+    )
+
+    if task == "summarize":
+        return smooth_bleu_score(gold_texts, pred_texts)
+    return nmt_bleu([[g.split()] for g in gold_texts],
+                    [p.split() for p in pred_texts])
+
+
+def combine_bleu_em(task: str, bleu: float, em_fraction: float) -> float:
+    """``dev_bleu_em`` (run_gen.py:316-322): summarize selects on BLEU
+    alone, defect on EM alone, everything else on their sum (EM in
+    percent)."""
+    if task == "summarize":
+        return bleu
+    if task == "defect":
+        return em_fraction * 100.0
+    return bleu + em_fraction * 100.0
+
+
+def _make_eval_fns(model: T5Model, max_target_length: int, beam_size: int,
+                   mesh=None) -> Tuple[Callable, Callable]:
+    """Jitted (eval loss, generate) pair — created once per fit so the
+    per-epoch BLEU evals reuse one compilation."""
+    loss_fn = lambda params, s, t: seq2seq_loss(model, params, s, t)
+    gen_fn = lambda params, src: generate(
+        model, params, src, max_len=max_target_length, beam_size=beam_size
+    )
+    if mesh is not None:
+        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+
+        rep, dsh = replicated(mesh), batch_sharding(mesh)
+        return (
+            jax.jit(loss_fn, in_shardings=(rep, dsh, dsh), out_shardings=rep),
+            jax.jit(gen_fn, in_shardings=(rep, dsh), out_shardings=rep),
+        )
+    return jax.jit(loss_fn), jax.jit(gen_fn)
 
 
 def evaluate_gen(
@@ -199,27 +257,21 @@ def evaluate_gen(
     beam_size: int = 1,
     mesh=None,
     host=None,
-) -> Dict[str, float]:
+    return_preds: bool = False,
+    fns: Optional[Tuple[Callable, Callable]] = None,
+) -> Dict[str, Any]:
     """Eval loss over padded batches + generation exact-match (shared by
-    fit_gen and fit_gen_multitask).
+    fit_gen and fit_gen_multitask). ``return_preds`` adds the raw generated
+    id rows (``pred_ids``) for BLEU scoring / prediction dumps. ``fns``:
+    pre-jitted (loss, generate) from ``_make_eval_fns`` — pass them when
+    calling per epoch, or every call re-traces fresh lambdas.
 
     ``mesh``/``host``: dp sharding / multi-controller feeding. Outputs
     replicate, so predictions and metrics are identical on every host."""
     pad_id = model.cfg.pad_token_id
-    loss_fn = lambda params, s, t: seq2seq_loss(model, params, s, t)
-    gen_fn = lambda params, src: generate(
-        model, params, src, max_len=max_target_length, beam_size=beam_size
+    eval_loss_fn, gen = fns or _make_eval_fns(
+        model, max_target_length, beam_size, mesh
     )
-    if mesh is not None:
-        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
-
-        rep, dsh = replicated(mesh), batch_sharding(mesh)
-        eval_loss_fn = jax.jit(loss_fn, in_shardings=(rep, dsh, dsh),
-                               out_shardings=rep)
-        gen = jax.jit(gen_fn, in_shardings=(rep, dsh), out_shardings=rep)
-    else:
-        eval_loss_fn = jax.jit(loss_fn)
-        gen = jax.jit(gen_fn)
     losses, preds = [], []
     for s, t, n_valid in _batches(
         eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
@@ -233,13 +285,30 @@ def evaluate_gen(
         if preds
         else np.zeros((0, max_target_length), np.int32)
     )
-    return {
+    out: Dict[str, Any] = {
         "eval_loss": float(np.mean(losses)) if losses else float("nan"),
         "exact_match": exact_match(
             pred, eval_data["target_ids"][: len(pred)],
             model.cfg.pad_token_id, model.cfg.eos_token_id,
         ),
     }
+    if return_preds:
+        out["pred_ids"] = pred
+    return out
+
+
+def _dump_gen_predictions(output_dir: str, tag: str, pred_texts, gold_texts,
+                          src_texts) -> None:
+    """``.output``/``.gold``/``.src`` prediction files per eval
+    (run_gen.py:106-123 eval_bleu_epoch)."""
+    import os
+
+    os.makedirs(output_dir, exist_ok=True)
+    for suffix, rows in (("output", pred_texts), ("gold", gold_texts),
+                         ("src", src_texts)):
+        with open(os.path.join(output_dir, f"{tag}.{suffix}"), "w") as f:
+            for row in rows:
+                f.write(row.strip() + "\n")
 
 
 def fit_gen(
@@ -252,9 +321,29 @@ def fit_gen(
     init_params: Optional[Any] = None,
     log: Optional[Callable[[str], None]] = None,
     mesh=None,
+    task: str = "",
+    decode_fn: Optional[Callable] = None,
+    output_dir: Optional[str] = None,
+    codebleu_lang: Optional[str] = None,
+    eval_bleu: bool = True,
 ) -> Dict[str, Any]:
-    """Mini run_gen: train, per-epoch eval loss, final generation metric.
-    Returns {"state", "eval_loss", "exact_match"}.
+    """run_gen's training protocol: per-epoch dev eval computing loss (the
+    ppl track) AND generation BLEU+EM, checkpoint selection on the
+    task-dependent ``dev_bleu_em``, early stop only when BOTH tracks have
+    stalled past the patience (run_gen.py:283-356). Returns the BEST state
+    with its epoch's metrics plus the full per-epoch ``history``.
+
+    ``eval_bleu=False`` is the reference's ``--do_eval_bleu`` off mode:
+    only the loss track runs per epoch, the best state is best-ppl
+    (checkpoint-best-ppl), early stop on the loss patience alone, and the
+    generation metrics are computed once on the selected state.
+
+    ``task`` picks the BLEU flavor and the selection combination
+    (bleu_for_task / combine_bleu_em); ``decode_fn`` maps stripped id lists
+    to text for BLEU/dumps (ids score as tokens without it);
+    ``output_dir`` writes per-epoch ``dev_e{N}.output/.gold/.src`` files;
+    ``codebleu_lang`` additionally reports CodeBLEU on the dev predictions
+    (the concode metric, run_gen.py:152-154) — requires ``decode_fn``.
 
     ``mesh``: optional data-parallel mesh — batches shard over the data
     axis, params replicate, GSPMD all-reduces the grads (the jit analog of
@@ -267,6 +356,9 @@ def fit_gen(
     if host is not None and mesh is None:
         raise ValueError("multi-process fit_gen needs an explicit global mesh")
     _check_host_batch_sizes(cfg, host)
+    if codebleu_lang and decode_fn is None:
+        raise ValueError("codebleu_lang needs a decode_fn: CodeBLEU parses "
+                         "source text, not token ids")
     n = len(train_data["source_ids"])
     steps_per_epoch = -(-n // cfg.batch_size)  # ceil: small sets still train
     max_steps = steps_per_epoch * cfg.max_epochs
@@ -280,7 +372,48 @@ def fit_gen(
     )
     step = _jit_gen_step(make_gen_train_step(model, tx, cfg), mesh, cfg)
     pad_id = model.cfg.pad_token_id
+    eos_id = model.cfg.eos_token_id
+    gold_texts = _ids_to_text(eval_data["target_ids"], pad_id, eos_id,
+                              decode_fn)
+    src_texts = _ids_to_text(eval_data["source_ids"], pad_id, eos_id,
+                             decode_fn)
     rng = np.random.RandomState(cfg.seed)
+    eval_fns = _make_eval_fns(model, max_target_length, beam_size, mesh)
+    history: list = []
+    best = {"state": state, "bleu_em": -1.0, "epoch": -1, "record": None}
+    best_loss = float("inf")
+    not_loss_dec = not_bleu_em_inc = 0
+    eval_loss_fn = eval_fns[0]
+
+    def loss_only_eval() -> float:
+        losses = []
+        for s, t, _ in _batches(eval_data, cfg.eval_batch_size,
+                                pad_tail=True, pad_id=pad_id):
+            losses.append(float(eval_loss_fn(
+                state.params, _lift_rows(s, mesh, host),
+                _lift_rows(t, mesh, host))))
+        return float(np.mean(losses)) if losses else float("nan")
+
+    def bleu_eval(cur_state):
+        ev = evaluate_gen(model, cur_state, eval_data, cfg,
+                          max_target_length, beam_size, mesh=mesh, host=host,
+                          return_preds=True, fns=eval_fns)
+        pred_texts = _ids_to_text(ev["pred_ids"], pad_id, eos_id, decode_fn)
+        bleu = bleu_for_task(task, gold_texts[: len(pred_texts)], pred_texts)
+        metrics = {
+            "eval_loss": ev["eval_loss"],
+            "exact_match": ev["exact_match"],
+            "bleu": bleu,
+            "bleu_em": combine_bleu_em(task, bleu, ev["exact_match"]),
+        }
+        if codebleu_lang:
+            from deepdfa_tpu.eval.codebleu import get_codebleu
+
+            metrics["codebleu"] = get_codebleu(
+                gold_texts[: len(pred_texts)], pred_texts, codebleu_lang
+            )["codebleu"]
+        return metrics, pred_texts
+
     for epoch in range(cfg.max_epochs):
         losses = []
         for src, tgt, _ in _batches(
@@ -290,12 +423,66 @@ def fit_gen(
                 state, _lift_rows(src, mesh, host), _lift_rows(tgt, mesh, host)
             )
             losses.append(loss)
+        record = {"epoch": epoch,
+                  "train_loss": float(np.mean(jax.device_get(losses)))}
+        if eval_bleu:
+            metrics, pred_texts = bleu_eval(state)
+            record.update(metrics)
+            if output_dir and (host is None or host[0] == 0):
+                _dump_gen_predictions(output_dir, f"dev_e{epoch}", pred_texts,
+                                      gold_texts[: len(pred_texts)],
+                                      src_texts[: len(pred_texts)])
+        else:
+            record["eval_loss"] = loss_only_eval()
+        history.append(record)
         if log:
-            log(f"epoch {epoch}: train_loss={float(np.mean(jax.device_get(losses))):.4f}")
+            log(f"epoch {epoch}: " + " ".join(
+                f"{k}={v:.4f}" for k, v in record.items()
+                if k != "epoch" and isinstance(v, float)))
+        # Two independent stall counters; a trailing epoch must beat BOTH
+        # to keep training past the patience (run_gen.py:283-356). Without
+        # the bleu track, best-ppl selects and the loss patience alone
+        # stops.
+        if record["eval_loss"] < best_loss:
+            best_loss, not_loss_dec = record["eval_loss"], 0
+            if not eval_bleu:
+                best = {"state": state, "bleu_em": -1.0, "epoch": epoch,
+                        "record": record}
+        else:
+            not_loss_dec += 1
+        if eval_bleu:
+            if record["bleu_em"] > best["bleu_em"]:
+                best = {"state": state, "bleu_em": record["bleu_em"],
+                        "epoch": epoch, "record": record}
+                not_bleu_em_inc = 0
+            else:
+                not_bleu_em_inc += 1
+        if (cfg.early_stop_patience is not None
+                and not_loss_dec > cfg.early_stop_patience
+                and (not eval_bleu
+                     or not_bleu_em_inc > cfg.early_stop_patience)):
+            if log:
+                log(f"early stop at epoch {epoch} (best {best['epoch']})")
+            break
 
-    ev = evaluate_gen(model, state, eval_data, cfg, max_target_length, beam_size,
-                      mesh=mesh, host=host)
-    return {"state": state, **ev}
+    r = dict(best["record"] or {"eval_loss": float("nan")})
+    if "bleu" not in r:
+        # Loss-only selection: generation metrics computed once on the
+        # selected state (the reference's final eval_bleu_epoch on the
+        # loaded best checkpoint).
+        metrics, pred_texts = bleu_eval(best["state"])
+        r.update(metrics, eval_loss=r.get("eval_loss", metrics["eval_loss"]))
+        if output_dir and (host is None or host[0] == 0):
+            _dump_gen_predictions(output_dir, "dev_best", pred_texts,
+                                  gold_texts[: len(pred_texts)],
+                                  src_texts[: len(pred_texts)])
+    out = {"state": best["state"], "best_epoch": best["epoch"],
+           "history": history, "eval_loss": r["eval_loss"],
+           "exact_match": r["exact_match"], "bleu": r["bleu"],
+           "bleu_em": r["bleu_em"]}
+    if "codebleu" in r:
+        out["codebleu"] = r["codebleu"]
+    return out
 
 
 def _jit_gen_step(step_fn, mesh, cfg):

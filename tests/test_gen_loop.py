@@ -85,9 +85,14 @@ def test_fit_gen_learns_copy_task():
     tcfg = TransformerTrainConfig(
         learning_rate=1e-3, max_epochs=500, batch_size=8, eval_batch_size=8
     )
-    out = fit_gen(model, data, data, tcfg, max_target_length=8)
+    # eval_bleu=False: loss-only epochs (generating every one of the 500
+    # epochs is the --do_eval_bleu mode, covered by the selection tests);
+    # the best-ppl state still gets the final generation metrics.
+    out = fit_gen(model, data, data, tcfg, max_target_length=8,
+                  eval_bleu=False)
     assert out["eval_loss"] < 1.5, out
     assert out["exact_match"] >= 0.75, out
+    assert out["bleu"] > 0.0  # id-token BLEU on the memorized rows
 
 
 def test_fit_gen_on_mesh_matches_single_device():
@@ -112,3 +117,119 @@ def test_fit_gen_on_mesh_matches_single_device():
                       mesh=make_mesh(n_data=jax.device_count()))
     np.testing.assert_allclose(single["eval_loss"], sharded["eval_loss"],
                                rtol=1e-4)
+
+
+def test_bleu_hand_goldens():
+    """Hand-derived values pin both BLEU flavors (the selection metrics).
+
+    ref [a b c d] vs hyp [a b c e]:
+      clipped matches by order 3/2/1/0 over guesses 4/3/2/1.
+    Smoothed sentence BLEU (smooth_bleu.py score_cooked, +1 on orders>=2,
+    soft BP): exp(mean(ln 3/4, ln 3/4, ln 2/3, ln 1/2)) with BP
+    min(0, 1-5/5)=0.
+    nmt corpus BLEU (+1/+1 every order, BP exp(1-1/ratio)=1 at ratio 1):
+    exp(mean(ln 4/5, ln 3/4, ln 2/3, ln 1/2)).
+    """
+    import math
+
+    from deepdfa_tpu.eval.codebleu.smooth_bleu import (
+        nmt_bleu,
+        sentence_smooth_bleu,
+        smooth_bleu_score,
+    )
+
+    want_smooth = math.exp(
+        (math.log(3 / 4) + math.log(3 / 4) + math.log(2 / 3) + math.log(1 / 2))
+        / 4
+    )
+    got = sentence_smooth_bleu(["a b c d"], "a b c e")
+    np.testing.assert_allclose(got, want_smooth, rtol=1e-12)
+
+    want_nmt = round(100 * math.exp(
+        (math.log(4 / 5) + math.log(3 / 4) + math.log(2 / 3) + math.log(1 / 2))
+        / 4
+    ), 2)
+    got = nmt_bleu([[["a", "b", "c", "d"]]], [["a", "b", "c", "e"]])
+    np.testing.assert_allclose(got, want_nmt, rtol=1e-12)
+
+    # Perfect match scores 100 on both; the corpus score averages per
+    # example for the smooth variant.
+    assert sentence_smooth_bleu(["x y"], "x y") == 1.0
+    np.testing.assert_allclose(
+        smooth_bleu_score(["a b c d", "x y"], ["a b c e", "x y"]),
+        (want_smooth + 1.0) * 100 / 2, rtol=1e-12,
+    )
+    # splitPuncts + lowercase: punctuation splits off, case folds.
+    assert smooth_bleu_score(["Foo(Bar);"], ["foo ( bar ) ;"]) == 100.0
+
+
+def test_combine_bleu_em_reference_rules():
+    from deepdfa_tpu.train.gen_loop import combine_bleu_em
+
+    assert combine_bleu_em("summarize", 40.0, 0.5) == 40.0
+    assert combine_bleu_em("defect", 40.0, 0.5) == 50.0
+    assert combine_bleu_em("translate", 40.0, 0.5) == 90.0  # bleu + em%
+
+
+def test_fit_gen_selects_best_bleu_em_epoch(tmp_path):
+    """The returned state/metrics are the argmax-bleu_em epoch's, the
+    history carries every epoch's bleu/em, and the per-epoch prediction
+    dumps land (run_gen.py:315-347 protocol)."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    data = synthetic_seq2seq(
+        16, vocab_size=32, max_source_length=12, max_target_length=8,
+        seed=0, reverse=False,
+    )
+    tcfg = TransformerTrainConfig(
+        learning_rate=1e-3, max_epochs=4, batch_size=8, eval_batch_size=8
+    )
+    out = fit_gen(T5Model(cfg), data, data, tcfg, max_target_length=8,
+                  task="translate", output_dir=str(tmp_path))
+    hist = out["history"]
+    assert len(hist) == 4
+    assert all("bleu" in h and "bleu_em" in h for h in hist)
+    best = max(hist, key=lambda h: h["bleu_em"])
+    # max picks the first of ties, matching the strict > update rule
+    assert out["best_epoch"] == best["epoch"]
+    assert out["bleu_em"] == best["bleu_em"]
+    assert out["bleu"] == best["bleu"]
+    for suffix in ("output", "gold", "src"):
+        assert (tmp_path / f"dev_e0.{suffix}").exists()
+    gold_lines = (tmp_path / "dev_e0.gold").read_text().strip().splitlines()
+    assert len(gold_lines) == 16
+
+
+def test_fit_gen_dual_patience_early_stop():
+    """Early stop requires BOTH the ppl and bleu_em tracks to stall past
+    the patience (run_gen.py:302-305,349-356): with lr=0 nothing improves
+    after epoch 0, so patience=1 stops after epoch 2."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    data = synthetic_seq2seq(
+        8, vocab_size=32, max_source_length=12, max_target_length=8,
+        seed=0, reverse=False,
+    )
+    tcfg = TransformerTrainConfig(
+        learning_rate=0.0, max_epochs=10, batch_size=8, eval_batch_size=8,
+        early_stop_patience=1,
+    )
+    out = fit_gen(T5Model(cfg), data, data, tcfg, max_target_length=8,
+                  task="translate")
+    # epoch 0 sets both bests; epochs 1 and 2 stall both counters past 1.
+    assert len(out["history"]) == 3
+    assert out["best_epoch"] == 0
+
+
+def test_fit_gen_codebleu_requires_decode():
+    import pytest
+
+    cfg = T5Config.tiny(vocab_size=32)
+    data = synthetic_seq2seq(8, vocab_size=32, max_source_length=8,
+                             max_target_length=8, seed=0)
+    with pytest.raises(ValueError, match="decode_fn"):
+        fit_gen(T5Model(cfg), data, data,
+                TransformerTrainConfig(max_epochs=1, batch_size=8),
+                codebleu_lang="java")
