@@ -459,11 +459,18 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         make_text_eval_step,
     )
 
-    for item in args.set:
+    injected = [
+        f"{k}={v}"
+        for k, v in json.loads(
+            os.environ.get("DEEPDFA_TUNE_PARAMS", "{}")
+        ).items()
+    ]
+    for item in injected + list(args.set):
         if not item.startswith("model."):
             # fit-text's trainer settings come from its own flags
             # (--epochs/--batch-size/...); silently ignoring a train./data.
-            # --set would train something other than what was asked.
+            # override — explicit or DEEPDFA_TUNE_PARAMS-injected — would
+            # train something other than what was asked.
             raise ValueError(
                 f"fit-text --set only configures the graph encoder "
                 f"(model.*); use the native flags instead of {item!r}"

@@ -179,13 +179,6 @@ def run_experiment(
         tcfg = dataclasses.replace(tcfg, **{k: v})
 
     t0 = time.time()
-    if pretrained and cfg.task in ("clone", "multi_task"):
-        # Refuse rather than silently train from random init while the
-        # result record claims a pretrained fine-tune.
-        raise NotImplementedError(
-            f"--pretrained is not wired for task {cfg.task!r} yet "
-            "(supported: defect and the generation family)"
-        )
     if pretrained and data != "synthetic" and tokenizer is None:
         # Without real tokenizer assets, dataset directories encode with
         # the hashing tokenizer, whose ids bear no relation to the BPE
@@ -200,13 +193,12 @@ def run_experiment(
         )
     tok = None
     if tokenizer is not None:
-        if data == "synthetic" or cfg.task == "multi_task":
-            # Synthetic data is random ids and multi_task never threads the
-            # tokenizer — recording one the run never used would misstate
-            # how the data was encoded.
+        if data == "synthetic":
+            # Synthetic data is random ids — recording a tokenizer the run
+            # never used would misstate how the data was encoded.
             raise ValueError(
-                "--tokenizer only applies to --data <dir> runs of the "
-                "single tasks; it has no effect here"
+                "--tokenizer only applies to --data <dir> runs; it has no "
+                "effect on synthetic data"
             )
         from deepdfa_tpu.data.text import load_bpe_tokenizer
 
@@ -219,9 +211,10 @@ def run_experiment(
         result = _run_defect(cfg, tcfg, data, tiny, pretrained, tok,
                              flowgnn=flowgnn)
     elif cfg.task == "clone":
-        result = _run_clone(cfg, tcfg, data, tiny, tok)
+        result = _run_clone(cfg, tcfg, data, tiny, tok, pretrained=pretrained)
     elif cfg.task == "multi_task":
-        result = _run_multitask(cfg, tcfg, data, tiny)
+        result = _run_multitask(cfg, tcfg, data, tiny, pretrained=pretrained,
+                                tok=tok)
     else:  # generation family: summarize / translate / refine / concode
         result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok,
                           out_dir=os.path.join(res_dir, run_name))
@@ -248,12 +241,16 @@ from deepdfa_tpu.data.text import check_tok_vocab as _check_tok_vocab
 
 
 def _gen_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
-                       pad_id: int, eos_id: int, tok=None):
-    """(train, dev) arrays from a CodeT5-format dataset directory
+                       pad_id: int, eos_id: int, tok=None,
+                       splits=("train", "dev"), source_prefix: str = ""):
+    """Per-split arrays from a CodeT5-format dataset directory
     (the reference's layout, CodeT5/utils.py get_filenames). ``tok``:
     trained BPE assets (--tokenizer); defaults to the hashing tokenizer —
     vocab assets are not redistributable here; etl/tokenizer_train.py
-    produces a real BPE to swap in."""
+    produces a real BPE to swap in. ``source_prefix``: the multi-task
+    "{task} {sub_task}: " marker (_utils.py:24-28)."""
+    import dataclasses as _dc
+
     from deepdfa_tpu.data.seq2seq import (
         READERS,
         encode_examples,
@@ -265,10 +262,12 @@ def _gen_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
     if tok is None:
         tok = HashingT5Tokenizer(vocab)
     out = []
-    for split in ("train", "dev"):
+    for split in splits:
         ex = READERS[cfg.task](
             get_filenames(data_dir, cfg.task, cfg.sub_task, split)
         )
+        if source_prefix:
+            ex = [_dc.replace(e, source=source_prefix + e.source) for e in ex]
         out.append(
             encode_examples(
                 ex, _tokenize_fn(tok), cfg.source_length, cfg.target_length,
@@ -307,6 +306,15 @@ def _load_pretrained_for(cfg, pretrained: str):
     return kind, mcfg, conv
 
 
+def _split_exists(data_dir: str, task: str, sub_task: str, split: str) -> bool:
+    from deepdfa_tpu.data.seq2seq import get_filenames
+
+    return all(
+        os.path.exists(p)
+        for p in get_filenames(data_dir, task, sub_task, split).split(",")
+    )
+
+
 def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
     from deepdfa_tpu.train.gen_loop import fit_gen
 
@@ -335,15 +343,26 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
     else:
         model = build_model(cfg, tiny=tiny, generation=True)
     vocab = model.cfg.vocab_size
+    testd = None
     if data == "synthetic":
         train = _toy_gen_data(64, vocab, cfg.source_length, cfg.target_length, cfg.seed)
         evald = _toy_gen_data(16, vocab, cfg.source_length, cfg.target_length, cfg.seed + 1)
         max_tgt = 8
     else:
-        train, evald = _gen_data_from_dir(
+        splits = ["train", "dev"]
+        # The paper's number comes from the test split evaluated with the
+        # best checkpoint after training (run_gen.py:370-395); read it when
+        # the directory ships one.
+        has_test = _split_exists(data, cfg.task, cfg.sub_task, "test")
+        if has_test:
+            splits.append("test")
+        parts = _gen_data_from_dir(
             cfg, data, vocab, model.cfg.pad_token_id,
             getattr(model.cfg, "eos_token_id", 2), tok=tok,
+            splits=tuple(splits),
         )
+        train, evald = parts[0], parts[1]
+        testd = parts[2] if has_test else None
         max_tgt = cfg.target_length
     # BLEU scores over decoded text when the tokenizer can decode (real BPE
     # assets); over token ids otherwise. CodeBLEU (the concode metric,
@@ -361,6 +380,38 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
               "best_epoch": int(out["best_epoch"])}
     if "codebleu" in out:
         result["codebleu"] = float(out["codebleu"])
+    if testd is not None:
+        from deepdfa_tpu.train.gen_loop import (
+            _ids_to_text,
+            bleu_for_task,
+            evaluate_gen,
+        )
+
+        ev = evaluate_gen(model, out["state"], testd, tcfg, max_tgt,
+                          return_preds=True)
+        pad, eos = model.cfg.pad_token_id, model.cfg.eos_token_id
+        preds = _ids_to_text(ev["pred_ids"], pad, eos, decode_fn)
+        golds = _ids_to_text(testd["target_ids"][: len(preds)], pad, eos,
+                             decode_fn)
+        result["test"] = {
+            "eval_loss": float(ev["eval_loss"]),
+            "exact_match": float(ev["exact_match"]),
+            "bleu": float(bleu_for_task(cfg.task, golds, preds)),
+        }
+        if cfg.task == "concode" and decode_fn:
+            # CodeBLEU is concode's paper-reported test metric
+            # (run_gen.py:152-154,386-391).
+            from deepdfa_tpu.eval.codebleu import get_codebleu
+
+            result["test"]["codebleu"] = float(
+                get_codebleu(golds, preds, "java")["codebleu"]
+            )
+        if out_dir:
+            from deepdfa_tpu.train.gen_loop import _dump_gen_predictions
+
+            srcs = _ids_to_text(testd["source_ids"][: len(preds)], pad, eos,
+                                decode_fn)
+            _dump_gen_predictions(out_dir, "test_best", preds, golds, srcs)
     return result
 
 
@@ -459,11 +510,28 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
         graphs_by_id, budget = graph_join_and_budget(
             gexamples, max(tcfg.batch_size, tcfg.eval_batch_size)
         )
-    _, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id,
-                       init_params=init_params, graphs_by_id=graphs_by_id,
-                       subkeys=subkeys, graph_budget=budget)
-    return {"best_val_f1": hist["best_val_f1"],
-            "best_epoch": hist["best_epoch"]}
+    best_state, hist = fit_text(model, data_d, splits, tcfg, pad_id=pad_id,
+                                init_params=init_params,
+                                graphs_by_id=graphs_by_id,
+                                subkeys=subkeys, graph_budget=budget)
+    result = {"best_val_f1": hist["best_val_f1"],
+              "best_epoch": hist["best_epoch"]}
+    if len(splits.get("test", ())):
+        import jax
+
+        from deepdfa_tpu.train.text_loop import (
+            evaluate_text,
+            make_text_eval_step,
+        )
+
+        ev = evaluate_text(
+            jax.jit(make_text_eval_step(model)), best_state, data_d,
+            splits["test"], tcfg, graphs_by_id, subkeys, budget,
+            pad_id=pad_id,
+        )
+        result["test"] = {"loss": float(ev["loss"]), **ev["metrics"],
+                          "num_missing": int(ev["num_missing"])}
+    return result
 
 
 def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
@@ -484,8 +552,13 @@ def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
     if tok is None:
         tok = (HashingT5Tokenizer if style == "t5"
                else HashingCodeTokenizer)(vocab)
+    splits = ["train", "dev"]
+    if _split_exists(data_dir, "defect", cfg.sub_task, "test"):
+        # The reference tests from the best checkpoint after training
+        # (run_defect.py:418-446) — that number is what the paper reports.
+        splits.append("test")
     parts = []
-    for split in ("train", "dev"):
+    for split in splits:
         codes, labels, idx = read_defect_examples(
             get_filenames(data_dir, "defect", cfg.sub_task, split)
         )
@@ -493,26 +566,52 @@ def _defect_data_from_dir(cfg: ExpConfig, data_dir: str, vocab: int,
                 for c, l, i in zip(codes, labels, idx)]
         parts.append(encode_dataset(rows, tok, block_size=cfg.source_length,
                                     style=style))
-    n_train = len(parts[0]["labels"])
-    n_dev = len(parts[1]["labels"])
-    data_d = {
-        k: np.concatenate([parts[0][k], parts[1][k]]) for k in parts[0]
-    }
-    return data_d, {"train": np.arange(n_train),
-                    "val": np.arange(n_train, n_train + n_dev)}
+    data_d = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+    bounds = np.cumsum([0] + [len(p["labels"]) for p in parts])
+    out = {"train": np.arange(bounds[0], bounds[1]),
+           "val": np.arange(bounds[1], bounds[2])}
+    if len(parts) == 3:
+        out["test"] = np.arange(bounds[2], bounds[3])
+    return data_d, out
 
 
-def _run_clone(cfg, tcfg, data, tiny, tok=None):
+def _clone_model_and_init(cfg, tiny, pretrained):
+    """CloneModel (always T5-stacked, CodeT5/models.py:64-122) with an
+    optional pretrained t5 subtree grafting onto the fresh head
+    (run_clone.py from_pretrained)."""
+    from deepdfa_tpu.models.t5 import CloneModel
+
+    init_params = None
+    if pretrained:
+        from deepdfa_tpu.models.pretrained import load_pretrained
+
+        kind, t5cfg, conv = load_pretrained(pretrained)
+        if kind != "t5":
+            raise ValueError(
+                f"the clone model is T5-stacked and needs a t5 checkpoint; "
+                f"{pretrained} holds {kind}"
+            )
+        init_params = {"params": {"t5": conv["params"]}}
+    else:
+        tag = (cfg.model_tag if cfg.model_tag.startswith("codet5")
+               else "codet5_base")
+        t5cfg = _t5_config(tag, tiny)
+    return CloneModel(t5cfg), t5cfg, init_params
+
+
+def _run_clone(cfg, tcfg, data, tiny, tok=None, pretrained=None):
     if data == "synthetic":
-        return _fit_clone_synthetic(cfg, tcfg, tiny)
+        return _fit_clone_synthetic(cfg, tcfg, tiny, pretrained)
 
     from deepdfa_tpu.data.seq2seq import get_filenames, read_clone_examples
     from deepdfa_tpu.data.text import HashingT5Tokenizer
-    from deepdfa_tpu.models.t5 import CloneModel
-    from deepdfa_tpu.train.clone_loop import encode_clone_pairs, fit_clone
+    from deepdfa_tpu.train.clone_loop import (
+        encode_clone_pairs,
+        evaluate_clone,
+        fit_clone,
+    )
 
-    tag = cfg.model_tag if cfg.model_tag.startswith("codet5") else "codet5_base"
-    t5cfg = _t5_config(tag, tiny)
+    model, t5cfg, init_params = _clone_model_and_init(cfg, tiny, pretrained)
     _check_tok_vocab(tok, t5cfg.vocab_size, pad_id=t5cfg.pad_token_id,
                      eos_id=t5cfg.eos_token_id)
     if tok is None:
@@ -522,8 +621,11 @@ def _run_clone(cfg, tcfg, data, tiny, tok=None):
     code_table = os.path.join(data, "clone", "data.jsonl")
     # Each half gets source_length tokens ([N, 2L] pair concat,
     # CodeT5/_utils.py:64-72).
+    splits = ["train", "dev"]
+    if _split_exists(data, "clone", cfg.sub_task, "test"):
+        splits.append("test")
     sets = {}
-    for split in ("train", "dev"):
+    for split in splits:
         pairs = read_clone_examples(
             get_filenames(data, "clone", cfg.sub_task, split), code_table
         )
@@ -531,19 +633,22 @@ def _run_clone(cfg, tcfg, data, tiny, tok=None):
             pairs, _tokenize_fn(tok), cfg.source_length,
             pad_id=t5cfg.pad_token_id, eos_id=t5cfg.eos_token_id,
         )
-    out = fit_clone(CloneModel(t5cfg), sets["train"], sets["dev"], tcfg)
-    return {"best_f1": out["best_f1"], "eval_metrics": out["eval_metrics"]}
+    out = fit_clone(model, sets["train"], sets["dev"], tcfg,
+                    init_params=init_params)
+    result = {"best_f1": out["best_f1"], "eval_metrics": out["eval_metrics"]}
+    if "test" in sets:
+        # run_clone evaluates the test index with the selected state.
+        result["test"] = evaluate_clone(model, out["state"].params,
+                                        sets["test"], tcfg)
+    return result
 
 
-def _fit_clone_synthetic(cfg, tcfg, tiny):
+def _fit_clone_synthetic(cfg, tcfg, tiny, pretrained=None):
     import numpy as np
 
-    from deepdfa_tpu.models.t5 import CloneModel
     from deepdfa_tpu.train.clone_loop import fit_clone
 
-    tag = cfg.model_tag if cfg.model_tag.startswith("codet5") else "codet5_base"
-    t5cfg = _t5_config(tag, tiny)
-    model = CloneModel(t5cfg)
+    model, t5cfg, init_params = _clone_model_and_init(cfg, tiny, pretrained)
     rng = np.random.RandomState(cfg.seed)
     n, seq = 48, 12
 
@@ -556,36 +661,85 @@ def _fit_clone_synthetic(cfg, tcfg, tiny):
     src = np.stack([pair(bool(l)) for l in labels]).astype(np.int32)
     train = {"source_ids": src[: int(n * 0.75)], "labels": labels[: int(n * 0.75)]}
     evald = {"source_ids": src[int(n * 0.75):], "labels": labels[int(n * 0.75):]}
-    out = fit_clone(model, train, evald, tcfg)
+    out = fit_clone(model, train, evald, tcfg, init_params=init_params)
     return {"best_f1": out["best_f1"], "eval_metrics": out["eval_metrics"]}
 
 
-def _run_multitask(cfg, tcfg, data, tiny):
+def _multitask_dir_data(data: str, vocab: int, pad_id: int,
+                        eos_id: int, tok, seed: int):
+    """(task_data, eval_data) dicts from whatever generation tasks a
+    CodeT5-layout directory ships — the run_multi_gen.py data assembly
+    (each task's source carries its "{task} {sub_task}: " prefix,
+    _utils.py:24-28), composed from the single-task readers."""
+    task_data, eval_data = {}, {}
+    for task in ("summarize", "translate", "refine", "concode"):
+        for sub in get_sub_tasks(task):
+            if not (_split_exists(data, task, sub, "train")
+                    and _split_exists(data, task, sub, "dev")):
+                continue
+            sub_cfg = resolve(task, sub, "codet5_small", seed=seed)
+            prefix = (f"{task} {sub}: " if sub != "none" else f"{task}: ")
+            train, dev = _gen_data_from_dir(
+                sub_cfg, data, vocab, pad_id, eos_id, tok=tok,
+                source_prefix=prefix,
+            )
+            name = f"{task}_{sub}" if sub != "none" else task
+            task_data[name], eval_data[name] = train, dev
+    if not task_data:
+        raise ValueError(
+            f"no multi-task training data under {data!r} (want the CodeT5 "
+            "layout: summarize/<lang>/, translate/, refine/<size>/, "
+            "concode/)"
+        )
+    return task_data, eval_data
+
+
+def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None):
     from deepdfa_tpu.train.gen_loop import fit_gen_multitask
 
-    if data != "synthetic":
-        # The reference's multi-task runner has its own sampling/data layout
-        # (run_multi_gen.py); per-task directories load through the single-
-        # task paths above — compose them instead of this launcher shortcut.
-        raise NotImplementedError(
-            "multi_task from a dataset directory: run the single tasks with "
-            "--data and combine with fit_gen_multitask directly"
+    init_params = None
+    if pretrained:
+        from deepdfa_tpu.models.pretrained import load_pretrained
+        from deepdfa_tpu.models.t5 import T5Model
+
+        kind, mcfg, conv = load_pretrained(pretrained)
+        if kind != "t5":
+            raise ValueError(
+                f"multi_task trains the T5 stack and needs a t5 checkpoint; "
+                f"{pretrained} holds {kind}"
+            )
+        model = T5Model(mcfg)
+        init_params = conv  # T5Model IS the converted tree
+    else:
+        tag = (cfg.model_tag if cfg.model_tag.startswith("codet5")
+               else "codet5_small")
+        model = build_model(
+            dataclasses.replace(cfg, model_tag=tag), tiny=tiny,
+            generation=True,
         )
-    tag = cfg.model_tag if cfg.model_tag.startswith("codet5") else "codet5_small"
-    model = build_model(
-        dataclasses.replace(cfg, model_tag=tag), tiny=tiny, generation=True
-    )
     vocab = model.cfg.vocab_size
-    tasks = {
-        name: _toy_gen_data(32, vocab, 16, 8, cfg.seed + i)
-        for i, name in enumerate(("summarize", "translate"))
-    }
-    evals = {
-        name: _toy_gen_data(8, vocab, 16, 8, cfg.seed + 10 + i)
-        for i, name in enumerate(("summarize", "translate"))
-    }
-    out = fit_gen_multitask(model, tasks, evals, tcfg, max_steps=40,
-                            max_target_length=8)
+    if data == "synthetic":
+        tasks = {
+            name: _toy_gen_data(32, vocab, 16, 8, cfg.seed + i)
+            for i, name in enumerate(("summarize", "translate"))
+        }
+        evals = {
+            name: _toy_gen_data(8, vocab, 16, 8, cfg.seed + 10 + i)
+            for i, name in enumerate(("summarize", "translate"))
+        }
+        max_steps, max_tgt = 40, 8
+    else:
+        tasks, evals = _multitask_dir_data(
+            data, vocab, model.cfg.pad_token_id,
+            model.cfg.eos_token_id, tok, cfg.seed,
+        )
+        total = sum(len(t["source_ids"]) for t in tasks.values())
+        epochs = tcfg.max_epochs if tcfg.max_epochs > 0 else 1
+        max_steps = max(epochs * -(-total // tcfg.batch_size), 1)
+        max_tgt = max(t["target_ids"].shape[1] for t in evals.values())
+    out = fit_gen_multitask(model, tasks, evals, tcfg, max_steps=max_steps,
+                            max_target_length=max_tgt,
+                            init_params=init_params)
     return {
         k: v for k, v in out.items()
         if k != "state" and not hasattr(v, "shape")

@@ -115,18 +115,26 @@ def fit_clone(
 
     rng = jax.random.PRNGKey(cfg.seed)
     params_rng, dropout_rng = jax.random.split(rng)
+    params = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        jnp.asarray(train_data["source_ids"][: cfg.batch_size]),
+    )
     if init_params is not None:
-        params = init_params
-    else:
-        params = model.init(
-            {"params": params_rng, "dropout": dropout_rng},
-            jnp.asarray(train_data["source_ids"][: cfg.batch_size]),
-        )
+        # Graft (don't replace): a pretrained tree may cover only the "t5"
+        # subtree while the clone head trains fresh (run_clone.py
+        # from_pretrained); the merge validates keys/shapes.
+        from deepdfa_tpu.train.text_loop import _merge_params
+
+        params = _merge_params(params, init_params)
     tx = make_text_optimizer(cfg, max_steps)
     state = CloneTrainState(jnp.zeros((), jnp.int32), params, tx.init(params),
                             dropout_rng)
+    # No donation: best_state is retained across later epochs' steps, and a
+    # donated state argument would delete its buffers (the fit_text
+    # pattern; donating here crashes the post-training test eval whenever
+    # the best epoch is not the last).
     if mesh is None:
-        step = jax.jit(make_clone_train_step(model, tx, cfg), donate_argnums=(0,))
+        step = jax.jit(make_clone_train_step(model, tx, cfg))
     else:
         # dp over the mesh's data axis (the DataParallel analog for the
         # clone task, reference run_clone.py).
@@ -134,65 +142,22 @@ def fit_clone(
 
         step = jit_dp_step(make_clone_train_step(model, tx, cfg), mesh,
                            n_batch_args=3, n_out=3,
-                           batch_sizes=(cfg.batch_size,))
-    def eval_forward(params, s, l, m):
-        loss, logits = clone_loss(model, params, s, l, m)
-        # softmax on device, inside the jitted program — the host should
-        # only ever see the final probs (one transfer, replicated).
-        return loss, jax.nn.softmax(logits, axis=-1)[:, 1]
-
-    if mesh is None:
-        eval_fn = jax.jit(eval_forward)
-    else:
-        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
-
-        rep, dsh = replicated(mesh), batch_sharding(mesh)
-        eval_fn = jax.jit(
-            eval_forward,
-            in_shardings=(rep, dsh, dsh, dsh), out_shardings=(rep, rep),
-        )
-
-    def batches(data, batch_size, order=None):
-        """Padded tail batch with an example mask: no rows dropped, and
-        small datasets still train (the gen_loop._batches contract)."""
-        idx = np.arange(len(data["source_ids"])) if order is None else order
-        for start in range(0, len(idx), batch_size):
-            sel = idx[start : start + batch_size]
-            src, labels = data["source_ids"][sel], data["labels"][sel]
-            n_valid = len(sel)
-            if n_valid < batch_size:
-                pad = batch_size - n_valid
-                src = np.concatenate(
-                    [src, np.zeros((pad, src.shape[1]), src.dtype)]
-                )
-                labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
-            mask = np.arange(batch_size) < n_valid
-            yield src, labels, mask
+                           batch_sizes=(cfg.batch_size,), donate=())
+    eval_fn = make_clone_eval_fn(model, mesh)
 
     np_rng = np.random.RandomState(cfg.seed)
     best_f1, best_state = -1.0, state
     best_metrics: dict = {}
     for epoch in range(cfg.max_epochs):
         order = np_rng.permutation(n)
-        for src, labels, mask in batches(train_data, cfg.batch_size, order):
+        for src, labels, mask in _clone_batches(train_data, cfg.batch_size, order):
             state, loss, _ = step(
                 state, _lift_rows(src, mesh, host), _lift_rows(labels, mesh, host),
                 _lift_rows(mask, mesh, host),
             )
 
-        stats = BinaryStats.zeros()
-        for src, labels, mask in batches(eval_data, cfg.eval_batch_size):
-            _, probs = eval_fn(
-                state.params, _lift_rows(src, mesh, host),
-                _lift_rows(labels, mesh, host), _lift_rows(mask, mesh, host),
-            )
-            # probs replicate; stats from host-side global labels/mask are
-            # identical on every host.
-            stats = stats + binary_stats(
-                jnp.asarray(np.asarray(probs)), jnp.asarray(labels, jnp.float32),
-                jnp.asarray(mask),
-            )
-        metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
+        metrics = evaluate_clone(model, state.params, eval_data, cfg,
+                                 mesh=mesh, host=host, eval_fn=eval_fn)
         if log:
             log(f"epoch {epoch}: eval_f1={metrics['f1']:.4f}")
         if metrics["f1"] > best_f1:
@@ -200,3 +165,62 @@ def fit_clone(
 
     # eval_metrics describe the returned (best) state, not the last epoch.
     return {"state": best_state, "best_f1": best_f1, "eval_metrics": best_metrics}
+
+
+def make_clone_eval_fn(model: "CloneModel", mesh=None):
+    def eval_forward(params, s, l, m):
+        loss, logits = clone_loss(model, params, s, l, m)
+        # softmax on device, inside the jitted program — the host should
+        # only ever see the final probs (one transfer, replicated).
+        return loss, jax.nn.softmax(logits, axis=-1)[:, 1]
+
+    if mesh is None:
+        return jax.jit(eval_forward)
+    from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+
+    rep, dsh = replicated(mesh), batch_sharding(mesh)
+    return jax.jit(
+        eval_forward,
+        in_shardings=(rep, dsh, dsh, dsh), out_shardings=(rep, rep),
+    )
+
+
+def _clone_batches(data, batch_size, order=None):
+    """Padded tail batch with an example mask: no rows dropped, and
+    small datasets still train (the gen_loop._batches contract)."""
+    idx = np.arange(len(data["source_ids"])) if order is None else order
+    for start in range(0, len(idx), batch_size):
+        sel = idx[start : start + batch_size]
+        src, labels = data["source_ids"][sel], data["labels"][sel]
+        n_valid = len(sel)
+        if n_valid < batch_size:
+            pad = batch_size - n_valid
+            src = np.concatenate(
+                [src, np.zeros((pad, src.shape[1]), src.dtype)]
+            )
+            labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+        mask = np.arange(batch_size) < n_valid
+        yield src, labels, mask
+
+
+def evaluate_clone(model, params, data, cfg, mesh=None, host=None,
+                   eval_fn=None) -> dict:
+    """Binary clone metrics over ``data`` — usable on the dev set per epoch
+    (fit_clone) or on the test split from the selected state (the
+    reference's post-training test eval, run_clone.py)."""
+    from deepdfa_tpu.train.gen_loop import _lift_rows
+
+    eval_fn = eval_fn or make_clone_eval_fn(model, mesh)
+    stats = BinaryStats.zeros()
+    for src, labels, mask in _clone_batches(data, cfg.eval_batch_size):
+        _, probs = eval_fn(
+            params, _lift_rows(src, mesh, host),
+            _lift_rows(labels, mesh, host), _lift_rows(mask, mesh, host),
+        )
+        # probs replicate; stats from host-side global labels/mask are
+        # identical on every host.
+        stats = stats + binary_stats(
+            jnp.asarray(np.asarray(probs)), jnp.asarray(labels, jnp.float32),
+            jnp.asarray(mask),
+        )
+    return {k: float(v) for k, v in compute_metrics(stats).items()}

@@ -370,7 +370,8 @@ def fit_gen(
         max_steps,
         init_params=init_params,
     )
-    step = _jit_gen_step(make_gen_train_step(model, tx, cfg), mesh, cfg)
+    step = _jit_gen_step(make_gen_train_step(model, tx, cfg), mesh, cfg,
+                         donate=False)
     pad_id = model.cfg.pad_token_id
     eos_id = model.cfg.eos_token_id
     gold_texts = _ids_to_text(eval_data["target_ids"], pad_id, eos_id,
@@ -485,13 +486,18 @@ def fit_gen(
     return out
 
 
-def _jit_gen_step(step_fn, mesh, cfg):
+def _jit_gen_step(step_fn, mesh, cfg, donate: bool = True):
+    """``donate=False`` whenever a past state is retained across steps
+    (best-epoch selection): donating the state argument deletes the
+    retained copy's buffers and the final eval crashes with
+    'Array has been deleted' — the fit_text pattern."""
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
     from deepdfa_tpu.parallel.mesh import jit_dp_step
 
     return jit_dp_step(step_fn, mesh, n_batch_args=2, n_out=2,
-                       batch_sizes=(cfg.batch_size,))
+                       batch_sizes=(cfg.batch_size,),
+                       donate=(0,) if donate else ())
 
 
 def task_sampling_probs(sizes: Dict[str, int], alpha: float = 0.7) -> Dict[str, float]:

@@ -63,7 +63,7 @@ def _write_codet5_dir(root):
     import os
 
     os.makedirs(root / "summarize" / "python", exist_ok=True)
-    for split in ("train", "valid"):
+    for split in ("train", "valid", "test"):
         with open(root / "summarize" / "python" / f"{split}.jsonl", "w") as f:
             for i in range(8):
                 f.write(json.dumps({
@@ -74,14 +74,14 @@ def _write_codet5_dir(root):
                 }) + "\n")
 
     os.makedirs(root / "translate", exist_ok=True)
-    for split in ("train", "valid"):
+    for split in ("train", "valid", "test"):
         with open(root / "translate" / f"{split}.java-cs.txt.java", "w") as f:
             f.write("int a = 1 ;\nint b = 2 ;\n")
         with open(root / "translate" / f"{split}.java-cs.txt.cs", "w") as f:
             f.write("var a = 1 ;\nvar b = 2 ;\n")
 
     os.makedirs(root / "defect", exist_ok=True)
-    for split in ("train", "valid"):
+    for split in ("train", "valid", "test"):
         with open(root / "defect" / f"{split}.jsonl", "w") as f:
             for i in range(12):
                 f.write(json.dumps({
@@ -94,7 +94,7 @@ def _write_codet5_dir(root):
     with open(root / "clone" / "data.jsonl", "w") as f:
         for i in range(6):
             f.write(json.dumps({"idx": i, "func": f"int g{i}() {{ return {i}; }}"}) + "\n")
-    for split in ("train", "valid"):
+    for split in ("train", "valid", "test"):
         with open(root / "clone" / f"{split}.txt", "w") as f:
             f.write("0\t1\t1\n2\t3\t0\n4\t5\t1\n")
 
@@ -111,6 +111,13 @@ def test_exp_gen_from_dataset_dir(tmp_path, task, sub):
         overrides={"max_epochs": 1, "batch_size": 4, "eval_batch_size": 4},
     )
     assert "eval_loss" in result and result["eval_loss"] == result["eval_loss"]
+    # The shipped test split is evaluated with the selected state and its
+    # predictions dumped (run_gen.py:370-395).
+    assert "bleu" in result["test"]
+    import os
+    assert os.path.exists(
+        tmp_path / "res" / f"{task}_{sub}_codet5_small" / "test_best.output"
+    )
 
 
 def test_exp_defect_from_dataset_dir(tmp_path):
@@ -121,6 +128,8 @@ def test_exp_defect_from_dataset_dir(tmp_path):
         overrides={"max_epochs": 1, "batch_size": 4, "eval_batch_size": 4},
     )
     assert 0.0 <= result["best_val_f1"] <= 1.0
+    # run_defect.py:418-446: the test file evaluates from the best state.
+    assert 0.0 <= result["test"]["f1"] <= 1.0
 
 
 def test_exp_defect_flowgnn_combined(tmp_path):
@@ -151,6 +160,22 @@ def test_exp_clone_from_dataset_dir(tmp_path):
         overrides={"max_epochs": 1, "batch_size": 3, "eval_batch_size": 3},
     )
     assert 0.0 <= result["best_f1"] <= 1.0
+    assert 0.0 <= result["test"]["f1"] <= 1.0
+
+
+def test_exp_multitask_from_dataset_dir(tmp_path):
+    """multi_task --data <dir>: every generation task the directory ships
+    trains in one sampled mix with its task prefix (run_multi_gen.py)."""
+    _write_codet5_dir(tmp_path)
+    cfg = resolve("multi_task", "none", "codet5_small")
+    result = run_experiment(
+        cfg, data=str(tmp_path), res_dir=str(tmp_path / "res"), tiny=True,
+        overrides={"max_epochs": 1, "batch_size": 4, "eval_batch_size": 4},
+    )
+    # summarize_python + both translate directions are present in the dir
+    assert set(result["tasks"]) >= {"summarize_python", "translate_java-cs"}
+    for metrics in result["tasks"].values():
+        assert "eval_loss" in metrics and "exact_match" in metrics
 
 
 def _train_tiny_bpe(tmp_path, vocab=300):

@@ -233,3 +233,41 @@ def test_fit_gen_codebleu_requires_decode():
         fit_gen(T5Model(cfg), data, data,
                 TransformerTrainConfig(max_epochs=1, batch_size=8),
                 codebleu_lang="java")
+
+
+def test_fit_gen_best_state_survives_later_epochs():
+    """Regression: the retained best-epoch state must stay usable after
+    later epochs' train steps (donated state buffers would be deleted —
+    'Array has been deleted' at the final eval). lr=0 pins best=epoch 0
+    while training continues to epoch 2, and eval_bleu=False routes the
+    final generation eval through the retained state."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    data = synthetic_seq2seq(8, vocab_size=32, max_source_length=12,
+                             max_target_length=8, seed=0, reverse=False)
+    tcfg = TransformerTrainConfig(
+        learning_rate=0.0, max_epochs=3, batch_size=8, eval_batch_size=8
+    )
+    out = fit_gen(T5Model(cfg), data, data, tcfg, max_target_length=8,
+                  eval_bleu=False)
+    assert out["best_epoch"] == 0
+    assert np.isfinite(out["eval_loss"])
+
+
+def test_fit_clone_best_state_survives_later_epochs():
+    """Same regression for the clone trainer's post-training test eval."""
+    from deepdfa_tpu.train.clone_loop import evaluate_clone, fit_clone
+    from deepdfa_tpu.models.t5 import CloneModel
+
+    cfg = T5Config.tiny(vocab_size=32)
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 32, size=(16, 8)).astype(np.int32)
+    data = {"source_ids": np.concatenate([src, src], axis=1),
+            "labels": rng.randint(0, 2, size=16).astype(np.int32)}
+    tcfg = TransformerTrainConfig(learning_rate=0.0, max_epochs=2,
+                                  batch_size=8, eval_batch_size=8)
+    model = CloneModel(cfg)
+    out = fit_clone(model, data, data, tcfg)
+    metrics = evaluate_clone(model, out["state"].params, data, tcfg)
+    assert np.isfinite(metrics["f1"])

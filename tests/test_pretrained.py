@@ -188,3 +188,48 @@ def test_pretrained_with_dataset_dir_rejected(tiny_t5_dir, tmp_path):
             data=str(tmp_path), res_dir=str(tmp_path / "res"), tiny=True,
             pretrained=path,
         )
+
+
+def test_exp_clone_finetunes_from_pretrained(tiny_t5_dir, tmp_path, capsys):
+    """Clone fine-tunes from a t5 checkpoint (run_clone.py from_pretrained):
+    the converted stack grafts under the fresh clone head and the shared
+    embedding lands verbatim in the trainer's init."""
+    from deepdfa_tpu.exp import main
+
+    path, hf = tiny_t5_dir
+    main([
+        "--task", "clone", "--model_tag", "codet5_small",
+        "--pretrained", path, "--epochs", "1",
+        "--res_dir", str(tmp_path),
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pretrained"] == path
+    assert np.isfinite(out["best_f1"])
+
+
+def test_exp_multitask_finetunes_from_pretrained(tiny_t5_dir, tmp_path,
+                                                 capsys):
+    """multi_task fine-tunes the full T5 stack from a checkpoint
+    (run_multi_gen.py from_pretrained)."""
+    from deepdfa_tpu.exp import main
+
+    path, _ = tiny_t5_dir
+    main([
+        "--task", "multi_task", "--model_tag", "codet5_small",
+        "--pretrained", path, "--epochs", "1",
+        "--res_dir", str(tmp_path),
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pretrained"] == path
+    assert set(out["tasks"]) == {"summarize", "translate"}
+
+
+def test_pretrained_clone_rejects_roberta(tiny_roberta_dir, tmp_path):
+    from deepdfa_tpu.exp import resolve, run_experiment
+
+    path, _ = tiny_roberta_dir
+    with pytest.raises(ValueError, match="t5 checkpoint"):
+        run_experiment(
+            resolve("clone", "none", "codet5_small"), data="synthetic",
+            res_dir=str(tmp_path), tiny=True, pretrained=path,
+        )
