@@ -68,6 +68,17 @@ def _coerce(value: str, field_type: Any):
     return value
 
 
+def env_injected_overrides() -> List[str]:
+    """DEEPDFA_TUNE_PARAMS (JSON {dotted: value}) as ``section.key=value``
+    items — THE parse of the env injection; build_configs and the fit-text
+    override guard must agree on it."""
+    env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
+    if not env_params:
+        return []
+    return [f"{dotted}={value}"
+            for dotted, value in json.loads(env_params).items()]
+
+
 def build_configs(
     config_files: List[str], overrides: List[str],
     inject_service_params: bool = False,
@@ -108,12 +119,7 @@ def build_configs(
             injected += [
                 f"{dotted}={value}" for dotted, value in nni_params.items()
             ]
-    env_params = os.environ.get("DEEPDFA_TUNE_PARAMS")
-    if env_params:
-        injected += [
-            f"{dotted}={value}"
-            for dotted, value in json.loads(env_params).items()
-        ]
+    injected += env_injected_overrides()
     overrides = injected + list(overrides)
     for item in overrides:
         dotted, _, value = item.partition("=")
@@ -459,13 +465,7 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         make_text_eval_step,
     )
 
-    injected = [
-        f"{k}={v}"
-        for k, v in json.loads(
-            os.environ.get("DEEPDFA_TUNE_PARAMS", "{}")
-        ).items()
-    ]
-    for item in injected + list(args.set):
+    for item in env_injected_overrides() + list(args.set):
         if not item.startswith("model."):
             # fit-text's trainer settings come from its own flags
             # (--epochs/--batch-size/...); silently ignoring a train./data.
@@ -672,6 +672,28 @@ def cmd_test_text(args) -> Dict[str, Any]:
     # (this run may cover an overridden dataset or the val fallback).
     _dump_predictions(args.profile_dir or args.checkpoint_dir, res,
                       name="test_predictions.csv")
+    if args.dbgbench:
+        # DbgBench protocol (paper Table 8; the reference's eval-export +
+        # bugs-detected analysis, unixcoder/linevul_main.py:742-857,
+        # run_all_eval_export_dbgbench_combined.sh): each example belongs
+        # to one known bug; a bug counts as detected when ANY of its
+        # functions is flagged.
+        from deepdfa_tpu.eval.report import dbgbench_report
+
+        with open(args.dbgbench) as f:
+            bug_of = {int(k): v for k, v in json.load(f).items()}
+        pairs = [(p, bug_of[int(i)])
+                 for p, i in zip(res["probs"], res["index"])
+                 if int(i) in bug_of]
+        if not pairs:
+            raise ValueError(
+                f"no evaluated example ids appear in {args.dbgbench} — "
+                "wrong bug map for this dataset?"
+            )
+        report["dbgbench"] = dbgbench_report(
+            [p for p, _ in pairs], [b for _, b in pairs],
+            threshold=args.dbgbench_threshold,
+        )
 
     if args.profile or args.time:
         from deepdfa_tpu.eval.profiling import ProfileRecorder, profile_eval
@@ -904,6 +926,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tt.add_argument("--profile", action="store_true")
     p_tt.add_argument("--time", action="store_true")
     p_tt.add_argument("--profile-dir", default=None)
+    p_tt.add_argument("--dbgbench", default=None, metavar="BUG_MAP.json",
+                      help="JSON {example_index: bug_id}; adds the Table-8 "
+                           "bugs-detected report over the evaluated split")
+    p_tt.add_argument("--dbgbench-threshold", type=float, default=0.5)
     p_tt.set_defaults(func=cmd_test_text)
 
     p_an = sub.add_parser("analyze")

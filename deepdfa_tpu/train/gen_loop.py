@@ -486,11 +486,12 @@ def fit_gen(
     return out
 
 
-def _jit_gen_step(step_fn, mesh, cfg, donate: bool = True):
-    """``donate=False`` whenever a past state is retained across steps
-    (best-epoch selection): donating the state argument deletes the
-    retained copy's buffers and the final eval crashes with
-    'Array has been deleted' — the fit_text pattern."""
+def _jit_gen_step(step_fn, mesh, cfg, donate: bool = False):
+    """Donation is opt-in: whenever a past state is retained across steps
+    (best-epoch selection, the fit_gen default), donating the state
+    argument deletes the retained copy's buffers and the final eval
+    crashes with 'Array has been deleted' — the fit_text pattern. Pass
+    donate=True only for loops that keep no old state."""
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
     from deepdfa_tpu.parallel.mesh import jit_dp_step

@@ -1,15 +1,34 @@
 #!/bin/bash
-# Cross-project generalization protocol (reference scripts/run_cross_project.sh,
-# paper Table 7): no project spans train/test.
+# Cross-project generalization protocol (reference scripts/run_cross_project.sh
+# for the GNN, LineVul/linevul/scripts/cross_project_{train,eval}_combined.sh
+# for the combined model; paper Table 7): no project spans train/test.
 #
-# Extra args: FIT_ARGS apply to the fit step, TEST_ARGS to the test step,
-# "$@" to both (must be valid for both subcommands).
+# Extra args: FIT_ARGS apply to the GNN fit step, TEST_ARGS to the GNN test
+# step, "$@" to both GNN steps (must be valid for both subcommands).
+# COMBINED=0 skips the combined stage; COMBINED_ARGS feed fit-text;
+# GRAPHS points the combined join at a real graph cache when DATASET is a
+# CSV directory (synthetic graphs only pair with synthetic text).
 set -e
 cd "$(dirname "$0")/.."
+DATASET="${DATASET:-synthetic:256}"
+GRAPHS="${GRAPHS:-synthetic}"
+CKPT="${CHECKPOINT_DIR:-runs/cross_project}"
+
 python -m deepdfa_tpu.cli fit --config configs/default.yaml \
-  --split-mode cross-project \
-  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" ${FIT_ARGS:-} "$@"
+  --dataset "$DATASET" --split-mode cross-project \
+  --checkpoint-dir "$CKPT" ${FIT_ARGS:-} "$@"
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
-  --split-mode cross-project \
-  --checkpoint-dir "${CHECKPOINT_DIR:-runs/cross_project}" --which best \
+  --dataset "$DATASET" --split-mode cross-project \
+  --checkpoint-dir "$CKPT" --which best \
   ${TEST_ARGS:-} "$@"
+
+if [ "${COMBINED:-1}" = "1" ]; then
+  echo "== combined DeepDFA+LineVul, cross-project =="
+  python -m deepdfa_tpu.cli fit-text --config configs/default.yaml \
+    --model linevul --dataset "$DATASET" --graphs "$GRAPHS" \
+    --split-mode cross-project \
+    --checkpoint-dir "${CKPT}_combined" \
+    --ddfa-checkpoint "$CKPT" ${COMBINED_ARGS:-}
+  python -m deepdfa_tpu.cli test-text \
+    --checkpoint-dir "${CKPT}_combined" --which best
+fi
