@@ -794,7 +794,8 @@ def cmd_tune(args) -> Dict[str, Any]:
     out_path = os.path.join(args.out_dir, "tune_results.jsonl")
     os.makedirs(args.out_dir, exist_ok=True)
     open(out_path, "w").close()  # fresh file per run: no stale trials
-    assessor = MedianStopAssessor(warmup_steps=args.assessor_warmup)
+    assessor = MedianStopAssessor(warmup_steps=args.assessor_warmup,
+                                  min_trials=args.assessor_min_trials)
     for trial in range(args.trials):
         pick = {k: v[rng.randint(len(v))] for k, v in space.items()}
         model_cfg = dataclasses.replace(
@@ -946,6 +947,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "terminate a trial (NNI start_step; with the "
                              "3-epoch trial default, 1 leaves epochs 2-3 "
                              "cuttable)")
+    p_tune.add_argument("--assessor-min-trials", type=int, default=3,
+                        help="completed trials before the assessor may cut "
+                             "anything — runs with --trials <= this can "
+                             "never early-stop")
     p_tune.set_defaults(func=cmd_tune)
 
     args = parser.parse_args(argv)

@@ -9,6 +9,12 @@ set -e
 cd "$(dirname "$0")/.."
 CKPT=${1:?usage: run_profiling.sh <checkpoint-dir> [extra cli args...]}
 shift || true
+case "$*" in *--dataset*) ;; *)
+  # cli test defaults --dataset to synthetic:256 — profiling a checkpoint
+  # against synthetic data is rarely what was meant; say so loudly.
+  echo "run_profiling.sh: no --dataset given, profiling on synthetic:256" \
+       "(pass --dataset <spec> to profile the checkpoint's real data)" >&2
+;; esac
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "$CKPT" --which best --profile --time "$@"
 python -m deepdfa_tpu.eval.report "$CKPT/profiledata.jsonl" "$CKPT/timedata.jsonl"

@@ -220,20 +220,28 @@ def test_fit_text_cross_project_and_dbgbench(tmp_path, capsys):
     assert report["loss"] == pytest.approx(result["test"]["loss"], rel=1e-5)
 
     # DbgBench: map the evaluated examples onto 2 bugs; expected detection
-    # computed by hand from the dumped probabilities.
+    # computed by hand from the dumped probabilities. The CSV rounds probs
+    # to 6 decimals, so pick a threshold mid-gap between two dumped values
+    # — rounding noise (<=5e-7) then cannot flip any comparison.
     with open(os.path.join(run, "test_predictions.csv")) as f:
         rows = [l.split(",") for l in f.read().strip().splitlines()[1:]]
     indices = [int(r[0]) for r in rows]
     probs = {int(r[0]): float(r[1]) for r in rows}
+    uniq = sorted(set(probs.values()))
+    if len(uniq) > 1:
+        gaps = [(b - a, (a + b) / 2) for a, b in zip(uniq, uniq[1:])]
+        threshold = max(gaps)[1]
+    else:
+        threshold = uniq[0] - 0.1
     bug_map = {idx: f"bug{i % 2}" for i, idx in enumerate(indices)}
     expected = {
-        b: any(probs[i] >= 0.5 for i, bb in bug_map.items() if bb == b)
+        b: any(probs[i] >= threshold for i, bb in bug_map.items() if bb == b)
         for b in ("bug0", "bug1")
     }
     bm = tmp_path / "bugs.json"
     bm.write_text(json.dumps(bug_map))
     main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8",
-          "--dbgbench", str(bm)])
+          "--dbgbench", str(bm), "--dbgbench-threshold", str(threshold)])
     report = _last_json(capsys)
     assert report["dbgbench"]["bugs_total"] == 2
     assert report["dbgbench"]["bugs_detected"] == sum(expected.values())
