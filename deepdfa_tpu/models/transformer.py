@@ -48,6 +48,11 @@ class EncoderConfig:
     # attention-probability dropout (standard for fused kernels).
     attention_impl: str = "dense"
     seq_axis: str = "seq"
+    # Rematerialize each transformer layer in the backward pass. The layers
+    # are matmul-bound, so recomputing activations costs little and frees
+    # the per-layer activation memory — what lets the combined model train
+    # at batch 64 / 512 tokens (and long context) on one 16G chip.
+    remat_layers: bool = False
 
     @classmethod
     def tiny(cls, vocab_size: int = 128) -> "EncoderConfig":
@@ -182,9 +187,13 @@ class RobertaEncoder(nn.Module):
                 "output_attentions needs attention_impl='dense'; "
                 f"got {c.attention_impl!r}"
             )
+        layer_cls = EncoderLayer
+        if c.remat_layers and not output_attentions:
+            # static_argnums counts self: (self, x, attn_mask, deterministic)
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
         attentions = []
         for i in range(c.num_layers):
-            x, attn = EncoderLayer(c, mesh=self.mesh, name=f"layer_{i}")(
+            x, attn = layer_cls(c, mesh=self.mesh, name=f"layer_{i}")(
                 x, attn_mask, deterministic
             )
             if output_attentions:

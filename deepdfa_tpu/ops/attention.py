@@ -4,10 +4,13 @@ The reference truncates every transformer input to 512 tokens
 (LineVul/linevul/linevul_main.py:126-131, CodeT5/utils.py max_source_length)
 because dense O(T^2) attention is all it has. Here long context is
 first-class: a blockwise streaming-softmax attention (pure JAX ``lax.scan``,
-O(T) memory in sequence length, differentiable) and a Pallas TPU flash
-kernel for the forward pass. Both compute exact softmax attention — not an
-approximation — via the online max/denominator recurrence, so they are
-drop-in replacements for the dense path at any length.
+O(T) memory in sequence length, differentiable) and Pallas TPU flash
+kernels for BOTH passes — the standard forward with a saved logsumexp plus
+dq and dk/dv backward kernels that rebuild probabilities from it (Dao et
+al.'s algorithm), so training keeps no O(T^2) residuals either. All compute
+exact softmax attention — not an approximation — via the online
+max/denominator recurrence, so they are drop-in replacements for the dense
+path at any length.
 
 These per-device primitives are also the building block of ring attention
 (deepdfa_tpu/parallel/ring.py): the streaming state ``(o, m, l)`` merges
@@ -166,11 +169,13 @@ def dense_attention(
 # Pallas TPU flash-attention forward kernel.
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *,
-                  causal, block_q, block_k, scale):
+def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc, m_s, l_s, *, causal, block_q, block_k, scale):
     """Grid (B*H, nq, nk); TPU executes the grid sequentially with the last
     axis innermost, so (acc, m, l) scratch carries the streaming-softmax
-    state across the nk steps of one (bh, qi) tile."""
+    state across the nk steps of one (bh, qi) tile. Also emits the row
+    logsumexp (the flash-attention residual the backward kernels rebuild
+    normalized probabilities from)."""
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -205,7 +210,96 @@ def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc[:] / jnp.maximum(l_s[:, 0][:, None], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+        # lse = shift + log(l): exp(s - lse) is the NORMALIZED probability.
+        # Fully-masked rows land near log(1e-30) ≈ -69, so exp(NEG_INF -
+        # lse) underflows to exactly 0 in the backward — no NaNs.
+        lse_ref[0, 0] = shift + jnp.log(l)
+
+
+def _flash_bwd_dq_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
+                         do_ref, dq_ref, dq_acc, *, causal, block_q, block_k,
+                         scale):
+    """dQ pass, grid (B*H, nq, nk): for one q tile, stream k tiles and
+    accumulate dq = scale * Σ_j dS·K with dS = P∘(dP − Δ), P rebuilt from
+    the saved logsumexp (standard flash backward; Dao et al. alg. 4)."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                  # [Bq]
+    delta = delta_ref[0, 0]                              # [Bq]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+    mask = mask_ref[0, 0] != 0
+    s = jnp.where(mask[None, :], s, NEG_INF)
+    if causal:
+        qi = pl.program_id(1)
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+    ds = p * (dp - delta[:, None])
+    dq_acc[:] = dq_acc[:] + jax.lax.dot(ds, k)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
+                          do_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                          block_q, block_k, scale):
+    """dK/dV pass, grid (B*H, nk, nq): for one k tile, stream q tiles and
+    accumulate dV = Σ_i Pᵀ·dO and dK = Σ_i dSᵀ·(scale·Q) — q is loaded
+    pre-scaled, which IS the scale factor dK needs (S = (scale·Q)·Kᵀ)."""
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+    mask = mask_ref[0, 0] != 0
+    s = jnp.where(mask[None, :], s, NEG_INF)
+    if causal:
+        ki = pl.program_id(1)
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])                        # [Bq, Bk]
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ()))                  # Pᵀ·dO [Bk, D]
+    )
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    ds = p * (dp - delta[:, None])
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ()))                  # dSᵀ·Q [Bk, D]
+    )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        # No extra scale: dk_acc already used the pre-scaled q.
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 try:  # Pallas import is deferred-safe: CPU-only environments still work.
@@ -217,9 +311,8 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
+def _flash_blocks(q, k, block_q, block_k):
+    tq, tk = q.shape[1], k.shape[1]
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     if tq % block_q or tk % block_k:
@@ -227,23 +320,41 @@ def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
             f"flash attention needs Tq%block_q==0 and Tk%block_k==0 "
             f"(got {tq}%{block_q}, {tk}%{block_k}); pad or use blockwise"
         )
-    if kv_mask is None:
-        kv_mask = jnp.ones((b, tk), jnp.int32)
+    return block_q, block_k
+
+
+def _bh(x):
+    """[B, T, H, D] -> [B*H, T, D] so one grid row is one (batch, head)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unbh(x, b, h):
+    bh_, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _mask_3d(kv_mask, b, tk):
     # [B, 1, Tk]: TPU block shapes must tile the last two dims, and a
     # singleton second-to-last dim satisfies the "equal to the array dim"
     # escape hatch that a [B, Tk] layout (block (1, Bk) over B>1) does not.
-    kv_mask = kv_mask.astype(jnp.int32)[:, None, :]
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, tk), jnp.int32)
+    return kv_mask.astype(jnp.int32)[:, None, :]
 
-    # [B, T, H, D] -> [B*H, T, D] so one grid row is one (batch, head).
-    def bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q, block_k = _flash_blocks(q, k, block_q, block_k)
+    mask3 = _mask_3d(kv_mask, b, tk)
 
     grid = (b * h, tq // block_q, tk // block_k)
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
         scale=1.0 / np.sqrt(d),
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -252,40 +363,103 @@ def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh_, qi, ki: (bh_, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(kv_mask, bh(q), bh(k), bh(v))
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    )(mask3, _bh(q), _bh(k), _bh(v))
+    return _unbh(out, b, h), lse
+
+
+def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    """Pallas dq + dk/dv passes (the standard flash backward): rebuild the
+    normalized probabilities from the saved logsumexp, Δ = rowsum(dO∘O),
+    dS = P∘(dP − Δ). O(T) memory like the forward — no quadratic residuals,
+    which is what lets 4096-token training fit and batch 64 at 512."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q, block_k = _flash_blocks(q, k, block_q, block_k)
+    mask3 = _mask_3d(kv_mask, b, tk)
+    scale = 1.0 / np.sqrt(d)
+
+    qb, kb, vb = _bh(q), _bh(k), _bh(v)
+    dob = _bh(g)
+    # Δ_i = Σ_d dO_id · O_id, [B*H, 1, Tq] like the lse layout.
+    delta = jnp.einsum(
+        "xtd,xtd->xt", dob.astype(jnp.float32), _bh(out).astype(jnp.float32)
+    )[:, None, :]
+
+    mask_spec = pl.BlockSpec((1, 1, block_k), lambda bh_, qi, ki: (bh_ // h, 0, ki))
+    row_q = pl.BlockSpec((1, 1, block_q), lambda bh_, qi, ki: (bh_, 0, qi))
+    qtile = pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0))
+    ktile = pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k, scale=scale),
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[mask_spec, row_q, row_q, qtile, ktile, ktile, qtile],
+        out_specs=qtile,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(mask3, lse, delta, qb, kb, vb, dob)
+
+    # dK/dV grid puts the k tile on the middle axis: (bh, ki, qi(inner)).
+    mask_k = pl.BlockSpec((1, 1, block_k), lambda bh_, ki, qi: (bh_ // h, 0, ki))
+    row_q2 = pl.BlockSpec((1, 1, block_q), lambda bh_, ki, qi: (bh_, 0, qi))
+    qtile2 = pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0))
+    ktile2 = pl.BlockSpec((1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k, scale=scale),
+        grid=(b * h, tk // block_k, tq // block_q),
+        in_specs=[mask_k, row_q2, row_q2, qtile2, ktile2, ktile2, qtile2],
+        out_specs=[ktile2, ktile2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mask3, lse, delta, qb, kb, vb, dob)
+    return _unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash(q, k, v, kv_mask, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, kv_mask, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k):
-    out = _flash(q, k, v, kv_mask, causal, block_q, block_k)
-    return out, (q, k, v, kv_mask)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, kv_mask, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
-    # Backward recomputes via the blockwise JAX path (same exact math), so
-    # XLA differentiates the recurrence; the Pallas kernel stays fwd-only.
-    q, k, v, kv_mask = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, kv_mask=kv_mask, causal=causal, block_size=block_k
-        ),
-        q, k, v,
-    )
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_mask, out, lse = res
+    interpret = jax.default_backend() != "tpu"
+    dq, dk, dv = _flash_backward(q, k, v, kv_mask, out, lse, g, causal,
+                                 block_q, block_k, interpret)
     dmask = (
         None if kv_mask is None
         else np.zeros(kv_mask.shape, jax.dtypes.float0)
@@ -296,11 +470,42 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pick_block(t: int, target: int) -> Optional[int]:
+    """Largest lane-aligned (128-multiple) divisor of ``t`` up to
+    ``target``; ``t`` itself for short sequences; None when no bounded tile
+    exists (odd lengths — the caller falls back to blockwise rather than
+    compiling an unbounded single-tile kernel).
+
+    Short-sequence grid sizing is the difference between winning and losing
+    the 512-token A/B: at (bq=128, bk=128) the b·h×4×4 grid is thousands of
+    ~4-MFLOP programs and per-program overhead dominates (measured 4.4 ms
+    fwd+bwd at B16·H12·T512·D64 on v5e vs 0.95 ms at (256, 512) — bigger
+    tiles amortize it and still fit VMEM comfortably)."""
+    if t <= max(target, 128):
+        return t
+    best = None
+    for b in range(128, min(target, t) + 1, 128):
+        if t % b == 0:
+            best = b
+    return best
+
+
 def flash_attention(q, k, v, kv_mask=None, causal=False,
-                    block_q: int = 128, block_k: int = 128):
-    """Pallas TPU flash attention (exact). Interprets on non-TPU backends so
-    tests cover the kernel math on the CPU mesh."""
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
+    """Pallas TPU flash attention (exact), fwd + bwd kernels. Interprets on
+    non-TPU backends so tests cover the kernel math on the CPU mesh.
+
+    Block sizes default to the measured sweet spot (q tiles up to 256, kv
+    tiles up to 512, divisor-aligned) — see ``_pick_block``. Sequences with
+    no bounded tiling (e.g. long odd lengths) take the blockwise path."""
     if not _HAVE_PALLAS:  # pragma: no cover
+        return blockwise_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+    if block_q is None:
+        block_q = _pick_block(q.shape[1], 256)
+    if block_k is None:
+        block_k = _pick_block(k.shape[1], 512)
+    if block_q is None or block_k is None:
         return blockwise_attention(q, k, v, kv_mask=kv_mask, causal=causal)
     return _flash(q, k, v, kv_mask, causal, block_q, block_k)
 

@@ -69,19 +69,77 @@ def test_flash_kernel_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_flash_gradients_via_blockwise_bwd():
-    q, k, v, mask = _rand(tq=16, tk=16)
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_dense(causal):
+    """The Pallas dq / dk-dv backward kernels (normalized-probability
+    rebuild from the saved logsumexp) against autodiff through dense."""
+    q, k, v, mask = _rand(tq=32, tk=32)
 
     def f(fn, q, k, v):
-        return (fn(q, k, v) ** 2).sum()
+        return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
 
     g_ref = jax.grad(lambda q, k, v: f(
-        lambda *a: dense_attention(*a, kv_mask=mask), q, k, v), (0, 1, 2))(q, k, v)
-    g_fl = jax.grad(lambda q, k, v: f(
-        lambda *a: flash_attention(*a, kv_mask=mask, block_q=8, block_k=8),
+        lambda *a: dense_attention(*a, kv_mask=mask, causal=causal),
         q, k, v), (0, 1, 2))(q, k, v)
-    for a, b in zip(g_ref, g_fl):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    g_fl = jax.grad(lambda q, k, v: f(
+        lambda *a: flash_attention(*a, kv_mask=mask, causal=causal,
+                                   block_q=16, block_k=16),
+        q, k, v), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ref, g_fl, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_auto_blocks():
+    """_pick_block: lane-aligned divisors up to the measured sweet spot;
+    auto-selected blocks must reproduce explicit ones."""
+    from deepdfa_tpu.ops.attention import _pick_block
+
+    assert _pick_block(512, 256) == 256
+    assert _pick_block(512, 512) == 512
+    assert _pick_block(4096, 512) == 512
+    assert _pick_block(96, 256) == 96      # short seq: one block
+    assert _pick_block(384, 256) == 128    # 256 does not divide 384
+    assert _pick_block(640, 512) == 128    # largest 128-multiple divisor
+    assert _pick_block(4104, 512) is None  # no bounded tile -> blockwise
+    q, k, v, mask = _rand(tq=128, tk=128)
+    ref = flash_attention(q, k, v, kv_mask=mask, block_q=128, block_k=128)
+    out = flash_attention(q, k, v, kv_mask=mask)  # auto
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    # untileable long sequences silently take the exact blockwise path
+    q2, k2, v2, m2 = _rand(tq=771, tk=771)
+    ref2 = dense_attention(q2, k2, v2, kv_mask=m2)
+    out2 = flash_attention(q2, k2, v2, kv_mask=m2)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_encoder_remat_matches_no_remat():
+    """remat_layers recomputes instead of storing — gradients must be
+    mathematically identical."""
+    import dataclasses
+
+    from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
+
+    cfg = dataclasses.replace(EncoderConfig.tiny(), attention_impl="blockwise",
+                              dropout_rate=0.0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, cfg.vocab_size, size=(2, 16)))
+
+    def loss(cfg):
+        enc = RobertaEncoder(cfg)
+        params = enc.init(jax.random.PRNGKey(0), ids, deterministic=True)
+        def f(p):
+            h, _ = enc.apply(p, ids, deterministic=True)
+            return (h.astype(jnp.float32) ** 2).sum()
+        return f(params), jax.grad(f)(params)
+
+    l0, g0 = loss(cfg)
+    l1, g1 = loss(dataclasses.replace(cfg, remat_layers=True))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
 
 
 def test_dispatch():

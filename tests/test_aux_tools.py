@@ -73,6 +73,7 @@ def test_task_sampling_probs():
     assert p["a"] / p["b"] < raw_ratio
 
 
+@pytest.mark.slow
 def test_fit_gen_multitask_runs_and_reports():
     from deepdfa_tpu.core.config import TransformerTrainConfig
     from deepdfa_tpu.data.seq2seq import synthetic_seq2seq

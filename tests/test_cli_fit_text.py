@@ -199,6 +199,7 @@ def test_make_text_optimizer_freeze_zeroes_updates():
     assert not np.allclose(np.asarray(new["params"]["roberta"]["w"]), 1.0)
 
 
+@pytest.mark.slow
 def test_fit_text_cross_project_and_dbgbench(tmp_path, capsys):
     """Combined cross-project protocol (cross_project_train_combined.sh
     parity) + the Table-8 DbgBench bugs-detected report from test-text."""
@@ -247,6 +248,7 @@ def test_fit_text_cross_project_and_dbgbench(tmp_path, capsys):
     assert report["dbgbench"]["bugs_detected"] == sum(expected.values())
 
 
+@pytest.mark.slow
 def test_test_text_dbgbench_rejects_foreign_map(tmp_path, capsys):
     run = str(tmp_path / "r")
     main([

@@ -3,6 +3,7 @@
 import dataclasses
 
 import numpy as np
+import pytest
 
 from deepdfa_tpu.core.config import TransformerTrainConfig
 from deepdfa_tpu.models.t5 import CloneModel, T5Config
@@ -50,6 +51,7 @@ def test_fit_clone_learns_identity_pairs():
     assert out["best_f1"] > 0.7, out["eval_metrics"]
 
 
+@pytest.mark.slow
 def test_fit_clone_on_mesh_matches_single_device():
     """fit_clone with a dp mesh reproduces the single-device best F1 (the
     DataParallel analog for the clone task)."""

@@ -1,6 +1,7 @@
 """CodeT5 defect trainer end-to-end on synthetic sample-mode data (tiny)."""
 
 import numpy as np
+import pytest
 
 from deepdfa_tpu.core.config import (
     FeatureSpec,
@@ -52,6 +53,7 @@ def test_codet5_fit_learns_synthetic_signal():
     assert len(history["epochs"]) == 4
 
 
+@pytest.mark.slow
 def test_codet5_combined_with_flowgnn_and_early_stop():
     examples, data, splits, feature = _dataset()
     gcfg = FlowGNNConfig(

@@ -99,6 +99,7 @@ def _write_codet5_dir(root):
             f.write("0\t1\t1\n2\t3\t0\n4\t5\t1\n")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("task,sub", [("summarize", "python"),
                                       ("translate", "java-cs")])
 def test_exp_gen_from_dataset_dir(tmp_path, task, sub):
@@ -163,6 +164,7 @@ def test_exp_clone_from_dataset_dir(tmp_path):
     assert 0.0 <= result["test"]["f1"] <= 1.0
 
 
+@pytest.mark.slow
 def test_exp_multitask_from_dataset_dir(tmp_path):
     """multi_task --data <dir>: every generation task the directory ships
     trains in one sampled mix with its task prefix (run_multi_gen.py)."""
@@ -218,6 +220,7 @@ def test_exp_tokenizer_vocab_guard(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_exp_pretrained_with_data_and_tokenizer(tmp_path):
     """The combination the NotImplementedError points at: a checkpoint plus
     its tokenizer assets fine-tunes on a real dataset directory."""

@@ -6,6 +6,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepdfa_tpu.core.config import TransformerTrainConfig
 from deepdfa_tpu.data.seq2seq import (
@@ -69,6 +70,7 @@ def test_loss_ignores_pad():
     np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_gen_learns_copy_task():
     """Pipeline integration: fit_gen must drive the loss down and greedy
     decode must reproduce the fitted sequences (teacher-forcing, scheduling,
@@ -95,6 +97,7 @@ def test_fit_gen_learns_copy_task():
     assert out["bleu"] > 0.0  # id-token BLEU on the memorized rows
 
 
+@pytest.mark.slow
 def test_fit_gen_on_mesh_matches_single_device():
     """fit_gen with a dp mesh reproduces the single-device run (the
     DataParallel analog for the generation tasks)."""
@@ -255,6 +258,7 @@ def test_fit_gen_best_state_survives_later_epochs():
     assert np.isfinite(out["eval_loss"])
 
 
+@pytest.mark.slow
 def test_fit_clone_best_state_survives_later_epochs():
     """Same regression for the clone trainer's post-training test eval."""
     from deepdfa_tpu.train.clone_loop import evaluate_clone, fit_clone

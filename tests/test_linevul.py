@@ -120,6 +120,7 @@ def test_missing_graphs_are_masked():
         assert batch.example_mask[row] == (int(idx) in graphs)
 
 
+@pytest.mark.slow
 def test_fit_text_learns():
     from deepdfa_tpu.train.text_loop import evaluate_text, fit_text, make_text_eval_step
 
@@ -136,6 +137,7 @@ def test_fit_text_learns():
     assert test["metrics"]["f1"] > 0.85, (test["metrics"], history["epochs"][-1])
 
 
+@pytest.mark.slow
 def test_fit_combined_learns():
     from deepdfa_tpu.train.text_loop import evaluate_text, fit_text, make_text_eval_step
 
@@ -157,6 +159,7 @@ def test_fit_combined_learns():
     assert test["num_missing"] == 0
 
 
+@pytest.mark.slow
 def test_combined_sharded_graphs_match_single_device():
     """Graphs shard with the text rows on the dp mesh (per-device sub-batches
     via shard_concat); losses must match the unsharded run for both message

@@ -20,6 +20,8 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent(

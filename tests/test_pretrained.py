@@ -131,6 +131,7 @@ def test_exp_defect_finetunes_from_pretrained(model_tag, fixture, tmp_path,
     )
 
 
+@pytest.mark.slow
 def test_exp_gen_finetunes_from_pretrained_t5(tiny_t5_dir, tmp_path, capsys):
     """Generation family fine-tunes from a T5 checkpoint through fit_gen."""
     from deepdfa_tpu.exp import main
@@ -157,6 +158,7 @@ def test_pretrained_kind_mismatch_rejected(tiny_roberta_dir, tmp_path):
         ])
 
 
+@pytest.mark.slow
 def test_exp_gen_finetunes_from_pretrained_roberta(tiny_roberta_dir, tmp_path,
                                                    capsys):
     """Encoder-tag generation fine-tunes from a RoBERTa checkpoint: the
@@ -207,6 +209,7 @@ def test_exp_clone_finetunes_from_pretrained(tiny_t5_dir, tmp_path, capsys):
     assert np.isfinite(out["best_f1"])
 
 
+@pytest.mark.slow
 def test_exp_multitask_finetunes_from_pretrained(tiny_t5_dir, tmp_path,
                                                  capsys):
     """multi_task fine-tunes the full T5 stack from a checkpoint

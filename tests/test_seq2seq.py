@@ -23,6 +23,7 @@ def _setup(b=2, src_len=10, seed=0):
     return model, params, src
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_forward():
     model, params, src = _setup()
     tgt_len = 6
@@ -63,6 +64,7 @@ def test_generic_greedy_and_beam():
     assert np.isfinite(np.asarray(score)).all()
 
 
+@pytest.mark.slow
 def test_fit_gen_works_with_seq2seq_model():
     from deepdfa_tpu.core.config import TransformerTrainConfig
     from deepdfa_tpu.data.seq2seq import synthetic_seq2seq

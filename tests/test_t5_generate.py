@@ -60,6 +60,7 @@ def test_cached_decode_matches_full_forward():
     np.testing.assert_allclose(np.asarray(stepped), np.asarray(full), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_greedy_matches_naive_decode():
     model, params, src = _setup()
     max_len = 8

@@ -69,6 +69,7 @@ def test_fit_tile_trains_on_sharded_mesh():
     assert np.isfinite(hist["epochs"][0]["train_loss"])
 
 
+@pytest.mark.slow
 def test_fit_text_with_tile_combined_model():
     """The combined LineVul+FlowGNN model with message_impl='tile' must
     train through fit_text (the flag derives from graph_config)."""

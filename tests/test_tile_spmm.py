@@ -147,6 +147,7 @@ def test_sharded_tile_spmm_matches_plain():
     )
 
 
+@pytest.mark.slow
 def test_fit_tile_on_mesh_matches_segment():
     """End-to-end: fit with message_impl='tile' on the full device mesh tracks
     the segment path's losses (removes the round-1 single-shard restriction)."""
