@@ -280,8 +280,10 @@ def bench_combined_train(
         # so add the analytic attention count: per layer the fwd kernel
         # does 2 T×T×D matmuls and the dq + dkv backward kernels 7 more
         # (each recomputes S and dP, plus dq/dk/dv) — 9 × 2·B·H·T²·D.
-        n_heads, n_layers = 12, 12  # codebert-base shape (_combined_setup)
-        flops += 9 * 2 * batch_size * n_heads * seq_len**2 * 64 * n_layers
+        enc = model.encoder_config
+        head_dim = enc.hidden_size // enc.num_heads
+        flops += (9 * 2 * batch_size * enc.num_heads * seq_len**2
+                  * head_dim * enc.num_layers)
     peak = _peak_flops()
     sec_per_step = dt / n_steps
     return eps, {

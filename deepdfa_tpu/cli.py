@@ -536,7 +536,7 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         # Params only: the eval-time restore must not depend on the
         # optimizer tree, whose structure changes with --freeze-graph.
         ckpt.save_best({"params": best_state.params}, history["best_epoch"],
-                       -history["best_val_f1"])
+                       metrics={"val_f1": history["best_val_f1"]})
         descriptor = {
             "model": args.model,
             "tiny": args.tiny,

@@ -165,7 +165,8 @@ def run_experiment(
     from deepdfa_tpu.core.config import TransformerTrainConfig
 
     run_name = f"{cfg.task}_{cfg.sub_task}_{cfg.model_tag}"
-    os.makedirs(os.path.join(res_dir, run_name), exist_ok=True)
+    out_dir = os.path.join(res_dir, run_name)
+    os.makedirs(out_dir, exist_ok=True)
 
     tcfg = TransformerTrainConfig(
         batch_size=cfg.batch_size,
@@ -209,15 +210,16 @@ def run_experiment(
         raise ValueError("--flowgnn only applies to --task defect")
     if cfg.task == "defect":
         result = _run_defect(cfg, tcfg, data, tiny, pretrained, tok,
-                             flowgnn=flowgnn)
+                             flowgnn=flowgnn, out_dir=out_dir)
     elif cfg.task == "clone":
-        result = _run_clone(cfg, tcfg, data, tiny, tok, pretrained=pretrained)
+        result = _run_clone(cfg, tcfg, data, tiny, tok, pretrained=pretrained,
+                            out_dir=out_dir)
     elif cfg.task == "multi_task":
         result = _run_multitask(cfg, tcfg, data, tiny, pretrained=pretrained,
-                                tok=tok)
+                                tok=tok, out_dir=out_dir)
     else:  # generation family: summarize / translate / refine / concode
         result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok,
-                          out_dir=os.path.join(res_dir, run_name))
+                          out_dir=out_dir)
     result["seconds"] = round(time.time() - t0, 2)
     result["config"] = dataclasses.asdict(cfg)
     if pretrained:
@@ -235,6 +237,26 @@ def run_experiment(
 
 def _tokenize_fn(tok):
     return lambda s: tok.convert_tokens_to_ids(tok.tokenize(s))
+
+
+def _save_best(out_dir: Optional[str], state, epoch: int,
+               metric_name: Optional[str] = None,
+               metric: Optional[float] = None) -> None:
+    """Persist the selected state's params (the reference keeps
+    checkpoint-best-* dirs per run, run_gen.py:280-300, run_defect.py:
+    383-405; params-only like fit-text so restore never depends on the
+    optimizer tree). Restore pattern: CheckpointManager(dir).restore("best",
+    {"params": fresh_init_params})."""
+    if out_dir is None:
+        return
+    import jax
+
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    CheckpointManager(out_dir).save_best(
+        {"params": jax.device_get(state.params)}, epoch,
+        metrics={metric_name: metric} if metric_name else None,
+    )
 
 
 from deepdfa_tpu.data.text import check_tok_vocab as _check_tok_vocab
@@ -373,6 +395,8 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
                   decode_fn=decode_fn, output_dir=out_dir,
                   codebleu_lang="java" if (cfg.task == "concode"
                                            and decode_fn) else None)
+    _save_best(out_dir, out["state"], out["best_epoch"],
+               "bleu_em", out["bleu_em"])
     result = {"eval_loss": float(out["eval_loss"]),
               "exact_match": float(out["exact_match"]),
               "bleu": float(out["bleu"]),
@@ -416,7 +440,7 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
 
 
 def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
-                flowgnn=None):
+                flowgnn=None, out_dir=None):
     """Defect classification — DefectModel (eos-pooled T5) for codet5 tags,
     encoder classifier otherwise; both train through fit_text.
 
@@ -514,6 +538,8 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
                                 init_params=init_params,
                                 graphs_by_id=graphs_by_id,
                                 subkeys=subkeys, graph_budget=budget)
+    _save_best(out_dir, best_state, hist["best_epoch"],
+               "val_f1", hist["best_val_f1"])
     result = {"best_val_f1": hist["best_val_f1"],
               "best_epoch": hist["best_epoch"]}
     if len(splits.get("test", ())):
@@ -599,9 +625,11 @@ def _clone_model_and_init(cfg, tiny, pretrained):
     return CloneModel(t5cfg), t5cfg, init_params
 
 
-def _run_clone(cfg, tcfg, data, tiny, tok=None, pretrained=None):
+def _run_clone(cfg, tcfg, data, tiny, tok=None, pretrained=None,
+               out_dir=None):
     if data == "synthetic":
-        return _fit_clone_synthetic(cfg, tcfg, tiny, pretrained)
+        return _fit_clone_synthetic(cfg, tcfg, tiny, pretrained,
+                                    out_dir=out_dir)
 
     from deepdfa_tpu.data.seq2seq import get_filenames, read_clone_examples
     from deepdfa_tpu.data.text import HashingT5Tokenizer
@@ -635,6 +663,7 @@ def _run_clone(cfg, tcfg, data, tiny, tok=None, pretrained=None):
         )
     out = fit_clone(model, sets["train"], sets["dev"], tcfg,
                     init_params=init_params)
+    _save_best(out_dir, out["state"], -1, "val_f1", out["best_f1"])
     result = {"best_f1": out["best_f1"], "eval_metrics": out["eval_metrics"]}
     if "test" in sets:
         # run_clone evaluates the test index with the selected state.
@@ -643,7 +672,7 @@ def _run_clone(cfg, tcfg, data, tiny, tok=None, pretrained=None):
     return result
 
 
-def _fit_clone_synthetic(cfg, tcfg, tiny, pretrained=None):
+def _fit_clone_synthetic(cfg, tcfg, tiny, pretrained=None, out_dir=None):
     import numpy as np
 
     from deepdfa_tpu.train.clone_loop import fit_clone
@@ -662,6 +691,7 @@ def _fit_clone_synthetic(cfg, tcfg, tiny, pretrained=None):
     train = {"source_ids": src[: int(n * 0.75)], "labels": labels[: int(n * 0.75)]}
     evald = {"source_ids": src[int(n * 0.75):], "labels": labels[int(n * 0.75):]}
     out = fit_clone(model, train, evald, tcfg, init_params=init_params)
+    _save_best(out_dir, out["state"], -1, "val_f1", out["best_f1"])
     return {"best_f1": out["best_f1"], "eval_metrics": out["eval_metrics"]}
 
 
@@ -694,7 +724,8 @@ def _multitask_dir_data(data: str, vocab: int, pad_id: int,
     return task_data, eval_data
 
 
-def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None):
+def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None,
+                   out_dir=None):
     from deepdfa_tpu.train.gen_loop import fit_gen_multitask
 
     init_params = None
@@ -740,6 +771,7 @@ def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None):
     out = fit_gen_multitask(model, tasks, evals, tcfg, max_steps=max_steps,
                             max_target_length=max_tgt,
                             init_params=init_params)
+    _save_best(out_dir, out["state"], -1)  # multitask keeps the final state
     return {
         k: v for k, v in out.items()
         if k != "state" and not hasattr(v, "shape")

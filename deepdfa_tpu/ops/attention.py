@@ -169,6 +169,23 @@ def dense_attention(
 # Pallas TPU flash-attention forward kernel.
 # ---------------------------------------------------------------------------
 
+def _tile_scores(mask_ref, q_ref, k_ref, qi, ki, *, causal, block_q, block_k,
+                 scale):
+    """The score tile every flash kernel rebuilds: pre-scaled q, raw k,
+    s = q·kᵀ with the padding and (optionally) causal masks at NEG_INF.
+    One implementation so forward and backward can never desynchronize."""
+    q = q_ref[0].astype(jnp.float32) * scale             # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)                     # [Bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+    mask = mask_ref[0, 0] != 0                           # [Bk] padding mask
+    s = jnp.where(mask[None, :], s, NEG_INF)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    return q, k, s, mask
+
+
 def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   acc, m_s, l_s, *, causal, block_q, block_k, scale):
     """Grid (B*H, nq, nk); TPU executes the grid sequentially with the last
@@ -185,18 +202,11 @@ def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    q = q_ref[0].astype(jnp.float32) * scale            # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)                    # [Bk, D]
+    _, _, s, mask = _tile_scores(
+        mask_ref, q_ref, k_ref, pl.program_id(1), ki, causal=causal,
+        block_q=block_q, block_k=block_k, scale=scale,
+    )
     v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
-
-    mask = mask_ref[0, 0] != 0                          # [Bk] padding mask
-    s = jnp.where(mask[None, :], s, NEG_INF)
-    if causal:
-        qi = pl.program_id(1)
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
 
     m_prev = m_s[:, 0]
     m_new = jnp.maximum(m_prev, s.max(axis=1))
@@ -231,21 +241,14 @@ def _flash_bwd_dq_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
+    _, k, s, _ = _tile_scores(
+        mask_ref, q_ref, k_ref, pl.program_id(1), ki, causal=causal,
+        block_q=block_q, block_k=block_k, scale=scale,
+    )
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]                                  # [Bq]
     delta = delta_ref[0, 0]                              # [Bq]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-    mask = mask_ref[0, 0] != 0
-    s = jnp.where(mask[None, :], s, NEG_INF)
-    if causal:
-        qi = pl.program_id(1)
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [Bq, Bk]
     ds = p * (dp - delta[:, None])
@@ -270,21 +273,14 @@ def _flash_bwd_dkv_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
+    q, _, s, _ = _tile_scores(
+        mask_ref, q_ref, k_ref, qi, pl.program_id(1), causal=causal,
+        block_q=block_q, block_k=block_k, scale=scale,
+    )
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
-    mask = mask_ref[0, 0] != 0
-    s = jnp.where(mask[None, :], s, NEG_INF)
-    if causal:
-        ki = pl.program_id(1)
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])                        # [Bq, Bk]
     dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ()))                  # Pᵀ·dO [Bk, D]

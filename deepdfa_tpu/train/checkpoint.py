@@ -41,9 +41,21 @@ class CheckpointManager:
         with open(self._meta_path, "w") as f:
             json.dump(self._meta, f)
 
-    def save_best(self, state: Any, epoch: int, val_loss: float) -> None:
+    def save_best(self, state: Any, epoch: int,
+                  val_loss: Optional[float] = None,
+                  metrics: Optional[dict] = None) -> None:
+        """``val_loss`` is the GNN trainer's selection quantity (lower is
+        better); runs that select on something else (val F1, bleu+em, ...)
+        record it under its own name via ``metrics`` so meta.json never
+        shows a negated stand-in in the val-loss field."""
         self._save("best", state)
-        self._meta.update({"best_epoch": epoch, "best_val_loss": val_loss})
+        self._meta["best_epoch"] = epoch
+        if val_loss is not None:
+            self._meta["best_val_loss"] = val_loss
+        if metrics:
+            self._meta["best_metrics"] = {
+                k: float(v) for k, v in metrics.items()
+            }
         self._write_meta()
 
     def save_last(self, state: Any, epoch: int) -> None:
