@@ -410,6 +410,15 @@ def _text_model_and_tokenizer(args, combined: bool, graph_cfg):
     if args.model == "codet5":
         from deepdfa_tpu.models.t5 import DefectModel, T5Config
 
+        if (getattr(args, "attention_impl", "auto") != "auto"
+                or getattr(args, "remat", False)):
+            # The T5 stack has its own attention; silently recording
+            # settings that were never in effect would poison test-text's
+            # reconstruction.
+            raise ValueError(
+                "--attention-impl/--remat configure the RoBERTa encoder "
+                "(--model linevul); the codet5 stack does not take them"
+            )
         t5cfg = T5Config.tiny() if args.tiny else T5Config.codet5_base()
         model = DefectModel(t5cfg, graph_config=gcfg)
         vocab, pad_id, style = t5cfg.vocab_size, t5cfg.pad_token_id, "t5"
@@ -420,6 +429,14 @@ def _text_model_and_tokenizer(args, combined: bool, graph_cfg):
         from deepdfa_tpu.models.transformer import EncoderConfig
 
         enc = EncoderConfig.tiny() if args.tiny else EncoderConfig()
+        enc = dataclasses.replace(
+            enc,
+            # "auto" = the measured champion per backend (flash kernels on
+            # TPU, blockwise elsewhere); "dense" remains available for the
+            # localization/attribution flows that need attention weights.
+            attention_impl=getattr(args, "attention_impl", "auto"),
+            remat_layers=getattr(args, "remat", False),
+        )
         model = LineVul(enc, graph_config=gcfg)
         vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
         eos_id = None
@@ -540,6 +557,8 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         descriptor = {
             "model": args.model,
             "tiny": args.tiny,
+            "attention_impl": args.attention_impl,
+            "remat": args.remat,
             "combined": combined,
             "block_size": tcfg.block_size,
             "dataset": args.dataset,
@@ -614,6 +633,8 @@ def cmd_test_text(args) -> Dict[str, Any]:
     ns = argparse.Namespace(
         model=desc["model"], tiny=desc["tiny"],
         tokenizer=args.tokenizer or desc.get("tokenizer"),
+        attention_impl=desc.get("attention_impl", "auto"),
+        remat=desc.get("remat", False),
     )
     combined = desc["combined"]
     model, tok, pad_id, style = _text_model_and_tokenizer(ns, combined,
@@ -897,6 +918,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "--freeze_graph)")
     p_ft.add_argument("--tiny", action="store_true",
                       help="tiny encoder shapes (smoke tests)")
+    p_ft.add_argument("--attention-impl", default="auto",
+                      choices=["auto", "dense", "blockwise", "flash"],
+                      help="encoder attention (auto = flash kernels on TPU, "
+                           "blockwise elsewhere; dense for attribution; "
+                           "ring needs a seq-axis mesh — library surface)")
+    p_ft.add_argument("--remat", action="store_true",
+                      help="rematerialize encoder layers (shapes beyond the "
+                           "measured 16G envelope — costs throughput inside "
+                           "it)")
     p_ft.add_argument("--tokenizer", default=None,
                       help="trained BPE assets (defaults to the hashing "
                            "tokenizer)")

@@ -156,6 +156,7 @@ def run_experiment(
     pretrained: Optional[str] = None,
     tokenizer: Optional[str] = None,
     flowgnn: Optional[str] = None,
+    beam_size: int = 10,
 ) -> Dict:
     """Run one experiment end to end; returns the result record written to
     ``<res_dir>/<task>_<sub_task>_<model_tag>/result.json`` (res_fn,
@@ -219,7 +220,7 @@ def run_experiment(
                                 tok=tok, out_dir=out_dir)
     else:  # generation family: summarize / translate / refine / concode
         result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok,
-                          out_dir=out_dir)
+                          out_dir=out_dir, beam_size=beam_size)
     result["seconds"] = round(time.time() - t0, 2)
     result["config"] = dataclasses.asdict(cfg)
     if pretrained:
@@ -337,7 +338,10 @@ def _split_exists(data_dir: str, task: str, sub_task: str, split: str) -> bool:
     )
 
 
-def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
+def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None,
+             beam_size=10):
+    """``beam_size``: dev/test decoding width (the reference's --beam_size,
+    run_gen.py:79,108 — default 10)."""
     from deepdfa_tpu.train.gen_loop import fit_gen
 
     init_params = None
@@ -391,8 +395,8 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
     # run_gen.py:152-154) additionally needs parseable source text.
     decode_fn = getattr(tok, "decode", None) if tok is not None else None
     out = fit_gen(model, train, evald, tcfg, max_target_length=max_tgt,
-                  init_params=init_params, task=cfg.task,
-                  decode_fn=decode_fn, output_dir=out_dir,
+                  beam_size=beam_size, init_params=init_params,
+                  task=cfg.task, decode_fn=decode_fn, output_dir=out_dir,
                   codebleu_lang="java" if (cfg.task == "concode"
                                            and decode_fn) else None)
     _save_best(out_dir, out["state"], out["best_epoch"],
@@ -412,7 +416,7 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None):
         )
 
         ev = evaluate_gen(model, out["state"], testd, tcfg, max_tgt,
-                          return_preds=True)
+                          beam_size=beam_size, return_preds=True)
         pad, eos = model.cfg.pad_token_id, model.cfg.eos_token_id
         preds = _ids_to_text(ev["pred_ids"], pad, eos, decode_fn)
         golds = _ids_to_text(testd["target_ids"][: len(preds)], pad, eos,
@@ -496,6 +500,9 @@ def _run_defect(cfg, tcfg, data, tiny, pretrained=None, tok=None,
             init_params = {"params": {"roberta": conv["params"]}}
         else:
             enc = EncoderConfig.tiny() if tiny else EncoderConfig()
+        # auto = flash kernels on TPU, blockwise elsewhere (attention impls
+        # don't touch the param tree, so pretrained grafts are unaffected).
+        enc = dataclasses.replace(enc, attention_impl="auto")
         model = LineVul(enc, graph_config=gcfg)
         vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
         eos_id = None  # the encoder classifier pools at [CLS], not eos
@@ -798,6 +805,9 @@ def main(argv=None) -> int:
                              "the vocab/merges pair etl/tokenizer_train.py "
                              "writes) for --data encoding; required to "
                              "combine --pretrained with --data")
+    parser.add_argument("--beam_size", type=int, default=10,
+                        help="dev/test decoding beam for the generation "
+                             "tasks (run_gen.py:79 default)")
     parser.add_argument("--flowgnn", default=None,
                         help="graph source (synthetic | dbize cache dir | "
                              "etl export .jsonl) activating the DeepDFA-"
@@ -814,6 +824,7 @@ def main(argv=None) -> int:
         cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,
         overrides=overrides, pretrained=args.pretrained,
         tokenizer=args.tokenizer, flowgnn=args.flowgnn,
+        beam_size=args.beam_size,
     )
     print(json.dumps(result))
     return 0

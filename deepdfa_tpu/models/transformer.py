@@ -42,8 +42,9 @@ class EncoderConfig:
     dtype: str = "float32"
     # Attention implementation: "dense" (O(T^2), returns weights — required
     # for line-level localization), "blockwise" (streaming-softmax lax.scan,
-    # O(T) memory), "flash" (Pallas TPU kernel), or "ring" (sequence-parallel
-    # over the mesh's seq axis — the long-context path the reference lacks,
+    # O(T) memory), "flash" (Pallas TPU fwd+bwd kernels), "auto" (flash on
+    # TPU, blockwise elsewhere), or "ring" (sequence-parallel over the
+    # mesh's seq axis — the long-context path the reference lacks,
     # SURVEY §5). Non-dense impls compute exact attention but apply no
     # attention-probability dropout (standard for fused kernels).
     attention_impl: str = "dense"
@@ -103,7 +104,7 @@ class SelfAttention(nn.Module):
             weights = jax.nn.softmax(scores + bias, axis=-1)
             weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
             out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-        elif c.attention_impl in ("blockwise", "flash"):
+        elif c.attention_impl in ("blockwise", "flash", "auto"):
             from deepdfa_tpu.ops.attention import attention as attn_fn
 
             out = attn_fn(q, k, v, kv_mask=attn_mask, impl=c.attention_impl)

@@ -507,11 +507,11 @@ def flash_attention(q, k, v, kv_mask=None, causal=False,
 
 
 def attention(q, k, v, kv_mask=None, causal=False, impl: str = "auto", **kw):
-    """Dispatch: 'dense' | 'blockwise' | 'flash' | 'auto' (flash on TPU when
-    shapes tile, else blockwise)."""
+    """Dispatch: 'dense' | 'blockwise' | 'flash' | 'auto' (flash on TPU —
+    it handles untileable shapes by falling back internally — else
+    blockwise)."""
     if impl == "auto":
-        tiled = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-        impl = "flash" if (jax.default_backend() == "tpu" and tiled) else "blockwise"
+        impl = "flash" if jax.default_backend() == "tpu" else "blockwise"
     if impl == "dense":
         return dense_attention(q, k, v, kv_mask=kv_mask, causal=causal, **kw)
     if impl == "blockwise":
