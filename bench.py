@@ -24,18 +24,33 @@ Measurement notes, learned the hard way on the tunneled axon backend:
   run pathologically slow through the tunnel).
 - Transformer compute runs bfloat16 — the TPU-native dtype (MXU) — with f32
   master weights; the reference's GPU numbers are fp32.
+- The tunneled chip shows large run-to-run variance for the small GNN step
+  (observed 154k-308k graphs/s across IDENTICAL code on one afternoon);
+  every A/B cited below was measured back-to-back in one process, which is
+  the only comparison this backend supports.
 
-Where the time goes (round-3 ablations on v5e; /tmp harnesses re-derivable
-from this file):
-- The GNN step is forward+backward COMPUTE, not overhead: chained-dependency
-  ablation gives fwd 0.89 ms, fwd+bwd 2.43 ms of the 2.46 ms step; the
-  optimizer update and metrics are ~free (optax.flatten: no change), and the
-  amortized dispatch is ~0.13 ms/step (two-unroll fit, reported below).
-  MFU ~1.3%: at hidden 128 the model is HBM/latency-bound, not MXU-bound —
-  the cost model counts only ~5.9 GFLOP/step at batch 256.
-- Bigger batches do NOT help the GNN: 256 -> 108k, 1024 -> 97k, 2048 -> 85k
-  graphs/s (the sequential tile grid and per-node ops scale linearly while
-  padding waste grows). 256 is the throughput optimum AND the parity shape.
+Where the time goes (round-3/4 ablations on v5e; /tmp harnesses
+re-derivable from this file):
+- GNN message_impl (round 4): the block-banded batched-matmul path
+  (ops/band_spmm.py) replaces the Pallas tile-grid kernel as flagship —
+  the tile kernel walks its 128-entry tile list with a *sequential* grid,
+  one DMA-latency-bound 128x128 matmul per step, while the banded layout
+  runs the whole adjacency as 2B+1 parallel [T,128,128] bmms (B=1 at CFG
+  sparsity). Isolated A/B pre-pooling-fix: 145.7k vs 114.0k graphs/s; the
+  tile A/B rides the extras every run (BENCH_r04: 308.3k vs 195.3k).
+- GNN pooling (round 4): TPU scatters serialize — the traced step spent
+  ~0.9 ms (of 1.76) in GlobalAttentionPool's scatter/gather fusions
+  (60-190 us EACH, vs ~12 us for an equivalent dense dot). Routing every
+  per-graph reduction and graph->node broadcast through one dense
+  assignment matrix (segment_onehot, pool_impl="matmul") and the
+  graph-label scatter-max through a masked row-max cut the step to
+  0.83 ms: 308.3k graphs/s bf16, 2.7x round 3's 114.4k. Remaining
+  profile: the 5-step scan fwd+bwd ~370 us, embedding-grad scatter-adds
+  ~240 us (the onehot alternative measures a wash at vocab 1002),
+  loss/opt/metrics ~100 us.
+- remat_steps stays on (281k vs 203k off in the harness A/B); bigger
+  batches stay flat (band, pre-pooling-fix: 256 -> 145.7k, 512 -> 154k,
+  1024 -> 152.5k); 256 is the parity shape and the headline.
 - Combined model (round-4 state): the Pallas flash kernel now WINS the
   512-token parity A/B — round 3's 2x loss was (a) a backward that
   recomputed through the blockwise lax.scan and (b) 128x128 tiles whose
@@ -106,7 +121,8 @@ def _peak_flops() -> float:
     return peak
 
 
-def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False):
+def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
+                  impl: "str | None" = None):
     """Training throughput at the published architecture (Table 2 config).
 
     ``dtype``: computation dtype for messages/GRU (params stay f32).
@@ -114,6 +130,10 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False):
     adjacency tiles; f32 is measured as the reference-dtype comparison point
     (its GPU baseline is fp32). Both train the synthetic task to the same F1
     (tests/test_train.py).
+
+    ``impl``: message-passing implementation; default "band" (the block-
+    banded batched-matmul path, the measured winner — module docstring) on
+    TPU and "segment" elsewhere. "tile" rides the extras as the A/B.
 
     ``diagnostics``: also return {flops_per_step, mfu, ms_per_step} — the
     cost-model FLOPs and achieved MFU against the chip's peak. The
@@ -126,8 +146,10 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False):
     from deepdfa_tpu.train.loop import make_train_state, make_train_step
     from __graft_entry__ import _example_batch
 
-    # The Pallas block-sparse tile SpMM path needs a TPU backend.
-    impl = "tile" if jax.default_backend() == "tpu" else "segment"
+    if impl is None:
+        # The banded path is pure XLA but its dense-diagonal zero-fill only
+        # pays off where the MXU eats it; segment ops win on CPU hosts.
+        impl = "band" if jax.default_backend() == "tpu" else "segment"
     model_cfg = FlowGNNConfig(message_impl=impl, dtype=dtype)
     data_cfg = DataConfig(batch_size=256)
     train_cfg = TrainConfig()
@@ -357,6 +379,13 @@ def main() -> None:
         flush=True,
     )
     graphs_per_sec_f32 = bench_deepdfa("float32")
+    # The tile-kernel A/B at the parity shape, re-checked every run (band
+    # wins since round 4 — module docstring); on non-TPU hosts both
+    # measurements fall back to segment and the A/B is a no-op.
+    graphs_per_sec_tile = (
+        bench_deepdfa("bfloat16", impl="tile")
+        if jax.default_backend() == "tpu" else None
+    )
     combined_eps, comb_diag = bench_combined_train(attention_impl="flash",
                                                    diagnostics=True)
     # The A/B at the parity shape, re-checked every run (flash wins since
@@ -411,6 +440,17 @@ def main() -> None:
                         "unit": "graphs/s",
                         "vs_baseline": round(graphs_per_sec_f32 / baseline_gnn, 3),
                     },
+                    *(
+                        [{
+                            "metric": "deepdfa_train_graphs_per_sec_tile",
+                            "value": round(graphs_per_sec_tile, 1),
+                            "unit": "graphs/s",
+                            "vs_baseline": round(
+                                graphs_per_sec_tile / baseline_gnn, 3
+                            ),
+                            "message_impl": "tile",
+                        }] if graphs_per_sec_tile is not None else []
+                    ),
                     {
                         "metric": "combined_train_examples_per_sec",
                         "value": round(combined_eps, 2),

@@ -333,11 +333,12 @@ def cmd_test(args) -> Dict[str, Any]:
     model = FlowGNN(model_cfg)
     subkeys = subkeys_for(model_cfg.feature)
     use_tile = model_cfg.message_impl == "tile"
+    use_band = model_cfg.message_impl == "band"
     use_df = model_cfg.label_style.startswith("dataflow_solution")
     example_batch = next(
         _batches(examples, splits["test"][: data_cfg.eval_batch_size], data_cfg,
                  subkeys, data_cfg.eval_batch_size, build_tile_adj=use_tile,
-                 with_dataflow=use_df)
+                 build_band_adj=use_band, with_dataflow=use_df)
     )
     state, _ = make_train_state(model, example_batch, train_cfg)
     ckpt = CheckpointManager(args.checkpoint_dir)
@@ -347,7 +348,8 @@ def cmd_test(args) -> Dict[str, Any]:
 
     eval_step = jax.jit(make_eval_step(model, train_cfg))
     res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
-                   build_tile_adj=use_tile, with_dataflow=use_df)
+                   build_tile_adj=use_tile, build_band_adj=use_band,
+                   with_dataflow=use_df)
     report = {"loss": res.loss, **res.metrics}
 
     if getattr(args, "profile", False) or getattr(args, "time", False):
@@ -369,7 +371,7 @@ def cmd_test(args) -> Dict[str, Any]:
         batches = list(
             _batches(examples, splits["test"], data_cfg, subkeys,
                      data_cfg.eval_batch_size, build_tile_adj=use_tile,
-                     with_dataflow=use_df)
+                     build_band_adj=use_band, with_dataflow=use_df)
         )
         recorder = ProfileRecorder(profile_path, time_path)
         summary = profile_eval(

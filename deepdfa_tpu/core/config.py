@@ -93,13 +93,20 @@ class FlowGNNConfig:
     # Computation dtype for messages/GRU; params stay float32.
     dtype: str = "float32"
     # "segment": XLA gather/scatter-add; "tile": Pallas block-sparse tile
-    # SpMM (requires batches built with build_tile_adj=True).
+    # SpMM (requires batches built with build_tile_adj=True); "band":
+    # block-banded batched matmul (build_band_adj=True) — the fastest TPU
+    # path (fully parallel MXU work, bench.py).
     message_impl: str = "segment"
     # Rematerialize the gated steps in the backward pass. The step is
     # HBM-bound, so recomputing activations beats storing them: ~7% higher
     # training throughput on v5e (110.8k vs 103.1k graphs/s at batch 256)
     # AND less memory. Gradients are mathematically identical.
     remat_steps: bool = True
+    # Attention-pooling implementation: "matmul" computes the per-graph
+    # softmax reductions/broadcasts as dense assignment-matrix matmuls (TPU
+    # scatters serialize — the measured win, bench.py); "segment" keeps the
+    # scatter formulation (the oracle).
+    pool_impl: str = "matmul"
 
     @property
     def input_dim(self) -> int:

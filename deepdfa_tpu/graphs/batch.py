@@ -22,7 +22,6 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from deepdfa_tpu.graphs.segment import segment_max
 
 
 @struct.dataclass
@@ -55,6 +54,9 @@ class GraphBatch:
     # Optional block-sparse adjacency (ops/tile_spmm.TileAdjacency) for the
     # Pallas MXU message-passing path; None → XLA segment ops.
     tile_adj: Optional[Any] = None
+    # Optional block-banded adjacency (ops/band_spmm.BandAdjacency): the
+    # fully-parallel batched-matmul message path (message_impl="band").
+    band_adj: Optional[Any] = None
     # Optional per-node dataflow-solution bits (_DF_IN/_DF_OUT analogues,
     # reference base_module.py:83-95): int32[max_nodes], built when the
     # examples carry "df_in"/"df_out" (batch_graphs(with_dataflow=True)).
@@ -81,11 +83,18 @@ def graph_label_from_nodes(batch: GraphBatch) -> jnp.ndarray:
     (DDFA/code_gnn/models/base_module.py:87-88: ``g.ndata["_VULN"].max()``
     per unbatched graph). Padded nodes are routed through value 0 so an
     all-padding slot yields label 0 (and is excluded by graph_mask anyway).
+
+    Computed as a masked broadcast-compare + row max instead of a
+    segment_max: XLA serializes TPU scatters, and this per-step scatter-max
+    cost ~70 us in the traced train step (bench.py module docstring); the
+    dense [n_graphs, max_nodes] reduce fuses into one cheap kernel.
     """
-    vuln = jnp.where(batch.node_mask, batch.node_vuln, 0)
-    return segment_max(
-        vuln.astype(jnp.float32), batch.node_graph, batch.n_graphs, initial=0.0
+    vuln = jnp.where(batch.node_mask, batch.node_vuln, 0).astype(jnp.float32)
+    member = (
+        batch.node_graph[None, :]
+        == jnp.arange(batch.n_graphs, dtype=batch.node_graph.dtype)[:, None]
     )
+    return jnp.where(member, vuln[None, :], 0.0).max(axis=1)
 
 
 # Bucket ladder for padding budgets: powers of two limit recompilation.
@@ -131,6 +140,8 @@ def batch_graphs(
     build_tile_adj: bool = False,
     tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
+    build_band_adj: bool = False,
+    band_bandwidth: Optional[int] = None,
     impl: str = "auto",
     with_dataflow: bool = False,
 ) -> "GraphBatch":
@@ -223,6 +234,17 @@ def batch_graphs(
             pad_nz=tile_pad_nz,
         )
 
+    band_adj = None
+    if build_band_adj:
+        from deepdfa_tpu.ops.band_spmm import build_band_adjacency
+        from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE
+
+        band_adj = build_band_adjacency(
+            senders, receivers, edge_mask, max_nodes,
+            tile=tile if tile is not None else DEFAULT_TILE,
+            bandwidth=band_bandwidth,
+        )
+
     df_in = df_out = None
     if with_dataflow:
         # Dataflow-solution bits ride outside the native batcher (a plain
@@ -254,6 +276,7 @@ def batch_graphs(
         graph_mask=jnp.asarray(graph_mask),
         graph_ids=jnp.asarray(graph_ids),
         tile_adj=tile_adj,
+        band_adj=band_adj,
         node_df_in=jnp.asarray(df_in) if df_in is not None else None,
         node_df_out=jnp.asarray(df_out) if df_out is not None else None,
     )
@@ -269,18 +292,22 @@ def batch_iterator(
     build_tile_adj: bool = False,
     tile: Optional[int] = None,  # None -> ops.tile_spmm.DEFAULT_TILE
     tile_pad_nz: Optional[int] = None,
+    build_band_adj: bool = False,
+    band_bandwidth: Optional[int] = None,
     with_dataflow: bool = False,
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would
     overflow the budget into the next batch (static-shape replacement for
     DGL's GraphDataLoader). With ``build_tile_adj`` every batch carries the
     Pallas block-sparse adjacency (pin ``tile_pad_nz`` so all batches share
-    one compiled kernel)."""
+    one compiled kernel); ``build_band_adj`` likewise attaches the banded
+    adjacency (pin ``band_bandwidth``)."""
     pending: List[Mapping] = []
     nodes = edges = 0
     kw = dict(
         add_self_loops=add_self_loops, build_tile_adj=build_tile_adj,
-        tile=tile, tile_pad_nz=tile_pad_nz, with_dataflow=with_dataflow,
+        tile=tile, tile_pad_nz=tile_pad_nz, build_band_adj=build_band_adj,
+        band_bandwidth=band_bandwidth, with_dataflow=with_dataflow,
     )
 
     def _cost(g):

@@ -2,9 +2,15 @@
 
 DGL implements gather/scatter message passing in CUDA (GatedGraphConv's SpMM,
 GlobalAttentionPooling's per-graph softmax). On TPU the same computation is
-expressed with static-shape segment reductions that XLA lowers to efficient
-sorted-scatter code; the Pallas kernel in ``deepdfa_tpu.ops`` specializes the
-hot path further.
+expressed with static-shape segment reductions; the kernels in
+``deepdfa_tpu.ops`` specialize the hot paths further.
+
+Scatter is the slow lane on TPU — XLA serializes it, and a traced train step
+spends most of its fixed cost in the pooling/embedding scatters (measured on
+v5e: ~60-190 us per scatter/gather fusion vs ~15 us for an equivalent-size
+matmul; bench.py module docstring). :func:`segment_onehot` is the dense
+escape hatch: a [num_segments, n] assignment matrix turns masked segment
+sums into MXU matmuls whose backward is also a matmul, no scatter anywhere.
 """
 
 from __future__ import annotations
@@ -35,6 +41,26 @@ def segment_max(
     if initial != -jnp.inf:
         out = jnp.where(jnp.isneginf(out), initial, out)
     return out
+
+
+def segment_onehot(
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """Dense assignment matrix ``M`` [num_segments, n]: ``M @ x`` equals the
+    masked ``segment_sum(x)`` — as one MXU matmul instead of a scatter, with
+    a matmul transpose (not a gather) as its autodiff backward.
+
+    ``M`` itself is structural: build it under ``stop_gradient`` semantics
+    (boolean comparisons carry no gradient) and reuse it for every reduction
+    over the same batch layout.
+    """
+    m = segment_ids[None, :] == jnp.arange(num_segments)[:, None]
+    if mask is not None:
+        m = m & mask[None, :]
+    return m.astype(dtype)
 
 
 def segment_softmax(

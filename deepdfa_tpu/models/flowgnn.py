@@ -38,10 +38,11 @@ class GatedGraphStep(nn.Module):
     a single edge-typed linear applied to sender states, summed into
     receivers, fed to a GRU cell as the input with the node state as carry.
 
-    Two aggregation paths: XLA segment ops (gather + scatter-add), or the
+    Three aggregation paths: XLA segment ops (gather + scatter-add), the
     Pallas block-sparse tile SpMM (``deepdfa_tpu.ops.tile_spmm``) when the
-    batch carries a precomputed ``TileAdjacency`` — dense MXU tiles instead
-    of irregular memory traffic.
+    batch carries a precomputed ``TileAdjacency``, or the block-banded
+    batched matmul (``deepdfa_tpu.ops.band_spmm``) — dense MXU work instead
+    of irregular memory traffic, fully parallel in the banded case.
     """
 
     hidden: int
@@ -69,6 +70,22 @@ class GatedGraphStep(nn.Module):
                 agg = tile_spmm_sharded(batch.tile_adj, msg, self.mesh)
             else:
                 agg = tile_spmm(batch.tile_adj, msg)
+        elif self.message_impl == "band":
+            if batch.band_adj is None:
+                raise ValueError(
+                    "message_impl='band' needs batch_graphs(build_band_adj=True)"
+                )
+            from deepdfa_tpu.ops.band_spmm import band_spmm, band_spmm_sharded
+
+            if batch.band_adj.vals.ndim == 5:
+                # Stacked per-shard adjacency (shard_concat on a dp mesh).
+                if self.mesh is None:
+                    raise ValueError(
+                        "sharded band batch needs FlowGNN(config, mesh=mesh)"
+                    )
+                agg = band_spmm_sharded(batch.band_adj, msg, self.mesh)
+            else:
+                agg = band_spmm(batch.band_adj, msg)
         else:
             gathered = jnp.take(msg, batch.senders, axis=0)
             gathered = jnp.where(batch.edge_mask[:, None], gathered, 0.0)
@@ -85,17 +102,71 @@ class GlobalAttentionPool(nn.Module):
     weighted sum of node features. Padded node slots get zero weight via the
     mask, so pooling over a padded batch equals pooling over the dynamic
     batch.
+
+    ``impl="matmul"`` (the default) routes every per-graph reduction AND
+    every graph-to-node broadcast through one dense assignment matrix
+    (graphs/segment.py:segment_onehot): TPU scatters serialize and even the
+    [graphs]->[nodes] broadcast gathers cost ~190 us each in the traced
+    train step, ~0.9 ms/step total in this pooling (bench.py module
+    docstring). The per-graph softmax shift itself is kept (numerics
+    identical to the segment path) but computed under stop_gradient, so its
+    scatter-max has no backward transpose. ``impl="segment"`` keeps the
+    scatter formulation (the oracle the matmul path is tested against).
     """
 
     dtype: jnp.dtype = jnp.float32
+    impl: str = "matmul"
 
     @nn.compact
     def __call__(self, feat, node_graph, node_mask, n_graphs):
         gate = nn.Dense(1, dtype=self.dtype, name="gate")(feat)[:, 0]
-        weights = segment_softmax(gate, node_graph, n_graphs, mask=node_mask)
-        weighted = feat * weights[:, None]
-        weighted = jnp.where(node_mask[:, None], weighted, 0.0)
-        return segment_sum(weighted, node_graph, n_graphs)
+        if self.impl == "segment":
+            weights = segment_softmax(gate, node_graph, n_graphs, mask=node_mask)
+            weighted = feat * weights[:, None]
+            weighted = jnp.where(node_mask[:, None], weighted, 0.0)
+            return segment_sum(weighted, node_graph, n_graphs)
+        if self.impl != "matmul":
+            raise ValueError(f"unknown pool impl {self.impl!r}")
+        from deepdfa_tpu.graphs.segment import segment_onehot
+
+        gate32 = jnp.where(node_mask, gate.astype(jnp.float32), -jnp.inf)
+        onehot32 = segment_onehot(node_graph, n_graphs, mask=node_mask)
+        # Per-graph stability shift, same values as segment_softmax's
+        # segment_max — computed as a dense masked row-max (one reduce
+        # fusion; the scatter-max alone cost ~70 us) under stop_gradient
+        # (softmax is shift-invariant, so the shift carries no true
+        # gradient). The [graphs]->[nodes] broadcast rides the onehot
+        # matmul instead of a (slow) gather.
+        shift = jax.lax.stop_gradient(
+            jnp.where(onehot32 != 0, gate32[None, :], -jnp.inf).max(axis=1)
+        )
+        shift = jnp.where(jnp.isneginf(shift), 0.0, shift)  # empty graphs
+        # f32 runs keep HIGHEST matmul precision so TPU stays comparable
+        # with the segment oracle (DEFAULT lowers f32 dots to bf16 MXU
+        # passes) — the same rule as band_spmm/tile_spmm. bf16 runs take
+        # DEFAULT everywhere: a bf16-rounded shift/denominator is no
+        # coarser than the surrounding bf16 compute, and HIGHEST's 6-pass
+        # decomposition over the [graphs, nodes] onehot costs ~0.27 ms of
+        # the 0.83 ms step (measured).
+        precision = (
+            jax.lax.Precision.HIGHEST
+            if jnp.dtype(self.dtype) == jnp.float32
+            else jax.lax.Precision.DEFAULT
+        )
+        shift_b = jnp.matmul(  # [nodes]; masked slots broadcast 0
+            shift, onehot32, precision=precision
+        )
+        e = jnp.where(node_mask, jnp.exp(gate32 - shift_b), 0.0)
+        denom = jnp.matmul(onehot32, e, precision=precision)
+        denom = jnp.where(denom > 0, denom, 1.0)  # empty graphs pool to 0
+        weighted = feat * e[:, None].astype(feat.dtype)
+        pooled = jax.lax.dot_general(
+            onehot32.astype(feat.dtype), weighted,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        return (pooled / denom[:, None]).astype(feat.dtype)
 
 
 class FlowGNN(nn.Module):
@@ -155,7 +226,9 @@ class FlowGNN(nn.Module):
         out = jnp.concatenate([ggnn_out, feat_embed], axis=-1)
 
         if cfg.label_style == "graph":
-            pooled = GlobalAttentionPool(dtype=dtype, name="pooling")(
+            pooled = GlobalAttentionPool(
+                dtype=dtype, impl=cfg.pool_impl, name="pooling"
+            )(
                 out, batch.node_graph, batch.node_mask, batch.n_graphs
             )
             if cfg.encoder_mode:

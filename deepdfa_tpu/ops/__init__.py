@@ -1,15 +1,27 @@
-"""Pallas TPU kernels for the hot ops.
+"""TPU kernels and dense reformulations of the hot ops.
 
 The default compute path is the XLA segment-op formulation in
-``deepdfa_tpu.graphs.segment``; kernels here specialize the hot ops when
-profiling shows XLA's generated code leaving HBM bandwidth on the table.
+``deepdfa_tpu.graphs.segment``; the modules here specialize the hot ops
+when profiling shows XLA's generated code leaving the MXU idle.
 
-- ``tile_spmm``: block-sparse dense-tile SpMM for GNN message aggregation
-  (MXU matmuls over scalar-prefetched tile coordinates), with a custom VJP.
+- ``band_spmm``: block-banded dense adjacency — GNN message aggregation as
+  2B+1 parallel batched MXU matmuls (pure XLA, autodiff backward). The
+  measured flagship on TPU (bench.py). Select with
+  ``FlowGNNConfig(message_impl="band")`` on batches built with
+  ``batch_graphs(build_band_adj=True)``.
+- ``tile_spmm``: block-sparse dense-tile SpMM (Pallas; MXU matmuls over
+  scalar-prefetched tile coordinates, sequential grid), with a custom VJP.
   Select with ``FlowGNNConfig(message_impl="tile")`` on batches built with
   ``batch_graphs(build_tile_adj=True)``.
+- ``attention``: blockwise streaming-softmax attention + Pallas flash
+  kernels (forward and dq/dk/dv backward) — the long-context path.
 """
 
+from deepdfa_tpu.ops.band_spmm import (  # noqa: F401
+    BandAdjacency,
+    band_spmm,
+    build_band_adjacency,
+)
 from deepdfa_tpu.ops.tile_spmm import (  # noqa: F401
     TileAdjacency,
     build_tile_adjacency,

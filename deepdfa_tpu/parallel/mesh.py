@@ -61,6 +61,8 @@ def shard_concat(
     base_shard: int = 0,
     tile_nz: Optional[int] = None,
     tile_dtype=None,
+    band_bandwidth: Optional[int] = None,
+    band_dtype=None,
 ) -> GraphBatch:
     """Concatenate D equal-budget per-device batches into one device-aligned
     global batch.
@@ -74,8 +76,9 @@ def shard_concat(
     references by its global position, since the lifted array's indices are
     global (senders/receivers/node_graph address rows of the full batch).
 
-    ``tile_nz``/``tile_dtype``: common tile budget and vals dtype for the
-    stacked adjacency; multi-controller callers pass the global maximum /
+    ``tile_nz``/``tile_dtype`` (and ``band_bandwidth``/``band_dtype`` for
+    the banded path): common budget and vals dtype for the stacked
+    adjacency; multi-controller callers pass the global maximum /
     globally-agreed dtype over all shards so every host's local stack
     shares one leaf shape AND dtype.
     """
@@ -112,6 +115,15 @@ def shard_concat(
             force_dtype=tile_dtype,
         )
 
+    band_adj = None
+    if all(b.band_adj is not None for b in shards):
+        from deepdfa_tpu.ops.band_spmm import stack_band_adjacencies
+
+        band_adj = stack_band_adjacencies(
+            [b.band_adj for b in shards], bandwidth=band_bandwidth,
+            force_dtype=band_dtype,
+        )
+
     return GraphBatch(
         node_feats={
             k: jnp.asarray(
@@ -128,6 +140,7 @@ def shard_concat(
         graph_mask=jnp.asarray(cat("graph_mask")),
         graph_ids=jnp.asarray(cat("graph_ids")),
         tile_adj=tile_adj,
+        band_adj=band_adj,
         node_df_in=(
             jnp.asarray(cat("node_df_in"))
             if all(b.node_df_in is not None for b in shards) else None

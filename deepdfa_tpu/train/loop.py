@@ -163,6 +163,7 @@ def _batches(
     batch_size: int,
     n_shards: int = 1,
     build_tile_adj: bool = False,
+    build_band_adj: bool = False,
     with_dataflow: bool = False,
     host: "Optional[Tuple[int, int]]" = None,
     with_global_meta: bool = False,
@@ -196,23 +197,27 @@ def _batches(
     per_shard = max(batch_size // n_shards, 1)
     budget_nodes = per_shard * data_cfg.max_nodes_per_graph
     budget_edges = budget_nodes * data_cfg.max_edges_per_node
-    if build_tile_adj:
+    if build_tile_adj or build_band_adj:
         # Per-shard node budget must be a tile multiple; shard_concat stacks
-        # the per-shard tile lists along a device axis for the sharded kernel.
+        # the per-shard adjacencies along a device axis for the sharded path.
         from deepdfa_tpu.ops.tile_spmm import align_to_tile
 
         budget_nodes = align_to_tile(budget_nodes)
-    # Multi-controller tile batches: every host packs the full shard group,
-    # but dense tiles are only materialized for the LOCAL shards — remote
-    # shards contribute just their pow2 budget and vals dtype, computed from
-    # edge lists alone, so all hosts stack to one agreed leaf shape+dtype.
-    build_dense = build_tile_adj and host is None
-    # Tile counts pad to powers of two inside build_tile_adjacency, so the
-    # jitted step sees a handful of distinct adjacency shapes (the same
-    # bucket-ladder compromise as the node/edge budgets), not one per batch.
+    # Multi-controller adjacency batches: every host packs the full shard
+    # group, but dense tiles/bands are only materialized for the LOCAL
+    # shards — remote shards contribute just their budget (pow2 tile count /
+    # bucketed bandwidth) and vals dtype, computed from edge lists alone, so
+    # all hosts stack to one agreed leaf shape+dtype.
+    build_dense_tile = build_tile_adj and host is None
+    build_dense_band = build_band_adj and host is None
+    # Tile counts pad to powers of two inside build_tile_adjacency (and
+    # bandwidths inside build_band_adjacency), so the jitted step sees a
+    # handful of distinct adjacency shapes (the same bucket-ladder
+    # compromise as the node/edge budgets), not one per batch.
     sub_iter = batch_iterator(
         chosen, per_shard, budget_nodes, budget_edges, subkeys,
-        build_tile_adj=build_dense, with_dataflow=with_dataflow,
+        build_tile_adj=build_dense_tile, build_band_adj=build_dense_band,
+        with_dataflow=with_dataflow,
     )
     if n_shards == 1:
         # with_global_meta is a multi-controller (n_shards > 1) concern;
@@ -230,7 +235,8 @@ def _batches(
         return
     empty = batch_graphs(
         [], per_shard, budget_nodes, budget_edges, subkeys,
-        build_tile_adj=build_dense, with_dataflow=with_dataflow,
+        build_tile_adj=build_dense_tile, build_band_adj=build_dense_band,
+        with_dataflow=with_dataflow,
     )
     sel = (
         local_shard_slice(n_shards, host[0], host[1]) if host is not None
@@ -257,33 +263,60 @@ def _batches(
         }
 
     def concat(group: List[GraphBatch]) -> GraphBatch:
-        if not build_tile_adj or host is None:
+        if host is None or not (build_tile_adj or build_band_adj):
             return shard_concat(group[sel], base_shard=base)
-        from deepdfa_tpu.ops.tile_spmm import (
-            build_tile_adjacency,
-            combine_tile_stats,
-            tile_nz_budget,
-            tile_vals_dtype,
-        )
-
-        def stat(b: GraphBatch):
-            m = np.asarray(b.edge_mask)
-            s, r = np.asarray(b.senders)[m], np.asarray(b.receivers)[m]
-            return tile_nz_budget(s, r, b.max_nodes), tile_vals_dtype(s, r)
-
-        tile_nz, tile_dt = combine_tile_stats([stat(b) for b in group])
-        local = [
-            b.replace(
-                tile_adj=build_tile_adjacency(
-                    np.asarray(b.senders), np.asarray(b.receivers),
-                    np.asarray(b.edge_mask), b.max_nodes, pad_nz=tile_nz,
-                )
+        local = list(group[sel])
+        kw: Dict[str, Any] = {}
+        if build_tile_adj:
+            from deepdfa_tpu.ops.tile_spmm import (
+                build_tile_adjacency,
+                combine_tile_stats,
+                tile_nz_budget,
+                tile_vals_dtype,
             )
-            for b in group[sel]
-        ]
-        return shard_concat(
-            local, base_shard=base, tile_nz=tile_nz, tile_dtype=tile_dt
-        )
+
+            def stat(b: GraphBatch):
+                m = np.asarray(b.edge_mask)
+                s, r = np.asarray(b.senders)[m], np.asarray(b.receivers)[m]
+                return tile_nz_budget(s, r, b.max_nodes), tile_vals_dtype(s, r)
+
+            tile_nz, tile_dt = combine_tile_stats([stat(b) for b in group])
+            local = [
+                b.replace(
+                    tile_adj=build_tile_adjacency(
+                        np.asarray(b.senders), np.asarray(b.receivers),
+                        np.asarray(b.edge_mask), b.max_nodes, pad_nz=tile_nz,
+                    )
+                )
+                for b in local
+            ]
+            kw.update(tile_nz=tile_nz, tile_dtype=tile_dt)
+        if build_band_adj:
+            from deepdfa_tpu.ops.band_spmm import (
+                band_width_for,
+                build_band_adjacency,
+                combine_band_stats,
+            )
+            from deepdfa_tpu.ops.tile_spmm import tile_vals_dtype
+
+            def bstat(b: GraphBatch):
+                m = np.asarray(b.edge_mask)
+                s, r = np.asarray(b.senders)[m], np.asarray(b.receivers)[m]
+                return band_width_for(s, r), tile_vals_dtype(s, r)
+
+            band_bw, band_dt = combine_band_stats([bstat(b) for b in group])
+            local = [
+                b.replace(
+                    band_adj=build_band_adjacency(
+                        np.asarray(b.senders), np.asarray(b.receivers),
+                        np.asarray(b.edge_mask), b.max_nodes,
+                        bandwidth=band_bw,
+                    )
+                )
+                for b in local
+            ]
+            kw.update(band_bandwidth=band_bw, band_dtype=band_dt)
+        return shard_concat(local, base_shard=base, **kw)
 
     def emit(group: List[GraphBatch]):
         batch = concat(group)
@@ -312,6 +345,7 @@ def evaluate(
     with_dataflow: bool = False,
     host: "Optional[Tuple[int, int]]" = None,
     mesh=None,
+    build_band_adj: bool = False,
 ) -> EvalResult:
     """``host``/``mesh``: multi-controller mode — each host feeds its local
     shard slice, lifted to global arrays. The jitted eval outputs replicate
@@ -329,7 +363,8 @@ def evaluate(
     probs_all, labels_all, ids_all = [], [], []
     for item in _batches(
         examples, indices, data_cfg, subkeys, data_cfg.eval_batch_size, n_shards,
-        build_tile_adj, with_dataflow, host, with_global_meta=host is not None,
+        build_tile_adj, build_band_adj, with_dataflow, host,
+        with_global_meta=host is not None,
     ):
         if host is not None:
             batch, gmeta = item
@@ -397,6 +432,7 @@ def fit(
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
     use_tile = model.config.message_impl == "tile"
+    use_band = model.config.message_impl == "band"
     use_df = model.config.label_style.startswith("dataflow_solution")
     # Multi-controller: every process runs this same loop; each feeds its
     # local slice of every global batch (host_shard contract, mesh.py).
@@ -412,7 +448,8 @@ def fit(
     # host-local batch slice, and the smaller init compiles faster.
     example_batch = next(
         _batches(examples, splits["train"][:data_cfg.batch_size], data_cfg, subkeys,
-                 max(data_cfg.batch_size // n_shards, 1), 1, use_tile, use_df)
+                 max(data_cfg.batch_size // n_shards, 1), 1, use_tile, use_band,
+                 use_df)
     )
     init_model = model.clone(mesh=None) if model.mesh is not None else model
     state, tx = make_train_state(init_model, example_batch, train_cfg)
@@ -477,9 +514,9 @@ def fit(
     try:
         return _fit_epochs(
             model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
-            use_tile, use_df, state, train_step, eval_step, labels, history,
-            best_state, checkpointer, tb_writer, log_every, start_epoch,
-            host, mesh, on_epoch_end,
+            use_tile, use_band, use_df, state, train_step, eval_step, labels,
+            history, best_state, checkpointer, tb_writer, log_every,
+            start_epoch, host, mesh, on_epoch_end,
         )
     finally:
         # close on every exit path: a diverging run (detect_anomaly raise)
@@ -501,9 +538,9 @@ def _check_anomaly(train_cfg, bad_step, epoch: int) -> None:
 
 def _fit_epochs(
     model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
-    use_tile, use_df, state, train_step, eval_step, labels, history, best_state,
-    checkpointer, tb_writer, log_every, start_epoch=0, host=None, mesh=None,
-    on_epoch_end=None,
+    use_tile, use_band, use_df, state, train_step, eval_step, labels, history,
+    best_state, checkpointer, tb_writer, log_every, start_epoch=0, host=None,
+    mesh=None, on_epoch_end=None,
 ):
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
@@ -532,8 +569,8 @@ def _fit_epochs(
         bad_step = jnp.asarray(-1, jnp.int32)
         n_batches = 0
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
-                              data_cfg.batch_size, n_shards, use_tile, use_df,
-                              host):
+                              data_cfg.batch_size, n_shards, use_tile,
+                              use_band, use_df, host):
             if host is not None:
                 batch = assemble_global_batch(batch, mesh)
             state, loss, bstats = train_step(state, batch)
@@ -552,7 +589,8 @@ def _fit_epochs(
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
         val = evaluate(eval_step, state, examples, splits["val"], data_cfg,
-                       subkeys, n_shards, use_tile, use_df, host, mesh)
+                       subkeys, n_shards, use_tile, use_df, host, mesh,
+                       build_band_adj=use_band)
         record = {
             "epoch": epoch,
             "train_loss": epoch_loss / max(n_batches, 1),

@@ -114,6 +114,7 @@ def text_graph_batches(
     build_tile_adj: bool = False,
     n_shards: int = 1,
     host: Optional[Tuple[int, int]] = None,
+    build_band_adj: bool = False,
 ) -> Iterable[TextBatch]:
     """Fixed-size text batches, each pre-joined with its graphs.
 
@@ -186,7 +187,7 @@ def text_graph_batches(
             if n_shards == 1:
                 gbatch = _slotted_graph_batch(
                     shard_slots[0], rows_per_shard, shard_nodes, shard_edges,
-                    subkeys, build_tile_adj,
+                    subkeys, build_tile_adj, build_band_adj,
                 )
             else:
                 from deepdfa_tpu.parallel.mesh import (
@@ -204,11 +205,12 @@ def text_graph_batches(
                 subs = [
                     _slotted_graph_batch(
                         shard_slots[d], rows_per_shard, shard_nodes,
-                        shard_edges, subkeys, build_tile_adj,
+                        shard_edges, subkeys, build_tile_adj, build_band_adj,
                     )
                     for d in range(*sel_sh.indices(n_shards))
                 ]
                 tile_nz = tile_dt = None
+                band_bw = band_dt = None
                 if host is not None and build_tile_adj:
                     # The pow2 tile budget and vals dtype depend on every
                     # shard's edge layout; compute them from edge lists
@@ -220,9 +222,19 @@ def text_graph_batches(
                         _shard_tile_stats(shard_slots[d], shard_nodes)
                         for d in range(n_shards)
                     ])
+                if host is not None and build_band_adj:
+                    # Same contract for the banded path: bandwidth + dtype
+                    # from edge lists alone.
+                    from deepdfa_tpu.ops.band_spmm import combine_band_stats
+
+                    band_bw, band_dt = combine_band_stats([
+                        _shard_band_stats(shard_slots[d])
+                        for d in range(n_shards)
+                    ])
                 gbatch = shard_concat(
                     subs, base_shard=sel_sh.start or 0, tile_nz=tile_nz,
-                    tile_dtype=tile_dt,
+                    tile_dtype=tile_dt, band_bandwidth=band_bw,
+                    band_dtype=band_dt,
                 )
         n_missing = int((index >= 0).sum() - mask.sum())
         gmeta = None
@@ -268,8 +280,31 @@ def _shard_tile_stats(slot_graphs, max_nodes: int):
     )
 
 
+def _shard_band_stats(slot_graphs):
+    """(bucketed bandwidth, vals dtype) a shard's banded adjacency will
+    carry, from edge lists alone — the band sibling of _shard_tile_stats,
+    sharing its packed-layout replication. Unlike the tile budget, the
+    bandwidth depends only on node spans within the packed layout, not on
+    the shard's node budget."""
+    from deepdfa_tpu.ops.band_spmm import band_width_for
+    from deepdfa_tpu.ops.tile_spmm import tile_vals_dtype
+
+    senders, receivers, off = [], [], 0
+    for _, g in slot_graphs:
+        n = int(g["num_nodes"])
+        loops = np.arange(off, off + n, dtype=np.int64)
+        senders += [np.asarray(g["senders"], np.int64) + off, loops]
+        receivers += [np.asarray(g["receivers"], np.int64) + off, loops]
+        off += n
+    z = np.zeros(0, np.int64)
+    s = np.concatenate(senders) if senders else z
+    r = np.concatenate(receivers) if receivers else z
+    return band_width_for(s, r), tile_vals_dtype(s, r)
+
+
 def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
-                         build_tile_adj: bool = False):
+                         build_tile_adj: bool = False,
+                         build_band_adj: bool = False):
     """batch_graphs, but graphs land in given slots (empty slots masked)."""
     ordered = []
     slot_of = {}
@@ -278,12 +313,13 @@ def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
         ordered.append(g)
     # n_slots graph slots regardless of how many graphs exist, so batch
     # shapes stay static across batches with missing graphs.
-    if build_tile_adj:
+    if build_tile_adj or build_band_adj:
         from deepdfa_tpu.ops.tile_spmm import align_to_tile
 
         max_nodes = align_to_tile(max_nodes)
     b = batch_graphs(ordered, n_slots, max_nodes, max_edges, subkeys,
-                     build_tile_adj=build_tile_adj)
+                     build_tile_adj=build_tile_adj,
+                     build_band_adj=build_band_adj)
     # Remap graph slot ids to text-row slots.
     remap = np.zeros(max(len(ordered), 1), np.int32)
     graph_mask = np.zeros(n_slots, bool)
@@ -303,9 +339,10 @@ def _slotted_graph_batch(slot_graphs, n_slots, max_nodes, max_edges, subkeys,
         edge_mask=b.edge_mask,
         graph_mask=jnp.asarray(graph_mask),
         graph_ids=jnp.asarray(graph_ids),
-        # The tile adjacency depends only on senders/receivers, which the
-        # slot remap leaves untouched.
+        # The tile/band adjacencies depend only on senders/receivers, which
+        # the slot remap leaves untouched.
         tile_adj=b.tile_adj,
+        band_adj=b.band_adj,
     )
 
 
@@ -425,6 +462,7 @@ def evaluate_text(
     eval_step, state, data, indices, cfg: TransformerTrainConfig,
     graphs_by_id=None, subkeys=None, graph_budget=None, pad_id: int = 1,
     build_tile_adj: bool = False, n_shards: int = 1, host=None, mesh=None,
+    build_band_adj: bool = False,
 ):
     """``host``/``mesh``: multi-controller mode — the jitted eval outputs
     replicate across hosts, and the batch carries host-side global
@@ -437,7 +475,7 @@ def evaluate_text(
     for batch in text_graph_batches(
         data, indices, cfg.eval_batch_size, graphs_by_id, subkeys, graph_budget,
         pad_id=pad_id, build_tile_adj=build_tile_adj, n_shards=n_shards,
-        host=host,
+        host=host, build_band_adj=build_band_adj,
     ):
         num_missing += batch.n_missing
         if host is not None:
@@ -499,6 +537,10 @@ def fit_text(
         model.graph_config is not None
         and model.graph_config.message_impl == "tile"
     )
+    build_band_adj = (
+        model.graph_config is not None
+        and model.graph_config.message_impl == "band"
+    )
     from deepdfa_tpu.parallel.mesh import DATA_AXIS
 
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
@@ -519,7 +561,8 @@ def fit_text(
         text_graph_batches(
             data, splits["train"][: cfg.batch_size], cfg.batch_size,
             graphs_by_id, subkeys, graph_budget, pad_id=pad_id,
-            build_tile_adj=build_tile_adj, n_shards=n_shards, host=host,
+            build_tile_adj=build_tile_adj, build_band_adj=build_band_adj,
+            n_shards=n_shards, host=host,
         )
     )
     if host is not None:
@@ -552,7 +595,8 @@ def fit_text(
         for batch in text_graph_batches(
             data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
             graph_budget, shuffle_rng=rng, pad_id=pad_id,
-            build_tile_adj=build_tile_adj, n_shards=n_shards, host=host,
+            build_tile_adj=build_tile_adj, build_band_adj=build_band_adj,
+            n_shards=n_shards, host=host,
         ):
             num_missing += batch.n_missing
             if host is not None:
@@ -565,7 +609,8 @@ def fit_text(
         val = evaluate_text(
             eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
             graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
-            n_shards=n_shards, host=host, mesh=mesh,
+            build_band_adj=build_band_adj, n_shards=n_shards, host=host,
+            mesh=mesh,
         )
         record = {
             "epoch": epoch,

@@ -1,0 +1,119 @@
+"""GlobalAttentionPool: the dense matmul path vs the segment-op oracle,
+and the dense graph-label extraction vs a numpy reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.models.flowgnn import GlobalAttentionPool
+
+
+def _case(rng, n_nodes=200, n_graphs=12, feat_dim=16, empty_slots=(3, 7),
+          gate_scale=1.0):
+    node_graph = np.sort(rng.integers(0, n_graphs, n_nodes)).astype(np.int32)
+    node_graph[node_graph == empty_slots[0]] = empty_slots[0] + 1
+    node_graph[node_graph == empty_slots[1]] = empty_slots[1] - 1
+    node_mask = rng.random(n_nodes) > 0.15
+    feat = rng.standard_normal((n_nodes, feat_dim)).astype(np.float32)
+    return (
+        jnp.asarray(feat),
+        jnp.asarray(node_graph),
+        jnp.asarray(node_mask),
+        n_graphs,
+        gate_scale,
+    )
+
+
+@pytest.mark.parametrize("gate_scale", [1.0, 30.0])
+def test_matmul_pool_matches_segment(gate_scale):
+    """Same params, same inputs: both impls agree on values and gradients —
+    including wildly spread gate logits (the per-graph shift keeps the
+    matmul path as stable as the oracle) and empty graph slots."""
+    rng = np.random.default_rng(0)
+    feat, node_graph, node_mask, n_graphs, _ = _case(rng, gate_scale=gate_scale)
+    feat = feat * gate_scale  # spreads the gate logits through the Dense
+
+    seg = GlobalAttentionPool(impl="segment")
+    mat = GlobalAttentionPool(impl="matmul")
+    params = seg.init(jax.random.PRNGKey(0), feat, node_graph, node_mask, n_graphs)
+
+    out_seg = seg.apply(params, feat, node_graph, node_mask, n_graphs)
+    out_mat = mat.apply(params, feat, node_graph, node_mask, n_graphs)
+    np.testing.assert_allclose(
+        np.asarray(out_seg), np.asarray(out_mat), rtol=2e-5, atol=2e-5
+    )
+
+    def loss(model):
+        def f(p, x):
+            return jnp.sum(model.apply(p, x, node_graph, node_mask, n_graphs) ** 2)
+        return f
+
+    g_seg = jax.grad(loss(seg), argnums=(0, 1))(params, feat)
+    g_mat = jax.grad(loss(mat), argnums=(0, 1))(params, feat)
+    # The gate BIAS gradient is analytically zero (softmax is invariant to
+    # a per-graph constant), so for both impls it is pure roundoff — its
+    # magnitude differs between the formulations (the matmul path leaks
+    # ~6e-3 at scale 30 where the oracle's cancellation lands at ~1e-6,
+    # both against weight gradients of magnitude ~60). Assert each is near
+    # the analytic zero instead of near each other, and compare the real
+    # gradients against the oracle with spread-scaled tolerance.
+    for g in (g_seg, g_mat):
+        bias = g[0]["params"]["gate"].pop("bias")
+        np.testing.assert_allclose(np.asarray(bias), 0.0, atol=1e-3 * gate_scale)
+    tol = 2e-4 * gate_scale
+    for a, b in zip(jax.tree_util.tree_leaves(g_seg), jax.tree_util.tree_leaves(g_mat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=tol, atol=tol)
+
+
+def test_matmul_pool_empty_batch():
+    """A fully-padded batch pools to zeros in both impls (no NaNs from the
+    empty-segment denominators)."""
+    n_nodes, n_graphs, d = 32, 4, 8
+    feat = jnp.ones((n_nodes, d))
+    node_graph = jnp.zeros(n_nodes, jnp.int32)
+    node_mask = jnp.zeros(n_nodes, bool)
+    for impl in ("segment", "matmul"):
+        m = GlobalAttentionPool(impl=impl)
+        p = m.init(jax.random.PRNGKey(0), feat, node_graph, node_mask, n_graphs)
+        out = np.asarray(m.apply(p, feat, node_graph, node_mask, n_graphs))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, 0.0)
+
+
+def test_unknown_pool_impl_refused():
+    m = GlobalAttentionPool(impl="nope")
+    feat = jnp.ones((8, 4))
+    ng = jnp.zeros(8, jnp.int32)
+    mask = jnp.ones(8, bool)
+    with pytest.raises(ValueError):
+        m.init(jax.random.PRNGKey(0), feat, ng, mask, 2)
+
+
+def test_graph_label_dense_matches_numpy():
+    """graph_label_from_nodes (dense row-max form) == per-graph max over
+    real nodes, with padded slots at 0."""
+    from deepdfa_tpu.core.config import FeatureSpec, subkeys_for
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.graphs.batch import (
+        batch_graphs,
+        graph_label_from_nodes,
+        pad_budget_for,
+    )
+
+    feature = FeatureSpec(limit_all=10)
+    graphs = synthetic_bigvul(10, feature, positive_fraction=0.5, seed=5)
+    budget = pad_budget_for(graphs, 16)
+    batch = batch_graphs(
+        graphs, 16, budget["max_nodes"], budget["max_edges"], subkeys_for(feature)
+    )
+    got = np.asarray(graph_label_from_nodes(batch))
+    ng = np.asarray(batch.node_graph)
+    nm = np.asarray(batch.node_mask)
+    nv = np.asarray(batch.node_vuln)
+    want = np.zeros(16, np.float32)
+    for g in range(16):
+        sel = (ng == g) & nm
+        if sel.any():
+            want[g] = max(nv[sel].max(), 0)
+    np.testing.assert_allclose(got, want)
