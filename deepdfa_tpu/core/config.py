@@ -105,8 +105,9 @@ class FlowGNNConfig:
     # Attention-pooling implementation: "matmul" computes the per-graph
     # softmax reductions/broadcasts as dense assignment-matrix matmuls (TPU
     # scatters serialize — the measured win, bench.py); "segment" keeps the
-    # scatter formulation (the oracle).
-    pool_impl: str = "matmul"
+    # scatter formulation (the oracle); "auto" picks matmul on TPU and
+    # segment elsewhere (CPU hosts pay real FLOPs for the zero-fill).
+    pool_impl: str = "auto"
 
     @property
     def input_dim(self) -> int:

@@ -103,7 +103,8 @@ class GlobalAttentionPool(nn.Module):
     mask, so pooling over a padded batch equals pooling over the dynamic
     batch.
 
-    ``impl="matmul"`` (the default) routes every per-graph reduction AND
+    ``impl="matmul"`` (the default on TPU via "auto") routes every
+    per-graph reduction AND
     every graph-to-node broadcast through one dense assignment matrix
     (graphs/segment.py:segment_onehot): TPU scatters serialize and even the
     [graphs]->[nodes] broadcast gathers cost ~190 us each in the traced
@@ -115,18 +116,25 @@ class GlobalAttentionPool(nn.Module):
     """
 
     dtype: jnp.dtype = jnp.float32
-    impl: str = "matmul"
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, feat, node_graph, node_mask, n_graphs):
+        impl = self.impl
+        if impl == "auto":
+            # Backend-gated like message_impl: the dense formulation's
+            # zero-fill is free on the MXU but real FLOPs on CPU hosts.
+            impl = (
+                "matmul" if jax.default_backend() == "tpu" else "segment"
+            )
         gate = nn.Dense(1, dtype=self.dtype, name="gate")(feat)[:, 0]
-        if self.impl == "segment":
+        if impl == "segment":
             weights = segment_softmax(gate, node_graph, n_graphs, mask=node_mask)
             weighted = feat * weights[:, None]
             weighted = jnp.where(node_mask[:, None], weighted, 0.0)
             return segment_sum(weighted, node_graph, n_graphs)
-        if self.impl != "matmul":
-            raise ValueError(f"unknown pool impl {self.impl!r}")
+        if impl != "matmul":
+            raise ValueError(f"unknown pool impl {impl!r}")
         from deepdfa_tpu.graphs.segment import segment_onehot
 
         gate32 = jnp.where(node_mask, gate.astype(jnp.float32), -jnp.inf)

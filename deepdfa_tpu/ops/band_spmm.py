@@ -41,7 +41,7 @@ from flax import struct
 
 from deepdfa_tpu.ops.tile_spmm import (
     DEFAULT_TILE,
-    align_to_tile,
+    _round_up_pow2,
     tile_vals_dtype,
 )
 
@@ -63,11 +63,10 @@ class BandAdjacency:
 
 
 def _bucket_bandwidth(b: int) -> int:
-    """Pow2 ladder (min 1) so near-miss batches share a compiled program."""
-    p = 1
-    while p < b:
-        p *= 2
-    return p
+    """Pow2 ladder (min 1) so near-miss batches share a compiled program —
+    the same bucketing rule as the tile path's budgets (shared helper, so
+    the multi-controller shape agreement can never drift between them)."""
+    return _round_up_pow2(max(b, 1))
 
 
 def band_width_for(

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from deepdfa_tpu.core.prng import fold_in_dropout
 from flax import struct
 
 from deepdfa_tpu.core.config import TransformerTrainConfig
@@ -62,7 +64,7 @@ def clone_loss(model: CloneModel, params, source_ids, labels, example_mask,
 
 def make_clone_train_step(model: CloneModel, tx, cfg: TransformerTrainConfig):
     def step(state: CloneTrainState, source_ids, labels, example_mask):
-        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+        dropout_rng = fold_in_dropout(state.dropout_rng, state.step)
 
         def loss_fn(params):
             return clone_loss(model, params, source_ids, labels, example_mask,

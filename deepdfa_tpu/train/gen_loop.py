@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from deepdfa_tpu.core.prng import fold_in_dropout
 from flax import struct
 
 from deepdfa_tpu.core.config import TransformerTrainConfig
@@ -87,7 +89,7 @@ def make_gen_train_state(
 
 def make_gen_train_step(model: T5Model, tx, cfg: TransformerTrainConfig) -> Callable:
     def step(state: GenTrainState, source_ids, target_ids):
-        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+        dropout_rng = fold_in_dropout(state.dropout_rng, state.step)
 
         def loss_fn(params):
             return seq2seq_loss(

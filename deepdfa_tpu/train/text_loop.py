@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from deepdfa_tpu.core.prng import fold_in_dropout
 from flax import struct
 
 from deepdfa_tpu.core.config import DataConfig, TransformerTrainConfig, subkeys_for
@@ -394,7 +396,7 @@ def _merge_params(params: Any, overrides: Any) -> Any:
 
 def make_text_train_step(model: LineVul, tx, cfg: TransformerTrainConfig) -> Callable:
     def step(state: TextTrainState, input_ids, labels, example_mask, graphs):
-        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+        dropout_rng = fold_in_dropout(state.dropout_rng, state.step)
 
         def loss_fn(params):
             logits = model.apply(
