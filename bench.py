@@ -44,10 +44,16 @@ re-derivable from this file):
   per-graph reduction and graph->node broadcast through one dense
   assignment matrix (segment_onehot, pool_impl="matmul") and the
   graph-label scatter-max through a masked row-max cut the step to
-  0.83 ms: 308.3k graphs/s bf16, 2.7x round 3's 114.4k. Remaining
-  profile: the 5-step scan fwd+bwd ~370 us, embedding-grad scatter-adds
-  ~240 us (the onehot alternative measures a wash at vocab 1002),
-  loss/opt/metrics ~100 us.
+  0.83 ms: 308.3k graphs/s bf16, 2.7x round 3's 114.4k.
+- GNN embeddings (round 4): the last scatters standing were the 4 tables'
+  grad accumulations (~240 us/step). Estimating them as "a wash at vocab
+  1002" was wrong in the scatter's favor — the whole-step A/B of the
+  onehot-matmul backward (segment.onehot_take, embed_impl="matmul")
+  measured 0.83 -> 0.61 ms/step: 419.6k graphs/s bf16 (3.7x round 3,
+  59.9x the 3090 baseline), f32 238.6k. Moral, twice over: never trust a
+  per-op estimate on this backend; only whole-step back-to-back A/Bs.
+  Remaining profile: the 5-step scan fwd+bwd ~370 us, loss/opt/metrics,
+  and the pooling/label dense ops.
 - remat_steps stays on (281k vs 203k off in the harness A/B); bigger
   batches stay flat (band, pre-pooling-fix: 256 -> 145.7k, 512 -> 154k,
   1024 -> 152.5k); 256 is the parity shape and the headline.

@@ -1,0 +1,23 @@
+"""The one backend gate for "auto" implementation choices.
+
+Several ops keep two formulations — a dense matmul/bmm form whose zero-fill
+is free on the MXU, and a segment/gather form that wins elsewhere — and
+resolve "auto" by backend. The rule lives here once so the sites
+(GlobalAttentionPool, EmbedTable, flash-vs-blockwise attention, rbg dropout
+keys) can never drift apart.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def tpu_backend() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def resolve_auto(impl: str, tpu: str, other: str) -> str:
+    """Map "auto" to the backend's choice; pass any explicit impl through."""
+    if impl != "auto":
+        return impl
+    return tpu if tpu_backend() else other

@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deepdfa_tpu.core.backend import tpu_backend
+
 
 def fold_in_dropout(base_rng: jnp.ndarray, step: jnp.ndarray):
     """fold_in(base, step), re-wrapped for fast TPU bit generation.
@@ -26,7 +28,7 @@ def fold_in_dropout(base_rng: jnp.ndarray, step: jnp.ndarray):
     backends, which nothing depends on.
     """
     k = jax.random.fold_in(base_rng, step)
-    if jax.default_backend() != "tpu":
+    if not tpu_backend():
         return k
     data = jnp.concatenate([jnp.ravel(k), jnp.ravel(k)]).astype(jnp.uint32)
     return jax.random.wrap_key_data(data, impl="rbg")

@@ -15,6 +15,8 @@ sums into MXU matmuls whose backward is also a matmul, no scatter anywhere.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -61,6 +63,50 @@ def segment_onehot(
     if mask is not None:
         m = m & mask[None, :]
     return m.astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def onehot_take(
+    table: jnp.ndarray, idx: jnp.ndarray, precision=None
+) -> jnp.ndarray:
+    """``table[idx]`` whose BACKWARD is an assignment-matrix matmul instead
+    of XLA's scatter-add.
+
+    The forward gather is cheap on TPU; the grad-accumulation scatter is
+    not (serialized — ~60 us per table per step in the traced GNN train
+    step vs ~15 us for the equivalent dense dot; swapping it for
+    ``onehot.T @ g`` measured 0.83 -> 0.61 ms/step end to end, bench.py).
+
+    ``precision`` applies to the backward dot. The cotangent arrives f32
+    regardless of training dtype (autodiff of the lookup's downstream
+    cast), so the caller picks: DEFAULT for bf16 training (one bf16
+    rounding of g, f32 MXU accumulation — no coarser than the surrounding
+    compute), HIGHEST for f32 runs (exact like the scatter).
+    """
+    return jnp.take(table, idx, axis=0)
+
+
+def _onehot_take_fwd(table, idx, precision=None):
+    # Zero-width marker carries the table's static row count and dtype into
+    # the backward without holding the table itself alive.
+    marker = jnp.zeros((table.shape[0], 0), table.dtype)
+    return jnp.take(table, idx, axis=0), (idx, marker)
+
+
+def _onehot_take_bwd(precision, res, g):
+    import numpy as np
+
+    idx, marker = res
+    onehot = segment_onehot(idx, marker.shape[0], dtype=g.dtype)
+    dtable = jax.lax.dot_general(
+        onehot, g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    ).astype(marker.dtype)
+    return dtable, np.zeros(idx.shape, jax.dtypes.float0)
+
+
+onehot_take.defvjp(_onehot_take_fwd, _onehot_take_bwd)
 
 
 def segment_softmax(

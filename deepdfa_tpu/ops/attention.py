@@ -472,11 +472,13 @@ def _pick_block(t: int, target: int) -> Optional[int]:
     exists (odd lengths — the caller falls back to blockwise rather than
     compiling an unbounded single-tile kernel).
 
-    Short-sequence grid sizing is the difference between winning and losing
-    the 512-token A/B: at (bq=128, bk=128) the b·h×4×4 grid is thousands of
-    ~4-MFLOP programs and per-program overhead dominates (measured 4.4 ms
-    fwd+bwd at B16·H12·T512·D64 on v5e vs 0.95 ms at (256, 512) — bigger
-    tiles amortize it and still fit VMEM comfortably)."""
+    Grid sizing is the difference between winning and losing the 512-token
+    A/B: small tiles make thousands of ~4-MFLOP programs and per-program
+    overhead dominates. Whole-train-step A/Bs on v5e (the only measurement
+    this backend supports — bench.py): combined bs16 at (bq, bk) =
+    (128, 512) 188.3 ex/s, (256, 512) 206.5, (512, 512) 214.1 — one
+    program per (head, whole sequence) at the parity shape. VMEM stays
+    comfortable (tiles are [block, 64])."""
     if t <= max(target, 128):
         return t
     best = None
@@ -492,13 +494,13 @@ def flash_attention(q, k, v, kv_mask=None, causal=False,
     """Pallas TPU flash attention (exact), fwd + bwd kernels. Interprets on
     non-TPU backends so tests cover the kernel math on the CPU mesh.
 
-    Block sizes default to the measured sweet spot (q tiles up to 256, kv
-    tiles up to 512, divisor-aligned) — see ``_pick_block``. Sequences with
-    no bounded tiling (e.g. long odd lengths) take the blockwise path."""
+    Block sizes default to the measured sweet spot (q and kv tiles up to
+    512, divisor-aligned) — see ``_pick_block``. Sequences with no bounded
+    tiling (e.g. long odd lengths) take the blockwise path."""
     if not _HAVE_PALLAS:  # pragma: no cover
         return blockwise_attention(q, k, v, kv_mask=kv_mask, causal=causal)
     if block_q is None:
-        block_q = _pick_block(q.shape[1], 256)
+        block_q = _pick_block(q.shape[1], 512)
     if block_k is None:
         block_k = _pick_block(k.shape[1], 512)
     if block_q is None or block_k is None:
@@ -510,8 +512,9 @@ def attention(q, k, v, kv_mask=None, causal=False, impl: str = "auto", **kw):
     """Dispatch: 'dense' | 'blockwise' | 'flash' | 'auto' (flash on TPU —
     it handles untileable shapes by falling back internally — else
     blockwise)."""
-    if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "blockwise"
+    from deepdfa_tpu.core.backend import resolve_auto
+
+    impl = resolve_auto(impl, tpu="flash", other="blockwise")
     if impl == "dense":
         return dense_attention(q, k, v, kv_mask=kv_mask, causal=causal, **kw)
     if impl == "blockwise":

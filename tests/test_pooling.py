@@ -117,3 +117,52 @@ def test_graph_label_dense_matches_numpy():
         if sel.any():
             want[g] = max(nv[sel].max(), 0)
     np.testing.assert_allclose(got, want)
+
+
+def test_embed_matmul_backward_matches_take():
+    """EmbedTable impl='matmul' (assignment-matrix gradient) == impl='take'
+    (scatter-add gradient) for values and table gradients, f32/HIGHEST."""
+    from deepdfa_tpu.models.flowgnn import EmbedTable
+
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, 50, 400), jnp.int32)
+    take = EmbedTable(50, 16, impl="take")
+    mat = EmbedTable(50, 16, impl="matmul")
+    params = take.init(jax.random.PRNGKey(0), idx)
+
+    np.testing.assert_allclose(
+        np.asarray(take.apply(params, idx)), np.asarray(mat.apply(params, idx))
+    )
+
+    cot = jnp.asarray(rng.standard_normal((400, 16)), jnp.float32)
+
+    def loss(model):
+        return lambda p: jnp.vdot(model.apply(p, idx), cot)
+
+    g_take = jax.grad(loss(take))(params)["params"]["embedding"]
+    g_mat = jax.grad(loss(mat))(params)["params"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(g_take), np.asarray(g_mat), rtol=1e-5, atol=1e-6
+    )
+
+    with pytest.raises(ValueError):
+        EmbedTable(50, 16, impl="nope").init(jax.random.PRNGKey(0), idx)
+
+
+def test_embed_table_param_tree_matches_nn_embed():
+    """EmbedTable keeps nn.Embed's param tree and init distribution family,
+    so checkpoints and the torch-golden param mapping stay valid."""
+    import flax.linen as nn
+    from deepdfa_tpu.models.flowgnn import EmbedTable
+
+    idx = jnp.zeros(4, jnp.int32)
+    p_new = EmbedTable(30, 8, impl="take").init(jax.random.PRNGKey(1), idx)
+    p_ref = nn.Embed(30, 8).init(jax.random.PRNGKey(1), idx)
+    leaves_new = jax.tree_util.tree_flatten_with_path(p_new)[0]
+    leaves_ref = jax.tree_util.tree_flatten_with_path(p_ref)[0]
+    assert [jax.tree_util.keystr(k) for k, _ in leaves_new] == [
+        jax.tree_util.keystr(k) for k, _ in leaves_ref
+    ]
+    for (_, a), (_, b) in zip(leaves_new, leaves_ref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
