@@ -57,22 +57,26 @@ re-derivable from this file):
 - remat_steps stays on (281k vs 203k off in the harness A/B); bigger
   batches stay flat (band, pre-pooling-fix: 256 -> 145.7k, 512 -> 154k,
   1024 -> 152.5k); 256 is the parity shape and the headline.
-- Combined model (round-4 state): the Pallas flash kernel now WINS the
+- Combined model (round-4 state): the Pallas flash kernel WINS the
   512-token parity A/B — round 3's 2x loss was (a) a backward that
   recomputed through the blockwise lax.scan and (b) 128x128 tiles whose
-  b·h×4×4 grid drowned in per-program overhead. With proper dq/dk/dv
-  backward kernels and measured block sizes (q<=256, kv<=512 —
-  ops/attention.py _pick_block), flash does 197 vs blockwise's 194 ex/s at
-  the msr parity shape (bs16), and — because the backward keeps no O(T^2)
-  residuals — batch 64 now FITS and is the throughput optimum: 218 ex/s
-  (bs128 regresses to 194; remat at these sizes only costs, 153 ex/s).
-  The blockwise A/B rides along in "extra" so a regression shows.
+  b·h×4×4 grid drowned in per-program overhead. Cumulative round-4 wins
+  measured by whole-step A/B: flash-by-default + q tiles 512 (one program
+  per head at the parity shape), rbg dropout keys (+7%), the FUSED
+  single-pass backward kernel (dq accumulated in a full-length VMEM
+  scratch inside the dk/dv sweep — every score tile computed once, not
+  twice), and the GNN encoder's scatter-free paths. Standing: 225.5 ex/s
+  bs16 (34.9%+ MFU, 5.8x the 3090) vs blockwise 200.8; bs64 225.5
+  (bs128 regresses; remat at these sizes only costs). The blockwise A/B
+  rides along in "extra" so a regression shows.
 - Long context: at 4096 tokens the blockwise path cannot even compile a
   training step (its lax.scan backward saves per-block logits — O(T^2)
   across steps — measured 54.8G required), while the flash kernels train
-  the full 12L combined model on one 16G chip. dense at 512 is also slower
-  than blockwise (155 vs 193 ex/s). Defaults: flash everywhere on TPU,
-  blockwise as the portable fallback, ring (parallel/ring.py) across chips.
+  the full 12L combined model on one 16G chip: 43.1k tok/s, 26.2% MFU
+  (the fused backward is worth +13% here — its dq pass elimination scales
+  with the tile count). dense at 512 is also slower than blockwise.
+  Defaults: flash everywhere on TPU, blockwise as the portable fallback,
+  ring (parallel/ring.py) across chips.
 """
 
 from __future__ import annotations

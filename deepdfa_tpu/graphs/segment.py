@@ -97,9 +97,19 @@ def _onehot_take_bwd(precision, res, g):
     import numpy as np
 
     idx, marker = res
-    onehot = segment_onehot(idx, marker.shape[0], dtype=g.dtype)
+    num = marker.shape[0]
+    # Match jnp.take's default (mode="fill") semantics exactly, as the
+    # scatter-add backward of the "take" oracle does: negative indices wrap
+    # pythonically, out-of-range indices contribute NOTHING (they match no
+    # row of the assignment matrix — take's forward filled them with NaN
+    # and its backward drops their cotangents). Any index rank flattens
+    # against the matching flattened cotangent rows.
+    flat_idx = jnp.ravel(idx)
+    flat_idx = jnp.where(flat_idx < 0, flat_idx + num, flat_idx)
+    flat_g = g.reshape(flat_idx.shape[0], -1)
+    onehot = segment_onehot(flat_idx, num, dtype=g.dtype)
     dtable = jax.lax.dot_general(
-        onehot, g, (((1,), (0,)), ((), ())),
+        onehot, flat_g, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=precision,
     ).astype(marker.dtype)

@@ -298,6 +298,62 @@ def _flash_bwd_dkv_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
+                            do_ref, dq_ref, dk_ref, dv_ref,
+                            dq_acc, dk_acc, dv_acc, *, causal, block_q,
+                            block_k, scale):
+    """Single-pass backward, grid (B*H, nk, qi(inner)): the dK/dV streaming
+    pattern, with dQ accumulated across the WHOLE (ki, qi) sweep in a
+    full-sequence-length VMEM scratch and written once per (batch, head).
+    Every (p, dp, ds) tile is computed ONCE instead of twice (the separate
+    dq pass reloads q/k/v/do and rebuilds the same scores), which halves
+    the backward's loads and per-program overhead — used whenever the
+    [Tq, D] f32 accumulator fits VMEM (dispatch guard in _flash_backward);
+    longer sequences take the two-pass kernels."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nk = pl.num_programs(1)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q, k, s, _ = _tile_scores(
+        mask_ref, q_ref, k_ref, qi, ki, causal=causal,
+        block_q=block_q, block_k=block_k, scale=scale,
+    )
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    p = jnp.exp(s - lse[:, None])                        # [Bq, Bk]
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ()))                  # Pᵀ·dO [Bk, D]
+    )
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    ds = p * (dp - delta[:, None])
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ()))                  # dSᵀ·(scale·Q) [Bk, D]
+    )
+    rows = pl.ds(qi * block_q, block_q)
+    dq_acc[rows] = dq_acc[rows] + jax.lax.dot(ds, k)
+
+    @pl.when(qi == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    @pl.when((ki == nk - 1) & (qi == nq - 1))
+    def _finalize_q():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
 try:  # Pallas import is deferred-safe: CPU-only environments still work.
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -305,6 +361,12 @@ try:  # Pallas import is deferred-safe: CPU-only environments still work.
     _HAVE_PALLAS = True
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
+
+
+# Ceiling for the fused backward's [Tq, D] f32 dq accumulator (VMEM scratch);
+# longer sequences take the two-pass dq + dk/dv kernels. Module-level so
+# tests can force the two-pass path at small shapes.
+_FUSED_BWD_MAX_BYTES = 4 * 1024 * 1024
 
 
 def _flash_blocks(q, k, block_q, block_k):
@@ -395,6 +457,37 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
     delta = jnp.einsum(
         "xtd,xtd->xt", dob.astype(jnp.float32), _bh(out).astype(jnp.float32)
     )[:, None, :]
+
+    # Single-pass backward whenever the full-length dq accumulator fits
+    # VMEM comfortably: every score tile is computed once instead of twice.
+    if tq * d * 4 <= _FUSED_BWD_MAX_BYTES:
+        mask_f = pl.BlockSpec((1, 1, block_k), lambda bh_, ki, qi: (bh_ // h, 0, ki))
+        row_qf = pl.BlockSpec((1, 1, block_q), lambda bh_, ki, qi: (bh_, 0, qi))
+        qtf = pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0))
+        ktf = pl.BlockSpec((1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, causal=causal,
+                              block_q=block_q, block_k=block_k, scale=scale),
+            grid=(b * h, tk // block_k, tq // block_q),
+            in_specs=[mask_f, row_qf, row_qf, qtf, ktf, ktf, qtf],
+            out_specs=[
+                pl.BlockSpec((1, tq, d), lambda bh_, ki, qi: (bh_, 0, 0)),
+                ktf,
+                ktf,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((tq, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(mask3, lse, delta, qb, kb, vb, dob)
+        return _unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h)
 
     mask_spec = pl.BlockSpec((1, 1, block_k), lambda bh_, qi, ki: (bh_ // h, 0, ki))
     row_q = pl.BlockSpec((1, 1, block_q), lambda bh_, qi, ki: (bh_, 0, qi))

@@ -270,3 +270,26 @@ def test_encoder_flash_remat_grads_match():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_flash_two_pass_backward_matches_fused(monkeypatch):
+    """The long-sequence two-pass backward (separate dq and dk/dv kernels)
+    agrees with the fused single-pass kernel the short shapes take."""
+    from deepdfa_tpu.ops import attention as A
+
+    q, k, v, mask = _rand(tq=64, tk=64)
+
+    def grads():
+        def f(q, k, v):
+            return A.flash_attention(
+                q, k, v, kv_mask=mask, block_q=32, block_k=32
+            ).astype(jnp.float32).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    fused = grads()
+    monkeypatch.setattr(A, "_FUSED_BWD_MAX_BYTES", 0)
+    two_pass = grads()
+    for a, b in zip(fused, two_pass):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )

@@ -166,3 +166,30 @@ def test_embed_table_param_tree_matches_nn_embed():
     for (_, a), (_, b) in zip(leaves_new, leaves_ref):
         assert a.shape == b.shape
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_embed_matmul_backward_2d_and_oob_indices():
+    """The matmul backward matches the scatter oracle for batched (2-D)
+    index arrays and for jnp.take's default index semantics: negative
+    indices wrap pythonically, out-of-range indices drop their cotangent
+    (take's forward filled them with NaN)."""
+    from deepdfa_tpu.models.flowgnn import EmbedTable
+
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, 20, (6, 30))
+    idx[0, 0] = 25  # out of range -> gradient dropped in both impls
+    idx[1, 2] = -3  # negative -> wraps to row 17 in both impls
+    idx = jnp.asarray(idx, jnp.int32)
+    take = EmbedTable(20, 8, impl="take")
+    mat = EmbedTable(20, 8, impl="matmul")
+    params = take.init(jax.random.PRNGKey(0), idx)
+    cot = jnp.asarray(rng.standard_normal((6, 30, 8)), jnp.float32)
+
+    def loss(model):
+        return lambda p: jnp.vdot(model.apply(p, idx), cot)
+
+    g_take = jax.grad(loss(take))(params)["params"]["embedding"]
+    g_mat = jax.grad(loss(mat))(params)["params"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(g_take), np.asarray(g_mat), rtol=1e-5, atol=1e-6
+    )
