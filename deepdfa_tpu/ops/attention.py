@@ -169,21 +169,52 @@ def dense_attention(
 # Pallas TPU flash-attention forward kernel.
 # ---------------------------------------------------------------------------
 
+def _bmm(a, b, contract):
+    """Batched-over-heads matmul with f32 MXU accumulation — the one dot
+    shape every flash kernel uses ([H, rows, cols] operands, batch dim 0)."""
+    return jax.lax.dot_general(
+        a, b, (((contract[0],), (contract[1],)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _tile_scores(mask_ref, q_ref, k_ref, qi, ki, *, causal, block_q, block_k,
                  scale):
     """The score tile every flash kernel rebuilds: pre-scaled q, raw k,
-    s = q·kᵀ with the padding and (optionally) causal masks at NEG_INF.
-    One implementation so forward and backward can never desynchronize."""
-    q = q_ref[0].astype(jnp.float32) * scale             # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)                     # [Bk, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+    s = q·kᵀ with the padding and (optionally) causal masks at NEG_INF —
+    batched over the block's heads ([H, Bq, D] x [H, Bk, D] -> [H, Bq, Bk]
+    as ONE dot_general; at D=64 a head only half-fills the MXU lanes, so
+    per-program work must be deep, and head-batching is what amortizes the
+    ~4 us/program overhead). One implementation so forward and backward can
+    never desynchronize."""
+    q = q_ref[...].astype(jnp.float32) * scale           # [H, Bq, D]
+    k = k_ref[...].astype(jnp.float32)                   # [H, Bk, D]
+    s = _bmm(q, k, (2, 2))                               # [H, Bq, Bk]
     mask = mask_ref[0, 0] != 0                           # [Bk] padding mask
-    s = jnp.where(mask[None, :], s, NEG_INF)
+    s = jnp.where(mask[None, None, :], s, NEG_INF)
     if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     return q, k, s, mask
+
+
+def _tile_grads(s, q, v_ref, do_ref, lse_ref, delta_ref, dk_acc, dv_acc):
+    """The shared dK/dV tile-gradient step (used by both the two-pass dk/dv
+    kernel and the fused backward, so they can never desynchronize):
+    rebuild P from the saved logsumexp, accumulate dV += Pᵀ·dO and
+    dK += dSᵀ·(scale·Q), and hand back dS for the caller's dQ use. ``q``
+    arrives pre-scaled, which IS the scale factor dK needs."""
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, 0, :]
+    delta = delta_ref[:, 0, :]
+    p = jnp.exp(s - lse[..., None])                      # [H, Bq, Bk]
+    dv_acc[...] = dv_acc[...] + _bmm(p, do, (1, 1))      # Pᵀ·dO [H, Bk, D]
+    dp = _bmm(do, v, (2, 2))
+    ds = p * (dp - delta[..., None])
+    dk_acc[...] = dk_acc[...] + _bmm(ds, q, (1, 1))      # dSᵀ·Q [H, Bk, D]
+    return ds
 
 
 def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -206,26 +237,26 @@ def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         mask_ref, q_ref, k_ref, pl.program_id(1), ki, causal=causal,
         block_q=block_q, block_k=block_k, scale=scale,
     )
-    v = v_ref[0].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)                   # [H, Bk, D]
 
-    m_prev = m_s[:, 0]
-    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    m_prev = m_s[..., 0]                                 # [H, Bq]
+    m_new = jnp.maximum(m_prev, s.max(axis=2))
     shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-    p = jnp.exp(s - shift[:, None])
-    p = jnp.where(mask[None, :], p, 0.0)
+    p = jnp.exp(s - shift[..., None])
+    p = jnp.where(mask[None, None, :], p, 0.0)
     corr = jnp.exp(jnp.where(m_prev <= NEG_INF / 2, NEG_INF, m_prev) - shift)
-    l_s[:, 0] = l_s[:, 0] * corr + p.sum(axis=1)
-    m_s[:, 0] = m_new
-    acc[:] = acc[:] * corr[:, None] + jax.lax.dot(p, v)
+    l_s[..., 0] = l_s[..., 0] * corr + p.sum(axis=2)
+    m_s[..., 0] = m_new
+    acc[...] = acc[...] * corr[..., None] + _bmm(p, v, (2, 1))
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_s[:, 0], 1e-30)
-        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+        l = jnp.maximum(l_s[..., 0], 1e-30)
+        o_ref[...] = (acc[...] / l[..., None]).astype(o_ref.dtype)
         # lse = shift + log(l): exp(s - lse) is the NORMALIZED probability.
         # Fully-masked rows land near log(1e-30) ≈ -69, so exp(NEG_INF -
         # lse) underflows to exactly 0 in the backward — no NaNs.
-        lse_ref[0, 0] = shift + jnp.log(l)
+        lse_ref[:, 0, :] = shift + jnp.log(l)
 
 
 def _flash_bwd_dq_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
@@ -245,18 +276,18 @@ def _flash_bwd_dq_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         mask_ref, q_ref, k_ref, pl.program_id(1), ki, causal=causal,
         block_q=block_q, block_k=block_k, scale=scale,
     )
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                  # [Bq]
-    delta = delta_ref[0, 0]                              # [Bq]
-    p = jnp.exp(s - lse[:, None])
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [Bq, Bk]
-    ds = p * (dp - delta[:, None])
-    dq_acc[:] = dq_acc[:] + jax.lax.dot(ds, k)
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)                 # [H, Bq, D]
+    lse = lse_ref[:, 0, :]                               # [H, Bq]
+    delta = delta_ref[:, 0, :]                           # [H, Bq]
+    p = jnp.exp(s - lse[..., None])
+    dp = _bmm(do, v, (2, 2))                             # [H, Bq, Bk]
+    ds = p * (dp - delta[..., None])
+    dq_acc[...] = dq_acc[...] + _bmm(ds, k, (2, 1))
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+        dq_ref[...] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
@@ -277,25 +308,13 @@ def _flash_bwd_dkv_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         mask_ref, q_ref, k_ref, qi, pl.program_id(1), causal=causal,
         block_q=block_q, block_k=block_k, scale=scale,
     )
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    p = jnp.exp(s - lse[:, None])                        # [Bq, Bk]
-    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ()))                  # Pᵀ·dO [Bk, D]
-    )
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
-    ds = p * (dp - delta[:, None])
-    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ()))                  # dSᵀ·Q [Bk, D]
-    )
+    ds = _tile_grads(s, q, v_ref, do_ref, lse_ref, delta_ref, dk_acc, dv_acc)
 
     @pl.when(qi == nq - 1)
     def _finalize():
         # No extra scale: dk_acc already used the pre-scaled q.
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_fused_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
@@ -328,30 +347,18 @@ def _flash_bwd_fused_kernel(mask_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         mask_ref, q_ref, k_ref, qi, ki, causal=causal,
         block_q=block_q, block_k=block_k, scale=scale,
     )
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    p = jnp.exp(s - lse[:, None])                        # [Bq, Bk]
-    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ()))                  # Pᵀ·dO [Bk, D]
-    )
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
-    ds = p * (dp - delta[:, None])
-    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ()))                  # dSᵀ·(scale·Q) [Bk, D]
-    )
+    ds = _tile_grads(s, q, v_ref, do_ref, lse_ref, delta_ref, dk_acc, dv_acc)
     rows = pl.ds(qi * block_q, block_q)
-    dq_acc[rows] = dq_acc[rows] + jax.lax.dot(ds, k)
+    dq_acc[:, rows] = dq_acc[:, rows] + _bmm(ds, k, (2, 1))
 
     @pl.when(qi == nq - 1)
     def _finalize_kv():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
     @pl.when((ki == nk - 1) & (qi == nq - 1))
     def _finalize_q():
-        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+        dq_ref[...] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 try:  # Pallas import is deferred-safe: CPU-only environments still work.
@@ -401,13 +408,42 @@ def _mask_3d(kv_mask, b, tk):
     return kv_mask.astype(jnp.int32)[:, None, :]
 
 
+def _pick_block_h(h: int, block_q: int, block_k: int, tq: int, d: int,
+                  with_dq_scratch: bool = False) -> int:
+    """Heads per program: the largest divisor of ``h`` keeping the VMEM
+    working set within a conservative ~10 MB of the 16 MB scoped limit.
+    The dominant live buffers are the [H, Bq, Bk] f32 score/probability
+    intermediates (several alive at once in the backward — measured 26.5 M
+    at block_h 6, bq=bk=512, which the compiler rejects), not the [*, D]
+    tiles. The kernels batch heads with 3-D dot_generals (see
+    _tile_scores). At the flagship shapes the winning config is the
+    LARGEST q tile with block_h 1 (whole-step A/B: bq512/bh1 225.5 ex/s vs
+    bq256/bh2 215.4) — big score tiles already amortize the per-program
+    overhead, so the head axis stays a knob for shapes whose score tiles
+    must be small."""
+    per_head = (
+        4 * block_q * block_k * 4            # score-sized f32 intermediates
+        + (2 * block_q + 2 * block_k) * d * 8  # tiles + accumulators
+    )
+    if with_dq_scratch:
+        per_head += tq * d * 4               # fused-backward dq accumulator
+    budget = 10 * 1024 * 1024
+    best = 1
+    for cand in range(1, h + 1):
+        if h % cand == 0 and cand * per_head <= budget:
+            best = cand
+    return best
+
+
 def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q, block_k = _flash_blocks(q, k, block_q, block_k)
+    block_h = _pick_block_h(h, block_q, block_k, tq, d)
+    hb = h // block_h  # head-blocks per batch; block_h | h by construction
     mask3 = _mask_3d(kv_mask, b, tk)
 
-    grid = (b * h, tq // block_q, tk // block_k)
+    grid = (b * hb, tq // block_q, tk // block_k)
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
         scale=1.0 / np.sqrt(d),
@@ -416,23 +452,23 @@ def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_k), lambda bh_, qi, ki: (bh_ // h, 0, ki)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda g, qi, ki: (g // hb, 0, ki)),
+            pl.BlockSpec((block_h, block_q, d), lambda g, qi, ki: (g, qi, 0)),
+            pl.BlockSpec((block_h, block_k, d), lambda g, qi, ki: (g, ki, 0)),
+            pl.BlockSpec((block_h, block_k, d), lambda g, qi, ki: (g, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh_, qi, ki: (bh_, 0, qi)),
+            pl.BlockSpec((block_h, block_q, d), lambda g, qi, ki: (g, qi, 0)),
+            pl.BlockSpec((block_h, 1, block_q), lambda g, qi, ki: (g, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_h, block_q, d), jnp.float32),
+            pltpu.VMEM((block_h, block_q, 1), jnp.float32),
+            pltpu.VMEM((block_h, block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(mask3, _bh(q), _bh(k), _bh(v))
@@ -451,6 +487,18 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
     mask3 = _mask_3d(kv_mask, b, tk)
     scale = 1.0 / np.sqrt(d)
 
+    block_h = _pick_block_h(h, block_q, block_k, tq, d, with_dq_scratch=True)
+    # Prefer fusing over a wider head batch: a smaller block_h whose
+    # [block_h, Tq, D] dq accumulator passes the fused guard beats a wider
+    # two-pass grid (the fused kernel halves the backward's loads).
+    fusable = [
+        c for c in range(1, block_h + 1)
+        if h % c == 0 and c * tq * d * 4 <= _FUSED_BWD_MAX_BYTES
+    ]
+    if fusable:
+        block_h = max(fusable)
+    hb = h // block_h
+
     qb, kb, vb = _bh(q), _bh(k), _bh(v)
     dob = _bh(g)
     # Δ_i = Σ_d dO_id · O_id, [B*H, 1, Tq] like the lse layout.
@@ -458,20 +506,21 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
         "xtd,xtd->xt", dob.astype(jnp.float32), _bh(out).astype(jnp.float32)
     )[:, None, :]
 
-    # Single-pass backward whenever the full-length dq accumulator fits
-    # VMEM comfortably: every score tile is computed once instead of twice.
-    if tq * d * 4 <= _FUSED_BWD_MAX_BYTES:
-        mask_f = pl.BlockSpec((1, 1, block_k), lambda bh_, ki, qi: (bh_ // h, 0, ki))
-        row_qf = pl.BlockSpec((1, 1, block_q), lambda bh_, ki, qi: (bh_, 0, qi))
-        qtf = pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0))
-        ktf = pl.BlockSpec((1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0))
+    # Single-pass backward whenever the (possibly shrunk) head batch's
+    # full-length dq accumulator fits VMEM: every score tile is computed
+    # once instead of twice.
+    if block_h * tq * d * 4 <= _FUSED_BWD_MAX_BYTES:
+        mask_f = pl.BlockSpec((1, 1, block_k), lambda g, ki, qi: (g // hb, 0, ki))
+        row_qf = pl.BlockSpec((block_h, 1, block_q), lambda g, ki, qi: (g, 0, qi))
+        qtf = pl.BlockSpec((block_h, block_q, d), lambda g, ki, qi: (g, qi, 0))
+        ktf = pl.BlockSpec((block_h, block_k, d), lambda g, ki, qi: (g, ki, 0))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_fused_kernel, causal=causal,
                               block_q=block_q, block_k=block_k, scale=scale),
-            grid=(b * h, tk // block_k, tq // block_q),
+            grid=(b * hb, tk // block_k, tq // block_q),
             in_specs=[mask_f, row_qf, row_qf, qtf, ktf, ktf, qtf],
             out_specs=[
-                pl.BlockSpec((1, tq, d), lambda bh_, ki, qi: (bh_, 0, 0)),
+                pl.BlockSpec((block_h, tq, d), lambda g, ki, qi: (g, 0, 0)),
                 ktf,
                 ktf,
             ],
@@ -481,39 +530,39 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
                 jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
             ],
             scratch_shapes=[
-                pltpu.VMEM((tq, d), jnp.float32),
-                pltpu.VMEM((block_k, d), jnp.float32),
-                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_h, tq, d), jnp.float32),
+                pltpu.VMEM((block_h, block_k, d), jnp.float32),
+                pltpu.VMEM((block_h, block_k, d), jnp.float32),
             ],
             interpret=interpret,
         )(mask3, lse, delta, qb, kb, vb, dob)
         return _unbh(dq, b, h), _unbh(dk, b, h), _unbh(dv, b, h)
 
-    mask_spec = pl.BlockSpec((1, 1, block_k), lambda bh_, qi, ki: (bh_ // h, 0, ki))
-    row_q = pl.BlockSpec((1, 1, block_q), lambda bh_, qi, ki: (bh_, 0, qi))
-    qtile = pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0))
-    ktile = pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0))
+    mask_spec = pl.BlockSpec((1, 1, block_k), lambda g, qi, ki: (g // hb, 0, ki))
+    row_q = pl.BlockSpec((block_h, 1, block_q), lambda g, qi, ki: (g, 0, qi))
+    qtile = pl.BlockSpec((block_h, block_q, d), lambda g, qi, ki: (g, qi, 0))
+    ktile = pl.BlockSpec((block_h, block_k, d), lambda g, qi, ki: (g, ki, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale),
-        grid=(b * h, tq // block_q, tk // block_k),
+        grid=(b * hb, tq // block_q, tk // block_k),
         in_specs=[mask_spec, row_q, row_q, qtile, ktile, ktile, qtile],
         out_specs=qtile,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_h, block_q, d), jnp.float32)],
         interpret=interpret,
     )(mask3, lse, delta, qb, kb, vb, dob)
 
-    # dK/dV grid puts the k tile on the middle axis: (bh, ki, qi(inner)).
-    mask_k = pl.BlockSpec((1, 1, block_k), lambda bh_, ki, qi: (bh_ // h, 0, ki))
-    row_q2 = pl.BlockSpec((1, 1, block_q), lambda bh_, ki, qi: (bh_, 0, qi))
-    qtile2 = pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0))
-    ktile2 = pl.BlockSpec((1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0))
+    # dK/dV grid puts the k tile on the middle axis: (g, ki, qi(inner)).
+    mask_k = pl.BlockSpec((1, 1, block_k), lambda g, ki, qi: (g // hb, 0, ki))
+    row_q2 = pl.BlockSpec((block_h, 1, block_q), lambda g, ki, qi: (g, 0, qi))
+    qtile2 = pl.BlockSpec((block_h, block_q, d), lambda g, ki, qi: (g, qi, 0))
+    ktile2 = pl.BlockSpec((block_h, block_k, d), lambda g, ki, qi: (g, ki, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, scale=scale),
-        grid=(b * h, tk // block_k, tq // block_q),
+        grid=(b * hb, tk // block_k, tq // block_q),
         in_specs=[mask_k, row_q2, row_q2, qtile2, ktile2, ktile2, qtile2],
         out_specs=[ktile2, ktile2],
         out_shape=[
@@ -521,8 +570,8 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_h, block_k, d), jnp.float32),
+            pltpu.VMEM((block_h, block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(mask3, lse, delta, qb, kb, vb, dob)

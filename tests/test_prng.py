@@ -1,0 +1,55 @@
+"""Dropout-key derivation (core/prng.py) and the shared backend gate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.core.backend import resolve_auto, tpu_backend
+from deepdfa_tpu.core.prng import fold_in_dropout
+
+
+def test_fold_in_dropout_deterministic_per_seed_and_step():
+    base = jax.random.PRNGKey(7)
+    k1 = fold_in_dropout(base, jnp.asarray(3))
+    k2 = fold_in_dropout(base, jnp.asarray(3))
+    k3 = fold_in_dropout(base, jnp.asarray(4))
+    m1 = jax.random.bernoulli(k1, 0.5, (64,))
+    m2 = jax.random.bernoulli(k2, 0.5, (64,))
+    m3 = jax.random.bernoulli(k3, 0.5, (64,))
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    assert (np.asarray(m1) != np.asarray(m3)).any()
+
+
+def test_fold_in_dropout_cpu_passthrough():
+    """On non-TPU backends the folded threefry key passes through
+    unchanged (the CPU test mesh is where this test runs)."""
+    if tpu_backend():
+        import pytest
+
+        pytest.skip("passthrough branch is the non-TPU path")
+    base = jax.random.PRNGKey(0)
+    got = fold_in_dropout(base, jnp.asarray(5))
+    want = jax.random.fold_in(base, jnp.asarray(5))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fold_in_dropout_works_under_jit_with_flax_dropout():
+    import flax.linen as nn
+
+    drop = nn.Dropout(0.5)
+
+    @jax.jit
+    def masks(base, step, x):
+        rng = fold_in_dropout(base, step)
+        return drop.apply({}, x, deterministic=False, rngs={"dropout": rng})
+
+    x = jnp.ones((16, 8))
+    out = masks(jax.random.PRNGKey(1), jnp.asarray(2), x)
+    vals = np.unique(np.asarray(out))
+    assert set(vals.tolist()) <= {0.0, 2.0}  # dropped or rescaled
+
+
+def test_resolve_auto():
+    expect = "a" if tpu_backend() else "b"
+    assert resolve_auto("auto", tpu="a", other="b") == expect
+    assert resolve_auto("explicit", tpu="a", other="b") == "explicit"
