@@ -217,7 +217,8 @@ def run_experiment(
                             out_dir=out_dir)
     elif cfg.task == "multi_task":
         result = _run_multitask(cfg, tcfg, data, tiny, pretrained=pretrained,
-                                tok=tok, out_dir=out_dir)
+                                tok=tok, out_dir=out_dir,
+                                beam_size=beam_size)
     else:  # generation family: summarize / translate / refine / concode
         result = _run_gen(cfg, tcfg, data, tiny, pretrained, tok,
                           out_dir=out_dir, beam_size=beam_size)
@@ -732,7 +733,7 @@ def _multitask_dir_data(data: str, vocab: int, pad_id: int,
 
 
 def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None,
-                   out_dir=None):
+                   out_dir=None, beam_size=None):
     from deepdfa_tpu.train.gen_loop import fit_gen_multitask
 
     init_params = None
@@ -775,14 +776,37 @@ def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None,
         epochs = tcfg.max_epochs if tcfg.max_epochs > 0 else 1
         max_steps = max(epochs * -(-total // tcfg.batch_size), 1)
         max_tgt = max(t["target_ids"].shape[1] for t in evals.values())
+    # Dev decoding beam: run_multi_gen.py's eval_bleu generates with a
+    # fixed num_beams=5 (:110) — NOT run_gen's --beam_size — so the CLI
+    # flag (default 10, a run_gen.py default) is ignored here.
+    del beam_size
+    # BLEU over decoded text when the tokenizer can decode, over token ids
+    # otherwise (the _run_gen rule) — selection must score the same space
+    # single-task runs report.
+    decode_fn = getattr(tok, "decode", None) if tok is not None else None
+    # --patience 0 disabled early stopping (tcfg.early_stop_patience=None,
+    # exp.py tcfg construction); distinguish that from "unset" — which
+    # keeps the reference's per-task patience table — via cfg.patience.
+    patience = ({name: None for name in evals} if cfg.patience == 0
+                else None)
     out = fit_gen_multitask(model, tasks, evals, tcfg, max_steps=max_steps,
-                            max_target_length=max_tgt,
-                            init_params=init_params)
-    _save_best(out_dir, out["state"], -1)  # multitask keeps the final state
-    return {
-        k: v for k, v in out.items()
-        if k != "state" and not hasattr(v, "shape")
-    }
+                            max_target_length=max_tgt, beam_size=5,
+                            init_params=init_params, decode_fn=decode_fn,
+                            patience=patience)
+    # checkpoint-last at the run root + per-task checkpoint-best-bleu dirs
+    # (run_multi_gen.py:334-357, :465-470).
+    _save_best(out_dir, out["state"], -1)
+    if out_dir:
+        import types
+
+        for name, params in out["best_params"].items():
+            if params is None:
+                continue
+            _save_best(os.path.join(out_dir, "checkpoint-best-bleu", name),
+                       types.SimpleNamespace(params=params),
+                       int(out["tasks"][name].get("step", -1)),
+                       "bleu_em", out["tasks"][name].get("bleu_em"))
+    return {"tasks": out["tasks"], "history": out["history"]}
 
 
 def main(argv=None) -> int:

@@ -512,6 +512,21 @@ def task_sampling_probs(sizes: Dict[str, int], alpha: float = 0.7) -> Dict[str, 
     return {k: v / z for k, v in p.items()}
 
 
+# Per-task early-stop patience table (run_multi_gen.py:254-267: summarize 2,
+# translate 5, refine 5, concode 3, defect 2).
+MULTITASK_PATIENCE = {"summarize": 2, "translate": 5, "refine": 5,
+                      "concode": 3, "defect": 2}
+
+
+def multitask_patience(name: str, fallback: Optional[int] = None) -> int:
+    """Patience for a task name like ``summarize_python`` (the reference
+    keys its table by ``cur_task.split('_')[0]``)."""
+    base = name.split("_")[0]
+    if base in MULTITASK_PATIENCE:
+        return MULTITASK_PATIENCE[base]
+    return fallback if fallback is not None else 3
+
+
 def fit_gen_multitask(
     model: T5Model,
     task_data: Dict[str, Dict[str, np.ndarray]],
@@ -520,17 +535,49 @@ def fit_gen_multitask(
     max_steps: int,
     alpha: float = 0.7,
     max_target_length: int = 32,
+    beam_size: int = 1,
+    eval_interval: Optional[int] = None,
     init_params: Optional[Any] = None,
     log: Optional[Callable[[str], None]] = None,
+    decode_fn: Optional[Callable] = None,
+    patience: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Any]:
     """Multi-task fine-tuning (run_multi_gen.py parity): each step samples a
     task by smoothed size-proportional probability and trains on a random
-    batch from it; eval reports per-task loss + exact match. Task prefixes
-    ("Summarize python: ...") belong in the data prep, as in the reference.
+    batch from it. Task prefixes ("Summarize python: ...") belong in the
+    data prep, as in the reference.
+
+    Selection protocol (run_multi_gen.py:248-357): every ``eval_interval``
+    steps (the reference's ``save_steps``) each not-yet-stopped task runs a
+    dev eval — loss (the ppl track, best value recorded) AND generation
+    BLEU+EM — and its ``dev_bleu_em`` (combine_bleu_em per task family)
+    drives PER-TASK best-state selection with PER-TASK patience
+    (``multitask_patience`` table; ``cfg.early_stop_patience`` overrides
+    every task when set; ``patience`` overrides per task, a value of None
+    disabling that task's early stop). A task whose
+    bleu_em stalls past its patience early-stops: its sampled training
+    batches are skipped from then on (:278-287), and 50 consecutive skipped
+    draws end training (:281-285, "all tasks have early stopped"). Best
+    params are snapshotted to HOST memory per task (the analog of the
+    reference's per-task ``checkpoint-best-bleu`` dirs), so retaining them
+    does not multiply device memory by the task count.
+
+    Returns the final ``state`` (the reference's checkpoint-last), per-task
+    ``tasks[name]`` = best-eval record (step/eval_loss/exact_match/bleu/
+    bleu_em + ``early_stopped``/``best_loss``), per-task ``history``, and
+    ``best_params[name]`` = host param tree of each task's selected state.
     """
     names = sorted(task_data)
+    eval_names = sorted(eval_data)
     probs = task_sampling_probs({k: len(task_data[k]["source_ids"]) for k in names},
                                 alpha)
+    pat: Dict[str, Optional[int]] = dict(patience or {})
+    for k in eval_names:
+        pat.setdefault(k, cfg.early_stop_patience
+                       if cfg.early_stop_patience is not None
+                       else multitask_patience(k))
+    if eval_interval is None:
+        eval_interval = max(max_steps // 5, 1)
     first = task_data[names[0]]
     state, tx = make_gen_train_state(
         model, first["source_ids"][: cfg.batch_size],
@@ -538,11 +585,72 @@ def fit_gen_multitask(
         init_params=init_params,
     )
     step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
+    eval_fns = _make_eval_fns(model, max_target_length, beam_size)
+    pad_id, eos_id = model.cfg.pad_token_id, model.cfg.eos_token_id
+    gold = {k: _ids_to_text(eval_data[k]["target_ids"], pad_id, eos_id,
+                            decode_fn) for k in eval_names}
+
+    best: Dict[str, Dict[str, Any]] = {
+        k: {"bleu_em": -1.0, "params": None, "record": None}
+        for k in eval_names
+    }
+    best_loss = {k: float("inf") for k in eval_names}
+    stall = {k: 0 for k in eval_names}
+    stopped = {k: False for k in eval_names}
+    history: Dict[str, list] = {k: [] for k in eval_names}
+
+    def eval_round(at_step: int) -> None:
+        # One host snapshot per round, shared by every improving task —
+        # the trees are identical and immutable within a round, so N tasks
+        # must not mean N device-to-host fetches of the same params.
+        snap: list = [None]
+        for name in eval_names:
+            if stopped[name]:
+                continue
+            ev = evaluate_gen(model, state, eval_data[name], cfg,
+                              max_target_length, beam_size,
+                              return_preds=True, fns=eval_fns)
+            base = name.split("_")[0]
+            preds = _ids_to_text(ev["pred_ids"], pad_id, eos_id, decode_fn)
+            bleu = bleu_for_task(base, gold[name][: len(preds)], preds)
+            record = {"step": at_step, "eval_loss": ev["eval_loss"],
+                      "exact_match": ev["exact_match"], "bleu": bleu,
+                      "bleu_em": combine_bleu_em(base, bleu,
+                                                 ev["exact_match"])}
+            history[name].append(record)
+            # ppl track: best value recorded (the reference additionally
+            # keeps a checkpoint-best-ppl dir per task, :412-427; only the
+            # bleu-selected state is retained here).
+            best_loss[name] = min(best_loss[name], record["eval_loss"])
+            if record["bleu_em"] > best[name]["bleu_em"]:
+                stall[name] = 0
+                if snap[0] is None:
+                    snap[0] = jax.device_get(state.params)
+                best[name] = {"bleu_em": record["bleu_em"],
+                              "params": snap[0], "record": record}
+            else:
+                stall[name] += 1
+                if pat[name] is not None and stall[name] > pat[name]:
+                    stopped[name] = True
+            if log:
+                log(f"eval@{at_step} [{name}] " + " ".join(
+                    f"{k}={v:.4f}" for k, v in record.items()
+                    if isinstance(v, float))
+                    + (" EARLY-STOPPED" if stopped[name] else ""))
 
     rng = np.random.RandomState(cfg.seed)
     p_vec = np.asarray([probs[k] for k in names])
-    for i in range(max_steps):
+    g = last_eval = skip = 0
+    while g < max_steps:
         task = names[rng.choice(len(names), p=p_vec)]
+        if stopped.get(task, False):
+            skip += 1
+            if skip > 50:
+                if log:
+                    log(f"all tasks early stopped at step {g}")
+                break
+            continue
+        skip = 0
         data = task_data[task]
         sel = rng.choice(len(data["source_ids"]),
                          min(cfg.batch_size, len(data["source_ids"])),
@@ -556,12 +664,25 @@ def fit_gen_multitask(
             tgt = np.concatenate([tgt, np.full((pad, tgt.shape[1]),
                                                model.cfg.pad_token_id, tgt.dtype)])
         state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
-        if log and (i + 1) % max(max_steps // 10, 1) == 0:
-            log(f"step {i+1}/{max_steps} [{task}] loss={float(loss):.4f}")
+        g += 1
+        if log and g % max(max_steps // 10, 1) == 0:
+            log(f"step {g}/{max_steps} [{task}] loss={float(loss):.4f}")
+        if g % eval_interval == 0:
+            last_eval = g
+            eval_round(g)
 
-    out: Dict[str, Any] = {"state": state, "tasks": {}}
-    for task in sorted(eval_data):
-        out["tasks"][task] = evaluate_gen(
-            model, state, eval_data[task], cfg, max_target_length
-        )
+    if last_eval != g:
+        # Trailing steps since the last eval boundary (or no eval at all:
+        # eval_interval > max_steps) still get a selection round, so every
+        # task leaves with a best record/state.
+        eval_round(g)
+
+    out: Dict[str, Any] = {"state": state, "tasks": {}, "history": history,
+                           "best_params": {}}
+    for name in eval_names:
+        rec = dict(best[name]["record"] or {"eval_loss": float("nan")})
+        rec["early_stopped"] = stopped[name]
+        rec["best_loss"] = best_loss[name]
+        out["tasks"][name] = rec
+        out["best_params"][name] = best[name]["params"]
     return out

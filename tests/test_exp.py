@@ -178,6 +178,15 @@ def test_exp_multitask_from_dataset_dir(tmp_path):
     assert set(result["tasks"]) >= {"summarize_python", "translate_java-cs"}
     for metrics in result["tasks"].values():
         assert "eval_loss" in metrics and "exact_match" in metrics
+        # per-task BLEU+EM selection records (run_multi_gen.py:316-333)
+        assert "bleu" in metrics and "bleu_em" in metrics
+        assert "step" in metrics and "early_stopped" in metrics
+    # per-task checkpoint-best-bleu dirs next to checkpoint-last
+    import os
+
+    run_dir = tmp_path / "res" / "multi_task_none_codet5_small"
+    for name in result["tasks"]:
+        assert (run_dir / "checkpoint-best-bleu" / name).is_dir(), name
 
 
 def _train_tiny_bpe(tmp_path, vocab=300):

@@ -275,3 +275,94 @@ def test_fit_clone_best_state_survives_later_epochs():
     out = fit_clone(model, data, data, tcfg)
     metrics = evaluate_clone(model, out["state"].params, data, tcfg)
     assert np.isfinite(metrics["f1"])
+
+
+def test_multitask_patience_table():
+    """Per-task patience keys off the task-family prefix
+    (run_multi_gen.py:254-267)."""
+    from deepdfa_tpu.train.gen_loop import multitask_patience
+
+    assert multitask_patience("summarize_python") == 2
+    assert multitask_patience("translate_java-cs") == 5
+    assert multitask_patience("refine_small") == 5
+    assert multitask_patience("concode") == 3
+    assert multitask_patience("defect") == 2
+    assert multitask_patience("custom_task", 7) == 7
+
+
+@pytest.mark.slow
+def test_fit_gen_multitask_per_task_selection():
+    """Per-task best_bleu_em selection (run_multi_gen.py:316-333): each
+    task's returned record is the argmax-bleu_em entry of its own history
+    (ties keep the EARLIER round, the strict-> rule), and the retained
+    best params reproduce that round's exact_match when re-evaluated — a
+    late-degrading task hands back its earlier best state, not the final
+    one."""
+    import dataclasses as _dc
+    from types import SimpleNamespace
+
+    from deepdfa_tpu.data.seq2seq import synthetic_seq2seq
+    from deepdfa_tpu.train.gen_loop import evaluate_gen, fit_gen_multitask
+
+    cfg = _dc.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    model = T5Model(cfg)
+    task_data = {
+        "copy": synthetic_seq2seq(16, vocab_size=32, max_source_length=10,
+                                  max_target_length=6, seed=0, reverse=False),
+        "reverse": synthetic_seq2seq(16, vocab_size=32, max_source_length=10,
+                                     max_target_length=6, seed=1,
+                                     reverse=True),
+    }
+    tcfg = TransformerTrainConfig(learning_rate=1e-3, batch_size=8,
+                                  eval_batch_size=8)
+    out = fit_gen_multitask(model, task_data, task_data, tcfg, max_steps=12,
+                            eval_interval=3, max_target_length=6)
+    for name in ("copy", "reverse"):
+        hist = out["history"][name]
+        assert len(hist) >= 2
+        best_val = max(h["bleu_em"] for h in hist)
+        rec = out["tasks"][name]
+        assert rec["bleu_em"] == best_val
+        assert rec["step"] == min(
+            h["step"] for h in hist if h["bleu_em"] == best_val
+        )
+        # The snapshotted params really are that round's model.
+        ev = evaluate_gen(
+            model, SimpleNamespace(params=out["best_params"][name]),
+            task_data[name], tcfg, max_target_length=6, beam_size=1,
+        )
+        np.testing.assert_allclose(ev["exact_match"], rec["exact_match"])
+        np.testing.assert_allclose(ev["eval_loss"], rec["eval_loss"],
+                                   rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_fit_gen_multitask_per_task_patience_early_stops_all():
+    """lr=0 freezes the metrics: round 1 sets each task's best, rounds 2-3
+    stall past patience=1, every task early-stops, and training terminates
+    on the consecutive-skip rule (run_multi_gen.py:278-287) without
+    reaching max_steps."""
+    import dataclasses as _dc
+
+    from deepdfa_tpu.data.seq2seq import synthetic_seq2seq
+    from deepdfa_tpu.train.gen_loop import fit_gen_multitask
+
+    cfg = _dc.replace(T5Config.tiny(vocab_size=32), dropout_rate=0.0)
+    model = T5Model(cfg)
+    task_data = {
+        "copy": synthetic_seq2seq(8, vocab_size=32, max_source_length=10,
+                                  max_target_length=6, seed=0, reverse=False),
+        "reverse": synthetic_seq2seq(8, vocab_size=32, max_source_length=10,
+                                     max_target_length=6, seed=1,
+                                     reverse=True),
+    }
+    tcfg = TransformerTrainConfig(learning_rate=0.0, batch_size=8,
+                                  eval_batch_size=8)
+    out = fit_gen_multitask(model, task_data, task_data, tcfg, max_steps=50,
+                            eval_interval=2, max_target_length=6,
+                            patience={"copy": 1, "reverse": 1})
+    for name in ("copy", "reverse"):
+        rec = out["tasks"][name]
+        assert rec["early_stopped"] is True
+        assert rec["step"] == 2  # first eval round's best survives
+        assert len(out["history"][name]) == 3  # best, stall, stall->stop
