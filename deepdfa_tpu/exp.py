@@ -406,6 +406,11 @@ def _run_gen(cfg, tcfg, data, tiny, pretrained=None, tok=None, out_dir=None,
               "exact_match": float(out["exact_match"]),
               "bleu": float(out["bleu"]),
               "bleu_em": float(out["bleu_em"]),
+              # Which space the BLEU n-grams were scored in: decoded subword
+              # text (comparable to reference numbers) vs raw token-id
+              # strings (self-consistent for selection only — synthetic/
+              # hashing runs have no invertible tokenizer).
+              "bleu_space": "text" if decode_fn else "ids",
               "best_epoch": int(out["best_epoch"])}
     if "codebleu" in out:
         result["codebleu"] = float(out["codebleu"])
@@ -806,7 +811,8 @@ def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None,
                        types.SimpleNamespace(params=params),
                        int(out["tasks"][name].get("step", -1)),
                        "bleu_em", out["tasks"][name].get("bleu_em"))
-    return {"tasks": out["tasks"], "history": out["history"]}
+    return {"tasks": out["tasks"], "history": out["history"],
+            "bleu_space": "text" if decode_fn else "ids"}
 
 
 def main(argv=None) -> int:
@@ -821,6 +827,11 @@ def main(argv=None) -> int:
                         help="tiny model shapes (smoke tests)")
     parser.add_argument("--epochs", type=int, default=None,
                         help="override the task table's epoch count")
+    parser.add_argument("--patience", type=int, default=None,
+                        help="override the task table's early-stop "
+                             "patience; 0 disables early stopping "
+                             "(multi_task: disables the per-task patience "
+                             "table)")
     parser.add_argument("--pretrained", default=None,
                         help="HF checkpoint dir to fine-tune from "
                              "(from_pretrained parity, run_defect.py:155-158)")
@@ -843,6 +854,8 @@ def main(argv=None) -> int:
         parser.error(f"sub_task {args.sub_task!r} invalid for {args.task!r} "
                      f"(choose from {get_sub_tasks(args.task)})")
     cfg = resolve(args.task, args.sub_task, args.model_tag, seed=args.seed)
+    if args.patience is not None:
+        cfg = dataclasses.replace(cfg, patience=args.patience)
     overrides = {"max_epochs": args.epochs} if args.epochs else None
     result = run_experiment(
         cfg, data=args.data, res_dir=args.res_dir, tiny=args.tiny,

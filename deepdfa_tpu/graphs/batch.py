@@ -76,7 +76,7 @@ class GraphBatch:
         return self.edge_mask.shape[0]
 
 
-def graph_label_from_nodes(batch: GraphBatch) -> jnp.ndarray:
+def graph_label_from_nodes(batch: GraphBatch, impl: str = "auto") -> jnp.ndarray:
     """Graph-level label = max node ``_VULN`` over real nodes.
 
     Parity with the reference's per-graph label extraction
@@ -84,11 +84,23 @@ def graph_label_from_nodes(batch: GraphBatch) -> jnp.ndarray:
     per unbatched graph). Padded nodes are routed through value 0 so an
     all-padding slot yields label 0 (and is excluded by graph_mask anyway).
 
-    Computed as a masked broadcast-compare + row max instead of a
+    On TPU, computed as a masked broadcast-compare + row max instead of a
     segment_max: XLA serializes TPU scatters, and this per-step scatter-max
     cost ~70 us in the traced train step (bench.py module docstring); the
-    dense [n_graphs, max_nodes] reduce fuses into one cheap kernel.
+    dense [n_graphs, max_nodes] reduce fuses into one cheap kernel. Off-TPU
+    the O(n) segment_max stays (CPU eval hosts should not pay the
+    O(n_graphs * max_nodes) zero-fill) — the pool_impl/embed_impl backend
+    gate, core/backend.py.
     """
+    from deepdfa_tpu.core.backend import resolve_auto
+    from deepdfa_tpu.graphs.segment import segment_max
+
+    if resolve_auto(impl, tpu="dense", other="segment") == "segment":
+        return segment_max(
+            jnp.where(batch.node_mask,
+                      batch.node_vuln.astype(jnp.float32), -jnp.inf),
+            batch.node_graph, batch.n_graphs, initial=0.0,
+        )
     vuln = jnp.where(batch.node_mask, batch.node_vuln, 0).astype(jnp.float32)
     member = (
         batch.node_graph[None, :]

@@ -25,7 +25,7 @@ i`` / ``kv_offset + j`` so sharded callers can pass their shard's offset.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -435,11 +435,59 @@ def _pick_block_h(h: int, block_q: int, block_k: int, tq: int, d: int,
     return best
 
 
-def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+# (config key) -> bool: did Mosaic accept a block_h > 1 program for this
+# shape? _pick_block_h's VMEM model is a hand-fit heuristic; rather than
+# hard-failing the training step when it undercounts for an untested shape,
+# a one-time batch-1 probe compile confirms each multi-head config and
+# degrades to the next smaller head divisor (block_h 1 always compiles).
+_BLOCK_H_OK: Dict[tuple, bool] = {}
+
+
+def _confirmed_block_h(cand: int, h: int, key: tuple, probe) -> int:
+    """Largest head divisor <= ``cand`` whose probe compile succeeds.
+    Probing only happens on real TPU backends — interpret-mode/CPU runs
+    have no Mosaic VMEM limit to trip."""
+    from deepdfa_tpu.core.backend import tpu_backend
+
+    if cand <= 1 or not tpu_backend():
+        return max(cand, 1)
+    while cand > 1:
+        if h % cand == 0:
+            ok = _BLOCK_H_OK.get(key + (cand,))
+            if ok is None:
+                try:
+                    probe(cand)
+                    ok = True
+                except Exception:
+                    ok = False
+                _BLOCK_H_OK[key + (cand,)] = ok
+            if ok:
+                return cand
+        cand -= 1
+    return 1
+
+
+def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret,
+                   block_h=None):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q, block_k = _flash_blocks(q, k, block_q, block_k)
-    block_h = _pick_block_h(h, block_q, block_k, tq, d)
+    if block_h is None:
+        cand = _pick_block_h(h, block_q, block_k, tq, d)
+        block_h = _confirmed_block_h(
+            cand, h,
+            ("fwd", h, block_q, block_k, tq, tk, d, str(q.dtype), causal),
+            lambda bh: jax.jit(
+                lambda q1, k1, v1: _flash_forward(
+                    q1, k1, v1, None, causal, block_q, block_k, interpret,
+                    block_h=bh,
+                )
+            ).lower(
+                jax.ShapeDtypeStruct((1, tq, h, d), q.dtype),
+                jax.ShapeDtypeStruct((1, tk, h, d), k.dtype),
+                jax.ShapeDtypeStruct((1, tk, h, d), v.dtype),
+            ).compile(),
+        )
     hb = h // block_h  # head-blocks per batch; block_h | h by construction
     mask3 = _mask_3d(kv_mask, b, tk)
 
@@ -476,7 +524,7 @@ def _flash_forward(q, k, v, kv_mask, causal, block_q, block_k, interpret):
 
 
 def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
-                    interpret):
+                    interpret, block_h=None):
     """Pallas dq + dk/dv passes (the standard flash backward): rebuild the
     normalized probabilities from the saved logsumexp, Δ = rowsum(dO∘O),
     dS = P∘(dP − Δ). O(T) memory like the forward — no quadratic residuals,
@@ -487,16 +535,36 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, block_q, block_k,
     mask3 = _mask_3d(kv_mask, b, tk)
     scale = 1.0 / np.sqrt(d)
 
-    block_h = _pick_block_h(h, block_q, block_k, tq, d, with_dq_scratch=True)
-    # Prefer fusing over a wider head batch: a smaller block_h whose
-    # [block_h, Tq, D] dq accumulator passes the fused guard beats a wider
-    # two-pass grid (the fused kernel halves the backward's loads).
-    fusable = [
-        c for c in range(1, block_h + 1)
-        if h % c == 0 and c * tq * d * 4 <= _FUSED_BWD_MAX_BYTES
-    ]
-    if fusable:
-        block_h = max(fusable)
+    if block_h is None:
+        block_h = _pick_block_h(h, block_q, block_k, tq, d,
+                                with_dq_scratch=True)
+        # Prefer fusing over a wider head batch: a smaller block_h whose
+        # [block_h, Tq, D] dq accumulator passes the fused guard beats a
+        # wider two-pass grid (the fused kernel halves the backward's
+        # loads).
+        fusable = [
+            c for c in range(1, block_h + 1)
+            if h % c == 0 and c * tq * d * 4 <= _FUSED_BWD_MAX_BYTES
+        ]
+        if fusable:
+            block_h = max(fusable)
+        block_h = _confirmed_block_h(
+            block_h, h,
+            ("bwd", h, block_q, block_k, tq, tk, d, str(q.dtype), causal),
+            lambda bh: jax.jit(
+                lambda q1, k1, v1, o1, l1, g1: _flash_backward(
+                    q1, k1, v1, None, o1, l1, g1, causal, block_q, block_k,
+                    interpret, block_h=bh,
+                )
+            ).lower(
+                jax.ShapeDtypeStruct((1, tq, h, d), q.dtype),
+                jax.ShapeDtypeStruct((1, tk, h, d), k.dtype),
+                jax.ShapeDtypeStruct((1, tk, h, d), v.dtype),
+                jax.ShapeDtypeStruct((1, tq, h, d), out.dtype),
+                jax.ShapeDtypeStruct((h, 1, tq), jnp.float32),
+                jax.ShapeDtypeStruct((1, tq, h, d), g.dtype),
+            ).compile(),
+        )
     hb = h // block_h
 
     qb, kb, vb = _bh(q), _bh(k), _bh(v)

@@ -148,13 +148,22 @@ def band_spmm(adj: BandAdjacency, msg: jnp.ndarray) -> jnp.ndarray:
     t, bw = adj.tile, adj.bandwidth
     n_tiles = adj.n_tiles
     h = msg.shape[1]
-    vals = jax.lax.stop_gradient(adj.vals).astype(msg.dtype)
+    vals = jax.lax.stop_gradient(adj.vals)
+    if vals.dtype == jnp.float32 and msg.dtype != jnp.float32:
+        # Upcast-only rule (the stack_band_adjacencies guard, applied at
+        # compute time too): tile_vals_dtype chose f32 because some edge
+        # multiplicity is not bf16-exact, so the einsum runs in f32 with
+        # upcast messages rather than downcasting vals.
+        msg_in = msg.astype(jnp.float32)
+    else:
+        vals = vals.astype(msg.dtype)
+        msg_in = msg
     precision = (
         jax.lax.Precision.HIGHEST
-        if msg.dtype == jnp.float32
+        if msg_in.dtype == jnp.float32
         else jax.lax.Precision.DEFAULT
     )
-    m = msg.reshape(n_tiles, t, h)
+    m = msg_in.reshape(n_tiles, t, h)
     mp = jnp.pad(m, ((bw, bw), (0, 0), (0, 0)))
     out = jnp.zeros((n_tiles, t, h), jnp.float32)
     for i in range(2 * bw + 1):

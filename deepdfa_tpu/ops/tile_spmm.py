@@ -375,13 +375,21 @@ def _spmm_kernel(rows_ref, cols_ref, vals_ref, msg_ref, out_ref):
     # bit-tight. bf16 inputs ride the MXU's native mixed-precision path
     # (bf16 × bf16 → f32).
     msg = msg_ref[:]
+    vals = vals_ref[0]
+    if vals.dtype == jnp.float32 and msg.dtype != jnp.float32:
+        # Upcast-only rule (as in band_spmm): f32 vals carry an edge
+        # multiplicity that is not bf16-exact — upcast msg, never downcast
+        # vals.
+        msg = msg.astype(jnp.float32)
+    else:
+        vals = vals.astype(msg.dtype)
     precision = (
         jax.lax.Precision.HIGHEST
         if msg.dtype == jnp.float32
         else jax.lax.Precision.DEFAULT
     )
     out_ref[:] += jnp.dot(
-        vals_ref[0].astype(msg.dtype),
+        vals,
         msg,
         preferred_element_type=jnp.float32,
         precision=precision,
@@ -415,12 +423,17 @@ def _spmm_xla(vals, rows, cols, msg, tile, n_row_tiles):
     msg_tiles = msg.reshape(n_row_tiles, tile, -1)[cols]
     # f32 accumulation regardless of input dtype, matching the Pallas
     # kernel's MXU accumulator so both impls agree bit-for-bit in bf16 too.
+    # Upcast-only dtype rule, same as the kernel.
+    if vals.dtype == jnp.float32 and msg.dtype != jnp.float32:
+        msg_tiles = msg_tiles.astype(jnp.float32)
+    else:
+        vals = vals.astype(msg.dtype)
     prod = jnp.einsum(
-        "krc,kch->krh", vals.astype(msg.dtype), msg_tiles,
+        "krc,kch->krh", vals, msg_tiles,
         preferred_element_type=jnp.float32,
         precision=(
             jax.lax.Precision.HIGHEST
-            if msg.dtype == jnp.float32
+            if msg_tiles.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT
         ),
     )

@@ -265,3 +265,29 @@ def test_fit_band_on_mesh_matches_segment():
         _, hist = fit(FlowGNN(cfg), ex, splits, tc, data, mesh=mesh)
         losses[impl] = [e["train_loss"] for e in hist["epochs"]]
     np.testing.assert_allclose(losses["band"], losses["segment"], rtol=2e-3, atol=2e-4)
+
+
+def test_band_spmm_f32_vals_not_downcast_for_bf16_messages():
+    """Upcast-only rule at compute time: f32 adjacency vals (picked by
+    tile_vals_dtype when an edge multiplicity is not bf16-exact, e.g. 257)
+    must stay f32 when the messages are bf16 — a downcast would silently
+    round 257 -> 256."""
+    from deepdfa_tpu.ops.band_spmm import BandAdjacency
+
+    tile = 8
+    vals = np.zeros((1, 1, tile, tile), np.float32)
+    vals[0, 0, 0, 0] = 257.0  # receiver 0 <- sender 0, multiplicity 257
+    adj = BandAdjacency(vals=jnp.asarray(vals), tile=tile, n_tiles=1,
+                        bandwidth=0)
+    msg = jnp.ones((tile, 4), jnp.bfloat16)
+    out = band_spmm(adj, msg)
+    assert out.dtype == jnp.bfloat16
+    # 257 survives the f32 compute (bf16 output rounds 257 -> 256/258 grid,
+    # but a downcast of vals would have produced exactly 256 from a 256.0
+    # multiplicand; check against the f32 reference computed the same way).
+    want = np.zeros((tile, 4), np.float32)
+    want[0] = 257.0
+    np.testing.assert_allclose(
+        np.asarray(out.astype(jnp.float32)),
+        np.asarray(jnp.asarray(want).astype(jnp.bfloat16).astype(jnp.float32)),
+    )

@@ -131,6 +131,42 @@ def test_cli_tune(tmp_path):
     assert "best_val_f1" in json.loads(lines[0])
 
 
+def test_cli_tune_custom_space(tmp_path):
+    """--space FILE swaps the baked-in four-axis space for an arbitrary
+    model./train. search space (the NNI search-space-config analog)."""
+    space_fn = tmp_path / "space.json"
+    space_fn.write_text(json.dumps({
+        "train.learning_rate": [5e-4],
+        "model.n_steps": [2, 3],
+    }))
+    out_dir = str(tmp_path / "tune")
+    main(
+        [
+            "tune", "--dataset", "synthetic:32", "--trials", "1",
+            "--epochs-per-trial", "1", "--out-dir", out_dir,
+            "--space", str(space_fn),
+            "--set", "data.batch_size=16",
+            "--set", "data.eval_batch_size=16",
+        ]
+    )
+    rec = json.loads(
+        open(os.path.join(out_dir, "tune_results.jsonl")).read().strip()
+    )
+    assert set(rec["params"]) == {"train.learning_rate", "model.n_steps"}
+    assert rec["params"]["model.n_steps"] in (2, 3)
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"optimizer.lr": [1e-3]}))
+    with pytest.raises(ValueError, match="scope"):
+        main([
+            "tune", "--dataset", "synthetic:32", "--trials", "1",
+            "--epochs-per-trial", "1", "--out-dir", out_dir,
+            "--space", str(bad),
+            "--set", "data.batch_size=16",
+            "--set", "data.eval_batch_size=16",
+        ])
+
+
 def test_crash_renames_log(tmp_path, monkeypatch):
     from deepdfa_tpu import cli
 

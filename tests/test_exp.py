@@ -52,6 +52,9 @@ def test_run_experiment_smoke(tmp_path, task, tag):
         overrides={"max_epochs": 1, "batch_size": 8, "eval_batch_size": 8},
     )
     assert result["config"]["task"] == task
+    if task in ("summarize", "multi_task"):
+        # synthetic runs score BLEU over token-id strings and say so
+        assert result["bleu_space"] == "ids"
     res_fn = tmp_path / "res" / f"{task}_{sub}_{tag}" / "result.json"
     assert json.loads(res_fn.read_text())["config"]["model_tag"] == tag
 

@@ -91,8 +91,9 @@ def test_unknown_pool_impl_refused():
 
 
 def test_graph_label_dense_matches_numpy():
-    """graph_label_from_nodes (dense row-max form) == per-graph max over
-    real nodes, with padded slots at 0."""
+    """graph_label_from_nodes (both the TPU dense row-max form and the
+    off-TPU segment_max form) == per-graph max over real nodes, with padded
+    slots at 0."""
     from deepdfa_tpu.core.config import FeatureSpec, subkeys_for
     from deepdfa_tpu.data.synthetic import synthetic_bigvul
     from deepdfa_tpu.graphs.batch import (
@@ -107,7 +108,6 @@ def test_graph_label_dense_matches_numpy():
     batch = batch_graphs(
         graphs, 16, budget["max_nodes"], budget["max_edges"], subkeys_for(feature)
     )
-    got = np.asarray(graph_label_from_nodes(batch))
     ng = np.asarray(batch.node_graph)
     nm = np.asarray(batch.node_mask)
     nv = np.asarray(batch.node_vuln)
@@ -116,7 +116,11 @@ def test_graph_label_dense_matches_numpy():
         sel = (ng == g) & nm
         if sel.any():
             want[g] = max(nv[sel].max(), 0)
-    np.testing.assert_allclose(got, want)
+    # Both backend-gated formulations (dense on TPU, segment_max off-TPU)
+    # match the oracle and each other.
+    for impl in ("auto", "dense", "segment"):
+        got = np.asarray(graph_label_from_nodes(batch, impl=impl))
+        np.testing.assert_allclose(got, want, err_msg=impl)
 
 
 def test_embed_matmul_backward_matches_take():

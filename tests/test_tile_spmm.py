@@ -196,3 +196,28 @@ def test_tiles_stay_bf16_resident_when_exact():
     r2 = np.ones(300, np.int64)
     adj2 = build_tile_adjacency(s2, r2, np.ones(300, bool), 8, tile=8)
     assert adj2.vals.dtype == jnp.float32
+
+
+def test_tile_spmm_f32_vals_not_downcast_for_bf16_messages():
+    """Upcast-only rule at compute time (as in band_spmm): when
+    tile_vals_dtype fell back to f32 (multiplicity 300 is not bf16-exact),
+    bf16 messages must not downcast the vals — 300 would silently round to
+    the bf16 grid (304)."""
+    from deepdfa_tpu.ops.tile_spmm import tile_spmm
+
+    s = np.zeros(300, np.int64)
+    r = np.ones(300, np.int64)
+    adj = build_tile_adjacency(s, r, np.ones(300, bool), 8, tile=8)
+    assert adj.vals.dtype == jnp.float32
+    msg = jnp.ones((8, 4), jnp.bfloat16)
+    for impl in ("xla", "interpret"):
+        out = tile_spmm(adj, msg, impl=impl)
+        want = np.zeros((8, 4), np.float32)
+        want[1] = 300.0
+        np.testing.assert_allclose(
+            np.asarray(out.astype(jnp.float32)),
+            np.asarray(
+                jnp.asarray(want).astype(jnp.bfloat16).astype(jnp.float32)
+            ),
+            err_msg=impl,
+        )
