@@ -13,6 +13,8 @@ reference's paper-Table-5 efficiency axes (BASELINE.md):
                                    ~39 examples/s on RTX 3090 (10h40m for 10
                                    epochs over ~150k examples, Table 5)
   combined_infer_ms_per_example    vs 15.4 ms/example on RTX 3090 (Table 5)
+  gen_decode_tokens_per_sec[_beam10]  codet5-base summarize-shape decode,
+                                   greedy + beam-10 (no reference baseline)
 
 Measurement notes, learned the hard way on the tunneled axon backend:
 - ``jax.block_until_ready`` returns optimistically there; the only reliable
@@ -324,6 +326,80 @@ def bench_combined_train(
     }
 
 
+def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
+                     src_len: int = 256, max_len: int = 128,
+                     n_calls: int = 3):
+    """Generation decode throughput at the summarize shape: codet5-base,
+    256-token sources, 128 generated tokens, batch 48 (exp.resolve's
+    reference table) — the loop the reference times in its generation eval
+    (CodeT5/run_gen.py:104-123, model.generate with beams).
+
+    tokens/s counts batch * max_len decode steps (the compute actually
+    run; the scan is fixed-trip). Params are cast to bf16 for decode — the
+    standard inference dtype, and the measured A/B: greedy 13.9k tok/s
+    bf16 vs 11.4k f32 on v5e (beam-10 is cache-bound and indifferent).
+
+    Round-5 findings baked into the defaults (each a back-to-back A/B on
+    v5e; see models/t5.py and models/t5_generate.py):
+    - decode_cache_layout="split": merged [B,T,768] storage relayouts on
+      every attention read — greedy 10.0k vs split 13.9k tok/s, beam-10
+      718 vs 1007.
+    - Beam-deduped cross K/V (cross cache primed unreplicated, beam factor
+      folded into the query axis): beam-10 went OOM -> 658 (merged layout)
+      -> 1007 tok/s, and the per-step encoder K/V read dropped 10x.
+    - Cross K/V out of the scan carry (closed-over constants): removes the
+      risk of per-step copies of the largest buffers in the program.
+    No MFU is reported: decode is HBM-bound by construction (arithmetic
+    intensity ~1 FLOP/byte at batch 48 — each step re-reads the decoder
+    params and the whole KV cache to produce one token per row); the
+    greedy step's ~1 GB/step traffic at the measured rate is ~0.3-0.4 of
+    the chip's HBM peak, and the beam step adds the cache gather
+    (read+write of the full self cache per step).
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+    from deepdfa_tpu.models.t5_generate import generate
+
+    cfg = dataclasses.replace(T5Config.codet5_base(), dtype="bfloat16",
+                              dropout_rate=0.0)
+    model = T5Model(cfg)
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(
+        rng.randint(3, cfg.vocab_size, size=(batch_size, src_len))
+        .astype(np.int32)
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        src, jnp.zeros((batch_size, 4), jnp.int32),
+    )
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params,
+    )
+
+    def decode(params, src, prev):
+        # Chain calls through a data dependency (the infer-bench barrier
+        # pattern) so the timed sequence cannot overlap on the device.
+        src = src.at[0, 0].add((prev * 0).astype(src.dtype))
+        seq = generate(model, params, src, max_len=max_len,
+                       beam_size=beam_size)
+        return seq, seq[0, 0]
+
+    step = jax.jit(decode).lower(params, src, jnp.zeros((), jnp.int32)).compile()
+    prev = jnp.zeros((), jnp.int32)
+
+    def call():
+        nonlocal prev
+        out, prev = step(params, src, prev)
+        return prev
+
+    dt = _timed(call, warmup=1, calls=n_calls, trials=2)
+    return batch_size * max_len * n_calls / dt
+
+
 def bench_combined_infer(batch_size: int = 16) -> float:
     import jax.numpy as jnp
 
@@ -421,6 +497,13 @@ def main() -> None:
         diagnostics=True,
     )
     infer_ms = bench_combined_infer()
+    # Generation decode (round-5 addition): greedy + the reference's
+    # beam-10 eval decoding at the summarize shape. No baseline number
+    # exists (BASELINE.md has no decode measurement); HBM-bound — see
+    # bench_gen_decode's docstring for the rationale and the layout/dedup
+    # A/Bs behind the defaults.
+    decode_greedy = bench_gen_decode(beam_size=1)
+    decode_beam10 = bench_gen_decode(beam_size=10, n_calls=2)
 
     baseline_gnn = BASELINE_GNN_GRAPHS_PER_SEC
     baseline_train = BASELINE_COMBINED_EXAMPLES_PER_SEC
@@ -513,6 +596,28 @@ def main() -> None:
                         # ratio >1 = faster than the 3090 here (time metric)
                         "vs_baseline": round(baseline_infer / infer_ms, 3),
                         "attention_impl": "flash",
+                    },
+                    {
+                        "metric": "gen_decode_tokens_per_sec",
+                        "value": round(decode_greedy, 1),
+                        "unit": "tokens/s",
+                        "vs_baseline": None,  # no reference decode number
+                        "beam_size": 1,
+                        "batch_size": 48,
+                        "model": "codet5_base",
+                        "src_len": 256,
+                        "max_len": 128,
+                    },
+                    {
+                        "metric": "gen_decode_tokens_per_sec_beam10",
+                        "value": round(decode_beam10, 1),
+                        "unit": "tokens/s",
+                        "vs_baseline": None,
+                        "beam_size": 10,
+                        "batch_size": 48,
+                        "model": "codet5_base",
+                        "src_len": 256,
+                        "max_len": 128,
                     },
                 ],
             }

@@ -825,7 +825,7 @@ def cmd_tune(args) -> Dict[str, Any]:
             "model": {f.name for f in dataclasses.fields(base_model)},
             "train": {f.name for f in dataclasses.fields(base_train)},
         }
-        for key in space:
+        for key, cands in space.items():
             scope, _, field = key.partition(".")
             if scope not in fields:
                 raise ValueError(
@@ -836,6 +836,26 @@ def cmd_tune(args) -> Dict[str, Any]:
                 raise ValueError(
                     f"search-space key {key!r}: no such {scope} config "
                     f"field"
+                )
+            # Coerce candidates to the field's current type now — a
+            # "64"-for-int or unparseable value must fail here, not after
+            # a trial's worth of dataset/assessor setup.
+            cur = getattr(base_model if scope == "model" else base_train,
+                          field)
+            if isinstance(cur, bool):
+                caster = bool
+            elif isinstance(cur, int):
+                caster = int
+            elif isinstance(cur, float):
+                caster = float
+            else:
+                caster = lambda v: v
+            try:
+                space[key] = [caster(v) for v in cands]
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"search-space key {key!r}: candidate not coercible to "
+                    f"{type(cur).__name__}: {e}"
                 )
     else:
         space = {

@@ -789,9 +789,11 @@ def _run_multitask(cfg, tcfg, data, tiny, pretrained=None, tok=None,
     # otherwise (the _run_gen rule) — selection must score the same space
     # single-task runs report.
     decode_fn = getattr(tok, "decode", None) if tok is not None else None
-    # --patience 0 disabled early stopping (tcfg.early_stop_patience=None,
-    # exp.py tcfg construction); distinguish that from "unset" — which
-    # keeps the reference's per-task patience table — via cfg.patience.
+    # --patience N > 0 reaches fit_gen_multitask as
+    # tcfg.early_stop_patience, which overrides the per-task table for
+    # every task. --patience 0 (disable) became early_stop_patience=None
+    # at tcfg construction — indistinguishable there from "unset", which
+    # keeps the reference's table — so the disable is passed explicitly.
     patience = ({name: None for name in evals} if cfg.patience == 0
                 else None)
     out = fit_gen_multitask(model, tasks, evals, tcfg, max_steps=max_steps,

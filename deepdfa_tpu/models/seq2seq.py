@@ -116,6 +116,20 @@ class _DecoderAttention(nn.Module):
                 k, v = ck.value, cv.value
                 mask = (jnp.arange(k.shape[1]) <= idx)[None, None, None, :]
 
+        # Beam-deduped cross K/V (same scheme as t5.py T5Attention): K/V
+        # stored once per batch row, queries carrying `beams` rows per row
+        # fold the beam factor into the query axis.
+        fold = None
+        if is_cross and k.shape[0] != q.shape[0]:
+            if q.shape[0] % k.shape[0]:
+                raise ValueError(
+                    f"cross-attention query rows {q.shape[0]} must be a "
+                    f"multiple of K/V rows {k.shape[0]}"
+                )
+            beams = q.shape[0] // k.shape[0]
+            fold = (q.shape[0], q.shape[1])
+            q = q.reshape(k.shape[0], beams * q.shape[1], *q.shape[2:])
+
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
         if self.causal and not decode and not is_cross:
             t = x.shape[1]
@@ -127,6 +141,8 @@ class _DecoderAttention(nn.Module):
             weights, deterministic=deterministic
         )
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        if fold is not None:
+            out = out.reshape(*fold, h, head_dim)
         out = out.reshape(out.shape[0], out.shape[1], d)
         return nn.Dense(d, name="out")(out)
 

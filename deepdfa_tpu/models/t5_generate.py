@@ -51,6 +51,32 @@ def _init_cache(model: T5Model, params, batch: int, max_len: int, enc_out, enc_m
     return variables["cache"]
 
 
+def _is_cross_path(path) -> bool:
+    return any(getattr(e, "key", None) in ("cross_k", "cross_v")
+               for e in path)
+
+
+def _partition_cache(cache):
+    """Split the decode cache into (cross, dynamic) trees. Cross-attention
+    K/V are projected once at priming and never written again, so carrying
+    them through the decode ``lax.scan`` only risks per-step copies of the
+    largest buffers in the program (at codet5-base/beam-10 they are ~4.5 GB
+    that the scan carry cannot donate in place); they become closed-over
+    constants instead. The two trees keep the full structure with ``None``
+    holes so they re-merge positionally."""
+    tm = jax.tree_util.tree_map_with_path
+    cross = tm(lambda p, x: x if _is_cross_path(p) else None, cache)
+    dyn = tm(lambda p, x: None if _is_cross_path(p) else x, cache)
+    return cross, dyn
+
+
+def _merge_cache(cross, dyn):
+    return jax.tree_util.tree_map(
+        lambda c, d: d if c is None else c, cross, dyn,
+        is_leaf=lambda x: x is None,
+    )
+
+
 def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask):
     """One cached decode step. token: [B, 1] -> logits [B, V], new cache."""
     logits, variables = model.apply(
@@ -82,26 +108,34 @@ def greedy_decode(
         {"params": params["params"]}, input_ids, attn_mask, method=type(model).encode
     )
     b = input_ids.shape[0]
-    cache = _init_cache(model, params, b, max_len, enc_out, attn_mask)
+    cross, dyn = _partition_cache(
+        _init_cache(model, params, b, max_len, enc_out, attn_mask)
+    )
 
     def body(carry, _):
-        cache, token, finished = carry
-        logits, cache = _step_logits(model, params, cache, token, enc_out, attn_mask)
+        dyn, token, finished = carry
+        logits, cache = _step_logits(
+            model, params, _merge_cache(cross, dyn), token, enc_out, attn_mask
+        )
+        dyn = _partition_cache(cache)[1]
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(finished, c.pad_token_id, nxt)
         finished = finished | (nxt == c.eos_token_id)
-        return (cache, nxt[:, None], finished), nxt
+        return (dyn, nxt[:, None], finished), nxt
 
     start = jnp.full((b, 1), c.decoder_start_token_id, jnp.int32)
     (_, _, _), tokens = jax.lax.scan(
-        body, (cache, start, jnp.zeros(b, bool)), None, length=max_len
+        body, (dyn, start, jnp.zeros(b, bool)), None, length=max_len
     )
     return tokens.T  # [max_len, B] -> [B, max_len]
 
 
 def _gather_beams(tree, beam_idx, batch: int, beams: int):
     """Reorder the beam-flattened leading axis of every array leaf by
-    ``beam_idx`` [batch, new_beams]."""
+    ``beam_idx`` [batch, new_beams]. Only the dynamic (self-attention)
+    cache ever reaches this — cross K/V are removed by _partition_cache,
+    which is what keeps the beam step free of the 18 x 480 MB gather
+    temporaries that OOMed beam-10 before round 5."""
 
     def gather(x):
         if not hasattr(x, "ndim") or x.ndim == 0:
@@ -138,10 +172,16 @@ def beam_search(
     enc_out = model.apply(
         {"params": params["params"]}, input_ids, attn_mask, method=type(model).encode
     )
-    # Expand batch to B*K rows (beam-major flatten).
-    rep = lambda x: jnp.repeat(x, k, axis=0)
-    enc_out_k, mask_k = rep(enc_out), rep(attn_mask)
-    cache = _init_cache(model, params, b * k, max_len, enc_out_k, mask_k)
+    # Decoder rows expand to B*K (beam-major flatten) but the encoder side
+    # does NOT: cross K/V are identical for every beam of a row, so the
+    # cache is primed with the unreplicated encoder outputs and the
+    # attention modules fold the beam factor into the query axis
+    # (T5Attention's beam-deduped cross path). At codet5-base/beam-10 the
+    # replicated alternative reads 10 identical copies of ~0.45 GB of
+    # encoder K/V per decode step.
+    cross, dyn = _partition_cache(
+        _init_cache(model, params, b * k, max_len, enc_out, attn_mask)
+    )
 
     # Alive state: only beam 0 starts live so the first step's top-k is not
     # k copies of the same hypothesis.
@@ -152,8 +192,11 @@ def beam_search(
     token = jnp.full((b * k, 1), c.decoder_start_token_id, jnp.int32)
 
     def body(carry, t):
-        cache, token, alive_logp, alive_seq, fin_seq, fin_score = carry
-        logits, cache = _step_logits(model, params, cache, token, enc_out_k, mask_k)
+        dyn, token, alive_logp, alive_seq, fin_seq, fin_score = carry
+        logits, cache = _step_logits(
+            model, params, _merge_cache(cross, dyn), token, enc_out, attn_mask
+        )
+        dyn = _partition_cache(cache)[1]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # [B*K, V]
         v = logp.shape[-1]
         total = alive_logp[:, :, None] + logp.reshape(b, k, v)  # [B, K, V]
@@ -186,12 +229,12 @@ def beam_search(
         chosen_beam = jnp.take_along_axis(cand_beam, alive_top, axis=1)  # [B, K]
         chosen_tok = jnp.take_along_axis(cand_tok, alive_top, axis=1)
 
-        cache = _gather_beams(cache, chosen_beam, b, k)
+        dyn = _gather_beams(dyn, chosen_beam, b, k)
         token = chosen_tok.reshape(b * k, 1)
-        return (cache, token, alive_logp, alive_seq, fin_seq, fin_score), None
+        return (dyn, token, alive_logp, alive_seq, fin_seq, fin_score), None
 
-    carry = (cache, token, alive_logp, alive_seq, fin_seq, fin_score)
-    (cache, token, alive_logp, alive_seq, fin_seq, fin_score), _ = jax.lax.scan(
+    carry = (dyn, token, alive_logp, alive_seq, fin_seq, fin_score)
+    (dyn, token, alive_logp, alive_seq, fin_seq, fin_score), _ = jax.lax.scan(
         body, carry, jnp.arange(max_len)
     )
 
