@@ -326,9 +326,38 @@ def bench_combined_train(
     }
 
 
+def _gen_decode_setup(batch_size: int = 48, src_len: int = 256):
+    """(model, bf16 params, src) for bench_gen_decode — built once and
+    shared between the greedy and beam runs (a full codet5-base init at
+    this shape is expensive through the tunnel)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+
+    cfg = dataclasses.replace(T5Config.codet5_base(), dtype="bfloat16",
+                              dropout_rate=0.0)
+    model = T5Model(cfg)
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(
+        rng.randint(3, cfg.vocab_size, size=(batch_size, src_len))
+        .astype(np.int32)
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        src, jnp.zeros((batch_size, 4), jnp.int32),
+    )
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params,
+    )
+    return model, params, src
+
+
 def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
                      src_len: int = 256, max_len: int = 128,
-                     n_calls: int = 3):
+                     n_calls: int = 3, setup=None):
     """Generation decode throughput at the summarize shape: codet5-base,
     256-token sources, 128 generated tokens, batch 48 (exp.resolve's
     reference table) — the loop the reference times in its generation eval
@@ -356,29 +385,11 @@ def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
     the chip's HBM peak, and the beam step adds the cache gather
     (read+write of the full self cache per step).
     """
-    import dataclasses
-
     import jax.numpy as jnp
 
-    from deepdfa_tpu.models.t5 import T5Config, T5Model
     from deepdfa_tpu.models.t5_generate import generate
 
-    cfg = dataclasses.replace(T5Config.codet5_base(), dtype="bfloat16",
-                              dropout_rate=0.0)
-    model = T5Model(cfg)
-    rng = np.random.RandomState(0)
-    src = jnp.asarray(
-        rng.randint(3, cfg.vocab_size, size=(batch_size, src_len))
-        .astype(np.int32)
-    )
-    params = model.init(
-        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-        src, jnp.zeros((batch_size, 4), jnp.int32),
-    )
-    params = jax.tree_util.tree_map(
-        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
-        params,
-    )
+    model, params, src = setup or _gen_decode_setup(batch_size, src_len)
 
     def decode(params, src, prev):
         # Chain calls through a data dependency (the infer-bench barrier
@@ -502,8 +513,10 @@ def main() -> None:
     # exists (BASELINE.md has no decode measurement); HBM-bound — see
     # bench_gen_decode's docstring for the rationale and the layout/dedup
     # A/Bs behind the defaults.
-    decode_greedy = bench_gen_decode(beam_size=1)
-    decode_beam10 = bench_gen_decode(beam_size=10, n_calls=2)
+    decode_setup = _gen_decode_setup()
+    decode_greedy = bench_gen_decode(beam_size=1, setup=decode_setup)
+    decode_beam10 = bench_gen_decode(beam_size=10, n_calls=2,
+                                     setup=decode_setup)
 
     baseline_gnn = BASELINE_GNN_GRAPHS_PER_SEC
     baseline_train = BASELINE_COMBINED_EXAMPLES_PER_SEC

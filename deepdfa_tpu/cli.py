@@ -431,6 +431,16 @@ def _text_model_and_tokenizer(args, combined: bool, graph_cfg):
         from deepdfa_tpu.models.transformer import EncoderConfig
 
         enc = EncoderConfig.tiny() if args.tiny else EncoderConfig()
+        if args.tiny:
+            # The tiny position table (66) must still cover --block-size
+            # (default 512): undersized tables used to NaN-fill silently.
+            enc = dataclasses.replace(
+                enc,
+                max_position_embeddings=max(
+                    enc.max_position_embeddings,
+                    args.block_size + enc.pad_token_id + 1,
+                ),
+            )
         enc = dataclasses.replace(
             enc,
             # "auto" = the measured champion per backend (flash kernels on
@@ -637,6 +647,7 @@ def cmd_test_text(args) -> Dict[str, Any]:
         tokenizer=args.tokenizer or desc.get("tokenizer"),
         attention_impl=desc.get("attention_impl", "auto"),
         remat=desc.get("remat", False),
+        block_size=desc["block_size"],
     )
     combined = desc["combined"]
     model, tok, pad_id, style = _text_model_and_tokenizer(ns, combined,
@@ -843,9 +854,18 @@ def cmd_tune(args) -> Dict[str, Any]:
             cur = getattr(base_model if scope == "model" else base_train,
                           field)
             if isinstance(cur, bool):
-                caster = bool
+                def caster(v):
+                    # bool("false") is True — parse, don't cast.
+                    if isinstance(v, bool):
+                        return v
+                    if isinstance(v, str) and v.lower() in ("true", "false"):
+                        return v.lower() == "true"
+                    raise ValueError(f"not a boolean: {v!r}")
             elif isinstance(cur, int):
-                caster = int
+                def caster(v):
+                    if isinstance(v, float) and not v.is_integer():
+                        raise ValueError(f"non-integral for int field: {v!r}")
+                    return int(v)
             elif isinstance(cur, float):
                 caster = float
             else:

@@ -1,0 +1,39 @@
+"""Beam-deduped cross-attention query folding, shared by the T5 and
+RoBERTa-seq2seq attention modules.
+
+During beam decoding (models/t5_generate.py) decoder rows are beam-major
+``b*K + beam`` while the encoder K/V are stored ONCE per batch row — every
+beam of a row attends over identical K/V, so replicating them would
+multiply the biggest HBM reads of the decode step by the beam width.
+Instead the beam factor folds into the query-length axis for the attention
+einsums (masks shaped [B, 1, 1, S] broadcast over it), and the output
+unfolds back to beam-major rows. This invariant is layout-critical: it
+assumes the beam-major flatten used by beam_search's ``reshape(b*k, 1)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def fold_beam_queries(q, k) -> Tuple[object, Optional[Tuple[int, int]]]:
+    """Fold q [B*K, T, ...] to [B, K*T, ...] when k has B rows. Returns
+    (q, fold) where fold is None (no-op) or the original (rows, q_len) for
+    unfold_beam_out."""
+    if k.shape[0] == q.shape[0]:
+        return q, None
+    if q.shape[0] % k.shape[0]:
+        raise ValueError(
+            f"cross-attention query rows {q.shape[0]} must be a multiple "
+            f"of K/V rows {k.shape[0]}"
+        )
+    beams = q.shape[0] // k.shape[0]
+    fold = (q.shape[0], q.shape[1])
+    return q.reshape(k.shape[0], beams * q.shape[1], *q.shape[2:]), fold
+
+
+def unfold_beam_out(out, fold: Optional[Tuple[int, int]]):
+    """Undo fold_beam_queries on the attention output [B, K*T, H, D]."""
+    if fold is None:
+        return out
+    return out.reshape(*fold, *out.shape[2:])

@@ -99,8 +99,17 @@ class LineVul(nn.Module):
 def cross_entropy_loss(
     logits: jnp.ndarray, labels: jnp.ndarray, example_mask: jnp.ndarray
 ) -> jnp.ndarray:
-    """Masked mean 2-class CE (linevul_model.py CE over keep_idx rows)."""
+    """Masked mean 2-class CE (linevul_model.py CE over keep_idx rows).
+
+    Masked rows are neutralized BEFORE log_softmax: padded tail rows
+    (all-pad inputs) can produce non-finite logits, and both the forward
+    (``NaN * 0 == NaN`` in a masked sum) and the backward (log_softmax's
+    VJP emits NaN for a non-finite row even under a zero cotangent — the
+    double-where problem) would poison the batch through the shared
+    parameters."""
+    logits = jnp.where(example_mask[:, None], logits, 0.0)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(log_probs, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    picked = jnp.where(example_mask, picked, 0.0)
     m = example_mask.astype(jnp.float32)
-    return -jnp.sum(picked * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return -jnp.sum(picked) / jnp.maximum(jnp.sum(m), 1.0)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu.models.beam_fold import fold_beam_queries, unfold_beam_out
 from deepdfa_tpu.models.transformer import EncoderConfig, RobertaEncoder
 
 
@@ -116,19 +117,11 @@ class _DecoderAttention(nn.Module):
                 k, v = ck.value, cv.value
                 mask = (jnp.arange(k.shape[1]) <= idx)[None, None, None, :]
 
-        # Beam-deduped cross K/V (same scheme as t5.py T5Attention): K/V
-        # stored once per batch row, queries carrying `beams` rows per row
-        # fold the beam factor into the query axis.
+        # Beam-deduped cross K/V (models/beam_fold.py): the beam factor
+        # folds into the query axis when K/V are stored once per batch row.
         fold = None
-        if is_cross and k.shape[0] != q.shape[0]:
-            if q.shape[0] % k.shape[0]:
-                raise ValueError(
-                    f"cross-attention query rows {q.shape[0]} must be a "
-                    f"multiple of K/V rows {k.shape[0]}"
-                )
-            beams = q.shape[0] // k.shape[0]
-            fold = (q.shape[0], q.shape[1])
-            q = q.reshape(k.shape[0], beams * q.shape[1], *q.shape[2:])
+        if is_cross:
+            q, fold = fold_beam_queries(q, k)
 
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
         if self.causal and not decode and not is_cross:
@@ -141,8 +134,7 @@ class _DecoderAttention(nn.Module):
             weights, deterministic=deterministic
         )
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-        if fold is not None:
-            out = out.reshape(*fold, h, head_dim)
+        out = unfold_beam_out(out, fold)
         out = out.reshape(out.shape[0], out.shape[1], d)
         return nn.Dense(d, name="out")(out)
 

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu.models.beam_fold import fold_beam_queries, unfold_beam_out
+
 
 @dataclasses.dataclass(frozen=True)
 class T5Config:
@@ -259,23 +261,11 @@ class T5Attention(nn.Module):
                 if self.has_relative_bias:
                     position_bias = self._rel_bias_row(idx, max_len)
 
-        # Beam-deduped cross K/V: generation stores/computes the encoder
-        # projections ONCE per batch row while queries carry `beams` rows
-        # per row (t5_generate.beam_search) — every beam of a row attends
-        # over identical K/V, so replicating them just multiplies the
-        # biggest HBM reads in the decode step by the beam width. Fold the
-        # beam factor into the query axis for the einsums; masks [B,1,1,S]
-        # broadcast over it.
+        # Beam-deduped cross K/V (models/beam_fold.py): the beam factor
+        # folds into the query axis when K/V are stored once per batch row.
         fold = None
-        if is_cross and k.shape[0] != q.shape[0]:
-            if q.shape[0] % k.shape[0]:
-                raise ValueError(
-                    f"cross-attention query rows {q.shape[0]} must be a "
-                    f"multiple of K/V rows {k.shape[0]}"
-                )
-            beams = q.shape[0] // k.shape[0]
-            fold = (q.shape[0], q.shape[1])
-            q = q.reshape(k.shape[0], beams * q.shape[1], *q.shape[2:])
+        if is_cross:
+            q, fold = fold_beam_queries(q, k)
 
         # No sqrt(d_kv) scaling — T5 folds it into the init.
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
@@ -287,8 +277,7 @@ class T5Attention(nn.Module):
         weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(d)
         weights = nn.Dropout(c.dropout_rate)(weights, deterministic=deterministic)
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-        if fold is not None:
-            out = out.reshape(*fold, c.num_heads, c.d_kv)
+        out = unfold_beam_out(out, fold)
         out = out.reshape(out.shape[0], out.shape[1], inner)
         init_o = nn.initializers.normal((c.num_heads * c.d_kv) ** -0.5)
         return (

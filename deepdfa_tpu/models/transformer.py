@@ -171,8 +171,14 @@ class RobertaEncoder(nn.Module):
         else:
             word = input_embeds
         # RoBERTa position ids: pad positions stay at pad_id; real tokens
-        # count up from pad_id+1.
+        # count up from pad_id+1. Ids past the table CLAMP to the last
+        # entry — explicitly, because JAX's out-of-bounds gather fills NaN
+        # under jit, which silently poisoned training (tiny table vs
+        # 512-token inputs, round 5). Clamping keeps sequences longer than
+        # the table trainable (the long-context perf shape at 4096 rides
+        # the 514-entry table by design — bench.py).
         positions = jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) * attn_mask + c.pad_token_id
+        positions = jnp.minimum(positions, c.max_position_embeddings - 1)
         pos = nn.Embed(
             c.max_position_embeddings, c.hidden_size, name="position_embeddings"
         )(positions)

@@ -1,0 +1,161 @@
+#!/bin/bash
+# One-command paper reproduction: preprocess -> DeepDFA fit/test ->
+# combined DeepDFA+LineVul fit-text/test-text (+ optional cross-project
+# and DbgBench stages), ending in ONE summary JSON with the Table
+# 3b/5/7/8-equivalent numbers.
+#
+# Reference flows stitched together here: scripts/performance_evaluation.sh:1-9
+# (DDFA -> combined -> profiling), LineVul/linevul/scripts/
+# msr_train_combined.sh:12-30 (the combined training command),
+# run_cross_project.sh + cross_project_{train,eval}_combined.sh (Table 7),
+# and the DbgBench evaluation (Table 8).
+#
+# Usage:
+#   scripts/reproduce_paper.sh                  # synthetic end-to-end dry-run
+#   DATA=/data/MSR TEXT_DATA=/data/msr_csvs scripts/reproduce_paper.sh
+#
+# Env knobs:
+#   DATA          raw dataset source for the ETL (Big-Vul csv / devign);
+#                 unset => synthetic dry-run of every stage
+#   TEXT_DATA     MSR csv directory for the combined model's text side
+#                 (required with DATA; synthetic mode derives it)
+#   DATASET_NAME  bigvul | devign (default bigvul)
+#   WORKDIR       output root (default runs/reproduce)
+#   EPOCHS        DeepDFA epochs (default 100 real / 5 synthetic — the
+#                 reference's main_cli epoch budget)
+#   TEXT_EPOCHS   combined epochs (default 10 real / 2 synthetic,
+#                 msr_train_combined.sh --epochs 10)
+#   SAMPLE        etl prepare --sample N (smoke a real dataset quickly)
+#   SYNTHETIC_N   synthetic dataset size (default 256)
+#   TINY=1        tiny text model (synthetic mode only; the CI size)
+#   CROSS_PROJECT=1  add the Table-7 cross-project stage
+#   DBGBENCH=bug_map.json  add the Table-8 DbgBench evaluation
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK="${WORKDIR:-runs/reproduce}"
+LOGS="$WORK/logs"
+mkdir -p "$LOGS"
+
+if [ -n "${DATA:-}" ]; then
+  DSNAME="${DATASET_NAME:-bigvul}"
+  echo "== preprocess ($DSNAME) =="
+  python -m deepdfa_tpu.etl.pipeline prepare --dataset "$DSNAME" \
+    --path "$DATA" --workdir "$WORK/etl" ${SAMPLE:+--sample "$SAMPLE"}
+  python -m deepdfa_tpu.etl.pipeline graphs --workdir "$WORK/etl" \
+    --workers "${WORKERS:-6}"
+  python -m deepdfa_tpu.etl.pipeline export --workdir "$WORK/etl"
+  DATASET="$WORK/etl/examples.jsonl"
+  GRAPHS="$DATASET"
+  TEXT_DATASET="${TEXT_DATA:?combined stage needs TEXT_DATA=<MSR csv dir>}"
+  EPOCHS="${EPOCHS:-100}"
+  TEXT_EPOCHS="${TEXT_EPOCHS:-10}"
+  TINYFLAG=""
+else
+  echo "== synthetic dry-run (set DATA=... to reproduce on real data) =="
+  DATASET="synthetic:${SYNTHETIC_N:-256}"
+  GRAPHS="synthetic"
+  TEXT_DATASET="$DATASET"
+  EPOCHS="${EPOCHS:-5}"
+  TEXT_EPOCHS="${TEXT_EPOCHS:-2}"
+  TINYFLAG="${TINY:+--tiny}"
+fi
+
+echo "== DeepDFA fit ($DATASET, $EPOCHS epochs) =="
+python -m deepdfa_tpu.cli fit --config configs/default.yaml \
+  --dataset "$DATASET" --set train.max_epochs="$EPOCHS" \
+  --checkpoint-dir "$WORK/deepdfa" | tee "$LOGS/ddfa_fit.out"
+
+echo "== DeepDFA test (Table 3b GNN row + Table 5 profiling) =="
+python -m deepdfa_tpu.cli test --config configs/default.yaml \
+  --dataset "$DATASET" --checkpoint-dir "$WORK/deepdfa" --which best \
+  --profile --time | tee "$LOGS/ddfa_test.out"
+python -m deepdfa_tpu.eval.report "$WORK/deepdfa/profiledata.jsonl" \
+  "$WORK/deepdfa/timedata.jsonl" | tee "$LOGS/ddfa_profile.out"
+
+echo "== combined fit-text (msr_train_combined.sh flow) =="
+python -m deepdfa_tpu.cli fit-text --config configs/default.yaml \
+  --model linevul --dataset "$TEXT_DATASET" --graphs "$GRAPHS" \
+  --epochs "$TEXT_EPOCHS" --checkpoint-dir "$WORK/combined" \
+  --ddfa-checkpoint "$WORK/deepdfa" $TINYFLAG | tee "$LOGS/combined_fit.out"
+
+echo "== combined test-text (Table 3b combined row + Table 5) =="
+python -m deepdfa_tpu.cli test-text --checkpoint-dir "$WORK/combined" \
+  --which best --profile --time | tee "$LOGS/combined_test.out"
+python -m deepdfa_tpu.eval.report "$WORK/combined/profiledata.jsonl" \
+  "$WORK/combined/timedata.jsonl" | tee "$LOGS/combined_profile.out"
+
+if [ "${CROSS_PROJECT:-0}" = "1" ]; then
+  echo "== cross-project (Table 7) =="
+  python -m deepdfa_tpu.cli fit --config configs/default.yaml \
+    --dataset "$DATASET" --split-mode cross-project \
+    --set train.max_epochs="$EPOCHS" \
+    --checkpoint-dir "$WORK/cross_deepdfa" | tee "$LOGS/cross_fit.out"
+  python -m deepdfa_tpu.cli test --config configs/default.yaml \
+    --dataset "$DATASET" --split-mode cross-project \
+    --checkpoint-dir "$WORK/cross_deepdfa" --which best \
+    | tee "$LOGS/cross_test.out"
+  python -m deepdfa_tpu.cli fit-text --config configs/default.yaml \
+    --model linevul --dataset "$TEXT_DATASET" --graphs "$GRAPHS" \
+    --split-mode cross-project --epochs "$TEXT_EPOCHS" \
+    --checkpoint-dir "$WORK/cross_combined" \
+    --ddfa-checkpoint "$WORK/cross_deepdfa" $TINYFLAG \
+    | tee "$LOGS/cross_combined_fit.out"
+  python -m deepdfa_tpu.cli test-text --checkpoint-dir "$WORK/cross_combined" \
+    --which best | tee "$LOGS/cross_combined_test.out"
+fi
+
+if [ -n "${DBGBENCH:-}" ]; then
+  echo "== DbgBench (Table 8) =="
+  python -m deepdfa_tpu.cli test-text --checkpoint-dir "$WORK/combined" \
+    --which best --dbgbench "$DBGBENCH" | tee "$LOGS/dbgbench.out"
+fi
+
+echo "== summary =="
+WORK="$WORK" python - << 'PY'
+import json, os
+
+work = os.environ["WORK"]
+logs = os.path.join(work, "logs")
+
+
+def last_json(name):
+    """Last parseable JSON line of a captured stage log (each CLI command
+    prints its result record as its final stdout line)."""
+    path = os.path.join(logs, name)
+    if not os.path.exists(path):
+        return None
+    out = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+summary = {
+    "table3b": {
+        "deepdfa": last_json("ddfa_test.out"),
+        "combined": last_json("combined_test.out"),
+    },
+    "table5_profiling": {
+        "deepdfa": last_json("ddfa_profile.out"),
+        "combined": last_json("combined_profile.out"),
+    },
+    "table7_cross_project": {
+        "deepdfa": last_json("cross_test.out"),
+        "combined": last_json("cross_combined_test.out"),
+    },
+    "table8_dbgbench": last_json("dbgbench.out"),
+}
+fn = os.path.join(work, "reproduce_summary.json")
+with open(fn, "w") as f:
+    json.dump(summary, f, indent=1)
+print(json.dumps({"summary": fn,
+                  "stages": {k: v is not None if not isinstance(v, dict)
+                             else {kk: vv is not None for kk, vv in v.items()}
+                             for k, v in summary.items()}}))
+PY

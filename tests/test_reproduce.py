@@ -1,0 +1,46 @@
+"""scripts/reproduce_paper.sh — the one-command paper reproduction — must
+dry-run green end to end on synthetic data, so the script itself is CI
+surface (VERDICT round-4 directive 4: a real-data run must not be the
+script's first execution)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_reproduce_paper_synthetic_dry_run(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        WORKDIR=str(tmp_path / "repro"),
+        TINY="1",
+        SYNTHETIC_N="64",
+        EPOCHS="1",
+        TEXT_EPOCHS="1",
+        CROSS_PROJECT="1",
+    )
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "reproduce_paper.sh")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1800,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+
+    summary_fn = tmp_path / "repro" / "reproduce_summary.json"
+    assert summary_fn.exists()
+    s = json.loads(summary_fn.read_text())
+    # Every non-optional stage produced its record with the headline metric
+    # (cli test/test-text print flat records: f1 at top level).
+    assert "f1" in s["table3b"]["deepdfa"]
+    assert "f1" in s["table3b"]["combined"]
+    for fam in ("deepdfa", "combined"):
+        assert "examples_per_sec" in s["table5_profiling"][fam]
+    assert "f1" in s["table7_cross_project"]["deepdfa"]
+    assert "f1" in s["table7_cross_project"]["combined"]
+    # Losses are finite — the silent-NaN regression this script's first
+    # dry run exposed (tiny position table vs 512-token block size).
+    assert s["table3b"]["combined"]["loss"] == s["table3b"]["combined"]["loss"]
