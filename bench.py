@@ -390,6 +390,9 @@ def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
     from deepdfa_tpu.models.t5_generate import generate
 
     model, params, src = setup or _gen_decode_setup(batch_size, src_len)
+    # The setup's shapes are authoritative — a prebuilt setup at another
+    # shape must not silently mislabel the per-example math.
+    batch_size, src_len = src.shape
 
     def decode(params, src, prev):
         # Chain calls through a data dependency (the infer-bench barrier

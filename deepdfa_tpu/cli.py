@@ -325,6 +325,8 @@ def cmd_test(args) -> Dict[str, Any]:
         _batches,
     )
 
+    import jax
+
     cfgs = build_configs(args.config, args.set)
     model_cfg, data_cfg, train_cfg = cfgs["model"], cfgs["data"], cfgs["train"]
     examples, splits = load_dataset(args.dataset, model_cfg.feature,
@@ -344,12 +346,45 @@ def cmd_test(args) -> Dict[str, Any]:
     ckpt = CheckpointManager(args.checkpoint_dir)
     state = ckpt.restore(args.which, state)
 
-    import jax
+    # --n-devices: dp-shard the eval batches over a mesh, like fit — the
+    # reference evaluates under DataParallel (linevul_main.py:259-260,
+    # run_defect.py:427-429). Per-example outputs replicate, so metrics,
+    # prediction dumps, and profiling behave identically.
+    mesh, host, n_shards = None, None, 1
+    if getattr(args, "n_devices", 1) > 1:
+        from deepdfa_tpu.parallel.mesh import (
+            DATA_AXIS,
+            batch_sharding,
+            make_mesh,
+            replicated,
+        )
 
-    eval_step = jax.jit(make_eval_step(model, train_cfg))
+        mesh = make_mesh(n_data=args.n_devices)
+        n_shards = int(mesh.shape[DATA_AXIS])
+        host = ((jax.process_index(), jax.process_count())
+                if jax.process_count() > 1 else None)
+        # The sharded tile kernel runs under shard_map and needs the mesh
+        # on the model (the fit contract, train/loop.py).
+        eval_model = model.clone(mesh=mesh)
+        eval_step = jax.jit(
+            make_eval_step(eval_model, train_cfg),
+            in_shardings=(replicated(mesh), batch_sharding(mesh)),
+            out_shardings=(replicated(mesh),) * 4,
+        )
+    else:
+        eval_step = jax.jit(make_eval_step(model, train_cfg))
+    if (getattr(args, "profile", False) or getattr(args, "time", False)) \
+            and host is not None:
+        # Fail before the pod-scale eval runs, not after.
+        raise ValueError(
+            "--profile/--time instrument a single process; run them "
+            "without multi-controller (they work with --n-devices on "
+            "one host)"
+        )
     res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
-                   build_tile_adj=use_tile, build_band_adj=use_band,
-                   with_dataflow=use_df)
+                   n_shards=n_shards, build_tile_adj=use_tile,
+                   build_band_adj=use_band, with_dataflow=use_df,
+                   host=host, mesh=mesh)
     report = {"loss": res.loss, **res.metrics}
 
     if getattr(args, "profile", False) or getattr(args, "time", False):
@@ -370,7 +405,8 @@ def cmd_test(args) -> Dict[str, Any]:
                 os.remove(p)  # fresh run, not an append to a stale one
         batches = list(
             _batches(examples, splits["test"], data_cfg, subkeys,
-                     data_cfg.eval_batch_size, build_tile_adj=use_tile,
+                     data_cfg.eval_batch_size, n_shards,
+                     build_tile_adj=use_tile,
                      build_band_adj=use_band, with_dataflow=use_df)
         )
         recorder = ProfileRecorder(profile_path, time_path)
@@ -691,14 +727,40 @@ def cmd_test_text(args) -> Dict[str, Any]:
                            tcfg.eval_batch_size, graphs_by_id, subkeys,
                            budget, pad_id=pad_id)
     )
+    # --n-devices: dp-shard the eval batches, like fit-text (the reference
+    # evaluates under DataParallel, linevul_main.py:259-260). Outputs
+    # replicate, so the report is identical to the single-device one.
+    mesh, host, n_shards = None, None, 1
+    if getattr(args, "n_devices", 1) > 1:
+        from deepdfa_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=args.n_devices)
+        n_shards = args.n_devices
+        host = ((jax.process_index(), jax.process_count())
+                if jax.process_count() > 1 else None)
+        model = model.clone(mesh=mesh)
+    if (args.profile or args.time) and host is not None:
+        # Fail before the pod-scale eval runs, not after.
+        raise ValueError(
+            "--profile/--time instrument a single process; run them "
+            "without multi-controller (they work with --n-devices on "
+            "one host)"
+        )
     state, _ = make_text_train_state(model, example, tcfg, max_steps=1)
     restored = CheckpointManager(args.checkpoint_dir).restore(
         args.which, {"params": state.params}
     )
     state = state.replace(params=restored["params"])
-    eval_step = jax.jit(make_text_eval_step(model))
+    if mesh is not None:
+        from deepdfa_tpu.parallel.mesh import jit_dp_step
+
+        eval_step = jit_dp_step(make_text_eval_step(model), mesh,
+                                n_batch_args=4, n_out=2, donate=())
+    else:
+        eval_step = jax.jit(make_text_eval_step(model))
     res = evaluate_text(eval_step, state, data, indices, tcfg, graphs_by_id,
-                        subkeys, budget, pad_id=pad_id)
+                        subkeys, budget, pad_id=pad_id, n_shards=n_shards,
+                        host=host, mesh=mesh)
     report: Dict[str, Any] = {"loss": res["loss"], **res["metrics"],
                               "num_missing": res["num_missing"],
                               "split": split_used}
@@ -751,7 +813,7 @@ def cmd_test_text(args) -> Dict[str, Any]:
              np.asarray(b.example_mask), b.graphs)
             for b in text_graph_batches(data, indices, tcfg.eval_batch_size,
                                         graphs_by_id, subkeys, budget,
-                                        pad_id=pad_id)
+                                        pad_id=pad_id, n_shards=n_shards)
         ]
         recorder = ProfileRecorder(profile_path, time_path)
         summary = profile_eval(
@@ -960,6 +1022,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     common(p_test)
     p_test.add_argument("--checkpoint-dir", required=True)
     p_test.add_argument("--which", default="best", help="best | last | epoch_N")
+    p_test.add_argument("--n-devices", type=int, default=1,
+                        help="dp-shard eval batches over a mesh (the "
+                             "reference's DataParallel eval)")
     # The reference's profiling flow (scripts/run_profiling.sh ->
     # --model.profile/--model.time, base_module.py:238-291): per-step
     # FLOPs/latency JSONL plus an aggregated Table-5-style summary.
@@ -1030,6 +1095,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tt.add_argument("--graphs", default=None)
     p_tt.add_argument("--tokenizer", default=None)
     p_tt.add_argument("--eval-batch-size", type=int, default=16)
+    p_tt.add_argument("--n-devices", type=int, default=1,
+                      help="dp-shard eval batches over a mesh (the "
+                           "reference's DataParallel eval)")
     p_tt.add_argument("--profile", action="store_true")
     p_tt.add_argument("--time", action="store_true")
     p_tt.add_argument("--profile-dir", default=None)

@@ -37,6 +37,8 @@ LOGS="$WORK/logs"
 mkdir -p "$LOGS"
 
 if [ -n "${DATA:-}" ]; then
+  # Validate EVERY required input before the hours-long ETL starts.
+  TEXT_DATASET="${TEXT_DATA:?combined stage needs TEXT_DATA=<MSR csv dir>}"
   DSNAME="${DATASET_NAME:-bigvul}"
   echo "== preprocess ($DSNAME) =="
   python -m deepdfa_tpu.etl.pipeline prepare --dataset "$DSNAME" \
@@ -46,7 +48,6 @@ if [ -n "${DATA:-}" ]; then
   python -m deepdfa_tpu.etl.pipeline export --workdir "$WORK/etl"
   DATASET="$WORK/etl/examples.jsonl"
   GRAPHS="$DATASET"
-  TEXT_DATASET="${TEXT_DATA:?combined stage needs TEXT_DATA=<MSR csv dir>}"
   EPOCHS="${EPOCHS:-100}"
   TEXT_EPOCHS="${TEXT_EPOCHS:-10}"
   TINYFLAG=""

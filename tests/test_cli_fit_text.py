@@ -263,3 +263,31 @@ def test_test_text_dbgbench_rejects_foreign_map(tmp_path, capsys):
     with pytest.raises(ValueError, match="bug map"):
         main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8",
               "--dbgbench", str(bm)])
+
+
+def test_test_text_n_devices_matches_single(tmp_path, capsys):
+    """test-text --n-devices shards eval over the virtual mesh and
+    reproduces the single-device report bit-for-bit (the DataParallel
+    eval parity, linevul_main.py:259-260)."""
+    import jax
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    run = str(tmp_path / "combined")
+    main([
+        "fit-text", "--model", "linevul", "--dataset", "synthetic:48",
+        "--graphs", "synthetic", "--tiny", "--epochs", "1",
+        "--batch-size", "8", "--block-size", "64",
+        "--checkpoint-dir", run, *TINY_GRAPH,
+    ])
+    _last_json(capsys)
+    main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8"])
+    single = _last_json(capsys)
+    main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8",
+          "--n-devices", "8"])
+    sharded = _last_json(capsys)
+    # Per-example outputs replicate, so every derived metric is identical;
+    # the scalar loss may differ in the last ulps from the cross-shard
+    # reduction order.
+    assert sharded.pop("loss") == pytest.approx(single.pop("loss"), rel=1e-6)
+    assert sharded == single
