@@ -79,6 +79,41 @@ re-derivable from this file):
   with the tile count). dense at 512 is also slower than blockwise.
   Defaults: flash everywhere on TPU, blockwise as the portable fallback,
   ring (parallel/ring.py) across chips.
+
+Round-5 findings (all back-to-back whole-step A/Bs on v5e):
+- Combined: the biggest remaining lever was the FFN activation — the
+  exact erf gelu runs on the VPU's transcendental path in forward AND
+  backward, ~11 ms of the 70 ms step. The ladder: baseline 227.1 ex/s;
+  gelu tanh-approx 269.0 (+18.5%); LN-in-bf16 231.8 (+2%, numerics risk,
+  not taken); both 272.4. Dropout costs ~3.7 ms (no-dropout step 236.1) —
+  left in, it is the training semantics. The GNN branch costs ~0.4 ms
+  (text-only 229.2 vs 228.0 combined) — nothing to win there. tanh gelu
+  (|delta| < 1e-3 vs erf) is now the EncoderConfig default; converted HF
+  checkpoints keep erf (models/pretrained.py). Sequence packing was
+  REJECTED by arithmetic, not measurement: at bq=bk=512 a packed
+  1024-token row runs the same diagonal tile count as two 512 rows, so
+  there is no program-count win at the parity shape. With the gelu
+  default: 271.1 ex/s bs16 (42.1% MFU), bs64 262.2, long-context 46.2k
+  tok/s (28.0% MFU).
+- GNN (attack-the-scan round): band tile 256 LOSES (349-352k vs 392-404k
+  graphs/s interleaved — fewer, deeper bmms pay more in the 2x
+  zero-padded diagonals than they save in program count); a fused
+  2-matmul GRU cell LOSES (365k vs 375k — XLA already fuses the six gate
+  matmuls' elementwise tails, and the concat adds traffic); UNROLLING the
+  5-step nn.scan WINS (405-410k vs 392-394k, +3-4% — cross-step fusion
+  the rolled carry forbids) and is now the model default (capped at 8).
+  The unroll also CORRECTED the MFU accounting: XLA's cost analysis does
+  not multiply a while-loop body by its trip count, so the rolled scan
+  reported 14.6 GFLOP/step where the unrolled program counts the true
+  54.7 G — round 4's "12.2% MFU, scan is the headroom" was an accounting
+  artifact; the step actually runs at ~45% MFU and the scan was never
+  the bottleneck it appeared to be. That IS the certification this round
+  owed: at 45% MFU on a step dominated by [T,128,128] band bmms and
+  128-wide GRU matmuls, the remaining gap to peak is tile-shape overhead,
+  not a missing rewrite.
+- Decode (first measured round): see bench_gen_decode's docstring —
+  split cache layout, beam-deduped cross K/V, cross K/V out of the scan
+  carry; greedy 14.2k tok/s, beam-10 1.0k tok/s.
 """
 
 from __future__ import annotations

@@ -315,6 +315,32 @@ def cmd_fit(args) -> Dict[str, Any]:
         return result
 
 
+def _eval_mesh(args):
+    """(mesh, host, n_shards) for ``--n-devices`` eval sharding, plus the
+    fail-early --profile/--time multi-controller guard — one source of
+    truth for cmd_test AND cmd_test_text (the reference's DataParallel
+    eval, linevul_main.py:259-260, run_defect.py:427-429)."""
+    import jax
+
+    mesh, host, n_shards = None, None, 1
+    if getattr(args, "n_devices", 1) > 1:
+        from deepdfa_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        mesh = make_mesh(n_data=args.n_devices)
+        n_shards = int(mesh.shape[DATA_AXIS])
+        host = ((jax.process_index(), jax.process_count())
+                if jax.process_count() > 1 else None)
+    if (getattr(args, "profile", False) or getattr(args, "time", False)) \
+            and host is not None:
+        # Fail before the pod-scale eval runs, not after.
+        raise ValueError(
+            "--profile/--time instrument a single process; run them "
+            "without multi-controller (they work with --n-devices on "
+            "one host)"
+        )
+    return mesh, host, n_shards
+
+
 def cmd_test(args) -> Dict[str, Any]:
     from deepdfa_tpu.models.flowgnn import FlowGNN
     from deepdfa_tpu.train.checkpoint import CheckpointManager
@@ -346,23 +372,14 @@ def cmd_test(args) -> Dict[str, Any]:
     ckpt = CheckpointManager(args.checkpoint_dir)
     state = ckpt.restore(args.which, state)
 
-    # --n-devices: dp-shard the eval batches over a mesh, like fit — the
-    # reference evaluates under DataParallel (linevul_main.py:259-260,
-    # run_defect.py:427-429). Per-example outputs replicate, so metrics,
-    # prediction dumps, and profiling behave identically.
-    mesh, host, n_shards = None, None, 1
-    if getattr(args, "n_devices", 1) > 1:
-        from deepdfa_tpu.parallel.mesh import (
-            DATA_AXIS,
-            batch_sharding,
-            make_mesh,
-            replicated,
-        )
+    # --n-devices: dp-shard the eval batches over a mesh, like fit.
+    # Per-example outputs replicate, so metrics, prediction dumps, and
+    # profiling behave identically (the per-graph node caps make the
+    # per-shard budget exact here, unlike the combined join).
+    mesh, host, n_shards = _eval_mesh(args)
+    if mesh is not None:
+        from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
 
-        mesh = make_mesh(n_data=args.n_devices)
-        n_shards = int(mesh.shape[DATA_AXIS])
-        host = ((jax.process_index(), jax.process_count())
-                if jax.process_count() > 1 else None)
         # The sharded tile kernel runs under shard_map and needs the mesh
         # on the model (the fit contract, train/loop.py).
         eval_model = model.clone(mesh=mesh)
@@ -373,14 +390,6 @@ def cmd_test(args) -> Dict[str, Any]:
         )
     else:
         eval_step = jax.jit(make_eval_step(model, train_cfg))
-    if (getattr(args, "profile", False) or getattr(args, "time", False)) \
-            and host is not None:
-        # Fail before the pod-scale eval runs, not after.
-        raise ValueError(
-            "--profile/--time instrument a single process; run them "
-            "without multi-controller (they work with --n-devices on "
-            "one host)"
-        )
     res = evaluate(eval_step, state, examples, splits["test"], data_cfg, subkeys,
                    n_shards=n_shards, build_tile_adj=use_tile,
                    build_band_adj=use_band, with_dataflow=use_df,
@@ -484,6 +493,11 @@ def _text_model_and_tokenizer(args, combined: bool, graph_cfg):
             # localization/attribution flows that need attention weights.
             attention_impl=getattr(args, "attention_impl", "auto"),
             remat_layers=getattr(args, "remat", False),
+            # Recorded in model.json so test-text rebuilds the SAME
+            # activation (tanh default since round 5; pre-round-5
+            # checkpoints read back as erf).
+            gelu_approximate=getattr(args, "gelu_approximate",
+                                     enc.gelu_approximate),
         )
         model = LineVul(enc, graph_config=gcfg)
         vocab, pad_id, style = enc.vocab_size, enc.pad_token_id, "roberta"
@@ -607,6 +621,7 @@ def cmd_fit_text(args) -> Dict[str, Any]:
             "tiny": args.tiny,
             "attention_impl": args.attention_impl,
             "remat": args.remat,
+            "gelu_approximate": getattr(args, "gelu_approximate", True),
             "combined": combined,
             "block_size": tcfg.block_size,
             "dataset": args.dataset,
@@ -684,6 +699,9 @@ def cmd_test_text(args) -> Dict[str, Any]:
         attention_impl=desc.get("attention_impl", "auto"),
         remat=desc.get("remat", False),
         block_size=desc["block_size"],
+        # Pre-round-5 checkpoints trained with the (then hardcoded) exact
+        # erf gelu; absent key => erf, so their eval numerics reproduce.
+        gelu_approximate=desc.get("gelu_approximate", False),
     )
     combined = desc["combined"]
     model, tok, pad_id, style = _text_model_and_tokenizer(ns, combined,
@@ -727,25 +745,24 @@ def cmd_test_text(args) -> Dict[str, Any]:
                            tcfg.eval_batch_size, graphs_by_id, subkeys,
                            budget, pad_id=pad_id)
     )
-    # --n-devices: dp-shard the eval batches, like fit-text (the reference
-    # evaluates under DataParallel, linevul_main.py:259-260). Outputs
-    # replicate, so the report is identical to the single-device one.
-    mesh, host, n_shards = None, None, 1
-    if getattr(args, "n_devices", 1) > 1:
-        from deepdfa_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh(n_data=args.n_devices)
-        n_shards = args.n_devices
-        host = ((jax.process_index(), jax.process_count())
-                if jax.process_count() > 1 else None)
+    # --n-devices: dp-shard the eval batches, like fit-text. Outputs
+    # replicate, so the report matches the single-device one.
+    mesh, host, n_shards = _eval_mesh(args)
+    if mesh is not None:
         model = model.clone(mesh=mesh)
-    if (args.profile or args.time) and host is not None:
-        # Fail before the pod-scale eval runs, not after.
-        raise ValueError(
-            "--profile/--time instrument a single process; run them "
-            "without multi-controller (they work with --n-devices on "
-            "one host)"
-        )
+        if combined:
+            # The shard packer divides the batch budget by n_shards, so a
+            # graph that fits the single-device budget could overflow its
+            # shard and be silently masked — widen the budget to cover
+            # per-shard packing of the worst rows.
+            from deepdfa_tpu.data.combined import graph_join_and_budget
+
+            rows_per_shard = max(args.eval_batch_size // n_shards, 1)
+            _, shard_b = graph_join_and_budget(
+                list(graphs_by_id.values()), rows_per_shard
+            )
+            budget = {k: max(budget[k], shard_b[k] * n_shards)
+                      for k in budget}
     state, _ = make_text_train_state(model, example, tcfg, max_steps=1)
     restored = CheckpointManager(args.checkpoint_dir).restore(
         args.which, {"params": state.params}

@@ -261,11 +261,17 @@ class FlowGNN(nn.Module):
         )
         # Weight sharing across steps (one GatedGraphConv applied n_steps
         # times) — scan over a length-n_steps axis with broadcast params.
+        # Fully unrolled (capped at 8 iterations per loop step): at the
+        # published 5-step depth XLA fuses across step boundaries that the
+        # rolled scan's carry structure forbids — whole-step A/B on v5e:
+        # 405-410k vs 392-394k graphs/s (+3-4%), consistent across
+        # interleaved repeats (round-5 notes, bench.py).
         scan = nn.scan(
             lambda mod, carry, _: (mod(carry, batch), None),
             variable_broadcast="params",
             split_rngs={"params": False},
             length=cfg.n_steps,
+            unroll=min(cfg.n_steps, 8),
         )
         ggnn_out, _ = scan(step, h, None)
 

@@ -49,8 +49,12 @@ def encoder_config_from_hf(hf_cfg, **overrides) -> EncoderConfig:
     """Derive :class:`EncoderConfig` from a transformers RobertaConfig.
 
     ``overrides`` pass through runtime choices the checkpoint doesn't fix
-    (``attention_impl`` etc.).
+    (``attention_impl`` etc.). ``gelu_approximate`` defaults to False here
+    — an HF checkpoint was trained with the exact erf gelu, and a
+    converted model must reproduce its numerics (override to True to trade
+    <1e-3 activation deviation for the measured +18% TPU training step).
     """
+    overrides.setdefault("gelu_approximate", False)
     return EncoderConfig(
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,

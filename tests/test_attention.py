@@ -241,6 +241,7 @@ def test_output_attentions_requires_dense():
         )
 
 
+@pytest.mark.slow
 def test_encoder_flash_remat_grads_match():
     """Fast-lane coverage of the novel interaction: nn.remat recomputation
     wrapping the Pallas custom_vjp flash path (checkpointed custom-vjp

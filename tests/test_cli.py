@@ -385,7 +385,11 @@ def test_cli_tune_records_assessor_fields(tmp_path):
 
 def test_cli_test_n_devices_matches_single(tmp_path):
     """cli test --n-devices shards eval batches over the virtual mesh and
-    reproduces the single-device report (DataParallel eval parity)."""
+    reproduces the single-device report (DataParallel eval parity).
+
+    Deliberately in the FAST lane (~18 s: 1-epoch tiny GNN fit + two
+    evals) so the default suite keeps one --n-devices eval test; the
+    heavier text-side sibling is slow-marked."""
     import jax
 
     if jax.device_count() < 8:
@@ -412,7 +416,9 @@ def test_cli_test_n_devices_matches_single(tmp_path):
 
     single = run_test([])
     sharded = run_test(["--n-devices", "8"])
-    # loss may differ in the last ulps from cross-shard reduction order;
-    # every derived metric is identical (per-example outputs replicate).
-    assert sharded.pop("loss") == pytest.approx(single.pop("loss"), rel=1e-6)
-    assert sharded == single
+    # Scalars may differ in the last ulps (cross-shard reduction order,
+    # different padded program shapes) — approx, not bit-equality, so a
+    # prob within float noise of the 0.5 threshold cannot flake the test.
+    assert set(sharded) == set(single)
+    for k in single:
+        assert sharded[k] == pytest.approx(single[k], rel=1e-5, abs=1e-6), k

