@@ -113,7 +113,10 @@ Round-5 findings (all back-to-back whole-step A/Bs on v5e):
   not a missing rewrite.
 - Decode (first measured round): see bench_gen_decode's docstring —
   split cache layout, beam-deduped cross K/V, cross K/V out of the scan
-  carry; greedy 14.2k tok/s, beam-10 1.0k tok/s.
+  carry; greedy 14.2k tok/s, beam-10 1.0k tok/s. Unrolling the decode
+  scan LOSES (unroll=4: 13.6k vs 14.3k greedy, 2x compile) — unlike the
+  GNN's 5 steps, 128 decode iterations gain nothing from cross-step
+  fusion and the program bloat hurts.
 """
 
 from __future__ import annotations

@@ -621,7 +621,13 @@ def cmd_fit_text(args) -> Dict[str, Any]:
             "tiny": args.tiny,
             "attention_impl": args.attention_impl,
             "remat": args.remat,
-            "gelu_approximate": getattr(args, "gelu_approximate", True),
+            # Record the activation the model ACTUALLY used (linevul's
+            # encoder; the codet5 stack is relu and ignores this on
+            # reconstruction) — never a second copy of the default.
+            "gelu_approximate": getattr(
+                getattr(model, "encoder_config", None),
+                "gelu_approximate", True,
+            ),
             "combined": combined,
             "block_size": tcfg.block_size,
             "dataset": args.dataset,

@@ -541,6 +541,7 @@ def fit_gen_multitask(
     log: Optional[Callable[[str], None]] = None,
     decode_fn: Optional[Callable] = None,
     patience: Optional[Dict[str, int]] = None,
+    mesh=None,
 ) -> Dict[str, Any]:
     """Multi-task fine-tuning (run_multi_gen.py parity): each step samples a
     task by smoothed size-proportional probability and trains on a random
@@ -566,7 +567,19 @@ def fit_gen_multitask(
     ``tasks[name]`` = best-eval record (step/eval_loss/exact_match/bleu/
     bleu_em + ``early_stopped``/``best_loss``), per-task ``history``, and
     ``best_params[name]`` = host param tree of each task's selected state.
+
+    ``mesh``: optional dp mesh — batches shard over the data axis, params
+    replicate (fit_gen's contract). Multi-controller: every host samples
+    the identical task/batch sequence (same seeded RandomState) and feeds
+    its local row slice — the _batches/host contract — replacing the
+    reference's DDP over run_multi_gen (its local_rank plumbing).
     """
+    host = _host_of()
+    if host is not None and mesh is None:
+        raise ValueError(
+            "multi-process fit_gen_multitask needs an explicit global mesh"
+        )
+    _check_host_batch_sizes(cfg, host)
     names = sorted(task_data)
     eval_names = sorted(eval_data)
     probs = task_sampling_probs({k: len(task_data[k]["source_ids"]) for k in names},
@@ -584,8 +597,9 @@ def fit_gen_multitask(
         first["target_ids"][: cfg.batch_size], cfg, max_steps,
         init_params=init_params,
     )
-    step = jax.jit(make_gen_train_step(model, tx, cfg), donate_argnums=(0,))
-    eval_fns = _make_eval_fns(model, max_target_length, beam_size)
+    step = _jit_gen_step(make_gen_train_step(model, tx, cfg), mesh, cfg,
+                         donate=True)
+    eval_fns = _make_eval_fns(model, max_target_length, beam_size, mesh)
     pad_id, eos_id = model.cfg.pad_token_id, model.cfg.eos_token_id
     gold = {k: _ids_to_text(eval_data[k]["target_ids"], pad_id, eos_id,
                             decode_fn) for k in eval_names}
@@ -608,8 +622,8 @@ def fit_gen_multitask(
             if stopped[name]:
                 continue
             ev = evaluate_gen(model, state, eval_data[name], cfg,
-                              max_target_length, beam_size,
-                              return_preds=True, fns=eval_fns)
+                              max_target_length, beam_size, mesh=mesh,
+                              host=host, return_preds=True, fns=eval_fns)
             base = name.split("_")[0]
             preds = _ids_to_text(ev["pred_ids"], pad_id, eos_id, decode_fn)
             bleu = bleu_for_task(base, gold[name][: len(preds)], preds)
@@ -663,7 +677,8 @@ def fit_gen_multitask(
                                                model.cfg.pad_token_id, src.dtype)])
             tgt = np.concatenate([tgt, np.full((pad, tgt.shape[1]),
                                                model.cfg.pad_token_id, tgt.dtype)])
-        state, loss = step(state, jnp.asarray(src), jnp.asarray(tgt))
+        state, loss = step(state, _lift_rows(src, mesh, host),
+                           _lift_rows(tgt, mesh, host))
         g += 1
         if log and g % max(max_steps // 10, 1) == 0:
             log(f"step {g}/{max_steps} [{task}] loss={float(loss):.4f}")
