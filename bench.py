@@ -116,7 +116,10 @@ Round-5 findings (all back-to-back whole-step A/Bs on v5e):
   carry; greedy 14.2k tok/s, beam-10 1.0k tok/s. Unrolling the decode
   scan LOSES (unroll=4: 13.6k vs 14.3k greedy, 2x compile) — unlike the
   GNN's 5 steps, 128 decode iterations gain nothing from cross-step
-  fusion and the program bloat hurts.
+  fusion and the program bloat hurts. Reordering the beam cache with a
+  one-hot bmm instead of take_along_axis also LOSES (728 vs 1151 tok/s,
+  sequences identical): unlike the GNN's scatter-adds, a LEADING-axis
+  gather vectorizes fine on TPU and the bmm just doubles the traffic.
 """
 
 from __future__ import annotations
