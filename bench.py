@@ -17,6 +17,8 @@ reference's paper-Table-5 efficiency axes (BASELINE.md):
                                    ~39 examples/s on RTX 3090 (10h40m for 10
                                    epochs over ~150k examples, Table 5)
   combined_infer_ms_per_example    vs 15.4 ms/example on RTX 3090 (Table 5)
+  deepdfa_infer_ms_per_example     DeepDFA-standalone forward at the parity
+                                   batch (256) vs 4.6 ms/example (Table 5)
   gen_decode_tokens_per_sec[_beam10]  codet5-base summarize-shape decode,
                                    greedy + beam-10 (no reference baseline)
 
@@ -255,6 +257,51 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     }
 
 
+
+
+def bench_deepdfa_infer(batch_size: int = 256, dtype: str = "bfloat16") -> float:
+    """DeepDFA-standalone inference latency (ms/example) at the published
+    architecture — the comparison point for the paper's 4.6 ms/example
+    (Table 5's DeepDFA row; the gap VERDICT.md round 5 called out).
+
+    Forward-only FlowGNN over the 256-graph parity batch; ms/example =
+    batch latency / batch size. The data-dependent chaining + final
+    device_get mirror bench_combined_infer — the only completion barrier
+    the tunneled backend honors (module docstring).
+    """
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from __graft_entry__ import _example_batch
+
+    impl = "band" if jax.default_backend() == "tpu" else "segment"
+    model_cfg = FlowGNNConfig(message_impl=impl, dtype=dtype)
+    batch = _example_batch(DataConfig(batch_size=batch_size), model_cfg)
+    model = FlowGNN(model_cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)
+
+    @jax.jit
+    def infer(params, batch, prev):
+        # Chain this call's input on the previous call's output (adds 0 to
+        # a feature table the forward actually reads) so the timed sequence
+        # cannot overlap or reorder on the device.
+        feats = dict(batch.node_feats)
+        k0 = sorted(feats)[0]
+        feats[k0] = feats[k0].at[0].add((prev * 0).astype(feats[k0].dtype))
+        logits = model.apply(params, batch.replace(node_feats=feats))
+        return logits, logits.reshape(-1)[0]
+
+    prev = jnp.zeros((), jnp.float32)
+
+    def call():
+        nonlocal prev
+        out, prev = infer(params, batch, prev)
+        return out
+
+    n_steps = 30
+    dt = _timed(call, warmup=3, calls=n_steps)
+    return dt / (n_steps * batch_size) * 1000.0  # ms/example
 
 
 def _combined_setup(batch_size: int = 16, seq_len: int = 512,
@@ -499,6 +546,7 @@ def bench_combined_infer(batch_size: int = 16) -> float:
 BASELINE_GNN_GRAPHS_PER_SEC = 7000.0
 BASELINE_COMBINED_EXAMPLES_PER_SEC = 39.0
 BASELINE_COMBINED_INFER_MS = 15.4
+BASELINE_DEEPDFA_INFER_MS = 4.6
 
 
 def main() -> None:
@@ -531,6 +579,9 @@ def main() -> None:
         bench_deepdfa("bfloat16", impl="tile")
         if jax.default_backend() == "tpu" else None
     )
+    # DeepDFA-standalone inference: the paper's 4.6 ms/example finally gets
+    # a comparison point (the round-5 VERDICT gap).
+    deepdfa_infer_ms = bench_deepdfa_infer()
     combined_eps, comb_diag = bench_combined_train(attention_impl="flash",
                                                    diagnostics=True)
     # The A/B at the parity shape, re-checked every run (flash wins since
@@ -582,6 +633,16 @@ def main() -> None:
                             "message_impl": "tile",
                         }] if graphs_per_sec_tile is not None else []
                     ),
+                    {
+                        "metric": "deepdfa_infer_ms_per_example",
+                        "value": round(deepdfa_infer_ms, 4),
+                        "unit": "ms",
+                        # ratio >1 = faster than the 3090 here (time metric)
+                        "vs_baseline": round(
+                            BASELINE_DEEPDFA_INFER_MS / deepdfa_infer_ms, 3
+                        ),
+                        "batch_size": 256,
+                    },
                     {
                         "metric": "combined_train_examples_per_sec",
                         "value": round(combined_eps, 2),

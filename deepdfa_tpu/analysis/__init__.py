@@ -1,0 +1,19 @@
+"""graftlint: dataflow-analysis-based static checking for JAX/TPU hazards.
+
+The paper this repo reproduces trains models to *emulate* dataflow analysis;
+this package runs the real thing over our own sources. A reaching-definitions
+/ taint solver (``dataflow.py``) over intra-procedural CFGs (``cfg.py``)
+drives hazard rules (``rules.py``) for the failure modes that cost TPU runs:
+silent host-device syncs in jitted or step-loop code, tracer leaks into
+Python control flow, recompilation triggers, impurity under ``jit``, and
+``jax.random`` key reuse. ``runner.py`` walks the package, diffs against a
+committed baseline, and reports only new findings with the def-use chain
+that triggered each one.
+
+Entry points: ``python -m deepdfa_tpu.cli analyze-code`` / ``scripts/lint.sh``.
+Everything here is stdlib-only (``ast``) — no jax import, so the linter runs
+anywhere in milliseconds.
+"""
+
+from deepdfa_tpu.analysis.rules import Finding, analyze_source  # noqa: F401
+from deepdfa_tpu.analysis.runner import run_analysis  # noqa: F401

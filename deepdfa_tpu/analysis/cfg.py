@@ -1,0 +1,294 @@
+"""Intra-procedural control-flow graphs over Python ``ast`` statements.
+
+One CFG node per simple statement; ``if``/``while``/``for``/``with`` get a
+head node owning just their test/iter/items expression, with the nested
+bodies flattened into their own nodes. This is the granularity the forward
+solvers in ``dataflow.py`` run at — fine enough for def-use chains with
+real line numbers, coarse enough that a whole package solves in well under
+a second.
+
+Every node records the stack of enclosing loop-head node ids
+(``loop_stack``), which the rules use to scope "inside the step loop"
+facts, and the chain of enclosing ``if`` tests (``guard_tests``), which the
+host-sync rule uses to recognize rate-limited (``n % k == 0``-guarded)
+syncs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: Statement classes that get a dedicated head node whose "owned"
+#: expressions exclude the nested bodies (those become their own nodes).
+_HEAD_KINDS = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+               ast.AsyncWith, ast.Try)
+
+
+@dataclasses.dataclass
+class Node:
+    idx: int
+    stmt: Optional[ast.AST]  # None for the synthetic entry/exit
+    kind: str  # "entry" | "exit" | "stmt" | "if" | "while" | "for" | "with" | "try"
+    line: int
+    loop_stack: Tuple[int, ...]  # enclosing loop-head node ids, outermost first
+    guard_tests: Tuple[ast.expr, ...]  # enclosing if-tests, outermost first
+    succs: Set[int] = dataclasses.field(default_factory=set)
+    preds: Set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def loop_depth(self) -> int:
+        return len(self.loop_stack)
+
+
+class CFG:
+    def __init__(self, func: Optional[ast.AST], nodes: List[Node],
+                 entry: int, exit_: int):
+        self.func = func
+        self.nodes = nodes
+        self.entry = entry
+        self.exit = exit_
+
+    def rpo(self) -> List[int]:
+        """Reverse post-order from entry — the forward-solver visit order."""
+        seen: Set[int] = set()
+        order: List[int] = []
+        stack: List[Tuple[int, Iterable[int]]] = [(self.entry, iter(sorted(self.nodes[self.entry].succs)))]
+        seen.add(self.entry)
+        while stack:
+            nid, it = stack[-1]
+            advanced = False
+            for s in it:
+                if s not in seen:
+                    seen.add(s)
+                    stack.append((s, iter(sorted(self.nodes[s].succs))))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(nid)
+                stack.pop()
+        return list(reversed(order))
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self._loops: List[Tuple[int, List[int]]] = []  # (head idx, break nodes)
+        self._guards: List[ast.expr] = []
+        self._exits: List[int] = []  # return/raise nodes -> exit
+
+    def _new(self, stmt: Optional[ast.AST], kind: str) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(Node(
+            idx=idx, stmt=stmt, kind=kind,
+            line=getattr(stmt, "lineno", 0),
+            loop_stack=tuple(h for h, _ in self._loops),
+            guard_tests=tuple(self._guards),
+        ))
+        return idx
+
+    def _link(self, preds: Iterable[int], nid: int) -> None:
+        for p in preds:
+            self.nodes[p].succs.add(nid)
+            self.nodes[nid].preds.add(p)
+
+    def _seq(self, stmts: List[ast.stmt], preds: Set[int]) -> Set[int]:
+        for stmt in stmts:
+            if not preds:
+                # Unreachable code after return/break still gets nodes (its
+                # defs must exist for the solver maps) but no inbound edges.
+                pass
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _stmt(self, stmt: ast.stmt, preds: Set[int]) -> Set[int]:
+        if isinstance(stmt, ast.If):
+            head = self._new(stmt, "if")
+            self._link(preds, head)
+            self._guards.append(stmt.test)
+            then_out = self._seq(stmt.body, {head})
+            else_out = self._seq(stmt.orelse, {head}) if stmt.orelse else {head}
+            self._guards.pop()
+            return then_out | else_out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            kind = "while" if isinstance(stmt, ast.While) else "for"
+            head = self._new(stmt, kind)
+            self._link(preds, head)
+            self._loops.append((head, []))
+            body_out = self._seq(stmt.body, {head})
+            self._link(body_out, head)  # back edge
+            _, breaks = self._loops.pop()
+            out = {head} | set(breaks)
+            if stmt.orelse:
+                out = self._seq(stmt.orelse, {head}) | set(breaks)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._new(stmt, "with")
+            self._link(preds, head)
+            return self._seq(stmt.body, {head})
+        if isinstance(stmt, ast.Try):
+            head = self._new(stmt, "try")
+            self._link(preds, head)
+            first_body = len(self.nodes)
+            body_out = self._seq(stmt.body, {head})
+            body_nodes = set(range(first_body, len(self.nodes))) | {head}
+            out = set(body_out)
+            if stmt.orelse:
+                out = self._seq(stmt.orelse, out)
+            for handler in stmt.handlers:
+                # Any statement in the body may raise into the handler.
+                h_out = self._seq(handler.body, set(body_nodes))
+                out |= h_out
+            if stmt.finalbody:
+                out = self._seq(stmt.finalbody, out)
+            return out
+        if isinstance(stmt, ast.Break):
+            nid = self._new(stmt, "stmt")
+            self._link(preds, nid)
+            if self._loops:
+                self._loops[-1][1].append(nid)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            nid = self._new(stmt, "stmt")
+            self._link(preds, nid)
+            if self._loops:
+                self.nodes[nid].succs.add(self._loops[-1][0])
+                self.nodes[self._loops[-1][0]].preds.add(nid)
+            return set()
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            nid = self._new(stmt, "stmt")
+            self._link(preds, nid)
+            self._exits.append(nid)
+            return set()
+        # Everything else — including nested FunctionDef/ClassDef, whose
+        # bodies are analyzed as their own CFGs — is one linear node.
+        nid = self._new(stmt, "stmt")
+        self._link(preds, nid)
+        return {nid}
+
+
+def build_cfg(func: ast.AST, body: Optional[List[ast.stmt]] = None) -> CFG:
+    """CFG for a function (or any statement list via ``body``)."""
+    b = _Builder()
+    entry = b._new(None, "entry")
+    stmts = body if body is not None else list(getattr(func, "body", []))
+    out = b._seq(stmts, {entry})
+    exit_ = b._new(None, "exit")
+    b._link(out | set(b._exits), exit_)
+    return CFG(func, b.nodes, entry, exit_)
+
+
+# ---------------------------------------------------------------------------
+# Per-node expression / definition accessors
+# ---------------------------------------------------------------------------
+
+
+def node_exprs(node: Node) -> List[ast.expr]:
+    """The expressions a node *owns* (excluding nested statement bodies)."""
+    s = node.stmt
+    if s is None:
+        return []
+    if isinstance(s, ast.If) or isinstance(s, ast.While):
+        return [s.test]
+    if isinstance(s, (ast.For, ast.AsyncFor)):
+        return [s.iter]
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in s.items]
+    if isinstance(s, ast.Try):
+        return []
+    if isinstance(s, _HEAD_KINDS):  # pragma: no cover — exhaustive above
+        return []
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # Decorators/defaults evaluate at def time; the body is its own CFG.
+        return list(s.decorator_list) + [
+            d for d in (s.args.defaults + s.args.kw_defaults) if d is not None
+        ]
+    if isinstance(s, ast.ClassDef):
+        return list(s.decorator_list) + list(s.bases)
+    out: List[ast.expr] = []
+    for child in ast.iter_child_nodes(s):
+        if isinstance(child, ast.expr):
+            out.append(child)
+    return out
+
+
+def _target_names(target: ast.expr, out: List[str]) -> None:
+    if isinstance(target, ast.Name):
+        out.append(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _target_names(elt, out)
+    elif isinstance(target, ast.Starred):
+        _target_names(target.value, out)
+    # Attribute/Subscript targets mutate an object — handled as soft defs.
+
+
+def _base_name(expr: ast.expr) -> Optional[str]:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def assigned_names(node: Node) -> Tuple[List[str], List[str]]:
+    """(hard defs, soft defs) a node introduces.
+
+    Hard defs rebind a plain name (kill + gen for the solvers); soft defs
+    mutate through an attribute/subscript target or augment in place (gen
+    without kill).
+    """
+    s = node.stmt
+    hard: List[str] = []
+    soft: List[str] = []
+    if s is None:
+        return hard, soft
+    if isinstance(s, ast.Assign):
+        for t in s.targets:
+            _target_names(t, hard)
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                base = _base_name(t)
+                if base:
+                    soft.append(base)
+    elif isinstance(s, ast.AnnAssign) and s.value is not None:
+        _target_names(s.target, hard)
+        if isinstance(s.target, (ast.Attribute, ast.Subscript)):
+            base = _base_name(s.target)
+            if base:
+                soft.append(base)
+    elif isinstance(s, ast.AugAssign):
+        if isinstance(s.target, ast.Name):
+            soft.append(s.target.id)
+        else:
+            base = _base_name(s.target)
+            if base:
+                soft.append(base)
+    elif isinstance(s, (ast.For, ast.AsyncFor)):
+        _target_names(s.target, hard)
+    elif isinstance(s, (ast.With, ast.AsyncWith)):
+        for item in s.items:
+            if item.optional_vars is not None:
+                _target_names(item.optional_vars, hard)
+    elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        hard.append(s.name)
+    elif isinstance(s, ast.Import):
+        for a in s.names:
+            hard.append((a.asname or a.name).split(".")[0])
+    elif isinstance(s, ast.ImportFrom):
+        for a in s.names:
+            hard.append(a.asname or a.name)
+    # Walrus targets anywhere in the owned expressions are hard defs too.
+    for expr in node_exprs(node):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.NamedExpr) and isinstance(sub.target, ast.Name):
+                hard.append(sub.target.id)
+    return hard, soft
+
+
+def deleted_names(node: Node) -> List[str]:
+    s = node.stmt
+    if isinstance(s, ast.Delete):
+        out: List[str] = []
+        for t in s.targets:
+            _target_names(t, out)
+        return out
+    return []

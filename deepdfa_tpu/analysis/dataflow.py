@@ -1,0 +1,327 @@
+"""Forward dataflow solvers over the ``cfg`` graphs.
+
+Two analyses drive the rules:
+
+- **Reaching definitions** — the textbook kill/gen pass (the same analysis
+  the reproduced paper's models learn to emulate; here it runs for real over
+  our own sources). Facts map ``name -> frozenset(def node ids)``.
+- **Taint** — which names (transitively) hold values derived from a set of
+  seeds: jit-scope parameters, or the results of jitted-step calls inside a
+  loop. Facts map ``name -> frozenset(Taint)`` where each ``Taint`` carries
+  the def-use chain that propagated it (for the report) and the loop that
+  seeded it (so a sink can be scoped to "the same loop as the step call").
+
+Both run a worklist to a fixpoint in reverse post-order; joins are key-wise
+unions, so termination is by finite fact height (defs and traces are drawn
+from the finite node set — traces are capped and compared structurally).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from deepdfa_tpu.analysis.cfg import (
+    CFG,
+    Node,
+    assigned_names,
+    deleted_names,
+    node_exprs,
+)
+
+# ---------------------------------------------------------------------------
+# Generic forward worklist
+# ---------------------------------------------------------------------------
+
+Fact = Dict[str, FrozenSet]
+
+
+def _join(a: Fact, b: Fact) -> Fact:
+    if not a:
+        return dict(b)
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        out[k] = v if cur is None else (cur | v)
+    return out
+
+
+def solve_forward(
+    cfg: CFG,
+    transfer: Callable[[Node, Fact], Fact],
+    entry_fact: Optional[Fact] = None,
+) -> Dict[int, Fact]:
+    """Fixpoint in-facts per node id."""
+    in_facts: Dict[int, Fact] = {cfg.entry: dict(entry_fact or {})}
+    out_facts: Dict[int, Fact] = {}
+    order = cfg.rpo()
+    pos = {nid: i for i, nid in enumerate(order)}
+    work = list(order)
+    in_work = set(work)
+    while work:
+        work.sort(key=lambda n: pos.get(n, 0), reverse=True)
+        nid = work.pop()
+        in_work.discard(nid)
+        node = cfg.nodes[nid]
+        fact: Fact = {}
+        if nid == cfg.entry:
+            fact = dict(entry_fact or {})
+        for p in node.preds:
+            if p in out_facts:
+                fact = _join(fact, out_facts[p])
+        in_facts[nid] = fact
+        new_out = transfer(node, fact)
+        if out_facts.get(nid) != new_out:
+            out_facts[nid] = new_out
+            for s in node.succs:
+                if s not in in_work:
+                    in_work.add(s)
+                    work.append(s)
+    return in_facts
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions
+# ---------------------------------------------------------------------------
+
+
+def reaching_definitions(cfg: CFG) -> Dict[int, Dict[str, FrozenSet[int]]]:
+    """For each node: the def sites (node ids) of every name reaching it."""
+
+    def transfer(node: Node, fact: Fact) -> Fact:
+        hard, soft = assigned_names(node)
+        if not hard and not soft and not isinstance(node.stmt, ast.Delete):
+            return fact
+        out = dict(fact)
+        for name in hard:
+            out[name] = frozenset((node.idx,))
+        for name in soft:
+            out[name] = out.get(name, frozenset()) | {node.idx}
+        for name in deleted_names(node):
+            out.pop(name, None)
+        return out
+
+    return solve_forward(cfg, transfer)
+
+
+# ---------------------------------------------------------------------------
+# Taint
+# ---------------------------------------------------------------------------
+
+_TRACE_CAP = 8
+
+#: Attribute reads that yield static (host) metadata, not traced values.
+_STATIC_ATTRS = frozenset(
+    {"shape", "dtype", "ndim", "size", "aval", "sharding", "device"}
+)
+
+#: Builtins whose result is a host value regardless of argument taint.
+#: float/int/bool are the *sinks* the rules flag — their result is a host
+#: scalar, so taint must not cascade past them (one finding per sync).
+_UNTAINTED_RESULT_CALLS = frozenset(
+    {"float", "int", "bool", "str", "len", "repr", "format", "isinstance",
+     "hasattr", "getattr", "type", "id", "print"}
+)
+
+#: Mutating method calls that propagate argument taint onto the receiver.
+_MUTATORS = frozenset({"append", "extend", "add", "update", "insert",
+                       "setdefault", "__setitem__"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    seed_loop: Optional[int]  # loop-head node id the seed fired in (None = whole function)
+    trace: Tuple[Tuple[int, str], ...]  # (line, "what happened") def-use chain
+
+    def extended(self, line: int, what: str) -> "Taint":
+        if len(self.trace) >= _TRACE_CAP:
+            return self
+        return Taint(self.seed_loop, self.trace + ((line, what),))
+
+
+def _expr_text(expr: ast.AST, limit: int = 60) -> str:
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # pragma: no cover — unparse covers all exprs we build
+        text = type(expr).__name__
+    return text if len(text) <= limit else text[: limit - 1] + "…"
+
+
+class TaintAnalysis:
+    """Configurable taint propagation.
+
+    ``seed_call(node, call)``: return a reason string when ``call`` is a
+    taint *source* at ``node`` (e.g. a jitted-step invocation); the Taint is
+    seeded with the node's innermost loop. ``cleaners``: dotted call names
+    whose result is host-side (explicit syncs like ``jax.device_get``).
+    ``resolve``: maps an expression to its dotted name (import-alias aware,
+    provided by rules.py).
+    """
+
+    def __init__(
+        self,
+        resolve: Callable[[ast.expr], Optional[str]],
+        seed_call: Optional[Callable[[Node, ast.Call], Optional[str]]] = None,
+        cleaners: FrozenSet[str] = frozenset(),
+        seed_params: Optional[Dict[str, str]] = None,
+    ):
+        self.resolve = resolve
+        self.seed_call = seed_call
+        self.cleaners = cleaners
+        self.seed_params = seed_params or {}
+
+    # -- expression evaluation ------------------------------------------------
+
+    def taint_of(self, expr: ast.expr, fact: Fact,
+                 node: Optional[Node] = None) -> FrozenSet[Taint]:
+        if isinstance(expr, ast.Name):
+            return fact.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return frozenset()
+            return self.taint_of(expr.value, fact, node)
+        if isinstance(expr, ast.Call):
+            return self._taint_of_call(expr, fact, node)
+        if isinstance(expr, ast.BoolOp):
+            return self._union(expr.values, fact, node)
+        if isinstance(expr, ast.BinOp):
+            return self.taint_of(expr.left, fact, node) | self.taint_of(
+                expr.right, fact, node)
+        if isinstance(expr, ast.UnaryOp):
+            return self.taint_of(expr.operand, fact, node)
+        if isinstance(expr, ast.Compare):
+            return self._union([expr.left] + list(expr.comparators), fact, node)
+        if isinstance(expr, ast.IfExp):
+            return self._union([expr.test, expr.body, expr.orelse], fact, node)
+        if isinstance(expr, ast.Subscript):
+            return self.taint_of(expr.value, fact, node) | self.taint_of(
+                expr.slice, fact, node)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return self._union(expr.elts, fact, node)
+        if isinstance(expr, ast.Dict):
+            return self._union(
+                [e for e in list(expr.keys) + list(expr.values) if e is not None],
+                fact, node)
+        if isinstance(expr, ast.Starred):
+            return self.taint_of(expr.value, fact, node)
+        if isinstance(expr, ast.NamedExpr):
+            return self.taint_of(expr.value, fact, node)
+        if isinstance(expr, ast.Slice):
+            return self._union(
+                [e for e in (expr.lower, expr.upper, expr.step) if e is not None],
+                fact, node)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            srcs = self._union([g.iter for g in expr.generators], fact, node)
+            return srcs | self.taint_of(expr.elt, fact, node)
+        if isinstance(expr, ast.DictComp):
+            srcs = self._union([g.iter for g in expr.generators], fact, node)
+            return srcs | self.taint_of(expr.key, fact, node) | self.taint_of(
+                expr.value, fact, node)
+        # Constants, f-strings (host str result), lambdas, etc.
+        return frozenset()
+
+    def _union(self, exprs: List[ast.expr], fact: Fact,
+               node: Optional[Node]) -> FrozenSet[Taint]:
+        out: FrozenSet[Taint] = frozenset()
+        for e in exprs:
+            out |= self.taint_of(e, fact, node)
+        return out
+
+    def _taint_of_call(self, call: ast.Call, fact: Fact,
+                       node: Optional[Node]) -> FrozenSet[Taint]:
+        dotted = self.resolve(call.func)
+        if dotted in self.cleaners:
+            return frozenset()
+        if dotted in _UNTAINTED_RESULT_CALLS:
+            return frozenset()
+        if self.seed_call is not None and node is not None:
+            reason = self.seed_call(node, call)
+            if reason is not None:
+                seed_loop = node.loop_stack[-1] if node.loop_stack else None
+                return frozenset(
+                    (Taint(seed_loop, ((node.line, reason),)),)
+                )
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        out = self._union(args, fact, node)
+        # A method call on a tainted object returns tainted (e.g.
+        # ``x.astype(...)``); a plain function keeps only argument taint.
+        if isinstance(call.func, ast.Attribute):
+            out |= self.taint_of(call.func.value, fact, node)
+        return out
+
+    # -- transfer -------------------------------------------------------------
+
+    def entry_fact(self, cfg: CFG) -> Fact:
+        fact: Fact = {}
+        line = getattr(cfg.func, "lineno", 0)
+        for name, reason in self.seed_params.items():
+            fact[name] = frozenset((Taint(None, ((line, reason),)),))
+        return fact
+
+    def transfer(self, node: Node, fact: Fact) -> Fact:
+        s = node.stmt
+        if s is None:
+            return fact
+        hard, soft = assigned_names(node)
+        if not hard and not soft and not isinstance(s, (ast.Delete, ast.Expr)):
+            return fact
+        out = dict(fact)
+        rhs: Optional[ast.expr] = None
+        if isinstance(s, ast.Assign):
+            rhs = s.value
+        elif isinstance(s, ast.AnnAssign):
+            rhs = s.value
+        elif isinstance(s, ast.AugAssign):
+            rhs = s.value
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            rhs = s.iter
+        taint: FrozenSet[Taint] = frozenset()
+        if rhs is not None:
+            taint = self.taint_of(rhs, fact, node)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            taint = self._union(
+                [item.context_expr for item in s.items], fact, node)
+        if taint:
+            what = _expr_text(rhs if rhs is not None else s)
+            lhs = ", ".join(hard) if hard else (soft[0] if soft else "?")
+            taint = frozenset(
+                t.extended(node.line, f"{lhs} ← {what}") for t in taint
+            )
+        for name in hard:
+            if taint:
+                out[name] = taint
+            else:
+                out.pop(name, None)  # rebound clean: kill
+        for name in soft:
+            if taint:
+                out[name] = out.get(name, frozenset()) | taint
+        # Mutator method calls taint their receiver: ``acc.append(loss)``.
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _MUTATORS
+                    and isinstance(call.func.value, ast.Name)):
+                arg_taint = self._union(
+                    list(call.args) + [kw.value for kw in call.keywords],
+                    fact, node)
+                if arg_taint:
+                    recv = call.func.value.id
+                    arg_taint = frozenset(
+                        t.extended(node.line,
+                                   f"{recv}.{call.func.attr}({_expr_text(call.args[0]) if call.args else ''})")
+                        for t in arg_taint)
+                    out[recv] = out.get(recv, frozenset()) | arg_taint
+        for name in deleted_names(node):
+            out.pop(name, None)
+        # Walrus defs inside owned expressions.
+        for expr in node_exprs(node):
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.NamedExpr) and isinstance(sub.target, ast.Name):
+                    t = self.taint_of(sub.value, fact, node)
+                    if t:
+                        out[sub.target.id] = t
+        return out
+
+    def solve(self, cfg: CFG) -> Dict[int, Fact]:
+        return solve_forward(cfg, self.transfer, self.entry_fact(cfg))

@@ -1,0 +1,563 @@
+"""Hazard rules over the taint / reaching-definitions facts.
+
+Rule catalogue (each backed by a positive+negative fixture in
+``tests/test_analysis.py``):
+
+  GL001 tracer-host-sync     float()/int()/bool()/.item()/.tolist()/
+                             np.asarray() on a traced value inside jit scope
+                             — under trace these either fail or bake a
+                             constant; on weakly-traced paths they force a
+                             silent device→host sync.
+  GL002 tracer-control-flow  Python ``if``/``while``/``assert`` branching on
+                             a traced value inside jit scope (TracerBoolConversionError
+                             at best, silently-baked branch at worst).
+  GL003 tracer-fstring       f-string interpolation of a traced value inside
+                             jit scope — formats the tracer repr at trace
+                             time, not the runtime value.
+  GL004 host-sync-in-step-loop  float()/int()/.item() on a jitted-step
+                             result inside the loop that dispatches the step
+                             — serializes host and device every iteration
+                             (the pattern that kills 10-hour runs). Syncs
+                             guarded by a ``n % k`` rate limiter and values
+                             passing through explicit transfers
+                             (jax.device_get / block_until_ready /
+                             np.asarray) are accepted.
+  GL005 impure-under-jit     time.*/np.random.*/stdlib random.*/print/open/
+                             global mutation inside jit scope — executed
+                             once at trace time, then constant-folded.
+  GL006 jit-in-loop          jax.jit/pjit/shard_map *creation* inside a loop
+                             body — a fresh wrapper (and usually a fresh
+                             compile) per iteration.
+  GL007 key-reuse            the same ``jax.random`` key definition consumed
+                             by two ``jax.random.*`` calls, or by one call
+                             in a deeper loop than every reaching definition
+                             — identical random streams where independent
+                             ones were intended.
+  GL008 nonstatic-python-scalar  a traced value where Python needs a static
+                             int (``range``, shape arguments) inside jit
+                             scope — needs ``static_argnums`` or a host-side
+                             value.
+
+Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
+pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
+repo convention that every def nested inside a ``make_*step`` factory is the
+body of a jitted step.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from deepdfa_tpu.analysis.cfg import CFG, Node, assigned_names, build_cfg, node_exprs
+from deepdfa_tpu.analysis.dataflow import (
+    Fact,
+    Taint,
+    TaintAnalysis,
+    _expr_text,
+    reaching_definitions,
+)
+
+RULES: Dict[str, str] = {
+    "GL000": "parse-error",
+    "GL001": "tracer-host-sync",
+    "GL002": "tracer-control-flow",
+    "GL003": "tracer-fstring",
+    "GL004": "host-sync-in-step-loop",
+    "GL005": "impure-under-jit",
+    "GL006": "jit-in-loop",
+    "GL007": "key-reuse",
+    "GL008": "nonstatic-python-scalar",
+}
+
+_JIT_NAMES = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+})
+_JIT_WRAPPER_SUFFIXES = ("jit_dp_step",)
+_PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+_MAKE_STEP_RE = re.compile(r"^_?make_.*step$")
+_STEP_CALL_RE = re.compile(r"^(?!make_).*(step|_fn)$|^step$")
+_HOST_CASTS = frozenset({"float", "int", "bool"})
+_SYNC_METHODS = frozenset({"item", "tolist", "numpy"})
+_NP_SYNC = frozenset({"numpy.asarray", "numpy.array"})
+_CLEANERS = frozenset({
+    "jax.device_get", "jax.block_until_ready", "numpy.asarray", "numpy.array",
+    "jax.experimental.multihost_utils.process_allgather",
+})
+_SHAPE_FNS = frozenset({
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full", "jax.numpy.empty",
+    "jax.numpy.arange", "jax.numpy.eye", "numpy.zeros", "numpy.ones",
+    "numpy.full", "numpy.empty", "numpy.arange", "numpy.eye",
+})
+_IMPURE_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "time.time_ns", "datetime.datetime.now", "open", "input", "print",
+})
+_IMPURE_PREFIXES = ("numpy.random.", "random.")
+_KEY_PRODUCERS = frozenset({
+    "PRNGKey", "key", "wrap_key_data", "key_data", "key_impl", "clone",
+})
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    function: str
+    message: str
+    trace: Tuple[str, ...] = ()
+    source_line: str = ""
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule]
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity, stable across unrelated edits: the
+        file, rule, enclosing function, and whitespace-normalized source of
+        the offending line."""
+        norm = "".join(self.source_line.split())
+        key = "|".join((self.path.replace("\\", "/"), self.rule,
+                        self.function, norm))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        head = (f"{self.path}:{self.line}:{self.col} {self.rule} "
+                f"{self.name}: {self.message}")
+        chain = [f"    ↳ {step}" for step in self.trace]
+        return "\n".join([head] + chain)
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    qualname: str
+    parents: Tuple[str, ...]  # enclosing function names, outermost first
+    parent: Optional["_FuncInfo"] = None  # enclosing function, if any
+
+
+class _Module:
+    def __init__(self, path: str, tree: ast.Module, lines: List[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.module_defs = {
+            n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # Local defs wrapped by jax.jit(...) / jit_dp_step(...) anywhere in
+        # the module: their bodies run under trace.
+        self.jit_wrapped: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and node.args:
+                dotted = self.resolve(node.func)
+                if dotted is None:
+                    continue
+                if dotted in _JIT_NAMES or dotted.endswith(_JIT_WRAPPER_SUFFIXES):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in self.module_defs:
+                        self.jit_wrapped.add(arg.id)
+
+    def resolve(self, expr: ast.expr) -> Optional[str]:
+        parts: List[str] = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        dotted = self.aliases.get(expr.id, expr.id)
+        return ".".join([dotted] + list(reversed(parts)))
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _collect_functions(tree: ast.Module) -> List[_FuncInfo]:
+    out: List[_FuncInfo] = []
+
+    def visit(node: ast.AST, qual: Tuple[str, ...], parents: Tuple[str, ...],
+              parent_fi: Optional[_FuncInfo]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = qual + (child.name,)
+                fi = _FuncInfo(child, ".".join(q), parents, parent_fi)
+                out.append(fi)
+                visit(child, q, parents + (child.name,), fi)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, qual + (child.name,), parents, parent_fi)
+            else:
+                visit(child, qual, parents, parent_fi)
+
+    visit(tree, (), (), None)
+    return out
+
+
+def _is_jit_decorated(mod: _Module, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = mod.resolve(target)
+        if dotted in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call) and dotted in _PARTIAL_NAMES:
+            for arg in dec.args:
+                if mod.resolve(arg) in _JIT_NAMES:
+                    return True
+    return False
+
+
+def _is_jit_scope(mod: _Module, fi: _FuncInfo) -> bool:
+    # Jit scope propagates into nested helpers: a local def inside a jitted
+    # function is traced when called, so its hazards are just as real.
+    cur: Optional[_FuncInfo] = fi
+    while cur is not None:
+        if _is_jit_decorated(mod, cur.node) or cur.node.name in mod.jit_wrapped:
+            return True
+        cur = cur.parent
+    return any(_MAKE_STEP_RE.match(p) for p in fi.parents)
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _fmt_trace(taints: FrozenSet[Taint]) -> Tuple[str, ...]:
+    best = min(taints, key=lambda t: (len(t.trace), t.trace))
+    return tuple(f"line {line}: {what}" for line, what in best.trace)
+
+
+def _is_none_check(test: ast.expr) -> bool:
+    """``x is None`` / ``x is not None`` — static optionality checks on a
+    traced argument are trace-time decisions, not data-dependent control
+    flow."""
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _guarded_by_modulo(node: Node) -> bool:
+    for test in node.guard_tests:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mod):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Per-function checks
+# ---------------------------------------------------------------------------
+
+
+class _FunctionChecker:
+    def __init__(self, mod: _Module, fi: _FuncInfo, jit_scope: bool):
+        self.mod = mod
+        self.fi = fi
+        self.jit_scope = jit_scope
+        self.cfg = build_cfg(fi.node)
+        self.findings: List[Finding] = []
+
+    def _report(self, rule: str, at: ast.AST, message: str,
+                taints: FrozenSet[Taint] = frozenset()) -> None:
+        line = getattr(at, "lineno", 0)
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line,
+            col=getattr(at, "col_offset", 0), function=self.fi.qualname,
+            message=message,
+            trace=_fmt_trace(taints) if taints else (),
+            source_line=self.mod.source_line(line),
+        ))
+
+    def run(self) -> List[Finding]:
+        if self.jit_scope:
+            self._check_jit_scope()
+        else:
+            self._check_step_loops()
+        self._check_jit_in_loop()
+        self._check_key_reuse()
+        return self.findings
+
+    # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
+
+    def _check_jit_scope(self) -> None:
+        fn = self.fi.node
+        analysis = TaintAnalysis(
+            self.mod.resolve,
+            cleaners=frozenset(),  # inside jit nothing "cleans" a tracer
+            seed_params={
+                p: f"'{p}' is a traced argument of jitted {fn.name}()"
+                for p in _params_of(fn)
+            },
+        )
+        facts = analysis.solve(self.cfg)
+        global_names = {
+            n for s in ast.walk(fn) if isinstance(s, ast.Global)
+            for n in s.names
+        }
+        for node in self.cfg.nodes:
+            fact = facts.get(node.idx, {})
+            if node.kind in ("if", "while"):
+                test = node.stmt.test
+                taints = analysis.taint_of(test, fact, node)
+                if taints and not _is_none_check(test):
+                    self._report(
+                        "GL002", test,
+                        f"Python `{node.kind}` on traced value "
+                        f"`{_expr_text(test)}` — use lax.cond/lax.while_loop "
+                        "or jnp.where",
+                        taints)
+            if isinstance(node.stmt, ast.Assert):
+                taints = analysis.taint_of(node.stmt.test, fact, node)
+                if taints:
+                    self._report(
+                        "GL002", node.stmt,
+                        f"assert on traced value "
+                        f"`{_expr_text(node.stmt.test)}` — use "
+                        "checkify/debug.check", taints)
+            if global_names:
+                hard, soft = assigned_names(node)
+                mutated = global_names & set(hard + soft)
+                if mutated:
+                    self._report(
+                        "GL005", node.stmt,
+                        f"mutation of global `{sorted(mutated)[0]}` under "
+                        "jit — side effects run once at trace time")
+            for expr in node_exprs(node):
+                self._scan_jit_expr(expr, fact, node, analysis)
+
+    def _scan_jit_expr(self, root: ast.expr, fact: Fact, node: Node,
+                       analysis: TaintAnalysis) -> None:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.FormattedValue):
+                taints = analysis.taint_of(sub.value, fact, node)
+                if taints:
+                    self._report(
+                        "GL003", sub,
+                        f"f-string interpolates traced value "
+                        f"`{_expr_text(sub.value)}` — under jit this formats "
+                        "the tracer, not the runtime value (use "
+                        "jax.debug.print)", taints)
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = self.mod.resolve(sub.func)
+            args = list(sub.args) + [kw.value for kw in sub.keywords]
+            arg_taints = analysis._union(args, fact, node)
+            if dotted in _HOST_CASTS and arg_taints:
+                self._report(
+                    "GL001", sub,
+                    f"{dotted}() on traced value forces a host sync / trace "
+                    "error under jit — keep it on device (jnp ops) or move "
+                    "it outside jit", arg_taints)
+            elif dotted in _NP_SYNC and arg_taints:
+                self._report(
+                    "GL001", sub,
+                    f"{dotted.replace('numpy', 'np')}() on traced value "
+                    "under jit — use jnp.asarray or move the transfer "
+                    "outside jit", arg_taints)
+            elif (isinstance(sub.func, ast.Attribute)
+                  and sub.func.attr in _SYNC_METHODS):
+                recv = analysis.taint_of(sub.func.value, fact, node)
+                if recv:
+                    self._report(
+                        "GL001", sub,
+                        f".{sub.func.attr}() on traced value "
+                        f"`{_expr_text(sub.func.value)}` under jit — host "
+                        "syncs don't belong in traced code", recv)
+            if dotted == "range" and arg_taints:
+                self._report(
+                    "GL008", sub,
+                    "range() over a traced value — Python loops need a "
+                    "static trip count (static_argnums, or lax.fori_loop)",
+                    arg_taints)
+            elif dotted in _SHAPE_FNS and sub.args:
+                shape_taint = analysis.taint_of(sub.args[0], fact, node)
+                if shape_taint:
+                    self._report(
+                        "GL008", sub,
+                        f"traced value as the shape argument of {dotted} — "
+                        "shapes must be static under jit (static_argnums)",
+                        shape_taint)
+            if dotted is not None and (
+                    dotted in _IMPURE_CALLS
+                    or dotted.startswith(_IMPURE_PREFIXES)):
+                self._report(
+                    "GL005", sub,
+                    f"impure call {dotted}() under jit — runs once at trace "
+                    "time and is baked into the compiled program (use "
+                    "jax.random / jax.debug instead)")
+
+    # -- step-loop host-sync rule (GL004) ------------------------------------
+
+    def _check_step_loops(self) -> None:
+        def seed(node: Node, call: ast.Call) -> Optional[str]:
+            if not node.loop_stack:
+                return None
+            func = call.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if name is not None and _STEP_CALL_RE.match(name):
+                return f"result of step call {name}(…) is a device value"
+            return None
+
+        analysis = TaintAnalysis(self.mod.resolve, seed_call=seed,
+                                 cleaners=_CLEANERS)
+        facts = analysis.solve(self.cfg)
+        for node in self.cfg.nodes:
+            if not node.loop_stack:
+                continue
+            fact = facts.get(node.idx, {})
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = self.mod.resolve(sub.func)
+                    is_cast = dotted in ("float", "int")
+                    is_item = (isinstance(sub.func, ast.Attribute)
+                               and sub.func.attr == "item")
+                    if not (is_cast or is_item):
+                        continue
+                    target = (sub.args if is_cast
+                              else [sub.func.value])
+                    taints = analysis._union(list(target), fact, node)
+                    live = frozenset(
+                        t for t in taints if t.seed_loop in node.loop_stack
+                    )
+                    if live and not _guarded_by_modulo(node):
+                        sync = (f"{dotted}()" if is_cast else ".item()")
+                        self._report(
+                            "GL004", sub,
+                            f"{sync} on a jitted-step result inside the step "
+                            "loop — blocks dispatch every iteration; "
+                            "accumulate on device and read once after the "
+                            "loop (or rate-limit with a `% k` guard)", live)
+
+    # -- recompilation (GL006) -----------------------------------------------
+
+    def _check_jit_in_loop(self) -> None:
+        for node in self.cfg.nodes:
+            if not node.loop_stack:
+                continue
+            for expr in node_exprs(node):
+                # A jit inside a lambda BODY is deferred, not created per
+                # iteration — exclude those subtrees before scanning
+                # (ast.walk has no skip, so collect them up front).
+                deferred = {
+                    id(n)
+                    for lam in ast.walk(expr) if isinstance(lam, ast.Lambda)
+                    for n in ast.walk(lam.body)
+                }
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Call) and id(sub) not in deferred:
+                        dotted = self.mod.resolve(sub.func)
+                        if dotted in _JIT_NAMES:
+                            self._report(
+                                "GL006", sub,
+                                f"{dotted}(…) created inside a loop — a "
+                                "fresh wrapper (and compile cache entry) "
+                                "per iteration; hoist the jit out of the "
+                                "loop")
+
+    # -- PRNG key reuse (GL007) ----------------------------------------------
+
+    def _check_key_reuse(self) -> None:
+        defs = reaching_definitions(self.cfg)
+        consumers: Dict[Tuple[str, int], List[Node]] = {}
+        depth_flagged: Set[int] = set()
+        for node in self.cfg.nodes:
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = self.mod.resolve(sub.func)
+                    if (dotted is None
+                            or not dotted.startswith("jax.random.")
+                            or dotted.rsplit(".", 1)[1] in _KEY_PRODUCERS):
+                        continue
+                    key_args = [a for a in sub.args[:1]
+                                if isinstance(a, ast.Name)]
+                    key_args += [kw.value for kw in sub.keywords
+                                 if kw.arg == "key"
+                                 and isinstance(kw.value, ast.Name)]
+                    for arg in key_args:
+                        sites = defs.get(node.idx, {}).get(
+                            arg.id, frozenset((self.cfg.entry,)))
+                        for d in sites:
+                            consumers.setdefault((arg.id, d), []).append(node)
+                        # Loop-constant key: every reaching def sits outside
+                        # the consumer's innermost loop.
+                        if node.loop_stack and node.idx not in depth_flagged:
+                            if all(self.cfg.nodes[d].loop_depth < node.loop_depth
+                                   for d in sites):
+                                depth_flagged.add(node.idx)
+                                self._report(
+                                    "GL007", sub,
+                                    f"PRNG key `{arg.id}` is defined outside "
+                                    "this loop but consumed inside it — the "
+                                    "same key (and random stream) repeats "
+                                    "every iteration; fold_in the loop "
+                                    "index or split per iteration")
+        for (name, d), nodes in consumers.items():
+            distinct = sorted({n.idx for n in nodes})
+            if len(distinct) < 2:
+                continue
+            lines = sorted({n.line for n in nodes})
+            at = next(n for n in nodes if n.idx == distinct[1])
+            def_line = self.cfg.nodes[d].line or "argument"
+            self._report(
+                "GL007", at.stmt if at.stmt is not None else self.fi.node,
+                f"PRNG key `{name}` (defined line {def_line}) feeds "
+                f"{len(distinct)} jax.random consumers (lines "
+                f"{', '.join(map(str, lines))}) — reused keys give "
+                "identical streams; jax.random.split per consumer")
+
+
+# ---------------------------------------------------------------------------
+# Module entry point
+# ---------------------------------------------------------------------------
+
+
+def analyze_source(path: str, source: Optional[str] = None) -> List[Finding]:
+    """All findings for one Python file (``source`` overrides reading
+    ``path`` — the test-fixture hook)."""
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        # A file the analyzer cannot parse is itself a (new) finding — a
+        # broken file must fail the gate, not silently skip analysis.
+        return [Finding(
+            rule="GL000", path=path, line=e.lineno or 0, col=0,
+            function="<module>", message=f"unparseable file: {e.msg}",
+            source_line="")]
+    mod = _Module(path, tree, source.splitlines())
+    findings: List[Finding] = []
+    for fi in _collect_functions(tree):
+        checker = _FunctionChecker(mod, fi, _is_jit_scope(mod, fi))
+        findings.extend(checker.run())
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,214 @@
+"""Package walker + baseline diffing for graftlint.
+
+``run_analysis`` walks the given paths (default: the ``deepdfa_tpu``
+package), analyzes every ``.py`` file, and diffs the findings against a
+committed baseline-suppressions file so CI fails only on NEW findings.
+
+Baseline entries are keyed by a line-number-free fingerprint (file, rule,
+function, normalized source line — ``Finding.fingerprint``), so unrelated
+edits above a suppressed finding don't resurrect it; identical fingerprints
+are count-aware, so *adding a second copy* of a suppressed hazard still
+fails. Regenerate with ``--write-baseline`` after deliberate suppressions.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepdfa_tpu.analysis.rules import Finding, analyze_source
+
+BASELINE_VERSION = 1
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_paths() -> List[str]:
+    return [os.path.join(repo_root(), "deepdfa_tpu")]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "configs", "lint_baseline.json")
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", "_build")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def collect_findings(paths: Sequence[str],
+                     root: Optional[str] = None) -> List[Finding]:
+    return _findings_for_files(iter_python_files(paths), root)
+
+
+def _findings_for_files(files: Sequence[str],
+                        root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    for path in files:
+        rel = os.path.relpath(path, root)
+        if rel.startswith(".."):  # outside the root: keep absolute
+            rel = path
+        findings.extend(analyze_source(rel, source=_read(path)))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> allowed count. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    counts: Dict[str, int] = collections.Counter(
+        entry["fingerprint"] for entry in doc.get("suppressions", [])
+    )
+    return dict(counts)
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "generated_by": "deepdfa_tpu.cli analyze-code --write-baseline",
+        "suppressions": [
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "name": f.name,
+                "file": f.path,
+                "function": f.function,
+                # informational only — the fingerprint is the key
+                "line": f.line,
+                "source": f.source_line,
+            }
+            for f in findings
+        ],
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """(new findings, stale fingerprints with unused counts).
+
+    Findings are suppressed fingerprint-by-fingerprint up to the baselined
+    count; the (n+1)-th identical finding is NEW. Leftover counts are stale
+    entries worth pruning from the baseline."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in remaining.items() if n > 0}
+    return new, stale
+
+
+def run_analysis(
+    paths: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    write_baseline_file: bool = False,
+    root: Optional[str] = None,
+) -> Dict:
+    """The analyze-code engine. Returns a JSON-able report:
+
+    ``{"files", "findings" (all), "new" (non-baselined), "stale_suppressions",
+    "exit_code"}`` — exit_code 1 iff new findings exist (and we're not
+    regenerating the baseline)."""
+    paths = list(paths) if paths else default_paths()
+    baseline_path = baseline_path or default_baseline_path()
+    files = iter_python_files(paths)
+    findings = _findings_for_files(files, root=root)
+    if write_baseline_file:
+        write_baseline(findings, baseline_path)
+        return {
+            "files": len(files),
+            "findings": [_as_dict(f) for f in findings],
+            "new": [],
+            "stale_suppressions": {},
+            "baseline": baseline_path,
+            "baseline_written": True,
+            "exit_code": 0,
+        }
+    baseline = load_baseline(baseline_path)
+    new, stale = apply_baseline(findings, baseline)
+    return {
+        "files": len(files),
+        "findings": [_as_dict(f) for f in findings],
+        "new": [_as_dict(f) for f in new],
+        "new_findings": new,
+        "stale_suppressions": stale,
+        "baseline": baseline_path,
+        "exit_code": 1 if new else 0,
+    }
+
+
+def _as_dict(f: Finding) -> Dict:
+    return {
+        "rule": f.rule,
+        "name": f.name,
+        "path": f.path,
+        "line": f.line,
+        "col": f.col,
+        "function": f.function,
+        "message": f.message,
+        "trace": list(f.trace),
+        "fingerprint": f.fingerprint,
+    }
+
+
+def format_report(report: Dict, verbose: bool = False) -> str:
+    """Human-readable lint output (the non-``--json`` CLI surface)."""
+    lines: List[str] = []
+    new = report.get("new_findings", [])
+    for f in new:
+        lines.append(f.format())
+    n_baselined = len(report["findings"]) - len(new)
+    summary = (
+        f"graftlint: {len(new)} new finding{'s' if len(new) != 1 else ''} "
+        f"({n_baselined} baselined, {report['files']} files)"
+    )
+    if report.get("baseline_written"):
+        summary = (
+            f"graftlint: baseline regenerated with "
+            f"{len(report['findings'])} suppressions -> {report['baseline']}"
+        )
+    lines.append(summary)
+    if report.get("stale_suppressions"):
+        lines.append(
+            f"graftlint: {sum(report['stale_suppressions'].values())} stale "
+            "suppression(s) no longer match any finding — regenerate the "
+            "baseline to prune them"
+        )
+    if verbose and not report.get("baseline_written"):
+        for f in report["findings"]:
+            lines.append(
+                f"  [all] {f['path']}:{f['line']} {f['rule']} {f['message']}"
+            )
+    return "\n".join(lines)

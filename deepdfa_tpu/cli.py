@@ -7,6 +7,7 @@ with one structured CLI over the dataclass configs:
   python -m deepdfa_tpu.cli fit  --config cfg.yaml --set train.max_epochs=5
   python -m deepdfa_tpu.cli test --checkpoint-dir runs/x --which best
   python -m deepdfa_tpu.cli analyze --dataset synthetic:256
+  python -m deepdfa_tpu.cli analyze-code          # graftlint over our sources
   python -m deepdfa_tpu.cli tune --trials 8 --dataset synthetic:256
 
 Reference semantics carried over:
@@ -884,6 +885,29 @@ def cmd_analyze(args) -> Dict[str, Any]:
     return report
 
 
+def cmd_analyze_code(args) -> Dict[str, Any]:
+    """graftlint: the dataflow-analysis-based static checker for JAX/TPU
+    hazards (host syncs in jitted/step-loop code, tracer control flow,
+    recompilation triggers, impurity under jit, PRNG key reuse) over our own
+    sources — the paper's core idea, dogfooded (analysis/ package). Reports
+    only findings not in the committed baseline; exits nonzero when any
+    exist (the scripts/lint.sh CI contract)."""
+    from deepdfa_tpu.analysis.runner import format_report, run_analysis
+
+    report = run_analysis(
+        paths=args.paths or None,
+        baseline_path=args.baseline,
+        write_baseline_file=args.write_baseline,
+    )
+    if args.json:
+        # new_findings holds Finding objects for the text formatter only
+        print(json.dumps({k: v for k, v in report.items()
+                          if k != "new_findings"}))
+    else:
+        print(format_report(report, verbose=args.verbose))
+    return report
+
+
 def cmd_tune(args) -> Dict[str, Any]:
     """Random hyperparameter search (the NNI replacement): samples the
     published search space (paper Table 2 context), runs short fits, ranks
@@ -1134,6 +1158,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     common(p_an)
     p_an.set_defaults(func=cmd_analyze)
 
+    p_ac = sub.add_parser(
+        "analyze-code",
+        help="graftlint: static JAX/TPU-hazard analysis over this repo's "
+             "own sources (reaching-defs + tracer taint); nonzero exit on "
+             "non-baselined findings")
+    p_ac.add_argument("paths", nargs="*",
+                      help="files/dirs to analyze (default: the "
+                           "deepdfa_tpu package)")
+    p_ac.add_argument("--baseline", default=None,
+                      help="baseline-suppressions JSON (default: "
+                           "configs/lint_baseline.json)")
+    p_ac.add_argument("--write-baseline", action="store_true",
+                      help="regenerate the baseline from the current "
+                           "findings (accepts them all)")
+    p_ac.add_argument("--json", action="store_true",
+                      help="machine-readable report on stdout")
+    p_ac.add_argument("--verbose", action="store_true",
+                      help="also list baselined findings")
+    p_ac.set_defaults(func=cmd_analyze_code)
+
     p_tune = sub.add_parser("tune")
     common(p_tune)
     p_tune.add_argument("--trials", type=int, default=8)
@@ -1155,7 +1199,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.set_defaults(func=cmd_tune)
 
     args = parser.parse_args(argv)
-    args.func(args)
+    result = args.func(args)
+    # analyze-code carries the CI contract in exit_code (new findings -> 1);
+    # every other command reports via its JSON line and exits 0.
+    if isinstance(result, dict) and result.get("exit_code"):
+        return int(result["exit_code"])
     return 0
 
 

@@ -280,7 +280,11 @@ def evaluate_gen(
     ):
         s_dev = _lift_rows(s, mesh, host)
         t_dev = _lift_rows(t, mesh, host)
-        losses.append(float(eval_loss_fn(state.params, s_dev, t_dev)))
+        # Losses stay on device until the single device_get below — the
+        # old float() here blocked the host BEFORE the gen dispatch each
+        # batch (graftlint GL004). The np.asarray on preds still transfers
+        # per batch; predictions are host outputs.
+        losses.append(eval_loss_fn(state.params, s_dev, t_dev))
         preds.append(np.asarray(gen(state.params, s_dev))[:n_valid])
     pred = (
         np.concatenate(preds)
@@ -288,7 +292,8 @@ def evaluate_gen(
         else np.zeros((0, max_target_length), np.int32)
     )
     out: Dict[str, Any] = {
-        "eval_loss": float(np.mean(losses)) if losses else float("nan"),
+        "eval_loss": (float(np.mean(jax.device_get(losses)))
+                      if losses else float("nan")),
         "exact_match": exact_match(
             pred, eval_data["target_ids"][: len(pred)],
             model.cfg.pad_token_id, model.cfg.eos_token_id,
@@ -389,13 +394,16 @@ def fit_gen(
     eval_loss_fn = eval_fns[0]
 
     def loss_only_eval() -> float:
+        # Device-accumulated like evaluate_gen: one host transfer at the
+        # end, not one per eval batch (graftlint GL004).
         losses = []
         for s, t, _ in _batches(eval_data, cfg.eval_batch_size,
                                 pad_tail=True, pad_id=pad_id):
-            losses.append(float(eval_loss_fn(
+            losses.append(eval_loss_fn(
                 state.params, _lift_rows(s, mesh, host),
-                _lift_rows(t, mesh, host))))
-        return float(np.mean(losses)) if losses else float("nan")
+                _lift_rows(t, mesh, host)))
+        return (float(np.mean(jax.device_get(losses)))
+                if losses else float("nan"))
 
     def bleu_eval(cur_state):
         ev = evaluate_gen(model, cur_state, eval_data, cfg,

@@ -471,7 +471,13 @@ def evaluate_text(
     labels/mask/index, so every host returns the same full per-example
     dump (PR CSVs, export_predictions, DbgBench all work on a pod)."""
     stats = BinaryStats.zeros()
-    total_loss, n = 0.0, 0
+    # Losses stay on device until one jax.device_get after the loop — the
+    # blocking scalar read the old per-batch float(loss) did is gone
+    # (graftlint GL004). The per-batch probs read below remains: those ARE
+    # host outputs. device_get over the retained list (vs eager adds into
+    # an accumulator) also stays legal on multi-controller pods, where
+    # eager math on non-fully-addressable replicated outputs is not.
+    losses = []
     probs_all, labels_all, index_all = [], [], []
     num_missing = 0
     for batch in text_graph_batches(
@@ -495,13 +501,12 @@ def evaluate_text(
         probs_all.append(p[m])
         labels_all.append(labels_np[m])
         index_all.append(index_np[m])
-        total_loss += float(loss)
-        n += 1
+        losses.append(loss)
     metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
     if num_missing:
         logger.info("eval: %d examples missing graphs (masked)", num_missing)
     return {
-        "loss": total_loss / max(n, 1),
+        "loss": float(np.mean(jax.device_get(losses))) if losses else 0.0,
         "metrics": metrics,
         "probs": np.concatenate(probs_all) if probs_all else np.zeros(0),
         "labels": np.concatenate(labels_all) if labels_all else np.zeros(0),

@@ -1,0 +1,11 @@
+#!/bin/bash
+# graftlint: dataflow-analysis-based static checking for JAX/TPU hazards
+# (deepdfa_tpu/analysis/) over this repo's own sources. Exits nonzero on any
+# finding not in configs/lint_baseline.json — the CI gate. Regenerate the
+# baseline after a deliberate suppression with:
+#   python -m deepdfa_tpu.cli analyze-code --write-baseline
+set -e
+cd "$(dirname "$0")/.."
+# The analyzer is stdlib-only, but the CLI module imports jax-adjacent
+# config; pin the CPU platform so a TPU plugin can never stall a lint.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli analyze-code "$@"

@@ -2,5 +2,8 @@
 # DeepDFA evaluation from the best checkpoint (reference DDFA/scripts/test.sh).
 set -e
 cd "$(dirname "$0")/.."
+# Static-analysis gate first: an eval run on code with a fresh TPU hazard
+# (graftlint finding) should fail in seconds, not after the checkpoint load.
+bash scripts/lint.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

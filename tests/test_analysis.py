@@ -1,0 +1,652 @@
+"""graftlint (deepdfa_tpu/analysis/) — rule fixtures, baseline mechanism,
+and the package self-check.
+
+Every rule id has a positive fixture (the hazard, detected) and a negative
+fixture (the idiomatic fix, clean) — the synthetic-snippet contract of the
+static-analysis issue. The self-check runs the full analyzer over the
+installed package with the committed baseline and must come back clean in
+the tier-1 fast lane.
+"""
+
+import json
+import time
+
+from deepdfa_tpu.analysis import analyze_source
+from deepdfa_tpu.analysis.cfg import build_cfg
+from deepdfa_tpu.analysis.dataflow import reaching_definitions
+from deepdfa_tpu.analysis.runner import (
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+)
+
+
+def rules_of(src: str):
+    return {f.rule for f in analyze_source("fixture.py", src)}
+
+
+def findings_for(src: str, rule: str):
+    return [f for f in analyze_source("fixture.py", src) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# GL001 tracer-host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_gl001_float_on_tracer_under_jit():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    y = x + 1
+    return float(y)
+"""
+    found = findings_for(src, "GL001")
+    assert len(found) == 1
+    assert found[0].line == 7
+    # the def-use chain names the propagation through y
+    assert any("y" in step for step in found[0].trace)
+
+
+def test_gl001_item_and_asarray_on_tracer():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    a = x.sum().item()
+    b = np.asarray(x)
+    return a, b
+"""
+    assert len(findings_for(src, "GL001")) == 2
+
+
+def test_gl001_negative_static_shape_is_clean():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    scale = float(x.shape[0])
+    return x * scale
+"""
+    assert "GL001" not in rules_of(src)
+
+
+def test_gl001_jit_wrap_of_local_def_counts_as_jit_scope():
+    src = """
+import jax
+
+def fwd(x):
+    return float(x)
+
+fwd_j = jax.jit(fwd)
+"""
+    assert "GL001" in rules_of(src)
+
+
+def test_gl001_make_step_convention_is_jit_scope():
+    src = """
+def make_train_step(model):
+    def step(state, batch):
+        return float(batch)
+    return step
+"""
+    assert "GL001" in rules_of(src)
+
+
+def test_gl001_nested_helper_inherits_jit_scope():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    def inner(y):
+        return float(y)
+    return inner(x)
+"""
+    assert "GL001" in rules_of(src)
+
+
+def test_gl001_partial_jit_decorator():
+    src = """
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnums=0)
+def step(n, x):
+    return float(x)
+"""
+    assert "GL001" in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL002 tracer-control-flow
+# ---------------------------------------------------------------------------
+
+
+def test_gl002_if_on_tracer():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    if x > 0:
+        return x
+    return -x
+"""
+    assert "GL002" in rules_of(src)
+
+
+def test_gl002_while_on_tracer():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    while x < 10:
+        x = x * 2
+    return x
+"""
+    assert "GL002" in rules_of(src)
+
+
+def test_gl002_negative_none_check_is_static():
+    src = """
+import jax
+
+@jax.jit
+def step(x, mask=None):
+    if mask is None:
+        return x
+    return x * mask
+"""
+    assert "GL002" not in rules_of(src)
+
+
+def test_gl002_negative_config_flag_is_clean():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    style = "graph"
+    if style == "graph":
+        return x
+    return -x
+"""
+    assert "GL002" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL003 tracer-fstring
+# ---------------------------------------------------------------------------
+
+
+def test_gl003_fstring_of_tracer():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    y = x * 2
+    msg = f"value={y}"
+    return x
+"""
+    assert "GL003" in rules_of(src)
+
+
+def test_gl003_negative_static_fstring():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    msg = f"batch={x.shape[0]}"
+    return x
+"""
+    assert "GL003" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL004 host-sync-in-step-loop
+# ---------------------------------------------------------------------------
+
+
+def test_gl004_float_on_step_result_in_loop():
+    src = """
+def evaluate(eval_step, state, batches):
+    total = 0.0
+    for b in batches:
+        loss = eval_step(state, b)
+        total += float(loss)
+    return total
+"""
+    found = findings_for(src, "GL004")
+    assert len(found) == 1
+    assert found[0].line == 6
+    assert any("eval_step" in step for step in found[0].trace)
+
+
+def test_gl004_negative_device_accumulation():
+    src = """
+import jax
+
+def evaluate(eval_step, state, batches):
+    losses = []
+    for b in batches:
+        loss = eval_step(state, b)
+        losses.append(loss)
+    return float(sum(jax.device_get(losses)))
+"""
+    assert "GL004" not in rules_of(src)
+
+
+def test_gl004_negative_modulo_guarded_log_sync():
+    src = """
+def fit(train_step, state, batches, log_every=50):
+    n = 0
+    for b in batches:
+        state, loss = train_step(state, b)
+        n += 1
+        if n % log_every == 0:
+            record = float(loss)
+    return state
+"""
+    assert "GL004" not in rules_of(src)
+
+
+def test_gl004_negative_sync_after_loop():
+    src = """
+def fit(train_step, state, batches):
+    import jax.numpy as jnp
+    loss_sum = jnp.zeros(())
+    for b in batches:
+        state, loss = train_step(state, b)
+        loss_sum = loss_sum + loss
+    return float(loss_sum)
+"""
+    assert "GL004" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL005 impure-under-jit
+# ---------------------------------------------------------------------------
+
+
+def test_gl005_time_and_np_random_under_jit():
+    src = """
+import time
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    t0 = time.time()
+    noise = np.random.normal(size=(4,))
+    return x + noise, t0
+"""
+    assert len(findings_for(src, "GL005")) == 2
+
+
+def test_gl005_global_mutation_under_jit():
+    src = """
+import jax
+
+_CACHE = 0
+
+@jax.jit
+def step(x):
+    global _CACHE
+    _CACHE = _CACHE + 1
+    return x
+"""
+    assert "GL005" in rules_of(src)
+
+
+def test_gl005_negative_host_function_may_time():
+    src = """
+import time
+
+def fit(batches):
+    t0 = time.time()
+    return time.time() - t0
+"""
+    assert "GL005" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL006 jit-in-loop
+# ---------------------------------------------------------------------------
+
+
+def test_gl006_jit_created_inside_loop():
+    src = """
+import jax
+
+def run(fn, batches):
+    outs = []
+    for b in batches:
+        outs.append(jax.jit(fn)(b))
+    return outs
+"""
+    assert "GL006" in rules_of(src)
+
+
+def test_gl006_negative_jit_deferred_in_lambda():
+    # a jit inside a lambda BODY is not created per iteration
+    src = """
+import jax
+
+def run(fns, batches):
+    probes = []
+    for f in fns:
+        probes.append(lambda b, f=f: jax.jit(f)(b))
+    return probes
+"""
+    assert "GL006" not in rules_of(src)
+
+
+def test_gl006_negative_jit_hoisted():
+    src = """
+import jax
+
+def run(fn, batches):
+    jfn = jax.jit(fn)
+    outs = []
+    for b in batches:
+        outs.append(jfn(b))
+    return outs
+"""
+    assert "GL006" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL007 key-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_gl007_same_key_two_consumers():
+    src = """
+import jax
+
+def sample(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+"""
+    found = findings_for(src, "GL007")
+    assert len(found) == 1
+    assert "key" in found[0].message
+
+
+def test_gl007_loop_constant_key():
+    src = """
+import jax
+
+def sample(key, n):
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, (4,)))
+    return outs
+"""
+    assert "GL007" in rules_of(src)
+
+
+def test_gl007_negative_split_per_consumer():
+    src = """
+import jax
+
+def sample(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+"""
+    assert "GL007" not in rules_of(src)
+
+
+def test_gl007_negative_rotating_key_in_loop():
+    # the localization.py idiom: the key is re-split every iteration
+    src = """
+import jax
+
+def sample(key, n):
+    outs = []
+    for _ in range(n):
+        key, k = jax.random.split(key)
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+"""
+    assert "GL007" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL008 nonstatic-python-scalar
+# ---------------------------------------------------------------------------
+
+
+def test_gl008_range_over_tracer():
+    src = """
+import jax
+
+@jax.jit
+def step(x, n):
+    acc = x
+    for _ in range(n):
+        acc = acc + 1
+    return acc
+"""
+    assert "GL008" in rules_of(src)
+
+
+def test_gl008_tracer_as_shape():
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(n):
+    return jnp.zeros(n)
+"""
+    assert "GL008" in rules_of(src)
+
+
+def test_gl008_negative_static_trip_count():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    acc = x
+    for _ in range(x.shape[0]):
+        acc = acc + 1
+    return acc
+"""
+    assert "GL008" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# CFG / dataflow plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_reaching_definitions_kill_and_branch_join():
+    import ast
+
+    src = """
+def f(c):
+    x = 1
+    if c:
+        x = 2
+    y = x
+"""
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    defs = reaching_definitions(cfg)
+    y_node = next(n for n in cfg.nodes
+                  if n.stmt is not None and n.line == 6)
+    sites = defs[y_node.idx]["x"]
+    # both the initial def and the branch redef reach the join
+    assert len(sites) == 2
+
+
+def test_cfg_loop_has_back_edge_and_loop_stack():
+    import ast
+
+    src = """
+def f(xs):
+    for x in xs:
+        y = x
+    return y
+"""
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    head = next(n for n in cfg.nodes if n.kind == "for")
+    body = next(n for n in cfg.nodes
+                if n.stmt is not None and n.line == 4)
+    assert head.idx in body.succs  # back edge
+    assert body.loop_stack == (head.idx,)
+    assert head.loop_stack == ()
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanism
+# ---------------------------------------------------------------------------
+
+_HAZARD = """
+import jax
+
+def sample(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+"""
+
+
+def _write_fixture(tmp_path, body, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def test_baseline_suppresses_known_findings(tmp_path):
+    path = _write_fixture(tmp_path, _HAZARD)
+    baseline = str(tmp_path / "baseline.json")
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    assert report["exit_code"] == 1 and len(report["new"]) == 1
+
+    # accept the finding into the baseline: the identical re-run is clean
+    report = run_analysis(paths=[path], baseline_path=baseline,
+                          write_baseline_file=True)
+    assert report["exit_code"] == 0
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    assert report["exit_code"] == 0 and report["new"] == []
+    assert len(report["findings"]) == 1  # still reported as baselined
+
+
+def test_baseline_survives_line_drift_but_not_new_copies(tmp_path):
+    path = _write_fixture(tmp_path, _HAZARD)
+    baseline = str(tmp_path / "baseline.json")
+    run_analysis(paths=[path], baseline_path=baseline,
+                 write_baseline_file=True)
+
+    # unrelated lines above shift every lineno: still suppressed
+    drifted = "import os\nimport sys\n" + _HAZARD
+    (tmp_path / "mod.py").write_text(drifted)
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    assert report["new"] == []
+
+    # a SECOND copy of the suppressed hazard (same fingerprint) is new:
+    # the baseline is count-aware
+    doubled = _HAZARD + _HAZARD.replace("def sample", "def sample2")
+    (tmp_path / "mod.py").write_text(doubled)
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    assert len(report["new"]) == 1
+
+
+def test_baseline_reports_stale_suppressions(tmp_path):
+    path = _write_fixture(tmp_path, _HAZARD)
+    baseline = str(tmp_path / "baseline.json")
+    run_analysis(paths=[path], baseline_path=baseline,
+                 write_baseline_file=True)
+    (tmp_path / "mod.py").write_text("def sample():\n    return 0\n")
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    assert report["exit_code"] == 0
+    assert sum(report["stale_suppressions"].values()) == 1
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+def test_apply_baseline_counts():
+    # direct unit: two identical fingerprints vs a count-1 baseline
+    fs = analyze_source("fixture.py", _HAZARD)
+    assert len(fs) == 1
+    new, stale = apply_baseline(fs + fs, {fs[0].fingerprint: 1})
+    assert len(new) == 1 and stale == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI surface + package self-check
+# ---------------------------------------------------------------------------
+
+
+def test_cli_analyze_code_json(capsys):
+    from deepdfa_tpu.cli import main
+
+    rc = main(["analyze-code", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["new"] == []
+    assert out["files"] > 50
+
+
+def test_cli_analyze_code_exit_nonzero_on_new_finding(tmp_path, capsys):
+    from deepdfa_tpu.cli import main
+
+    path = _write_fixture(tmp_path, _HAZARD)
+    rc = main(["analyze-code", path,
+               "--baseline", str(tmp_path / "none.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GL007" in out and "1 new finding" in out
+
+
+def test_package_self_check_clean_and_fast():
+    """The acceptance criterion: the analyzer over the whole package, with
+    the committed baseline, finds nothing new — in well under a minute."""
+    t0 = time.time()
+    report = run_analysis()
+    elapsed = time.time() - t0
+    assert elapsed < 60, f"analyzer took {elapsed:.1f}s (budget 60s)"
+    msgs = "\n".join(
+        f"{f['path']}:{f['line']} {f['rule']} {f['message']}"
+        for f in report["new"]
+    )
+    assert report["new"] == [], f"new graftlint findings:\n{msgs}"
+    assert report["files"] > 50  # the walk really covered the package
+
+
+def test_self_check_covers_every_rule_implementation():
+    """All 8 hazard rule ids (plus the parse-error sentinel) are wired:
+    each hazard has at least one firing fixture above; this guards the
+    registry/implementation agreement."""
+    from deepdfa_tpu.analysis.rules import RULES
+
+    assert set(RULES) == {f"GL00{i}" for i in range(0, 9)}
+
+
+def test_unparseable_file_is_a_finding(tmp_path):
+    path = _write_fixture(tmp_path, "def broken(:\n", name="bad.py")
+    report = run_analysis(paths=[path],
+                          baseline_path=str(tmp_path / "b.json"))
+    assert report["exit_code"] == 1
+    assert report["new"][0]["rule"] == "GL000"
