@@ -858,6 +858,206 @@ def cmd_test_text(args) -> Dict[str, Any]:
     return report
 
 
+# ---------------------------------------------------------------------------
+# Serving (serve / score — the checkpoint-to-responses path, deepdfa_tpu/serve)
+# ---------------------------------------------------------------------------
+
+
+def _serve_config(args, block_size: Optional[int] = None):
+    from deepdfa_tpu.serve import ServeConfig
+
+    kw: Dict[str, Any] = dict(
+        batch_slots=args.batch_slots,
+        deadline_ms=args.deadline_ms,
+        queue_capacity=args.queue_capacity,
+        cache_capacity=args.cache_capacity,
+    )
+    if block_size is not None:
+        kw["block_size"] = block_size
+    return ServeConfig(**kw)
+
+
+def _build_serve_engine(args):
+    """(engine, model_cfg): the serving engine from checkpoints.
+
+    Without ``--checkpoint-dir`` the GNN lane runs on random-init params —
+    smoke mode for exercising the serving stack itself (scripts/serve.sh
+    from scripts/test.sh); scores are meaningless and the log says so.
+    ``--combined-checkpoint-dir`` (a fit-text linevul run dir) attaches
+    the combined DDFA+LineVul lane; its recorded block_size wins.
+    """
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    cfgs = build_configs(args.config, args.set)
+    model_cfg = cfgs["model"]
+    if model_cfg.label_style != "graph":
+        raise ValueError("serving scores functions; use label_style=graph")
+    model = FlowGNN(model_cfg)
+
+    combined_model = combined_params = tokenizer = None
+    block_size = None
+    if getattr(args, "combined_checkpoint_dir", None):
+        with open(os.path.join(args.combined_checkpoint_dir,
+                               "model.json")) as f:
+            desc = json.load(f)
+        if desc["model"] != "linevul" or not desc["combined"]:
+            raise ValueError(
+                "--combined-checkpoint-dir must hold a combined linevul "
+                "fit-text run (model.json says otherwise)"
+            )
+        gdict = dict(desc["graph_config"])
+        gdict["feature"] = FeatureSpec(**gdict["feature"])
+        ns = argparse.Namespace(
+            model=desc["model"], tiny=desc["tiny"],
+            tokenizer=desc.get("tokenizer"),
+            attention_impl=desc.get("attention_impl", "auto"),
+            remat=desc.get("remat", False),
+            block_size=desc["block_size"],
+            gelu_approximate=desc.get("gelu_approximate", False),
+        )
+        combined_model, tokenizer, _, _ = _text_model_and_tokenizer(
+            ns, True, FlowGNNConfig(**gdict)
+        )
+        combined_params = CheckpointManager(
+            args.combined_checkpoint_dir
+        ).restore_params(args.combined_which)
+        block_size = desc["block_size"]
+
+    serve_cfg = _serve_config(args, block_size=block_size)
+    if args.checkpoint_dir:
+        gnn_params = CheckpointManager(args.checkpoint_dir).restore_params(
+            args.which
+        )
+    else:
+        logger.warning(
+            "no --checkpoint-dir: serving RANDOM-INIT weights (smoke mode "
+            "— the serving stack is real, the scores are not)"
+        )
+        gnn_params = random_gnn_params(model, serve_cfg)
+
+    engine = ServeEngine(
+        model, gnn_params, config=serve_cfg,
+        combined_model=combined_model, combined_params=combined_params,
+        tokenizer=tokenizer,
+    )
+    return engine, model_cfg
+
+
+def _smoke_http(engine, host: str, port: int, n: int,
+                feature) -> Dict[str, Any]:
+    """Self-drive the full HTTP stack with ``n`` synthetic functions
+    (chunks exercise batching; a duplicated chunk exercises the cache)."""
+    import threading
+    import urllib.request
+
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    server = ServeHTTPServer((host, port), engine)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    bound_port = server.server_address[1]
+    base = f"http://{host}:{bound_port}"
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    try:
+        graphs = synthetic_bigvul(n, feature, positive_fraction=0.5, seed=0)
+        payload = [
+            {"id": int(g["id"]),
+             "graph": {"num_nodes": int(g["num_nodes"]),
+                       "senders": np.asarray(g["senders"]).tolist(),
+                       "receivers": np.asarray(g["receivers"]).tolist(),
+                       "feats": {k: np.asarray(v).tolist()
+                                 for k, v in g["feats"].items()}}}
+            for g in graphs
+        ]
+        results = []
+        chunk = max(engine.config.batch_slots // 2, 1)
+        for start in range(0, n, chunk):
+            results += post(
+                {"functions": payload[start:start + chunk]}
+            )["results"]
+        # Duplicate the first chunk: CI-scan traffic, must hit the cache.
+        dup = post({"functions": payload[:chunk]})["results"]
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        ok = (all("prob" in r for r in results)
+              and all(r.get("cached") for r in dup))
+        return {"smoke": n, "ok": ok, "cached_replay": len(dup),
+                "metrics": metrics}
+    finally:
+        server.shutdown()
+
+
+def cmd_serve(args) -> Dict[str, Any]:
+    """Serve scoring requests over HTTP (deepdfa_tpu/serve): deadline-aware
+    bucketed micro-batching, AOT bucket warmup (zero steady-state
+    recompiles), content-hash caching, 429 backpressure, GNN-only
+    degradation. ``--smoke N`` self-drives the full stack with N synthetic
+    requests and exits — the scripts/test.sh gate."""
+    from deepdfa_tpu.serve.http import serve_forever
+
+    engine, model_cfg = _build_serve_engine(args)
+    if not args.no_warmup:
+        n = engine.warmup()
+        logger.info("warmed %d bucket shapes", n)
+    if args.smoke is not None:
+        report = _smoke_http(engine, args.host, args.port, args.smoke,
+                             model_cfg.feature)
+        print(json.dumps(report))
+        if not report["ok"]:
+            report["exit_code"] = 1
+        return report
+    serve_forever(engine, args.host, args.port)
+    return {}
+
+
+def cmd_score(args) -> Dict[str, Any]:
+    """Offline batch client of the serving path: scores a dataset through
+    the same cache + micro-batcher + bucketed executables the HTTP
+    endpoint uses, and writes the predictions CSV (the cmd_test_text
+    writer)."""
+    engine, model_cfg = _build_serve_engine(args)
+    engine.warmup()
+    examples, splits = load_dataset(args.dataset, model_cfg.feature,
+                                    split_mode=args.split_mode)
+    indices = (np.arange(len(examples)) if args.split == "all"
+               else np.asarray(splits[args.split]))
+    chosen = [examples[int(i)] for i in indices]
+    results = engine.score_sync(chosen)
+    # Admission failures (oversize/malformed rows) come back inline; they
+    # are counted and skipped, not allowed to abort the batch run.
+    probs, labels, ids, errors = [], [], [], []
+    for i, (ex, r) in enumerate(zip(chosen, results)):
+        if "error" in r:
+            errors.append({"id": int(ex.get("id", i)), **r})
+            continue
+        probs.append(r["prob"])
+        labels.append(int(ex.get("label", 0)))
+        ids.append(int(ex.get("id", i)))
+    os.makedirs(args.out_dir, exist_ok=True)
+    _dump_predictions(args.out_dir, {"index": ids, "probs": probs,
+                                     "labels": labels},
+                      name="score_predictions.csv")
+    report = {"n_scored": len(probs), "n_errors": len(errors),
+              "errors": errors[:10], "split": args.split,
+              "out": os.path.join(args.out_dir, "score_predictions.csv"),
+              "serving": engine.snapshot()}
+    print(json.dumps(report))
+    return report
+
+
 def cmd_analyze(args) -> Dict[str, Any]:
     """Feature coverage: share of definition nodes whose abstract-dataflow
     index is known vs UNKNOWN (index 1) vs not-a-definition (index 0) —
@@ -1153,6 +1353,55 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "bugs-detected report over the evaluated split")
     p_tt.add_argument("--dbgbench-threshold", type=float, default=0.5)
     p_tt.set_defaults(func=cmd_test_text)
+
+    # Serving: the checkpoint-to-responses path (deepdfa_tpu/serve).
+    def serve_knobs(p):
+        p.add_argument("--batch-slots", type=int, default=16,
+                       help="largest micro-batch (slot-bucket ladder top)")
+        p.add_argument("--deadline-ms", type=float, default=100.0,
+                       help="per-request latency budget; a bucket flushes "
+                            "once the oldest request has spent half of it")
+        p.add_argument("--queue-capacity", type=int, default=256,
+                       help="pending requests before 429-style rejection")
+        p.add_argument("--cache-capacity", type=int, default=4096,
+                       help="content-hash result cache entries (0 = off)")
+
+    p_srv = sub.add_parser(
+        "serve", help="HTTP scoring endpoint: deadline-aware bucketed "
+                      "micro-batching over AOT-warmed shapes")
+    p_srv.add_argument("--config", action="append", default=[])
+    p_srv.add_argument("--set", action="append", default=[], metavar="S.K=V")
+    p_srv.add_argument("--checkpoint-dir", default=None,
+                       help="cli fit run dir (omit for random-init smoke "
+                            "mode)")
+    p_srv.add_argument("--which", default="best")
+    p_srv.add_argument("--combined-checkpoint-dir", default=None,
+                       help="fit-text combined linevul run dir: attaches "
+                            "the DDFA+LineVul lane for requests with code")
+    p_srv.add_argument("--combined-which", default="best")
+    p_srv.add_argument("--host", default="127.0.0.1")
+    p_srv.add_argument("--port", type=int, default=8321)
+    p_srv.add_argument("--no-warmup", action="store_true",
+                       help="skip AOT bucket warmup (first requests then "
+                            "pay the compiles)")
+    p_srv.add_argument("--smoke", type=int, default=None, metavar="N",
+                       help="self-drive the full HTTP stack with N "
+                            "synthetic requests, print the report, exit")
+    serve_knobs(p_srv)
+    p_srv.set_defaults(func=cmd_serve)
+
+    p_sc = sub.add_parser(
+        "score", help="offline batch client of the serving path (cache + "
+                      "micro-batcher + bucketed executables)")
+    common(p_sc)
+    p_sc.add_argument("--checkpoint-dir", default=None,
+                      help="cli fit run dir (omit for random-init smoke)")
+    p_sc.add_argument("--which", default="best")
+    p_sc.add_argument("--split", default="all",
+                      choices=["all", "train", "val", "test"])
+    p_sc.add_argument("--out-dir", default="runs/score")
+    serve_knobs(p_sc)
+    p_sc.set_defaults(func=cmd_score)
 
     p_an = sub.add_parser("analyze")
     common(p_an)

@@ -105,6 +105,109 @@ def pr_curve(
     return np.array(precisions), np.array(recalls), thresholds
 
 
+def latency_quantile(samples_ms, q: float) -> float:
+    """Nearest-rank quantile over latency samples (host-side, ms).
+
+    Nearest-rank (not interpolated) so the reported p99 is a latency some
+    request actually experienced — the convention serving dashboards use.
+    Empty samples report 0.0.
+    """
+    xs = np.sort(np.asarray(samples_ms, np.float64))
+    if xs.size == 0:
+        return 0.0
+    rank = min(int(np.ceil(q * xs.size)) - 1, xs.size - 1)
+    return float(xs[max(rank, 0)])
+
+
+class ServingStats:
+    """Host-side accumulator for the serving layer (deepdfa_tpu/serve).
+
+    The serving siblings of :class:`BinaryStats`: counters and sums that
+    fold across micro-batches, snapshotted into the ``/metrics`` endpoint
+    and the bench report. Everything here is plain Python/numpy — these
+    numbers are assembled from values that already crossed to the host
+    (response assembly), never from in-flight device buffers, so updating
+    them adds no device sync.
+
+    Latencies keep a bounded ring of the most recent ``latency_window``
+    samples; p50/p99 are over that window (a serving dashboard's rolling
+    quantile, bounded memory under sustained traffic).
+
+    Thread-safe: every mutation is a read-modify-write invoked from many
+    transport threads (submit) plus the pump thread (completion), so a
+    lock serializes them — without it, concurrent bumps lose increments
+    and /metrics drifts.
+    """
+
+    COUNTERS = (
+        "submitted", "completed", "rejected", "oversized", "cache_hits",
+        "cache_misses", "degraded", "batches", "compiles",
+    )
+
+    def __init__(self, latency_window: int = 8192):
+        import threading
+
+        self._lock = threading.Lock()
+        for name in self.COUNTERS:
+            setattr(self, name, 0)
+        self.occupancy_used = 0   # real requests over all flushed batches
+        self.occupancy_slots = 0  # padded slots over all flushed batches
+        self._latency_window = latency_window
+        self._latencies_ms = np.zeros(latency_window, np.float64)
+        self._latency_count = 0  # total ever observed (ring write cursor)
+
+    def bump(self, counter: str, by: int = 1) -> None:
+        if counter not in self.COUNTERS:
+            raise ValueError(f"unknown serving counter {counter!r}")
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + by)
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies_ms[
+                self._latency_count % self._latency_window
+            ] = seconds * 1000.0
+            self._latency_count += 1
+
+    def record_batch(self, n_real: int, n_slots: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.occupancy_used += n_real
+            self.occupancy_slots += n_slots
+
+    @property
+    def latencies_ms(self) -> np.ndarray:
+        with self._lock:
+            n = min(self._latency_count, self._latency_window)
+            return self._latencies_ms[:n].copy()
+
+    @property
+    def occupancy(self) -> float:
+        return (self.occupancy_used / self.occupancy_slots
+                if self.occupancy_slots else 0.0)
+
+    @property
+    def cache_hit_rate(self) -> float:
+        looked = self.cache_hits + self.cache_misses
+        return self.cache_hits / looked if looked else 0.0
+
+    def snapshot(self, queue_depth: int = 0) -> Dict[str, float]:
+        """One JSON-able dict: the /metrics endpoint body and the bench
+        record."""
+        out: Dict[str, float] = {name: getattr(self, name)
+                                 for name in self.COUNTERS}
+        lat = self.latencies_ms
+        out.update(
+            queue_depth=queue_depth,
+            batch_occupancy=self.occupancy,
+            cache_hit_rate=self.cache_hit_rate,
+            latency_p50_ms=latency_quantile(lat, 0.50),
+            latency_p99_ms=latency_quantile(lat, 0.99),
+            latency_samples=int(lat.size),
+        )
+        return out
+
+
 def classification_report_dict(
     probs: np.ndarray, labels: np.ndarray, threshold: float = 0.5
 ) -> Dict[str, Dict[str, float]]:

@@ -109,15 +109,33 @@ def graph_label_from_nodes(batch: GraphBatch, impl: str = "auto") -> jnp.ndarray
     return jnp.where(member, vuln[None, :], 0.0).max(axis=1)
 
 
-# Bucket ladder for padding budgets: powers of two limit recompilation.
-_BUCKETS = [2 ** i for i in range(4, 22)]
+# Bucket ladder top for padding budgets: beyond it, sizes stay exact (a
+# pow2 round-up at tens of millions of slots doubles memory for nothing).
+_BUCKET_TOP = 2 ** 21
 
 
-def _bucket(n: int) -> int:
-    for b in _BUCKETS:
-        if n <= b:
-            return b
-    return n
+def select_bucket(n: int, maximum: Optional[int] = None,
+                  minimum: int = 16) -> int:
+    """Round ``n`` up to the padding-bucket ladder (powers of two from
+    ``minimum``).
+
+    THE bucket-rounding rule, shared by training batching
+    (:func:`pad_budget_for`, ladder base 16) and the serving micro-batcher
+    (``deepdfa_tpu.serve``, slot ladder base 1) — one rule means one
+    bounded set of compiled shapes across both paths. ``maximum`` caps the
+    result (a serving slot count never exceeds the configured batch);
+    ``n`` beyond the cap or the ladder top comes back unrounded so callers
+    fail on their budget checks instead of silently over-allocating.
+    """
+    n = max(int(n), 1)
+    if maximum is not None and n >= maximum:
+        return max(n, maximum)
+    if n > _BUCKET_TOP:
+        return n
+    b = minimum
+    while b < n:
+        b *= 2
+    return b if maximum is None else min(b, maximum)
 
 
 def pad_budget_for(
@@ -137,8 +155,8 @@ def pad_budget_for(
         max_edges = max(max_edges, edges)
     return {
         "n_graphs": n_graphs,
-        "max_nodes": _bucket(max(max_nodes, 1)),
-        "max_edges": _bucket(max(max_edges, 1)),
+        "max_nodes": select_bucket(max(max_nodes, 1)),
+        "max_edges": select_bucket(max(max_edges, 1)),
     }
 
 

@@ -1,0 +1,79 @@
+"""Inference-only apply paths for the serving layer.
+
+Training steps (train/loop.py, train/text_loop.py) carry labels, masks,
+loss, and metric stats through the jitted program; serving wants the
+smallest possible program per bucket shape — params + padded batch in,
+per-slot probabilities out. These factories are that program. They are the
+functions the serve engine AOT-compiles once per bucket at startup
+(``deepdfa_tpu/serve/engine.py``), so anything added here is paid again at
+every warm bucket shape.
+
+Correctness contract: on the same (padded) inputs, ``make_gnn_infer`` must
+reproduce the probabilities of the offline eval path
+(``make_eval_step`` -> sigmoid) and ``make_combined_infer`` those of
+``make_text_eval_step`` — pinned by tests/test_serve.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.models.linevul import LineVul
+
+
+def make_gnn_infer(model: FlowGNN) -> Callable:
+    """(params, GraphBatch) -> float32 probs per graph slot.
+
+    ``label_style`` must be "graph" (one logit per graph slot); padded
+    slots produce garbage probabilities that callers drop via
+    ``batch.graph_mask`` — the same masking contract as evaluate().
+    """
+    if model.config.label_style != "graph":
+        raise ValueError(
+            f"serving scores functions (label_style='graph'), got "
+            f"{model.config.label_style!r}"
+        )
+
+    def infer(params, batch: GraphBatch) -> jnp.ndarray:
+        return jax.nn.sigmoid(model.apply(params, batch))
+
+    return infer
+
+
+def make_combined_infer(model: LineVul) -> Callable:
+    """(params, input_ids, GraphBatch) -> float32 P(vulnerable) per row.
+
+    The DeepDFA+LineVul combined forward (text row i joined with graph
+    slot i), deterministic (no dropout) — the probability column of
+    make_text_eval_step without loss/labels.
+    """
+    if model.graph_config is None:
+        raise ValueError("combined inference needs LineVul(graph_config=...)")
+
+    def infer(params, input_ids: jnp.ndarray, graphs: GraphBatch) -> jnp.ndarray:
+        logits = model.apply(params, input_ids, graphs, deterministic=True)
+        return jax.nn.softmax(logits, axis=-1)[:, 1]
+
+    return infer
+
+
+def make_text_infer(model: LineVul) -> Callable:
+    """(params, input_ids) -> float32 P(vulnerable) per row — the pure
+    LineVul path (no graph encoder), for text-only deployments."""
+    if model.graph_config is not None:
+        raise ValueError(
+            "model has a graph encoder; use make_combined_infer (its "
+            "params include the flowgnn subtree, which a text-only apply "
+            "would silently skip)"
+        )
+
+    def infer(params, input_ids: jnp.ndarray) -> jnp.ndarray:
+        logits = model.apply(params, input_ids, None, deterministic=True)
+        return jax.nn.softmax(logits, axis=-1)[:, 1]
+
+    return infer

@@ -1,0 +1,46 @@
+"""TPU-native batched inference serving.
+
+The path from a checkpoint to answering scoring requests: a bounded
+request queue feeding a deadline-aware micro-batcher that packs incoming
+functions into the same padded graph/token bucket shapes training uses
+(graphs/batch.py's ladder via ``select_bucket``), an engine that
+AOT-compiles every bucket shape at startup so steady-state traffic
+triggers zero recompiles, a content-hash result cache (duplicate
+submissions are the common case in CI-scan traffic), explicit
+backpressure (429-style rejection with retry-after), and graceful
+degradation (combined DDFA+LineVul falls back to GNN-only when the
+tokenizer path errors).
+
+Layout:
+  config.py   ServeConfig: slots/budgets/deadlines/capacities + buckets
+  cache.py    content_hash + ResultCache (LRU)
+  batcher.py  ServeRequest + MicroBatcher (admission, flush policy)
+  engine.py   ServeEngine: warmup, submit, pump, drain, score_sync
+  http.py     stdlib http.server JSON endpoint (cli.py serve)
+  replay.py   seeded bursty traces + virtual-clock replay (bench, tests)
+
+Design anchors: Just-in-Time Dynamic-Batching (arXiv:1904.07421) for the
+deadline-aware flush policy; Fast Training of Sparse GNNs on Dense
+Hardware (arXiv:1906.11786) for keeping padded static shapes end to end.
+"""
+
+from deepdfa_tpu.serve.batcher import (
+    MicroBatcher,
+    OversizedError,
+    RejectedError,
+    ServeRequest,
+)
+from deepdfa_tpu.serve.cache import ResultCache, content_hash
+from deepdfa_tpu.serve.config import ServeConfig
+from deepdfa_tpu.serve.engine import ServeEngine
+
+__all__ = [
+    "MicroBatcher",
+    "OversizedError",
+    "RejectedError",
+    "ResultCache",
+    "ServeConfig",
+    "ServeEngine",
+    "ServeRequest",
+    "content_hash",
+]

@@ -1,0 +1,178 @@
+"""Request queue + deadline-aware micro-batcher.
+
+The serving half of the shape-bucketing problem ``graphs/batch.py``
+solves for training: requests accumulate per lane (model path) and flush
+as one padded micro-batch when either
+
+  * the lane holds a full ``batch_slots`` bucket (fill-flush — maximum
+    occupancy, no reason to wait), or
+  * the oldest request has spent ``flush_fraction`` of its deadline
+    budget waiting (deadline-flush — the other half of the budget is
+    reserved for compute + response assembly).
+
+This is the Just-in-Time Dynamic-Batching policy (arXiv:1904.07421)
+specialized to a two-condition trigger. When several lanes are due at
+once, the lane whose oldest request has the least remaining budget
+flushes first — the SLA, not throughput, breaks ties.
+
+Backpressure is explicit: admissions beyond ``queue_capacity`` raise
+:class:`RejectedError` carrying a retry-after hint (the HTTP layer maps
+it to 429 + Retry-After), and single graphs that could never fit a slot
+raise :class:`OversizedError` (413) instead of poisoning a bucket.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Deque, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepdfa_tpu.serve.config import ServeConfig
+
+
+class RejectedError(Exception):
+    """Queue full — retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"serving queue full; retry after {retry_after_s:.3f}s"
+        )
+
+
+class OversizedError(Exception):
+    """Request exceeds the per-slot graph budget (no bucket could hold it)."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One function to score, plus its result plumbing.
+
+    ``event`` lets a transport thread block until the pump thread (or a
+    cache hit) calls :meth:`finish`; single-threaded drivers (replay,
+    offline scoring) just read ``result`` after draining.
+    """
+
+    rid: int
+    key: str                      # content hash (cache line)
+    graph: Mapping
+    lane: str                     # "gnn" | "combined"
+    arrival: float                # engine-clock seconds
+    deadline_s: float
+    input_ids: Optional[np.ndarray] = None   # combined lane only
+    degraded: bool = False        # tokenizer failed -> gnn fallback
+    result: Optional[Dict] = None
+    event: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False
+    )
+
+    def finish(self, result: Dict) -> None:
+        self.result = result
+        self.event.set()
+
+    def flush_at(self, fraction: float) -> float:
+        """Clock time at which this request forces a deadline-flush."""
+        return self.arrival + fraction * self.deadline_s
+
+
+class MicroBatcher:
+    """Per-lane FIFO queues with the two-condition flush policy.
+
+    Thread-safe: admission (transport threads) and due/take (the pump
+    thread) serialize on one lock. Time never comes from the wall here —
+    callers pass ``now`` from the engine's clock, which is virtual in
+    replay/bench and monotonic in live serving.
+    """
+
+    def __init__(self, config: ServeConfig, lanes: Sequence[str] = ("gnn",)):
+        self.config = config
+        self._pending: Dict[str, Deque[ServeRequest]] = {
+            lane: collections.deque() for lane in lanes
+        }
+        self._lock = threading.Lock()
+
+    @property
+    def lanes(self) -> Tuple[str, ...]:
+        return tuple(self._pending)
+
+    def depth(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._pending.values())
+
+    def admit(self, req: ServeRequest) -> None:
+        """Enqueue or raise (RejectedError / OversizedError).
+
+        The per-request size caps make bucket budgets exact (any
+        ``batch_slots`` admitted graphs fit the top bucket), so this is
+        the only size check in the serving path.
+        """
+        n = int(req.graph["num_nodes"])
+        e = len(req.graph["senders"]) + n  # + self loops, as batching adds
+        reason = self.config.admission_caps(n, e)
+        if reason is not None:
+            raise OversizedError(reason)
+        with self._lock:
+            if req.lane not in self._pending:
+                raise ValueError(f"unknown lane {req.lane!r}")
+            if sum(len(q) for q in self._pending.values()) \
+                    >= self.config.queue_capacity:
+                # Retry once the current flush window has passed: by then
+                # at least one bucket has drained.
+                raise RejectedError(
+                    self.config.flush_fraction * self.config.deadline_ms
+                    / 1000.0
+                )
+            self._pending[req.lane].append(req)
+
+    def due(self, now: float) -> Optional[str]:
+        """The lane to flush at ``now``, or None.
+
+        Fill-due and deadline-due lanes compete; the request with the
+        least remaining deadline budget wins (deadline-flush vs
+        fill-flush ordering is by urgency, not arrival of the condition).
+        Deadline scans cover the WHOLE queue, not just the head:
+        ``deadline_ms`` is per-request public API, so a short-deadline
+        request behind a long-deadline head must still force its flush
+        (flushes drain FIFO, so the head rides along).
+        """
+        with self._lock:
+            best: Optional[Tuple[float, str]] = None
+            for lane, q in self._pending.items():
+                if not q:
+                    continue
+                filled = len(q) >= self.config.batch_slots
+                deadline_due = now >= min(
+                    r.flush_at(self.config.flush_fraction) for r in q
+                )
+                if not (filled or deadline_due):
+                    continue
+                remaining = min(r.arrival + r.deadline_s for r in q) - now
+                if best is None or remaining < best[0]:
+                    best = (remaining, lane)
+            return best[1] if best else None
+
+    def next_flush_time(self, now: float) -> Optional[float]:
+        """Earliest clock time any lane becomes due (<= now when one
+        already is) — the pump scheduler's sleep horizon."""
+        with self._lock:
+            t: Optional[float] = None
+            for q in self._pending.values():
+                if not q:
+                    continue
+                when = (now if len(q) >= self.config.batch_slots
+                        else min(r.flush_at(self.config.flush_fraction)
+                                 for r in q))
+                if t is None or when < t:
+                    t = when
+            return t
+
+    def take(self, lane: str) -> List[ServeRequest]:
+        """Pop the lane's next micro-batch (FIFO, up to ``batch_slots``)."""
+        with self._lock:
+            q = self._pending[lane]
+            out = [q.popleft() for _ in range(min(len(q),
+                                                  self.config.batch_slots))]
+            return out

@@ -1,0 +1,107 @@
+"""Serving configuration: bucket shapes, flush policy, capacities.
+
+The bucket contract is the whole design: every shape the jitted inference
+program can see is derivable from this config alone, so the engine can
+AOT-compile all of them at startup and steady-state traffic never
+recompiles. Slot counts round up the power-of-two ladder
+(``graphs.batch.select_bucket`` — the same rounding rule training
+batching uses), node/edge budgets scale per slot exactly like
+``DataConfig.max_nodes``/``max_edges``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from deepdfa_tpu.graphs.batch import select_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    # Micro-batch geometry. Per-request caps (one graph per request) make
+    # the bucket budgets exact: any `s` admitted requests fit the s-slot
+    # bucket by construction, so admission is the only size check.
+    batch_slots: int = 16            # largest micro-batch (slot-ladder top)
+    max_nodes_per_graph: int = 64    # admission cap, as DataConfig
+    max_edges_per_node: int = 4      # admission cap (incl. self loops)
+
+    # Flush policy: a lane flushes when it holds ``batch_slots`` requests
+    # (fill-flush) OR when the oldest request has spent ``flush_fraction``
+    # of its deadline budget waiting (deadline-flush) — half-spent by
+    # default, leaving the other half for compute + response assembly.
+    deadline_ms: float = 100.0
+    flush_fraction: float = 0.5
+
+    # Backpressure: pending requests beyond ``queue_capacity`` are
+    # rejected with a retry-after hint instead of growing latency
+    # unboundedly.
+    queue_capacity: int = 256
+
+    # Content-hash result cache entries (0 disables caching).
+    cache_capacity: int = 4096
+
+    # Combined-lane text geometry (must match the checkpoint's block_size).
+    block_size: int = 512
+
+    # Rolling latency-quantile window (core.metrics.ServingStats).
+    latency_window: int = 8192
+
+    # Pinned block-band width for message_impl="band" models: serving must
+    # fix it up front (a per-batch bucketed width would mint new compiled
+    # shapes at runtime). 1 covers any packing of <=128-node graphs
+    # (every edge stays within one 128-tile of the diagonal).
+    band_bandwidth: int = 1
+
+    def __post_init__(self):
+        if self.batch_slots < 1:
+            raise ValueError("batch_slots must be >= 1")
+        if not 0.0 < self.flush_fraction <= 1.0:
+            raise ValueError("flush_fraction must be in (0, 1]")
+        if self.queue_capacity < self.batch_slots:
+            raise ValueError(
+                "queue_capacity below batch_slots could never fill a bucket"
+            )
+
+    @property
+    def slot_buckets(self) -> List[int]:
+        """Every micro-batch slot count the engine may emit (ascending)."""
+        out: List[int] = []
+        s = 1
+        while s < self.batch_slots:
+            out.append(s)
+            s *= 2
+        out.append(self.batch_slots)
+        return out
+
+    def bucket_for(self, n_requests: int) -> int:
+        return select_bucket(n_requests, maximum=self.batch_slots, minimum=1)
+
+    def budget_for(self, slots: int,
+                   tile: Optional[int] = None) -> Dict[str, int]:
+        """Padded node/edge budgets of the ``slots``-slot bucket.
+
+        ``tile``: align the node budget up to a tile multiple (the
+        band/tile adjacency layouts require it).
+        """
+        max_nodes = select_bucket(slots * self.max_nodes_per_graph)
+        if tile:
+            max_nodes = -(-max_nodes // tile) * tile
+        return {
+            "n_graphs": slots,
+            "max_nodes": max_nodes,
+            "max_edges": select_bucket(max_nodes * self.max_edges_per_node),
+        }
+
+    def admission_caps(self, num_nodes: int, num_edges: int) -> Optional[str]:
+        """None when a graph fits a single slot; else the rejection reason.
+
+        ``num_edges`` counts self loops (batching adds one per node).
+        """
+        if num_nodes > self.max_nodes_per_graph:
+            return (f"graph has {num_nodes} nodes > per-request cap "
+                    f"{self.max_nodes_per_graph}")
+        if num_edges > num_nodes * self.max_edges_per_node:
+            return (f"graph has {num_edges} edges (incl. self loops) > "
+                    f"per-request cap {num_nodes * self.max_edges_per_node}")
+        return None

@@ -1,0 +1,408 @@
+"""The serving engine: bucket-warmed AOT inference over micro-batches.
+
+Owns the three serving invariants:
+
+  * **Zero steady-state recompiles.** Every shape the jitted inference
+    programs can see is ``(lane, slot-bucket)`` — derivable from
+    ServeConfig alone — and :meth:`ServeEngine.warmup` AOT-compiles all
+    of them at startup. ``ServingStats.compiles`` counts every compile;
+    after warmup it must not move (the acceptance gate in
+    tests/test_serve.py and bench.py).
+  * **Content-addressed caching.** Duplicate submissions (the CI-scan
+    common case) are answered from the LRU without touching the queue.
+  * **Graceful degradation.** A combined-lane request whose tokenizer
+    path errors falls back to the GNN-only lane, flagged ``degraded`` in
+    its response, instead of failing the request.
+
+Time comes from an injected ``clock`` callable (monotonic seconds): live
+serving passes ``time.monotonic``, replay/bench/tests pass a virtual
+clock — nothing in the engine reads the wall directly, which is what
+makes the bench trace deterministic.
+
+Host-sync discipline (graftlint GL004): each micro-batch's probabilities
+cross to the host once, via one ``np.asarray`` at response assembly;
+per-request ``float()`` reads index that numpy array, never a device
+buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.core.config import subkeys_for
+from deepdfa_tpu.core.metrics import ServingStats
+from deepdfa_tpu.graphs.batch import batch_graphs
+from deepdfa_tpu.models.infer import make_combined_infer, make_gnn_infer
+from deepdfa_tpu.serve.batcher import (
+    MicroBatcher,
+    OversizedError,
+    RejectedError,
+    ServeRequest,
+)
+from deepdfa_tpu.serve.cache import ResultCache, content_hash
+from deepdfa_tpu.serve.config import ServeConfig
+
+logger = logging.getLogger(__name__)
+
+
+class BadRequestError(Exception):
+    """Malformed scoring payload (missing subkeys, out-of-range edges)."""
+
+
+@dataclasses.dataclass
+class _Lane:
+    name: str
+    infer: Callable
+    params: Any
+    subkeys: Sequence[str]
+    band: bool  # message_impl == "band": banded adjacency, tile-aligned
+
+
+def bucket_batch(config: ServeConfig, graphs: Sequence[Mapping], slots: int,
+                 subkeys: Sequence[str], band: bool = False):
+    """Pack ``graphs`` into the ``slots``-slot serving bucket shape.
+
+    THE bucket-shape constructor: warmup examples, live micro-batches,
+    and smoke-mode init batches all come through here, so a shape
+    mismatch between warmup and steady state cannot exist by
+    construction.
+    """
+    from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE
+
+    budget = config.budget_for(slots, tile=DEFAULT_TILE if band else None)
+    return batch_graphs(
+        graphs, slots, budget["max_nodes"], budget["max_edges"], subkeys,
+        build_band_adj=band,
+        band_bandwidth=config.band_bandwidth if band else None,
+    )
+
+
+def random_gnn_params(model, config: ServeConfig, seed: int = 0):
+    """Random-init FlowGNN params shaped for this serving config — smoke
+    and bench mode (the serving stack is real, the scores are not)."""
+    empty = bucket_batch(
+        config, [], 1, subkeys_for(model.config.feature),
+        band=model.config.message_impl == "band",
+    )
+    return model.init(jax.random.PRNGKey(seed), empty)
+
+
+class ServeEngine:
+    """Checkpoint-to-responses inference engine.
+
+    ``gnn_model``/``gnn_params``: a standalone FlowGNN classifier
+    (label_style "graph") — always present; it is both the graph-only
+    scoring path and the degradation target. ``combined_model``/
+    ``combined_params`` (+ ``tokenizer``): the DeepDFA+LineVul lane for
+    requests that carry source code.
+
+    Threading: ``submit`` may run on many transport threads;
+    ``pump``/``drain`` must run on exactly one (the pump thread or the
+    driving loop). The batcher and cache carry the locks.
+    """
+
+    def __init__(
+        self,
+        gnn_model,
+        gnn_params,
+        config: Optional[ServeConfig] = None,
+        combined_model=None,
+        combined_params=None,
+        tokenizer=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or ServeConfig()
+        self.stats = ServingStats(self.config.latency_window)
+        self.cache = ResultCache(self.config.cache_capacity)
+        self._clock = clock
+        self._rid = itertools.count()
+        self._compiled: Dict[Tuple[str, int], Any] = {}
+
+        self._lanes: Dict[str, _Lane] = {
+            "gnn": self._make_lane("gnn", make_gnn_infer(gnn_model),
+                                   gnn_params, gnn_model.config),
+        }
+        self.tokenizer = tokenizer
+        if combined_model is not None:
+            if tokenizer is None:
+                raise ValueError("combined serving needs a tokenizer")
+            self._lanes["combined"] = self._make_lane(
+                "combined", make_combined_infer(combined_model),
+                combined_params, combined_model.graph_config,
+            )
+        self.batcher = MicroBatcher(self.config, lanes=tuple(self._lanes))
+
+    @staticmethod
+    def _make_lane(name, infer, params, graph_cfg) -> _Lane:
+        if graph_cfg.message_impl not in ("segment", "band"):
+            raise ValueError(
+                f"serving supports message_impl 'segment' or 'band' (pinned "
+                f"bandwidth), got {graph_cfg.message_impl!r} — per-batch "
+                "adjacency budgets would mint new compiled shapes at runtime"
+            )
+        return _Lane(name, infer, params, subkeys_for(graph_cfg.feature),
+                     band=graph_cfg.message_impl == "band")
+
+    def now(self) -> float:
+        return self._clock()
+
+    # -- bucket shapes -----------------------------------------------------
+
+    @property
+    def n_warm(self) -> int:
+        """Compiled (lane, slot-bucket) executables currently held."""
+        return len(self._compiled)
+
+    def warm_buckets(self) -> List[Tuple[str, int]]:
+        return [(lane, slots) for lane in self._lanes
+                for slots in self.config.slot_buckets]
+
+    def warmup(self) -> int:
+        """AOT-compile every (lane, slot-bucket) shape; returns the count.
+
+        After this returns, a trace whose every micro-batch fits
+        ``batch_slots`` runs with zero new compiles.
+        """
+        before = self.stats.compiles
+        for lane, slots in self.warm_buckets():
+            self._executable(lane, slots)
+        return self.stats.compiles - before
+
+    def _executable(self, lane: str, slots: int):
+        key = (lane, slots)
+        exe = self._compiled.get(key)
+        if exe is None:
+            exe = self._compile(lane, slots)
+            self._compiled[key] = exe
+        return exe
+
+    def _compile(self, lane_name: str, slots: int):
+        lane = self._lanes[lane_name]
+        t0 = time.perf_counter()
+        empty = self._graph_batch(lane, [], slots)
+        if lane_name == "combined":
+            ids = jnp.zeros((slots, self.config.block_size), jnp.int32)
+            lowered = jax.jit(lane.infer).lower(lane.params, ids, empty)
+        else:
+            lowered = jax.jit(lane.infer).lower(lane.params, empty)
+        exe = lowered.compile()
+        self.stats.bump("compiles")
+        logger.info("compiled %s bucket slots=%d in %.2fs", lane_name, slots,
+                    time.perf_counter() - t0)
+        return exe
+
+    def _graph_batch(self, lane: _Lane, graphs: Sequence[Mapping],
+                     slots: int):
+        return bucket_batch(self.config, graphs, slots, lane.subkeys,
+                            band=lane.band)
+
+    # -- admission ---------------------------------------------------------
+
+    def _normalize_graph(self, graph: Mapping) -> Dict:
+        """Validate + canonicalize one request graph (raises
+        BadRequestError on malformed payloads — the HTTP 400 class, kept
+        distinct from capacity rejections)."""
+        try:
+            n = int(graph["num_nodes"])
+            senders = np.asarray(graph["senders"], np.int32)
+            receivers = np.asarray(graph["receivers"], np.int32)
+            feats = {k: np.asarray(v, np.int32)
+                     for k, v in graph["feats"].items()}
+        except (KeyError, TypeError, ValueError) as e:
+            raise BadRequestError(f"malformed graph payload: {e}")
+        if n < 1:
+            raise BadRequestError("graph needs at least one node")
+        if senders.shape != receivers.shape or senders.ndim != 1:
+            raise BadRequestError("senders/receivers must be equal-length 1-d")
+        if len(senders) and (senders.min() < 0 or receivers.min() < 0
+                             or senders.max() >= n or receivers.max() >= n):
+            raise BadRequestError("edge endpoint out of range")
+        union = set()
+        for lane in self._lanes.values():
+            union.update(lane.subkeys)
+        for key in union:
+            if key not in feats:
+                raise BadRequestError(f"missing feature subkey {key!r}")
+            if feats[key].shape != (n,):
+                raise BadRequestError(
+                    f"feats[{key!r}] must have shape ({n},)"
+                )
+        out = {"num_nodes": n, "senders": senders, "receivers": receivers,
+               "feats": feats,
+               "vuln": np.zeros(n, np.int32)}  # labels don't exist at serve
+        if "id" in graph:
+            out["id"] = int(graph["id"])
+        return out
+
+    def submit(self, graph: Mapping, code: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        """Admit one scoring request; returns its ServeRequest handle.
+
+        Cache hits complete immediately (result set, event signalled);
+        misses enqueue for the next micro-batch. Raises BadRequestError /
+        OversizedError / RejectedError — the transport maps them to
+        400 / 413 / 429.
+        """
+        now = self._clock()
+        self.stats.bump("submitted")
+        norm = self._normalize_graph(graph)
+
+        lane, input_ids, degraded = "gnn", None, False
+        if code is not None and "combined" in self._lanes:
+            try:
+                from deepdfa_tpu.data.text import encode_function
+
+                input_ids = encode_function(code, self.tokenizer,
+                                            self.config.block_size)
+                lane = "combined"
+            except Exception:
+                # Tokenizer path down for this payload: degrade to the
+                # graph-only lane rather than failing the request.
+                logger.warning("tokenizer failed; degrading to gnn lane",
+                               exc_info=True)
+                degraded = True
+                self.stats.bump("degraded")
+
+        key = content_hash(norm, code if lane == "combined" else None)
+        req = ServeRequest(
+            rid=next(self._rid), key=key, graph=norm, lane=lane,
+            arrival=now,
+            deadline_s=(deadline_ms if deadline_ms is not None
+                        else self.config.deadline_ms) / 1000.0,
+            input_ids=input_ids, degraded=degraded,
+        )
+        cached = self.cache.get(key)
+        if cached is not None:
+            self.stats.bump("cache_hits")
+            self.stats.bump("completed")
+            self.stats.observe_latency(0.0)
+            req.finish(dict(cached, rid=req.rid, cached=True,
+                            degraded=req.degraded))
+            return req
+        try:
+            self.batcher.admit(req)
+        except OversizedError:
+            self.stats.bump("oversized")
+            raise
+        except RejectedError:
+            self.stats.bump("rejected")
+            raise
+        # Counted only for ADMITTED requests: a rejected submission that
+        # gets retried must not inflate the miss count (cache_hit_rate
+        # feeds the bench report).
+        self.stats.bump("cache_misses")
+        return req
+
+    # -- execution ---------------------------------------------------------
+
+    def pump(self) -> int:
+        """Flush every lane currently due; returns micro-batches run."""
+        n = 0
+        while True:
+            lane = self.batcher.due(self._clock())
+            if lane is None:
+                return n
+            reqs = self.batcher.take(lane)
+            if reqs:
+                self._run_batch(lane, reqs)
+                n += 1
+
+    def drain(self) -> int:
+        """Flush everything pending regardless of deadlines (offline
+        scoring, shutdown)."""
+        n = 0
+        while self.batcher.depth():
+            for lane in self.batcher.lanes:
+                reqs = self.batcher.take(lane)
+                if reqs:
+                    self._run_batch(lane, reqs)
+                    n += 1
+        return n
+
+    def pending(self) -> int:
+        return self.batcher.depth()
+
+    def next_flush_time(self) -> Optional[float]:
+        return self.batcher.next_flush_time(self._clock())
+
+    def _run_batch(self, lane_name: str, reqs: List[ServeRequest]) -> None:
+        lane = self._lanes[lane_name]
+        slots = self.config.bucket_for(len(reqs))
+        exe = self._executable(lane_name, slots)
+        w0 = time.perf_counter()
+        gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
+        if lane_name == "combined":
+            pad_id = int(self.tokenizer.pad_token_id)
+            ids = np.full((slots, self.config.block_size), pad_id, np.int32)
+            for i, r in enumerate(reqs):
+                ids[i] = r.input_ids
+            probs = exe(lane.params, jnp.asarray(ids), gb)
+        else:
+            probs = exe(lane.params, gb)
+        # One host transfer per micro-batch; everything after this indexes
+        # numpy (GL004: per-request reads must not ride on device buffers).
+        p = np.asarray(probs)
+        # Virtual clocks (replay/bench) expose advance(): credit them with
+        # this batch's measured wall time so recorded latencies include
+        # compute, not just queueing. Live monotonic clocks tick on their
+        # own.
+        advance = getattr(self._clock, "advance", None)
+        if advance is not None:
+            advance(time.perf_counter() - w0)
+        done = self._clock()
+        self.stats.record_batch(len(reqs), slots)
+        for i, r in enumerate(reqs):
+            # The cache line holds only content-derived values; "degraded"
+            # describes THIS request's handling (its tokenizer failure),
+            # not the content, so it must never ride a shared cache entry.
+            value = {"prob": float(p[i]), "model": lane_name}
+            self.cache.put(r.key, value)
+            r.finish(dict(value, rid=r.rid, cached=False,
+                          degraded=r.degraded))
+            self.stats.bump("completed")
+            self.stats.observe_latency(done - r.arrival)
+
+    # -- offline client ----------------------------------------------------
+
+    def score_sync(self, graphs: Sequence[Mapping],
+                   codes: Optional[Sequence[Optional[str]]] = None,
+                   ) -> List[Dict]:
+        """Score a list of functions through the full serving path
+        (cache + batcher + bucketed execution), returning results in
+        submission order — the ``cli.py score`` engine.
+
+        Backpressure is absorbed, not surfaced: a rejected submit drains
+        the queue and retries (an offline client has nowhere to shed load
+        to). Per-function admission failures (oversize graph, malformed
+        payload) come back as inline ``{"error", "detail"}`` entries — one
+        bad dataset row must not abort the other N thousand.
+        """
+        out: List[Optional[ServeRequest]] = []
+        errors: Dict[int, Dict] = {}
+        for i, graph in enumerate(graphs):
+            code = codes[i] if codes is not None else None
+            try:
+                out.append(self.submit(graph, code=code))
+            except RejectedError:
+                self.drain()
+                out.append(self.submit(graph, code=code))
+            except OversizedError as e:
+                errors[i] = {"error": "oversized", "detail": str(e)}
+                out.append(None)
+            except BadRequestError as e:
+                errors[i] = {"error": "bad_request", "detail": str(e)}
+                out.append(None)
+        self.drain()
+        return [errors[i] if r is None else r.result
+                for i, r in enumerate(out)]
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.stats.snapshot(queue_depth=self.batcher.depth())

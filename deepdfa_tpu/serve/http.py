@@ -1,0 +1,197 @@
+"""stdlib JSON endpoint over the serve engine (no new dependencies).
+
+Contract (documented in README "Serving"):
+
+  POST /score
+      {"functions": [{"id"?, "graph": {"num_nodes", "senders",
+       "receivers", "feats": {subkey: [...]}}, "code"?}, ...],
+       "deadline_ms"?}
+      -> 200 {"results": [{"rid", "prob", "model", "degraded", "cached"}
+              | {"error", ...}, ...]}   (per-function errors inline)
+      -> 429 {"error": "rejected", "retry_after_s"} + Retry-After header
+         when EVERY function was shed by backpressure
+      -> 400 {"error": "bad_request", "detail"} on malformed payloads
+  GET /metrics   -> ServingStats snapshot (queue depth, occupancy,
+                    p50/p99 latency, cache hit rate, compile count)
+  GET /healthz   -> {"status": "ok", "warm_buckets": N}
+
+Transport threads (ThreadingHTTPServer, one per connection) submit into
+the engine and block on each request's event; a single pump thread owns
+execution, waking on the batcher's next flush horizon. This split keeps
+the engine's one-pump-thread contract while the stdlib server fans out
+connections.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
+from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
+
+logger = logging.getLogger(__name__)
+
+# Pump idle sleep bounds: short enough that a fresh first request in an
+# empty queue waits at most ~2 ms before its flush window starts being
+# tracked, long enough to not spin.
+_PUMP_MIN_SLEEP_S = 0.002
+_PUMP_MAX_SLEEP_S = 0.050
+
+
+class _PumpThread(threading.Thread):
+    def __init__(self, engine: ServeEngine):
+        super().__init__(name="serve-pump", daemon=True)
+        self.engine = engine
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self.engine.pump()
+            except Exception:
+                logger.exception("pump failed")
+            horizon = self.engine.next_flush_time()
+            if horizon is None:
+                sleep = _PUMP_MAX_SLEEP_S
+            else:
+                sleep = min(max(horizon - self.engine.now(),
+                                _PUMP_MIN_SLEEP_S), _PUMP_MAX_SLEEP_S)
+            self._halt.wait(sleep)
+        # Shutdown: answer whatever is still queued.
+        try:
+            self.engine.drain()
+        except Exception:
+            logger.exception("drain on shutdown failed")
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    server: "ServeHTTPServer"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send_json(self, code: int, payload: Dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        engine = self.server.engine
+        if self.path == "/healthz":
+            self._send_json(200, {
+                "status": "ok",
+                "warm_buckets": engine.n_warm,
+            })
+        elif self.path == "/metrics":
+            self._send_json(200, engine.snapshot())
+        else:
+            self._send_json(404, {"error": "not_found"})
+
+    def do_POST(self) -> None:
+        if self.path != "/score":
+            self._send_json(404, {"error": "not_found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            functions = doc["functions"]
+            if not isinstance(functions, list) or not functions:
+                raise ValueError("'functions' must be a non-empty list")
+            deadline_ms = doc.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if not deadline_ms > 0:
+                    raise ValueError("deadline_ms must be > 0")
+        except Exception as e:
+            self._send_json(400, {"error": "bad_request", "detail": str(e)})
+            return
+        engine = self.server.engine
+        submitted, results = [], []
+        for fn in functions:
+            entry: Dict = {}
+            try:
+                req = engine.submit(fn["graph"], code=fn.get("code"),
+                                    deadline_ms=deadline_ms)
+                submitted.append((req, entry))
+            except RejectedError as e:
+                entry.update(error="rejected",
+                             retry_after_s=e.retry_after_s)
+            except OversizedError as e:
+                entry.update(error="oversized", detail=str(e))
+            except BadRequestError as e:
+                entry.update(error="bad_request", detail=str(e))
+            except KeyError as e:
+                entry.update(error="bad_request",
+                             detail=f"missing field {e}")
+            except (TypeError, AttributeError) as e:
+                # e.g. a null or string where a function object belongs —
+                # the inline-error contract covers malformed entries too.
+                entry.update(error="bad_request", detail=str(e))
+            results.append(entry)
+
+        if not submitted and all(r.get("error") == "rejected"
+                                 for r in results):
+            retry = max(r["retry_after_s"] for r in results)
+            # Header per RFC 7231: integer delay-seconds (urllib3 et al.
+            # int() it); the JSON body keeps the precise float.
+            self._send_json(429, {"error": "rejected",
+                                  "retry_after_s": retry},
+                            headers={"Retry-After":
+                                     str(max(int(-(-retry // 1)), 1))})
+            return
+
+        # Block until the pump thread answers each admitted request; the
+        # timeout is generous (deadline covers queueing + compute, and a
+        # stuck pump must surface as an error, not a hang).
+        wait_s = ((deadline_ms or engine.config.deadline_ms) / 1000.0) * 10 \
+            + 30.0
+        for req, entry in submitted:
+            if req.event.wait(timeout=wait_s) and req.result is not None:
+                entry.update(req.result)
+            else:
+                entry.update(error="timeout")
+        self._send_json(200, {"results": results})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, address: Tuple[str, int], engine: ServeEngine):
+        super().__init__(address, ServeHandler)
+        self.engine = engine
+        self.pump_thread = _PumpThread(engine)
+
+    def start_pump(self) -> None:
+        self.pump_thread.start()
+
+    def shutdown(self) -> None:  # type: ignore[override]
+        self.pump_thread.stop()
+        super().shutdown()
+        self.pump_thread.join(timeout=10.0)
+
+
+def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
+                  port: int = 8080) -> None:
+    """Blocking entry: warm the buckets, start the pump, serve."""
+    server = ServeHTTPServer((host, port), engine)
+    server.start_pump()
+    logger.info("serving on %s:%d (%d warm buckets)", host,
+                server.server_address[1], engine.n_warm)
+    try:
+        server.serve_forever()
+    finally:
+        server.shutdown()

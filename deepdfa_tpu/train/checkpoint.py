@@ -74,6 +74,37 @@ class CheckpointManager:
         path = os.path.join(self.directory, name)
         return self._ckpt.restore(path, target=jax.device_get(target))
 
+    def restore_params(self, name: str = "best") -> Any:
+        """Restore just the model variables of a saved state — the
+        inference path (serve engine, offline scoring).
+
+        Target-free restore, so no optimizer tree has to be reconstructed
+        (its structure varies with freeze flags and schedules and does not
+        exist at serve time). Works on both checkpoint layouts: trainer
+        states (``TrainState``/``TextTrainState`` — params under the
+        ``params`` key) and the params-only dicts ``cmd_fit_text`` writes.
+        Returns the apply-ready variables dict (``{"params": ...}``).
+        """
+        path = os.path.join(self.directory, name)
+        if not self.has(name):
+            raise FileNotFoundError(
+                f"no checkpoint {name!r} under {self.directory}"
+            )
+        restored = self._ckpt.restore(path)
+        if isinstance(restored, dict):
+            inner = restored.get("params")
+            if isinstance(inner, dict) and "params" in inner:
+                # Trainer state (step/params/opt_state) or the
+                # {"params": state.params} wrapper: unwrap one level.
+                return inner
+            if inner is not None:
+                # Already the apply-ready variables dict.
+                return restored
+        raise ValueError(
+            f"checkpoint {path} holds no recognizable variables dict "
+            "(expected a trainer state or a {{'params': ...}} tree)"
+        )
+
     @property
     def best_meta(self) -> dict:
         return dict(self._meta)

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Serving endpoint (deepdfa_tpu/serve): deadline-aware bucketed
+# micro-batching over AOT-warmed shapes, content-hash caching, 429
+# backpressure — the checkpoint-to-responses path.
+#
+#   CHECKPOINT_DIR=runs/deepdfa bash scripts/serve.sh        # serve a run
+#   COMBINED_DIR=runs/combined bash scripts/serve.sh          # + text lane
+#   bash scripts/serve.sh --smoke 8                           # self-test
+#
+# Extra flags pass through to `cli serve` (--port, --batch-slots,
+# --deadline-ms, --queue-capacity, --cache-capacity, ...).
+set -e
+cd "$(dirname "$0")/.."
+ARGS=()
+if [ -n "${CHECKPOINT_DIR:-}" ]; then
+  ARGS+=(--checkpoint-dir "$CHECKPOINT_DIR")
+fi
+if [ -n "${COMBINED_DIR:-}" ]; then
+  ARGS+=(--combined-checkpoint-dir "$COMBINED_DIR")
+fi
+python -m deepdfa_tpu.cli serve --config configs/default.yaml \
+  "${ARGS[@]}" "$@"

@@ -5,5 +5,13 @@ cd "$(dirname "$0")/.."
 # Static-analysis gate first: an eval run on code with a fresh TPU hazard
 # (graftlint finding) should fail in seconds, not after the checkpoint load.
 bash scripts/lint.sh
+# Serving smoke: the full HTTP stack (bucket warmup -> micro-batcher ->
+# content cache) self-driven with synthetic requests on a tiny random-init
+# model — seconds, and it fails before the slow eval does. Checkpoint env
+# vars are cleared: the smoke's tiny --set shapes must not try to load the
+# eval checkpoint below.
+CHECKPOINT_DIR= COMBINED_DIR= bash scripts/serve.sh --smoke 8 \
+  --batch-slots 4 --port 0 \
+  --set model.hidden_dim=8 --set model.n_steps=2
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

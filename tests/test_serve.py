@@ -1,0 +1,539 @@
+"""Serving layer (deepdfa_tpu/serve): flush policy, occupancy accounting,
+content cache, backpressure, degradation, and the replay acceptance gate
+(zero post-warmup compiles + offline-path correctness).
+
+Engines are module-scoped (warmup compiles are the cost center here), so
+stat assertions are deltas and each test leaves its engine drained.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig, TrainConfig
+from deepdfa_tpu.core.metrics import ServingStats, latency_quantile
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.graphs.batch import pad_budget_for, select_bucket
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.serve import (
+    MicroBatcher,
+    OversizedError,
+    RejectedError,
+    ResultCache,
+    ServeConfig,
+    ServeEngine,
+    ServeRequest,
+    content_hash,
+)
+from deepdfa_tpu.serve.engine import BadRequestError, random_gnn_params
+from deepdfa_tpu.serve.replay import VirtualClock, bursty_trace, replay
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+def graphs_n(n, seed=0):
+    return synthetic_bigvul(n, FEAT, positive_fraction=0.5, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def eng4():
+    """Shared warmed engine: 4 slots, capacity-4 queue, capacity-2 cache.
+
+    Tests assert stat DELTAS and leave the queue drained.
+    """
+    clock = VirtualClock()
+    config = ServeConfig(batch_slots=4, deadline_ms=100.0,
+                         queue_capacity=4, cache_capacity=2)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config, clock=clock)
+    eng.warmup()
+    return eng, clock
+
+
+@pytest.fixture(scope="module")
+def combined_eng():
+    """Shared warmed combined engine (2 slots) with a tokenizer that
+    fails on payloads containing BOOM."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.data.text import HashingCodeTokenizer
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.serve.engine import bucket_batch
+
+    class FailingTokenizer(HashingCodeTokenizer):
+        def tokenize(self, text):
+            if "BOOM" in text:
+                raise RuntimeError("tokenizer down")
+            return super().tokenize(text)
+
+    enc = dataclasses.replace(EncoderConfig.tiny(),
+                              max_position_embeddings=70)
+    config = ServeConfig(batch_slots=2, block_size=32)
+    gnn = FlowGNN(TINY)
+    gnn_params = random_gnn_params(gnn, config)
+    comb = LineVul(enc, graph_config=dataclasses.replace(
+        TINY, encoder_mode=True))
+    empty = bucket_batch(config, [], 2,
+                         ("api", "datatype", "literal", "operator"))
+    comb_params = comb.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((2, 32), jnp.int32), empty, deterministic=True,
+    )
+    clock = VirtualClock()
+    eng = ServeEngine(gnn, gnn_params, config=config, combined_model=comb,
+                      combined_params=comb_params,
+                      tokenizer=FailingTokenizer(enc.vocab_size),
+                      clock=clock)
+    warmed = eng.warmup()
+    return eng, clock, warmed
+
+
+# ---------------------------------------------------------------------------
+# select_bucket (the shared rounding rule)
+# ---------------------------------------------------------------------------
+
+
+def test_select_bucket_ladder():
+    assert select_bucket(1) == 16          # training ladder base
+    assert select_bucket(40) == 64
+    assert select_bucket(64) == 64
+    assert select_bucket(65) == 128
+    # serving slot ladder: base 1, capped at the batch
+    assert select_bucket(1, maximum=16, minimum=1) == 1
+    assert select_bucket(3, maximum=16, minimum=1) == 4
+    assert select_bucket(16, maximum=16, minimum=1) == 16
+    # beyond the cap: unrounded, so budget checks fail loudly downstream
+    assert select_bucket(20, maximum=16, minimum=1) == 20
+
+
+def test_pad_budget_uses_ladder():
+    graphs = graphs_n(8)
+    budget = pad_budget_for(graphs, 8)
+    assert budget["max_nodes"] == select_bucket(budget["max_nodes"])
+    assert budget["max_edges"] == select_bucket(budget["max_edges"])
+
+
+# ---------------------------------------------------------------------------
+# Flush policy (batcher alone — no model, no engine)
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, lane="gnn", arrival=0.0, deadline_s=0.1, n=4):
+    graph = {"num_nodes": n, "senders": np.zeros(1, np.int32),
+             "receivers": np.ones(1, np.int32), "feats": {}}
+    return ServeRequest(rid=rid, key=f"k{rid}", graph=graph, lane=lane,
+                        arrival=arrival, deadline_s=deadline_s)
+
+
+def test_fill_flush_fires_immediately():
+    b = MicroBatcher(ServeConfig(batch_slots=4, queue_capacity=16))
+    for i in range(3):
+        b.admit(_req(i))
+    assert b.due(now=0.0) is None  # partial, deadline budget untouched
+    b.admit(_req(3))
+    assert b.due(now=0.0) == "gnn"  # full: flush now, no deadline wait
+    taken = b.take("gnn")
+    assert [r.rid for r in taken] == [0, 1, 2, 3]  # FIFO
+    assert b.due(now=0.0) is None
+
+
+def test_deadline_flush_at_half_budget():
+    b = MicroBatcher(ServeConfig(batch_slots=4, queue_capacity=16))
+    b.admit(_req(0, arrival=0.0, deadline_s=0.1))
+    assert b.due(now=0.049) is None             # budget < half spent
+    assert b.next_flush_time(now=0.0) == pytest.approx(0.05)
+    assert b.due(now=0.05) == "gnn"             # half spent: flush
+    assert [r.rid for r in b.take("gnn")] == [0]
+
+
+def test_flush_ordering_deadline_beats_fill():
+    """A deadline-due partial bucket outranks a merely-full fresh one:
+    urgency (least remaining budget) orders flushes, not arrival of the
+    flush condition."""
+    b = MicroBatcher(ServeConfig(batch_slots=2, queue_capacity=16),
+                     lanes=("gnn", "combined"))
+    # Old partial on gnn: due at t=0.05, deadline at 0.1.
+    b.admit(_req(0, lane="gnn", arrival=0.0, deadline_s=0.1))
+    # Fresh full bucket on combined: fill-due immediately, deadline 0.16.
+    b.admit(_req(1, lane="combined", arrival=0.06, deadline_s=0.1))
+    b.admit(_req(2, lane="combined", arrival=0.06, deadline_s=0.1))
+    assert b.due(now=0.06) == "gnn"       # remaining 0.04 < 0.10
+    b.take("gnn")
+    assert b.due(now=0.06) == "combined"  # then the full bucket
+
+
+def test_deadline_flush_scans_whole_queue():
+    """deadline_ms is per-request API: a short-deadline request behind a
+    long-deadline head must still force the flush at ITS half-budget
+    (the head rides along FIFO)."""
+    b = MicroBatcher(ServeConfig(batch_slots=16, queue_capacity=32))
+    b.admit(_req(0, arrival=0.0, deadline_s=10.0))   # long-deadline head
+    b.admit(_req(1, arrival=0.01, deadline_s=0.1))   # short, behind it
+    assert b.due(now=0.02) is None
+    assert b.next_flush_time(now=0.02) == pytest.approx(0.06)
+    assert b.due(now=0.061) == "gnn"
+    assert [r.rid for r in b.take("gnn")] == [0, 1]
+
+
+def test_take_caps_at_batch_slots():
+    b = MicroBatcher(ServeConfig(batch_slots=2, queue_capacity=16))
+    for i in range(5):
+        b.admit(_req(i))
+    assert len(b.take("gnn")) == 2
+    assert b.depth() == 3
+
+
+# ---------------------------------------------------------------------------
+# Occupancy accounting
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_occupancy_accounting(eng4):
+    eng, clock = eng4
+    used0, slots0 = eng.stats.occupancy_used, eng.stats.occupancy_slots
+    gs = graphs_n(7, seed=11)
+    # 3 requests, deadline-flushed: bucket 4 slots, 3 used.
+    for g in gs[:3]:
+        eng.submit(g)
+    clock.advance(0.06)
+    assert eng.pump() == 1
+    assert eng.stats.occupancy_used - used0 == 3
+    assert eng.stats.occupancy_slots - slots0 == 4
+    # A full (distinct-content) bucket on top: +4 used / +4 slots.
+    for g in gs[3:]:
+        eng.submit(g)
+    assert eng.pump() == 1
+    assert eng.stats.occupancy_used - used0 == 7
+    assert eng.stats.occupancy_slots - slots0 == 8
+
+
+def test_single_request_uses_one_slot_bucket(eng4):
+    eng, clock = eng4
+    slots0 = eng.stats.occupancy_slots
+    eng.submit(graphs_n(1, seed=12)[0])
+    clock.advance(1.0)
+    eng.pump()
+    assert eng.stats.occupancy_slots - slots0 == 1  # bucket_for(1) == 1
+
+
+# ---------------------------------------------------------------------------
+# Content cache
+# ---------------------------------------------------------------------------
+
+
+def test_content_hash_ignores_dtype_and_labels():
+    g = graphs_n(1)[0]
+    as_lists = {"num_nodes": int(g["num_nodes"]),
+                "senders": np.asarray(g["senders"]).tolist(),
+                "receivers": np.asarray(g["receivers"]).tolist(),
+                "feats": {k: np.asarray(v).tolist()
+                          for k, v in g["feats"].items()}}
+    assert content_hash(g) == content_hash(as_lists)
+    assert content_hash(g) != content_hash(g, code="int f();")
+
+
+def test_cache_hit_miss_and_eviction(eng4):
+    eng, clock = eng4
+    g1, g2, g3 = graphs_n(3, seed=13)
+
+    r1 = eng.submit(g1)
+    eng.drain()
+    assert r1.result is not None and not r1.result["cached"]
+    batches_before = eng.stats.batches
+    hits_before = eng.stats.cache_hits
+
+    # Hit: identical content completes without touching the queue.
+    r1b = eng.submit(g1)
+    assert r1b.result is not None and r1b.result["cached"]
+    assert r1b.result["prob"] == r1.result["prob"]
+    assert eng.stats.batches == batches_before
+    assert eng.stats.cache_hits == hits_before + 1
+
+    # Fill the capacity-2 LRU with g2, g3 -> g1 evicted -> miss again.
+    eng.submit(g2)
+    eng.submit(g3)
+    eng.drain()
+    hits_mid = eng.stats.cache_hits
+    r1c = eng.submit(g1)
+    assert r1c.result is None  # queued, not answered from cache
+    eng.drain()
+    assert eng.stats.cache_hits == hits_mid
+    assert r1c.result["prob"] == pytest.approx(r1.result["prob"], abs=1e-6)
+
+
+def test_result_cache_lru_order():
+    c = ResultCache(capacity=2)
+    c.put("a", {"prob": 1})
+    c.put("b", {"prob": 2})
+    assert c.get("a") is not None  # refresh a
+    c.put("c", {"prob": 3})       # evicts b (LRU), not a
+    assert c.get("b") is None
+    assert c.get("a") is not None and c.get("c") is not None
+
+
+# ---------------------------------------------------------------------------
+# Backpressure + admission
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_rejection_with_retry_after(eng4):
+    eng, clock = eng4
+    rejected0 = eng.stats.rejected
+    gs = graphs_n(5, seed=14)
+    for g in gs[:4]:
+        eng.submit(g)
+    with pytest.raises(RejectedError) as e:
+        eng.submit(gs[4])
+    assert e.value.retry_after_s > 0
+    assert eng.stats.rejected - rejected0 == 1
+    eng.pump()  # full bucket drains
+    eng.submit(gs[4])  # now admitted
+    eng.drain()
+
+
+def test_oversized_graph_rejected(eng4):
+    eng, clock = eng4
+    oversized0 = eng.stats.oversized
+    n = eng.config.max_nodes_per_graph + 1
+    g = dict(graphs_n(1)[0])
+    g["num_nodes"] = n
+    g["senders"] = np.zeros(0, np.int32)
+    g["receivers"] = np.zeros(0, np.int32)
+    g["feats"] = {k: np.ones(n, np.int64) for k in g["feats"]}
+    with pytest.raises(OversizedError):
+        eng.submit(g)
+    assert eng.stats.oversized - oversized0 == 1
+
+
+def test_bad_request_rejected(eng4):
+    eng, clock = eng4
+    g = dict(graphs_n(1)[0])
+    g["senders"] = np.asarray([999], np.int32)  # endpoint out of range
+    g["receivers"] = np.asarray([0], np.int32)
+    with pytest.raises(BadRequestError):
+        eng.submit(g)
+    missing = dict(graphs_n(1)[0])
+    missing["feats"] = {}
+    with pytest.raises(BadRequestError):
+        eng.submit(missing)
+
+
+# ---------------------------------------------------------------------------
+# Degradation (combined -> GNN-only when the tokenizer path errors)
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_warmup_covered_both_lanes(combined_eng):
+    eng, clock, warmed = combined_eng
+    assert warmed == len(eng.warm_buckets()) == 4  # 2 lanes x buckets {1,2}
+    assert eng.warmup() == 0  # idempotent: nothing recompiles
+
+
+def test_degradation_to_gnn_lane(combined_eng):
+    eng, clock, _ = combined_eng
+    degraded0 = eng.stats.degraded
+    g = graphs_n(2, seed=15)
+    ok = eng.submit(g[0], code="int f(int a) { return a; }")
+    broken = eng.submit(g[1], code="BOOM")
+    graph_only = eng.submit(g[1])
+    eng.drain()
+    assert ok.result["model"] == "combined" and not ok.result["degraded"]
+    assert broken.result["model"] == "gnn" and broken.result["degraded"]
+    assert eng.stats.degraded - degraded0 == 1
+    # The degraded score IS the gnn-lane score of the same graph (it also
+    # shares its cache line with the graph-only submission).
+    assert broken.result["prob"] == pytest.approx(graph_only.result["prob"],
+                                                  abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Replay acceptance: zero post-warmup compiles, occupancy, offline parity
+# ---------------------------------------------------------------------------
+
+
+def test_replay_trace_is_deterministic():
+    a = bursty_trace(50, FEAT, seed=3)
+    b = bursty_trace(50, FEAT, seed=3)
+    assert [e.at for e in a] == [e.at for e in b]
+    assert [int(e.graph["id"]) for e in a] == [int(e.graph["id"]) for e in b]
+    assert [e.at for e in bursty_trace(50, FEAT, seed=4)] != [e.at for e in a]
+
+
+def test_replay_200_requests_matches_offline_eval():
+    """The acceptance gate: a 200-request synthetic trace after warmup
+    completes with zero new XLA compiles, >=50% batch occupancy, and
+    every response equal to the offline cmd_test path (make_eval_step's
+    probability output) on the same inputs."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.core.config import subkeys_for
+    from deepdfa_tpu.graphs.batch import batch_graphs
+    from deepdfa_tpu.train.loop import TrainState, make_eval_step
+
+    clock = VirtualClock()
+    config = ServeConfig(batch_slots=8, deadline_ms=100.0)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+    eng = ServeEngine(model, params, config=config, clock=clock)
+    warmed = eng.warmup()
+
+    trace = bursty_trace(200, FEAT, seed=1)
+    out = replay(eng, trace, clock)
+    m = out["metrics"]
+
+    assert m["compiles"] == warmed, "steady-state traffic recompiled"
+    assert m["completed"] == 200 and m["dropped"] == 0
+    assert m["batch_occupancy"] >= 0.5
+    assert m["cache_hit_rate"] > 0  # the duplicate fraction hit
+    assert all(r.result is not None for r in out["requests"])
+
+    # Offline reference: the cmd_test eval step over the same graphs.
+    eval_step = jax.jit(make_eval_step(model, TrainConfig()))
+    state = TrainState(jnp.zeros((), jnp.int32), params, None)
+    by_id = {}
+    for r in out["requests"]:
+        by_id[int(r.graph["id"])] = r.result["prob"]
+    gs = [e.graph for e in trace]
+    budget = pad_budget_for(gs, 16)
+    subkeys = subkeys_for(FEAT)
+    for start in range(0, len(gs), 16):
+        chunk = gs[start:start + 16]
+        batch = batch_graphs(chunk, 16, budget["max_nodes"],
+                             budget["max_edges"], subkeys)
+        _, probs, _, _ = eval_step(state, batch)
+        p = np.asarray(probs)
+        for i, g in enumerate(chunk):
+            assert by_id[int(g["id"])] == pytest.approx(float(p[i]),
+                                                        abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint restore-for-inference
+# ---------------------------------------------------------------------------
+
+
+def test_restore_params_roundtrip(tmp_path):
+    from deepdfa_tpu.models.infer import make_gnn_infer
+    from deepdfa_tpu.serve.engine import bucket_batch
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    config = ServeConfig(batch_slots=2)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config, seed=7)
+    ckpt = CheckpointManager(str(tmp_path / "run"))
+    ckpt.save_best({"params": params}, epoch=0)
+
+    restored = CheckpointManager(str(tmp_path / "run")).restore_params("best")
+    clock = VirtualClock()
+    eng = ServeEngine(model, restored, config=config, clock=clock)
+    eng.warmup()
+    g = graphs_n(1, seed=9)[0]
+    got = eng.score_sync([g])[0]["prob"]
+
+    # Reference: direct jitted inference on the original (unsaved) params.
+    infer = jax.jit(make_gnn_infer(model))
+    from deepdfa_tpu.core.config import subkeys_for
+
+    batch = bucket_batch(config, [eng._normalize_graph(g)], 1,
+                         subkeys_for(FEAT))
+    ref = float(np.asarray(infer(params, batch))[0])
+    assert got == pytest.approx(ref, abs=1e-6)
+
+
+def test_restore_params_missing_checkpoint(tmp_path):
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    with pytest.raises(FileNotFoundError):
+        CheckpointManager(str(tmp_path / "empty")).restore_params("best")
+
+
+# ---------------------------------------------------------------------------
+# ServingStats
+# ---------------------------------------------------------------------------
+
+
+def test_latency_quantile_nearest_rank():
+    xs = [1.0, 2.0, 3.0, 4.0]
+    assert latency_quantile(xs, 0.5) == 2.0
+    assert latency_quantile(xs, 0.99) == 4.0
+    assert latency_quantile([], 0.99) == 0.0
+
+
+def test_serving_stats_window_and_snapshot():
+    s = ServingStats(latency_window=4)
+    for ms in (1, 2, 3, 4, 100):  # 1 falls out of the window
+        s.observe_latency(ms / 1000.0)
+    assert len(s.latencies_ms) == 4
+    snap = s.snapshot(queue_depth=3)
+    assert snap["queue_depth"] == 3
+    assert snap["latency_p99_ms"] == pytest.approx(100.0)
+    with pytest.raises(ValueError):
+        s.bump("nonexistent")
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (stdlib server, real clock, loopback) — reuses eng4's
+# compiled buckets? No: the HTTP engine runs a real monotonic clock, so
+# it builds its own (2-bucket) engine.
+# ---------------------------------------------------------------------------
+
+
+def test_http_score_metrics_and_cache():
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, deadline_ms=40.0)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config), config=config)
+    eng.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    try:
+        gs = graphs_n(3, seed=5)
+        payload = [{"graph": {
+            "num_nodes": int(g["num_nodes"]),
+            "senders": np.asarray(g["senders"]).tolist(),
+            "receivers": np.asarray(g["receivers"]).tolist(),
+            "feats": {k: np.asarray(v).tolist()
+                      for k, v in g["feats"].items()},
+        }} for g in gs]
+        out = post({"functions": payload})
+        assert len(out["results"]) == 3
+        assert all(0.0 <= r["prob"] <= 1.0 for r in out["results"])
+        # Re-scan: all served from the content cache.
+        again = post({"functions": payload})
+        assert all(r["cached"] for r in again["results"])
+        # Malformed function -> inline 400-class error, not a dropped conn.
+        bad = post({"functions": [{"graph": {"num_nodes": 2}}]})
+        assert bad["results"][0]["error"] == "bad_request"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["completed"] >= 3
+        assert metrics["cache_hits"] >= 3
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["warm_buckets"] == 2
+    finally:
+        server.shutdown()
