@@ -37,6 +37,13 @@ Rule catalogue (each backed by a positive+negative fixture in
                              int (``range``, shape arguments) inside jit
                              scope — needs ``static_argnums`` or a host-side
                              value.
+  GL009 swallowed-device-exception  a bare ``except:`` / ``except
+                             Exception:`` that neither re-raises nor logs,
+                             wrapped around jit'd or device calls — TPU
+                             faults (preemption, XLA OOM, device errors)
+                             vanish inside it, exactly the signals the
+                             resilience layer (checkpoint fallback, retry,
+                             rollback) needs to see.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -71,6 +78,7 @@ RULES: Dict[str, str] = {
     "GL006": "jit-in-loop",
     "GL007": "key-reuse",
     "GL008": "nonstatic-python-scalar",
+    "GL009": "swallowed-device-exception",
 }
 
 _JIT_NAMES = frozenset({
@@ -100,6 +108,20 @@ _IMPURE_CALLS = frozenset({
 _IMPURE_PREFIXES = ("numpy.random.", "random.")
 _KEY_PRODUCERS = frozenset({
     "PRNGKey", "key", "wrap_key_data", "key_data", "key_impl", "clone",
+})
+_BROAD_EXC = frozenset({
+    "Exception", "BaseException", "builtins.Exception",
+    "builtins.BaseException",
+})
+# A call through any of these counts as "the handler tells someone":
+# logger-style attribute calls, stdlib warning/printing, traceback dumps.
+_LOG_ATTRS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+})
+_LOG_CALLS = frozenset({
+    "print", "warnings.warn", "traceback.print_exc",
+    "traceback.print_exception", "traceback.format_exc",
 })
 
 
@@ -298,6 +320,7 @@ class _FunctionChecker:
             self._check_step_loops()
         self._check_jit_in_loop()
         self._check_key_reuse()
+        self._check_swallowed_exceptions()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -532,6 +555,89 @@ class _FunctionChecker:
                 f"{len(distinct)} jax.random consumers (lines "
                 f"{', '.join(map(str, lines))}) — reused keys give "
                 "identical streams; jax.random.split per consumer")
+
+
+    # -- swallowed device exceptions (GL009) ---------------------------------
+
+    def _is_broad_handler(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:  # bare except
+            return True
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        return any(self.mod.resolve(t) in _BROAD_EXC for t in types)
+
+    def _handler_swallows(self, handler: ast.ExceptHandler) -> bool:
+        """No re-raise and no logging anywhere in the handler body
+        (nested defs excluded: a deferred function is not this handler's
+        error path)."""
+        for sub in _walk_skip_defs(handler.body):
+            if isinstance(sub, ast.Raise):
+                return False
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = self.mod.resolve(sub.func)
+            if dotted is not None and (
+                    dotted in _LOG_CALLS or dotted.startswith("logging.")):
+                return False
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _LOG_ATTRS):
+                return False
+        return True
+
+    def _try_has_device_call(self, body: List[ast.stmt]) -> bool:
+        """Does the guarded block dispatch jit'd or device work? jax.*
+        calls (jnp resolves through the alias table), module-level
+        jit-wrapped defs, and step-shaped calls (the make_*step protocol)
+        all count."""
+        for sub in _walk_skip_defs(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = self.mod.resolve(sub.func)
+            if dotted is not None and (dotted == "jax"
+                                       or dotted.startswith("jax.")):
+                return True
+            func = sub.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if name is not None and (name in self.mod.jit_wrapped
+                                     or _STEP_CALL_RE.match(name)):
+                return True
+        return False
+
+    def _check_swallowed_exceptions(self) -> None:
+        # Only Trys belonging directly to THIS function: nested defs carry
+        # their own checker pass.
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.Try):
+                continue
+            if not self._try_has_device_call(node.body):
+                continue
+            for handler in node.handlers:
+                if (self._is_broad_handler(handler)
+                        and self._handler_swallows(handler)):
+                    what = ("except:" if handler.type is None
+                            else "except Exception:")
+                    self._report(
+                        "GL009", handler,
+                        f"broad `{what}` swallows errors around jit'd/"
+                        "device calls (no re-raise, no logging) — TPU "
+                        "faults the resilience layer must see (preemption, "
+                        "XLA OOM, device errors) vanish here; log the "
+                        "exception or re-raise")
+
+
+def _walk_skip_defs(nodes):
+    """ast.walk over a statement list that does NOT descend into nested
+    function/class definitions (they are analyzed as their own scopes)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
 
 
 # ---------------------------------------------------------------------------

@@ -372,6 +372,7 @@ def cmd_test(args) -> Dict[str, Any]:
     state, _ = make_train_state(model, example_batch, train_cfg)
     ckpt = CheckpointManager(args.checkpoint_dir)
     state = ckpt.restore(args.which, state)
+    restored = ckpt.last_restored or {}
 
     # --n-devices: dp-shard the eval batches over a mesh, like fit.
     # Per-example outputs replicate, so metrics, prediction dumps, and
@@ -396,6 +397,17 @@ def cmd_test(args) -> Dict[str, Any]:
                    build_band_adj=use_band, with_dataflow=use_df,
                    host=host, mesh=mesh)
     report = {"loss": res.loss, **res.metrics}
+    if restored.get("fallback"):
+        # The requested snapshot was damaged and an older intact one was
+        # loaded: these metrics describe THAT model — a report silently
+        # labelled with --which would misattribute them.
+        report["restored_snapshot"] = restored["name"]
+        report["restored_fallback"] = True
+        logger.error(
+            "test: snapshot %r was damaged; metrics below are for the "
+            "fallback snapshot %r (epoch %s)", args.which,
+            restored["name"], restored.get("epoch"),
+        )
 
     if getattr(args, "profile", False) or getattr(args, "time", False):
         # run_profiling.sh parity: re-run the test batches under the
@@ -1108,6 +1120,33 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
     return report
 
 
+def cmd_chaos(args) -> Dict[str, Any]:
+    """Chaos soak (deepdfa_tpu/resilience): provoke five fault classes —
+    simulated preemption, NaN loss, checkpoint corruption, ETL item
+    failure, serving flush failure — against a tiny synthetic workload and
+    verify every recovery contract, including the bit-for-bit
+    kill-and-resume determinism gate. Exits nonzero on any miss.
+
+    (Custom fault plans don't belong here — the soak's scenarios arm
+    their own; arm ``DEEPDFA_FAULT_PLAN`` against a regular command
+    (``fit``, ``serve``, ...) to drive arbitrary fault sites by hand.)"""
+    from deepdfa_tpu.resilience import chaos
+
+    if args.epochs < 2:
+        # The preemption scenario kills epoch >= 1 and resumes; with one
+        # epoch it can never fire and the soak would report a missed
+        # recovery contract instead of the actual argument error.
+        raise ValueError("chaos: --epochs must be >= 2 (the preemption "
+                         "scenario interrupts a later epoch)")
+    n = 48
+    if args.dataset.startswith("synthetic") and ":" in args.dataset:
+        n = int(args.dataset.split(":")[1])
+    report = chaos.run_soak(out_dir=args.out_dir, n_examples=n,
+                            epochs=args.epochs)
+    print(json.dumps(report))
+    return report
+
+
 def cmd_tune(args) -> Dict[str, Any]:
     """Random hyperparameter search (the NNI replacement): samples the
     published search space (paper Table 2 context), runs short fits, ranks
@@ -1426,6 +1465,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_ac.add_argument("--verbose", action="store_true",
                       help="also list baselined findings")
     p_ac.set_defaults(func=cmd_analyze_code)
+
+    p_ch = sub.add_parser(
+        "chaos",
+        help="fault-injection soak: preemption/NaN/corruption/ETL/serving "
+             "faults against a tiny run, verifying every recovery contract "
+             "(resume determinism is bit-for-bit); nonzero exit on any miss")
+    p_ch.add_argument("--dataset", default="synthetic:48",
+                      help="synthetic:N — the soak's workload size")
+    p_ch.add_argument("--epochs", type=int, default=3,
+                      help="epochs per training scenario (>= 2)")
+    p_ch.add_argument("--out-dir", default="runs/chaos")
+    p_ch.set_defaults(func=cmd_chaos)
 
     p_tune = sub.add_parser("tune")
     common(p_tune)

@@ -189,6 +189,14 @@ class TrainConfig:
     # of config_default.yaml:40): synchronizes every step when on, so it
     # costs throughput — a debugging aid, not a production default.
     detect_anomaly: bool = False
+    # What a detected non-finite loss does. "raise" is the fail-fast parity
+    # path (FloatingPointError, today's behavior). "rollback" self-heals:
+    # restore the last good state, skip the offending batch window, keep
+    # training — at most ``anomaly_retry_budget`` times per fit before the
+    # run fails anyway (a persistently-diverging run must still die).
+    # "rollback" implies detection even with detect_anomaly=False.
+    anomaly_policy: str = "raise"
+    anomaly_retry_budget: int = 3
     # Optional TensorBoard event directory (MyTensorBoardLogger parity).
     tensorboard_dir: Optional[str] = None
 
@@ -210,6 +218,14 @@ class TransformerTrainConfig:
     grad_clip_norm: float = 1.0
     seed: int = 1
     early_stop_patience: Optional[int] = None  # CodeT5 uses patience on eval F1
+    # Non-finite-loss handling, mirroring TrainConfig: detection is one
+    # host check per epoch (the loss transfer already happens there), and
+    # "rollback" restores the epoch-start state and moves on — at most
+    # ``anomaly_retry_budget`` times per fit. Default keeps fail-fast
+    # parity ("raise" — and detection off unless opted in).
+    detect_anomaly: bool = False
+    anomaly_policy: str = "raise"
+    anomaly_retry_budget: int = 3
 
 
 def subkeys_for(spec: FeatureSpec) -> Sequence[str]:

@@ -141,7 +141,7 @@ class ServingStats:
 
     COUNTERS = (
         "submitted", "completed", "rejected", "oversized", "cache_hits",
-        "cache_misses", "degraded", "batches", "compiles",
+        "cache_misses", "degraded", "batches", "compiles", "failures",
     )
 
     def __init__(self, latency_window: int = 8192):

@@ -1,0 +1,125 @@
+"""Shared retry/backoff utility: jittered exponential backoff, a wall-clock
+deadline, and a *typed* giveup.
+
+The ETL layer (Joern REPLs, forked pool workers) and any future external
+dependency share one retry discipline instead of ad-hoc sleep loops:
+
+* exponential backoff with full jitter — retries from many workers
+  de-synchronize instead of stampeding a recovering dependency;
+* a deadline — a retry loop may never hold a multi-hour pipeline hostage;
+* ``giveup_on`` — errors that retrying cannot fix (bad input, missing
+  binary) re-raise immediately instead of burning the attempt budget;
+* :class:`GiveUp` — callers distinguish "retries exhausted" from the
+  underlying error type, with the last error chained as ``__cause__``.
+
+Determinism: pass a seeded ``rng`` (and a virtual ``sleep``/``clock``) to
+make backoff schedules replayable in tests and fault-plan soaks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+_RNG = random.Random()
+
+
+class GiveUp(Exception):
+    """Retries exhausted (attempts or deadline). The last underlying
+    exception is chained as ``__cause__`` and kept as ``.last``."""
+
+    def __init__(self, message: str, last: BaseException, attempts: int,
+                 elapsed_s: float):
+        super().__init__(message)
+        self.last = last
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` total calls (1 = no retry). Delay before retry k
+    (1-based) is ``base_delay_s * multiplier**(k-1)`` capped at
+    ``max_delay_s``, then jittered down to ``delay * (1 - jitter * u)``
+    with ``u ~ U[0, 1)`` (full-jitter style: never longer than the
+    deterministic schedule, so deadlines stay honest)."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 10.0
+    jitter: float = 0.5
+    deadline_s: Optional[float] = None
+    retry_on: Tuple[type, ...] = (Exception,)
+    giveup_on: Tuple[type, ...] = ()
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+
+
+def backoff_delays(policy: RetryPolicy,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """The jittered delay schedule (one entry per retry, i.e.
+    ``max_attempts - 1`` entries)."""
+    rng = rng or _RNG
+    delay = policy.base_delay_s
+    for _ in range(policy.max_attempts - 1):
+        capped = min(delay, policy.max_delay_s)
+        yield capped * (1.0 - policy.jitter * rng.random())
+        delay *= policy.multiplier
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    args: Tuple = (),
+    kwargs: Optional[dict] = None,
+    policy: RetryPolicy = RetryPolicy(),
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    rng: Optional[random.Random] = None,
+) -> Any:
+    """Call ``fn(*args, **kwargs)`` under ``policy``.
+
+    ``on_retry(attempt, exc, delay)`` runs before each sleep — the hook
+    where callers repair state (e.g. restart a crashed Joern session)
+    before the next attempt. Exceptions in ``giveup_on`` re-raise
+    untouched; exhausting attempts or the deadline raises :class:`GiveUp`
+    with the last error chained.
+    """
+    kwargs = kwargs or {}
+    start = clock()
+    delays = backoff_delays(policy, rng)
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.giveup_on:
+            raise
+        except policy.retry_on as exc:
+            last = exc
+            elapsed = clock() - start
+            delay = next(delays, None)
+            over_deadline = (
+                policy.deadline_s is not None
+                and delay is not None
+                and elapsed + delay > policy.deadline_s
+            )
+            if delay is None or over_deadline:
+                why = ("deadline exceeded" if over_deadline
+                       else "attempts exhausted")
+                raise GiveUp(
+                    f"{getattr(fn, '__name__', 'call')} failed after "
+                    f"{attempt} attempt(s) in {elapsed:.2f}s ({why}): "
+                    f"{type(exc).__name__}: {exc}",
+                    last=exc, attempts=attempt, elapsed_s=elapsed,
+                ) from exc
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

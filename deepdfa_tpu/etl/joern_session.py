@@ -14,16 +14,27 @@ write a line, read until the ``joern>`` prompt, strip ANSI escapes.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import shutil
 import subprocess
 import time
 from pathlib import Path
-from typing import List, Mapping, Optional
+from typing import Callable, List, Mapping, Optional
+
+from deepdfa_tpu.resilience import inject
+
+logger = logging.getLogger(__name__)
 
 _ANSI_RE = re.compile(r"\x1b\[[0-9;?]*[A-Za-z]|\x1b\][^\x07]*\x07|[\r\x00\x08]")
 PROMPT = "joern>"
+
+
+class JoernDiedError(RuntimeError):
+    """The Joern child exited (EOF on the pty) — distinct from a hang
+    (:class:`TimeoutError`), but both recover the same way: restart the
+    session and re-run the item."""
 
 
 def joern_available() -> bool:
@@ -92,8 +103,17 @@ class JoernSession:
                 continue
             try:
                 chunk = os.read(self._master, 65536)
-            except OSError:
-                break
+            except OSError as e:
+                raise JoernDiedError(
+                    f"joern pty read failed ({e}); the JVM likely died"
+                ) from e
+            if not chunk:
+                # EOF: the child exited. Failing immediately (instead of
+                # spinning until the read deadline) is what keeps a crashed
+                # JVM from stalling a whole ETL worker for timeout_s.
+                raise JoernDiedError(
+                    "joern exited mid-command (EOF on the REPL pty)"
+                )
             buf += chunk
             text = _ANSI_RE.sub("", buf.decode(errors="replace"))
             if text.rstrip().endswith(PROMPT):
@@ -101,6 +121,14 @@ class JoernSession:
         raise TimeoutError(f"joern prompt not seen within {self.timeout_s}s")
 
     def send(self, line: str) -> str:
+        # Fault hooks: `kill` murders the child JVM (the next read sees
+        # EOF -> JoernDiedError), `hang` raises the read deadline's
+        # TimeoutError directly — both drive the restart-and-retry path in
+        # extract_cpg_batch without a real Joern install.
+        for spec in inject.fire("joern.send"):
+            if spec.kind == "kill":
+                self._proc.kill()
+                self._proc.wait()
         os.write(self._master, (line + "\n").encode())
         out = self._read_until_prompt()
         # Strip the echoed command and the trailing prompt.
@@ -137,29 +165,80 @@ def extract_cpg_batch(
     out_dir: Path,
     worker_id: int = 0,
     failed_log: Optional[Path] = None,
+    session_factory: Optional[Callable[..., "JoernSession"]] = None,
+    attempts: int = 3,
 ) -> List[Path]:
     """Run Joern over a batch of single-function C files, exporting
     ``<name>.nodes.json``/``.edges.json`` next to each via
     ``scripts/export_cpg.sc`` (getgraphs.py:71-156 semantics: per-item fault
     tolerance, failures logged and skipped). ``worker_id`` keys the Joern
     workspace — concurrent sessions must not share one (the REPL writes
-    project metadata into its workspace directory)."""
-    if not joern_available():
+    project metadata into its workspace directory).
+
+    Session-death recovery: a read timeout (hung REPL) or a dead JVM
+    (:class:`JoernDiedError`) restarts the session and re-runs the item,
+    up to ``attempts`` tries per item under jittered backoff
+    (core/retry.py) — one wedged JVM must cost one restart, not the batch.
+    ``session_factory`` (tests) substitutes the real REPL.
+    """
+    from deepdfa_tpu.core.retry import GiveUp, RetryPolicy, retry_call
+
+    factory = session_factory or JoernSession
+    if session_factory is None and not joern_available():
         raise RuntimeError("joern binary not found on PATH")
     script = Path(__file__).parent / "scripts" / "export_cpg.sc"
     done: List[Path] = []
-    session = JoernSession(worker_id, out_dir / "ws")
+    holder = [factory(worker_id, out_dir / "ws")]
+    _SESSION_FATAL = (TimeoutError, JoernDiedError, OSError)
+
+    def new_session() -> None:
+        try:
+            holder[0].close()
+        except Exception:
+            logger.warning("joern worker %d: close of the dead session "
+                           "failed", worker_id, exc_info=True)
+        holder[0] = factory(worker_id, out_dir / "ws")
+
+    def restart(attempt: int, exc: BaseException, delay: float) -> None:
+        logger.warning(
+            "joern worker %d: %s: %s — restarting the session (attempt %d, "
+            "retrying in %.2fs)", worker_id, type(exc).__name__, exc,
+            attempt, delay,
+        )
+        new_session()
+
+    def run_item(path: Path) -> None:
+        holder[0].run_script(script, {"filename": str(Path(path).resolve())})
+        if not path.with_suffix(path.suffix + ".nodes.json").exists():
+            raise RuntimeError("export produced no nodes.json")
+
+    policy = RetryPolicy(
+        max_attempts=max(attempts, 1),
+        base_delay_s=0.1,
+        retry_on=(TimeoutError, JoernDiedError, OSError),
+    )
     try:
         for path in c_files:
             try:
-                session.run_script(script, {"filename": str(Path(path).resolve())})
-                if not path.with_suffix(path.suffix + ".nodes.json").exists():
-                    raise RuntimeError("export produced no nodes.json")
+                retry_call(run_item, (path,), policy=policy,
+                           on_retry=restart)
                 done.append(path)
-            except Exception as exc:  # per-item fault tolerance
+            except Exception as exc:  # per-item fault tolerance (incl. GiveUp)
+                logger.warning("joern worker %d: giving up on %s (%s)",
+                               worker_id, path, exc)
                 if failed_log:
                     with open(failed_log, "a") as f:
                         f.write(f"{path}\t{exc}\n")
+                # A give-up on a dead/hung session (retry_call only
+                # restarts BETWEEN attempts, so the final failure leaves
+                # the corpse in the holder — and attempts=1 never restarts
+                # at all) must not poison the next item's budget.
+                if isinstance(exc, _SESSION_FATAL) or (
+                        isinstance(exc, GiveUp)
+                        and isinstance(exc.last, _SESSION_FATAL)):
+                    logger.warning("joern worker %d: restarting the session "
+                                   "after a terminal failure", worker_id)
+                    new_session()
     finally:
-        session.close()
+        holder[0].close()
     return done

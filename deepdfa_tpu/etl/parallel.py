@@ -1,4 +1,4 @@
-"""Process-parallel ETL map with per-item fault tolerance.
+"""Process-parallel ETL map with per-item fault tolerance and requeue.
 
 Parity with the reference's ``dfmp`` (DDFA/sastvd/__init__.py:198-244:
 multiprocessing Pool map over dataframe rows, 6 workers default, tqdm
@@ -6,6 +6,20 @@ progress, ordered results) and its ETL failure posture (SURVEY §5: every
 per-function step catches, logs, and continues — failures land in
 ``failed_joern.txt``-style sidecar files rather than aborting a multi-hour
 preprocessing run).
+
+On top of that, the resilience contract (ISSUE 3):
+
+* **Per-item attempt cap.** An item whose ``fn`` raises is requeued and
+  retried up to ``attempts`` total tries before its slot becomes ``None``
+  — transient faults (a flaky external tool, an injected chaos fault)
+  self-heal instead of punching holes in the dataset.
+* **Crashed-worker requeue.** If the pool itself dies (a worker segfaults
+  or is OOM-killed, which tears down ``Pool.map`` entirely), the
+  unfinished items are requeued into *isolated* single-item subprocesses
+  with a timeout, so one poison item can neither kill the parent nor
+  take the rest of the batch down with it.
+* **Fault hook.** ``inject`` site ``etl.item`` (index = item position)
+  lets fault plans fail or kill specific work items deterministically.
 """
 
 from __future__ import annotations
@@ -14,11 +28,19 @@ import logging
 import multiprocessing as mp
 import os
 import threading
-from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from deepdfa_tpu.resilience import inject
 
 logger = logging.getLogger(__name__)
 
 _SENTINEL_ERROR = "__pmap_error__"
+
+# Timeout for the isolated requeue path only (the pool path keeps the
+# reference's no-timeout semantics): a poison item that hangs its isolated
+# subprocess is killed and recorded as failed.
+ISOLATED_TIMEOUT_S = 300.0
 
 # The mapped function travels to fork()ed workers by memory inheritance,
 # not pickling — so closures and lambdas work (the reference's dfmp
@@ -30,11 +52,53 @@ _ACTIVE_FN: Optional[Callable] = None
 _ACTIVE_LOCK = threading.RLock()
 
 
-def _call(item):
+def _call(indexed: Tuple[int, Any]):
+    idx, item = indexed
     try:
+        inject.fire("etl.item", index=idx)
         return _ACTIVE_FN(item)
     except Exception as e:  # per-item fault tolerance: record, don't abort
         return (_SENTINEL_ERROR, repr(item)[:200], f"{type(e).__name__}: {e}")
+
+
+def _isolated_entry(indexed: Tuple[int, Any], queue) -> None:
+    queue.put(_call(indexed))
+
+
+def _run_isolated(indexed: Tuple[int, Any],
+                  timeout_s: float = ISOLATED_TIMEOUT_S):
+    """One item in its own fork()ed process: survives segfaults and hangs.
+    Returns the item result or an error sentinel."""
+    ctx = mp.get_context("fork")
+    queue = ctx.SimpleQueue()
+    proc = ctx.Process(target=_isolated_entry, args=(indexed, queue))
+    proc.start()
+    # Drain the queue BEFORE joining: a result bigger than the pipe buffer
+    # (~64KB — CPG-sized payloads easily are) blocks the child's put until
+    # the parent reads, so a blind join would deadlock and misreport a
+    # healthy item as a timeout.
+    deadline = time.monotonic() + timeout_s
+    while queue.empty() and proc.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    if not queue.empty():
+        result = queue.get()
+        proc.join(10.0)
+        if proc.is_alive():
+            proc.kill()
+            proc.join()
+        return result
+    if proc.is_alive():
+        proc.kill()
+        proc.join()
+        return (_SENTINEL_ERROR, repr(indexed[1])[:200],
+                f"TimeoutError: isolated item exceeded {timeout_s}s")
+    proc.join()
+    return (_SENTINEL_ERROR, repr(indexed[1])[:200],
+            f"WorkerCrash: isolated worker exit code {proc.exitcode}")
+
+
+def _is_failure(r: Any) -> bool:
+    return isinstance(r, tuple) and len(r) == 3 and r[0] == _SENTINEL_ERROR
 
 
 def pmap(
@@ -44,33 +108,83 @@ def pmap(
     desc: str = "",
     failed_log: Optional[str] = None,
     chunksize: int = 1,
+    attempts: int = 2,
 ) -> List[Any]:
     """Map ``fn`` over ``items`` with a process pool; ordered results.
 
-    Items whose ``fn`` raises yield ``None`` in the result list; the failure
-    is logged (and appended to ``failed_log`` when given) and processing
-    continues — the reference's getgraphs.py:57-59 semantics.
+    Items whose ``fn`` raises are retried up to ``attempts`` total tries
+    (requeued into isolated subprocesses on the pool path, re-called
+    inline on the serial path); items still failing yield ``None`` in the
+    result list, with the failure logged (and appended to ``failed_log``
+    when given) — the reference's getgraphs.py:57-59 semantics, plus the
+    attempt cap. A crashed *pool* (worker segfault) requeues the whole
+    batch through the isolated path instead of aborting.
     Degenerates to a serial loop for ``workers <= 1``, tiny inputs, or
     platforms without fork (avoids fork overhead and keeps tracebacks
-    direct under debuggers).
+    direct under debuggers). ``chunksize`` is accepted for dfmp-call-site
+    parity; scheduling is per-item (ETL payloads are seconds each, so
+    chunking never paid for itself).
     """
     global _ACTIVE_FN
+    attempts = max(attempts, 1)
+    indexed = list(enumerate(items))
     with _ACTIVE_LOCK:  # RLock: threads serialize, same-thread nesting enters
         prev = _ACTIVE_FN  # save/restore so a nested serial pmap doesn't
         _ACTIVE_FN = fn    # null the outer call's function
         try:
-            if workers <= 1 or len(items) < 2 or os.name != "posix":
-                results = [_call(item) for item in items]
+            serial = workers <= 1 or len(items) < 2 or os.name != "posix"
+            if serial:
+                results = [_call(x) for x in indexed]
             else:
-                with mp.get_context("fork").Pool(workers) as pool:
-                    results = pool.map(_call, items, chunksize=chunksize)
+                # ProcessPoolExecutor over mp.Pool: a hard-crashed worker
+                # (segfault, OOM-kill) breaks the pool with an exception on
+                # the affected futures instead of hanging map() forever —
+                # detection is what makes requeue possible at all. fn
+                # exceptions never reach the futures (_call returns error
+                # sentinels), so a future failure IS a pool-level crash;
+                # those items fall into the requeue loop below.
+                from concurrent.futures import ProcessPoolExecutor
+
+                results = []
+                with ProcessPoolExecutor(
+                    max_workers=workers, mp_context=mp.get_context("fork")
+                ) as pool:
+                    futures = [pool.submit(_call, x) for x in indexed]
+                    for x, fut in zip(indexed, futures):
+                        try:
+                            results.append(fut.result())
+                        except Exception as e:
+                            logger.warning(
+                                "%s: worker crashed under item %d (%s); "
+                                "requeueing it isolated", desc or "pmap",
+                                x[0], type(e).__name__,
+                            )
+                            results.append((
+                                _SENTINEL_ERROR, repr(x[1])[:200],
+                                f"WorkerCrash: {type(e).__name__}: {e}",
+                            ))
+            # Per-item attempt cap: requeue failures until the budget is
+            # spent. Serial path retries inline (same-process semantics);
+            # pool path retries isolated, so a repeatedly-crashing item
+            # stays contained.
+            for retry in range(attempts - 1):
+                failed_idx = [i for i, r in enumerate(results)
+                              if _is_failure(r)]
+                if not failed_idx:
+                    break
+                logger.warning("%s: retrying %d failed item(s) (attempt "
+                               "%d/%d)", desc or "pmap", len(failed_idx),
+                               retry + 2, attempts)
+                for i in failed_idx:
+                    results[i] = (_call(indexed[i]) if serial
+                                  else _run_isolated(indexed[i]))
         finally:
             _ACTIVE_FN = prev
 
     out: List[Any] = []
     failures = []
     for r in results:
-        if isinstance(r, tuple) and len(r) == 3 and r[0] == _SENTINEL_ERROR:
+        if _is_failure(r):
             failures.append((r[1], r[2]))
             out.append(None)
         else:

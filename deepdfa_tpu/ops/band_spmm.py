@@ -257,7 +257,9 @@ def band_spmm_sharded(
     def local(a: BandAdjacency, m: jnp.ndarray) -> jnp.ndarray:
         return band_spmm(jax.tree_util.tree_map(lambda x: x[0], a), m)
 
-    return jax.shard_map(
+    from deepdfa_tpu.parallel.mesh import shard_map_compat
+
+    return shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(adj_spec, P(DATA_AXIS)),

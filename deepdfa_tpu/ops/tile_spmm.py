@@ -345,7 +345,9 @@ def tile_spmm_sharded(
         squeezed = jax.tree_util.tree_map(lambda x: x[0], a)
         return tile_spmm(squeezed, m, impl)
 
-    return jax.shard_map(
+    from deepdfa_tpu.parallel.mesh import shard_map_compat
+
+    return shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(adj_spec, P(DATA_AXIS)),

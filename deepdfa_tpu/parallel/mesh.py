@@ -29,6 +29,32 @@ SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs,
+                     check_vma: bool = True, axis_names=None):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=, axis_names=)``;
+    the 0.4.x line in this image only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=, auto=)`` —
+    where ``auto`` is the *complement* of ``axis_names``. One shim keeps
+    every kernel call site version-agnostic.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+    from jax.experimental.shard_map import shard_map as sm_legacy
+
+    kwargs = {"check_rep": check_vma}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return sm_legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kwargs)
+
+
 def make_mesh(
     n_data: Optional[int] = None,
     n_model: int = 1,

@@ -43,7 +43,11 @@ def ring_attention(
     """Per-shard ring attention. Arrays are the local sequence shard
     ``[B, Ts, H, D]`` (mask ``[B, Ts]``); must run under a mesh with
     ``axis_name`` manual (shard_map)."""
-    n = jax.lax.axis_size(axis_name)
+    # lax.axis_size is a newer-jax API; psum of a concrete 1 over the axis
+    # is the 0.4.x-era idiom and resolves statically (no collective).
+    _axis_size = getattr(jax.lax, "axis_size", None)
+    n = (_axis_size(axis_name) if _axis_size is not None
+         else jax.lax.psum(1, axis_name))
     idx = jax.lax.axis_index(axis_name)
     b, ts, h, d = q.shape
     qs = q  # scaling happens inside blockwise_attention
@@ -90,18 +94,31 @@ def ring_attention_sharded(
     """Global-view ring attention: shards ``[B, T, H, D]`` over ``axis_name``
     and runs :func:`ring_attention` manually on each shard. Other mesh axes
     (data/model) remain auto-partitioned by GSPMD, so this composes with a
-    dp×sp mesh inside one ``jit``."""
-    spec_qkv = P(None, axis_name)
-    spec_mask = P(None, axis_name)
+    dp×sp mesh inside one ``jit``.
+
+    On the 0.4.x jax line partial-manual shard_map (``axis_names`` ⊂ mesh
+    axes) is unsupported at the XLA level (IsManualSubgroup check failure),
+    so the legacy path goes FULL-manual, sharding the batch axis over the
+    data axis as well — semantics-preserving because the ring body is
+    per-example over batch (its only collective is the seq-axis ppermute);
+    it adds the constraint that the global batch divide the data-axis size,
+    which every trainer batch already satisfies (the shard packers divide
+    batches by ``n_data`` by construction)."""
+    from deepdfa_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
+
+    partial_manual_ok = getattr(jax, "shard_map", None) is not None
+    batch_axis = None if partial_manual_ok else DATA_AXIS
+    spec_qkv = P(batch_axis, axis_name)
+    spec_mask = P(batch_axis, axis_name)
 
     fn = partial(ring_attention, causal=causal, axis_name=axis_name,
                  block_size=block_size)
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
         out_specs=spec_qkv,
-        axis_names={axis_name},
+        axis_names={axis_name} if partial_manual_ok else None,
         check_vma=False,
     )
     if kv_mask is None:

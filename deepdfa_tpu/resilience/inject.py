@@ -1,0 +1,306 @@
+"""Seeded, declarative fault injection.
+
+A *fault plan* is a JSON document describing faults to fire at named
+*sites* instrumented across the codebase:
+
+.. code-block:: json
+
+    {"seed": 0, "faults": [
+      {"site": "train.epoch_start", "kind": "raise", "at": 1},
+      {"site": "train.loss",        "kind": "nan",   "at": 5},
+      {"site": "checkpoint.saved",  "kind": "corrupt", "name": "last"},
+      {"site": "joern.send",        "kind": "kill"},
+      {"site": "joern.send",        "kind": "hang"},
+      {"site": "etl.item",          "kind": "raise", "at": 2},
+      {"site": "serve.batch",       "kind": "raise", "at": 0}
+    ]}
+
+Spec fields:
+
+``site``
+    Which hook fires it. Instrumented sites and their index semantics:
+
+    ========================  =================================================
+    ``train.epoch_start``     start of each training epoch; index = epoch
+                              number (simulated preemption when ``kind=raise``)
+    ``train.loss``            after each optimizer step; index = step ordinal
+                              within the run (``kind=nan`` poisons the loss)
+    ``checkpoint.saved``      after each snapshot write; ``name`` filters on
+                              the snapshot name; ``kind=corrupt|truncate``
+                              damages the on-disk snapshot
+    ``joern.send``            before each Joern REPL command; ``kind=kill``
+                              kills the child JVM, ``kind=hang`` simulates an
+                              unresponsive REPL (raises ``TimeoutError``)
+    ``etl.item``              before each parallel-map work item; index = item
+                              position in the input sequence
+    ``serve.batch``           before each serving micro-batch executes; index
+                              = flush ordinal
+    ========================  =================================================
+
+``kind``
+    ``raise`` (throw ``exc``), ``nan`` (poison a loss), ``corrupt`` /
+    ``truncate`` (damage a snapshot file), ``kill`` / ``hang`` (child
+    process faults). Sites ignore kinds they don't understand.
+``at`` / ``every`` / ``p``
+    Match conditions on the spec's occurrence index: exact index, a
+    period, or a probability drawn from the plan's seeded RNG. With none
+    given the spec matches every occurrence.
+``times``
+    Maximum number of fires (default 1 for exact/unconditional specs,
+    unlimited for ``every``/``p`` specs). Exhausted specs go inert.
+``exc`` / ``msg`` / ``name``
+    Exception type name for ``raise`` (resolved from builtins, default
+    :class:`FaultError`), message, and the snapshot-name filter for
+    checkpoint faults.
+
+Arming: programmatically (``install(plan)`` / ``clear()`` / the ``armed``
+context manager) or via the environment — ``DEEPDFA_FAULT_PLAN`` holding
+either inline JSON or a path to a JSON file. The env plan is loaded once
+per process; its per-spec counters then evolve with the process, which
+is what makes a plan deterministic: same plan + same code path = same
+faults. Forked workers inherit a *copy* of the armed plan, so counters
+diverge per process — plans targeting forked sites should match on the
+caller-provided index (``at``), which is position-derived, not
+count-derived.
+
+With no plan armed every hook is a cheap no-op (one None check), so the
+instrumentation stays in production code paths.
+"""
+
+from __future__ import annotations
+
+import builtins
+import contextlib
+import dataclasses
+import json
+import logging
+import os
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "DEEPDFA_FAULT_PLAN"
+
+KINDS = ("raise", "nan", "corrupt", "truncate", "kill", "hang")
+
+
+class FaultError(RuntimeError):
+    """Default exception for injected ``raise`` faults."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    kind: str
+    at: Optional[int] = None
+    every: Optional[int] = None
+    p: Optional[float] = None
+    times: Optional[int] = None
+    exc: str = "FaultError"
+    msg: str = ""
+    name: Optional[str] = None
+    # runtime state
+    seen: int = 0   # filter-passing occurrences of this spec's site
+    fired: int = 0  # times this spec actually fired
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {KINDS})")
+        if self.times is None:
+            # Exact-index and unconditional specs are one-shot by default;
+            # periodic/probabilistic specs keep firing.
+            self.times = 1 if (self.every is None and self.p is None) else 0
+
+    def exhausted(self) -> bool:
+        return bool(self.times) and self.fired >= self.times
+
+    def matches(self, idx: int, rng: random.Random) -> bool:
+        if self.at is not None and idx != self.at:
+            return False
+        if self.every is not None and idx % self.every != 0:
+            return False
+        if self.p is not None and rng.random() >= self.p:
+            return False
+        return True
+
+    def exception(self) -> BaseException:
+        cls: Any = FaultError
+        if self.exc and self.exc != "FaultError":
+            cand = getattr(builtins, self.exc, None)
+            if isinstance(cand, type) and issubclass(cand, BaseException):
+                cls = cand
+            else:
+                logger.warning("fault plan names unknown exception %r; "
+                               "raising FaultError", self.exc)
+        return cls(self.msg or
+                   f"injected fault at {self.site} (occurrence {self.seen})")
+
+
+class FaultPlan:
+    """A parsed plan plus its per-spec runtime counters."""
+
+    def __init__(self, faults: Sequence[FaultSpec], seed: int = 0):
+        self.faults = list(faults)
+        self.seed = seed
+        self.rng = random.Random(seed)
+
+    @classmethod
+    def from_doc(cls, doc: Dict) -> "FaultPlan":
+        fields = {f.name for f in dataclasses.fields(FaultSpec)
+                  if f.name not in ("seen", "fired")}
+        specs = []
+        for raw in doc.get("faults", []):
+            unknown = set(raw) - fields
+            if unknown:
+                raise ValueError(
+                    f"fault spec {raw!r}: unknown field(s) {sorted(unknown)}"
+                )
+            specs.append(FaultSpec(**raw))
+        return cls(specs, seed=int(doc.get("seed", 0)))
+
+    @classmethod
+    def from_source(cls, source: str) -> "FaultPlan":
+        """Inline JSON (starts with ``{``) or a path to a JSON file — the
+        ``DEEPDFA_FAULT_PLAN`` formats."""
+        text = source.strip()
+        if not text.startswith("{"):
+            with open(text, encoding="utf-8") as f:
+                text = f.read()
+        return cls.from_doc(json.loads(text))
+
+    def fire(self, site: str, index: Optional[int] = None,
+             **ctx: Any) -> Tuple[FaultSpec, ...]:
+        """Advance counters; raise any matching ``raise``/``hang`` fault,
+        return the other matching specs for the caller to act on."""
+        hits: List[FaultSpec] = []
+        for spec in self.faults:
+            if spec.site != site or spec.exhausted():
+                continue
+            want_name = spec.name
+            if want_name is not None and ctx.get("name") != want_name:
+                continue
+            idx = index if index is not None else spec.seen
+            spec.seen += 1
+            if spec.matches(idx, self.rng):
+                spec.fired += 1
+                hits.append(spec)
+        for spec in hits:
+            if spec.kind == "raise":
+                raise spec.exception()
+            if spec.kind == "hang":
+                # A real hang would stall the caller until its own read
+                # deadline; surfacing the deadline's TimeoutError directly
+                # keeps soaks fast while exercising the same recovery path.
+                raise TimeoutError(
+                    spec.msg or f"injected hang at {site} "
+                                f"(occurrence {spec.seen - 1})")
+        return tuple(hits)
+
+    def report(self) -> List[Dict]:
+        return [
+            {"site": s.site, "kind": s.kind, "seen": s.seen, "fired": s.fired}
+            for s in self.faults
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Process-global arming
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _PLAN, _ENV_CHECKED
+    _PLAN = plan
+    _ENV_CHECKED = True
+    return plan
+
+
+def clear() -> None:
+    global _PLAN, _ENV_CHECKED
+    _PLAN = None
+    _ENV_CHECKED = True
+
+
+def active() -> Optional[FaultPlan]:
+    global _PLAN, _ENV_CHECKED
+    if _PLAN is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        raw = os.environ.get(ENV_VAR)
+        if raw:
+            _PLAN = FaultPlan.from_source(raw)
+            logger.warning("fault plan armed from %s (%d specs)", ENV_VAR,
+                           len(_PLAN.faults))
+    return _PLAN
+
+
+@contextlib.contextmanager
+def armed(plan: FaultPlan):
+    """Arm ``plan`` for the duration of the block, restoring the previous
+    arming state after — the test/soak entry point."""
+    global _PLAN, _ENV_CHECKED
+    prev, prev_checked = _PLAN, _ENV_CHECKED
+    install(plan)
+    try:
+        yield plan
+    finally:
+        _PLAN, _ENV_CHECKED = prev, prev_checked
+
+
+# ---------------------------------------------------------------------------
+# Site hooks (the instrumented-code API)
+# ---------------------------------------------------------------------------
+
+
+def fire(site: str, index: Optional[int] = None,
+         **ctx: Any) -> Tuple[FaultSpec, ...]:
+    """The hook call: no-op unless a plan is armed. May raise (``raise``/
+    ``hang`` faults); returns matching non-raising specs otherwise."""
+    plan = active()
+    if plan is None:
+        return ()
+    return plan.fire(site, index, **ctx)
+
+
+def corrupt_loss(loss, site: str = "train.loss", index: Optional[int] = None):
+    """NaN-poison a loss value when a matching ``nan`` fault fires.
+
+    Works on jnp and numpy scalars alike (multiplication by NaN keeps the
+    value on device — no host sync added by the hook)."""
+    for spec in fire(site, index):
+        if spec.kind == "nan":
+            return loss * float("nan")
+    return loss
+
+
+def corrupt_path(path: str, mode: str = "corrupt") -> str:
+    """Damage a snapshot: flip bytes in (``corrupt``) or halve
+    (``truncate``) the largest file under ``path``. Returns the damaged
+    file's path. Deterministic target selection so plans replay."""
+    target = path
+    if os.path.isdir(path):
+        files = []
+        for dirpath, _, filenames in os.walk(path):
+            for fn in sorted(filenames):
+                p = os.path.join(dirpath, fn)
+                files.append((os.path.getsize(p), p))
+        files = [f for f in sorted(files, reverse=True) if f[0] > 0]
+        if not files:
+            raise FileNotFoundError(f"no non-empty file under {path}")
+        target = files[0][1]
+    if mode == "truncate":
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.truncate(size // 2)
+    else:
+        with open(target, "r+b") as f:
+            data = bytearray(f.read())
+            for pos in (0, len(data) // 2, len(data) - 1):
+                data[pos] ^= 0xFF
+            f.seek(0)
+            f.write(data)
+    return target

@@ -39,6 +39,7 @@ import numpy as np
 
 from deepdfa_tpu.core.config import subkeys_for
 from deepdfa_tpu.core.metrics import ServingStats
+from deepdfa_tpu.resilience import inject
 from deepdfa_tpu.graphs.batch import batch_graphs
 from deepdfa_tpu.models.infer import make_combined_infer, make_gnn_infer
 from deepdfa_tpu.serve.batcher import (
@@ -124,6 +125,10 @@ class ServeEngine:
         self.cache = ResultCache(self.config.cache_capacity)
         self._clock = clock
         self._rid = itertools.count()
+        # Monotonic flush ordinal for the fault hook: counts every
+        # _run_batch invocation, failed or not (stats.batches counts only
+        # successes, which would pin a fault plan's index on failure).
+        self._flush_ordinal = itertools.count()
         self._compiled: Dict[Tuple[str, int], Any] = {}
 
         self._lanes: Dict[str, _Lane] = {
@@ -338,18 +343,38 @@ class ServeEngine:
         slots = self.config.bucket_for(len(reqs))
         exe = self._executable(lane_name, slots)
         w0 = time.perf_counter()
-        gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
-        if lane_name == "combined":
-            pad_id = int(self.tokenizer.pad_token_id)
-            ids = np.full((slots, self.config.block_size), pad_id, np.int32)
-            for i, r in enumerate(reqs):
-                ids[i] = r.input_ids
-            probs = exe(lane.params, jnp.asarray(ids), gb)
-        else:
-            probs = exe(lane.params, gb)
-        # One host transfer per micro-batch; everything after this indexes
-        # numpy (GL004: per-request reads must not ride on device buffers).
-        p = np.asarray(probs)
+        try:
+            # Fault hook (index = flush ordinal): a `raise` here simulates
+            # an executable/device failure mid-flush.
+            inject.fire("serve.batch", index=next(self._flush_ordinal))
+            gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
+            if lane_name == "combined":
+                pad_id = int(self.tokenizer.pad_token_id)
+                ids = np.full((slots, self.config.block_size), pad_id,
+                              np.int32)
+                for i, r in enumerate(reqs):
+                    ids[i] = r.input_ids
+                probs = exe(lane.params, jnp.asarray(ids), gb)
+            else:
+                probs = exe(lane.params, gb)
+            # One host transfer per micro-batch; everything after this
+            # indexes numpy (GL004: per-request reads must not ride on
+            # device buffers).
+            p = np.asarray(probs)
+        except Exception as e:
+            # Flush isolation: THIS micro-batch's requests fail (HTTP 500
+            # class), the queue keeps draining, and later flushes run on
+            # the already-compiled executables — one bad batch must not
+            # wedge the pump thread or leak hung requests.
+            logger.exception("micro-batch failed (%s lane, %d requests)",
+                             lane_name, len(reqs))
+            self.stats.bump("failures", by=len(reqs))
+            detail = f"{type(e).__name__}: {e}"
+            for r in reqs:
+                r.finish({"rid": r.rid, "error": "internal",
+                          "detail": detail, "cached": False,
+                          "degraded": r.degraded})
+            return
         # Virtual clocks (replay/bench) expose advance(): credit them with
         # this batch's measured wall time so recorded latencies include
         # compute, not just queueing. Live monotonic clocks tick on their

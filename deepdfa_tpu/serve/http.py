@@ -11,6 +11,9 @@ Contract (documented in README "Serving"):
       -> 429 {"error": "rejected", "retry_after_s"} + Retry-After header
          when EVERY function was shed by backpressure
       -> 400 {"error": "bad_request", "detail"} on malformed payloads
+      -> 500 {"results": [{"error": "internal", ...}, ...]} when every
+         function in the POST died in a failed micro-batch (engine flush
+         isolation: only that flush fails; the queue keeps draining)
   GET /metrics   -> ServingStats snapshot (queue depth, occupancy,
                     p50/p99 latency, cache hit rate, compile count)
   GET /healthz   -> {"status": "ok", "warm_buckets": N}
@@ -164,7 +167,13 @@ class ServeHandler(BaseHTTPRequestHandler):
                 entry.update(req.result)
             else:
                 entry.update(error="timeout")
-        self._send_json(200, {"results": results})
+        # Flush-failure surface: when EVERY function in this POST died in
+        # a failed micro-batch (engine flush isolation), the response is a
+        # 500 — the per-request errors stay inline either way, and a batch
+        # with any successful function keeps the 200 + inline-error shape.
+        status = 500 if (results and all(r.get("error") == "internal"
+                                         for r in results)) else 200
+        self._send_json(status, {"results": results})
 
 
 class ServeHTTPServer(ThreadingHTTPServer):

@@ -1,4 +1,4 @@
-"""Checkpointing via orbax.
+"""Checkpointing via orbax, hardened for preemptible hardware.
 
 Reproduces the reference's checkpoint semantics (SURVEY §5): best-by-val-loss
 with ``save_last`` (Lightning ModelCheckpoint, config_default.yaml:23-29),
@@ -7,16 +7,59 @@ partial-load-and-freeze of the graph encoder for the combined models
 (main_cli.py:136-144 ``--freeze_graph`` strips head/pooling keys). Best
 checkpoint metadata is stored explicitly instead of being re-parsed out of
 filenames (main_cli.py:175-184).
+
+Robustness contract (the preemptible-TPU posture, tests/test_resilience.py):
+
+* ``meta.json`` writes are atomic (tmp file + ``os.replace`` + fsync of
+  file and directory) — a preemption mid-write can never brick resume;
+  a corrupt existing meta.json degrades to defaults with a warning
+  instead of crashing at construction.
+* Every snapshot records a content checksum in ``meta.json``; restores
+  verify it and, on mismatch (or an unreadable snapshot), fall back to
+  the newest intact snapshot. Fallback order: the requested name first,
+  then every other recorded snapshot by descending epoch, ties broken
+  ``last`` > ``epoch_N`` > ``best``. ``last_restored`` reports what was
+  actually loaded so resume can restart from the surviving epoch.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
-from typing import Any, Optional
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
+
+from deepdfa_tpu.resilience import inject
+
+logger = logging.getLogger(__name__)
+
+_EPOCH_NAME_RE = re.compile(r"^epoch_(\d+)$")
+
+
+class CheckpointError(RuntimeError):
+    """No intact snapshot exists for a requested restore."""
+
+
+def snapshot_checksum(path: str) -> str:
+    """Content digest of one snapshot directory: sha256 over the sorted
+    relative paths and file bytes."""
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(p, path).encode())
+            h.update(b"\0")
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            h.update(b"\0")
+    return h.hexdigest()
 
 
 class CheckpointManager:
@@ -26,20 +69,68 @@ class CheckpointManager:
         self.periodic_every = periodic_every
         self._ckpt = ocp.StandardCheckpointer()
         self._meta_path = os.path.join(self.directory, "meta.json")
-        self._meta = {"best_epoch": -1, "best_val_loss": float("inf"),
-                      "last_epoch": -1}
+        self._meta: Dict[str, Any] = {
+            "best_epoch": -1, "best_val_loss": float("inf"),
+            "last_epoch": -1,
+        }
+        # What the latest restore() actually loaded ({"name", "epoch",
+        # "fallback"}) — resume reads this to restart from the snapshot
+        # that survived, not the one that was asked for.
+        self.last_restored: Optional[Dict[str, Any]] = None
         if os.path.exists(self._meta_path):
-            with open(self._meta_path) as f:
-                self._meta.update(json.load(f))
+            try:
+                with open(self._meta_path) as f:
+                    self._meta.update(json.load(f))
+            except (json.JSONDecodeError, OSError, ValueError) as e:
+                # A preemption that outran the (pre-hardening) plain write,
+                # or disk corruption: the snapshots themselves may still be
+                # fine, so degrade to defaults instead of bricking the run
+                # directory. Checksums for existing snapshots are lost;
+                # restores of them proceed unverified with a warning.
+                logger.warning(
+                    "corrupt meta.json in %s (%s); continuing with default "
+                    "metadata — snapshot checksums are lost, restores of "
+                    "pre-existing snapshots run unverified",
+                    self.directory, e,
+                )
 
-    def _save(self, name: str, state: Any) -> None:
+    # -- writes ------------------------------------------------------------
+
+    def _save(self, name: str, state: Any, epoch: int) -> None:
+        """Write the snapshot and record its checksum in the in-memory
+        meta; the caller performs the single atomic meta write (this is
+        the per-epoch hot path — bench_checkpoint_resilience's
+        ckpt_save_ms — so one fsync'd write per save, not two)."""
         path = os.path.join(self.directory, name)
         self._ckpt.save(path, jax.device_get(state), force=True)
         self._ckpt.wait_until_finished()
+        self._meta.setdefault("snapshots", {})[name] = {
+            "epoch": int(epoch),
+            "sha256": snapshot_checksum(path),
+        }
+        # Fault hook AFTER the checksum is recorded: injected damage is
+        # exactly what verification must catch on restore.
+        for spec in inject.fire("checkpoint.saved", name=name):
+            if spec.kind in ("corrupt", "truncate"):
+                damaged = inject.corrupt_path(path, mode=spec.kind)
+                logger.warning("injected %s of snapshot %s (%s)",
+                               spec.kind, name, damaged)
 
     def _write_meta(self) -> None:
-        with open(self._meta_path, "w") as f:
+        """Atomic: a reader (or a resumed run) sees either the old meta or
+        the new one, never a torn write — and the rename is durable before
+        we report success."""
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self._meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path)
+        dir_fd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     def save_best(self, state: Any, epoch: int,
                   val_loss: Optional[float] = None,
@@ -48,7 +139,7 @@ class CheckpointManager:
         better); runs that select on something else (val F1, bleu+em, ...)
         record it under its own name via ``metrics`` so meta.json never
         shows a negated stand-in in the val-loss field."""
-        self._save("best", state)
+        self._save("best", state, epoch)
         self._meta["best_epoch"] = epoch
         if val_loss is not None:
             self._meta["best_val_loss"] = val_loss
@@ -59,20 +150,129 @@ class CheckpointManager:
         self._write_meta()
 
     def save_last(self, state: Any, epoch: int) -> None:
-        self._save("last", state)
+        self._save("last", state, epoch)
         self._meta["last_epoch"] = epoch
         self._write_meta()
+
+    def maybe_save_periodic(self, state: Any, epoch: int) -> None:
+        if self.periodic_every and (epoch + 1) % self.periodic_every == 0:
+            self._save(f"epoch_{epoch}", state, epoch)
+            self._write_meta()
+
+    # -- integrity ---------------------------------------------------------
 
     def has(self, name: str) -> bool:
         return os.path.isdir(os.path.join(self.directory, name))
 
-    def maybe_save_periodic(self, state: Any, epoch: int) -> None:
-        if self.periodic_every and (epoch + 1) % self.periodic_every == 0:
-            self._save(f"epoch_{epoch}", state)
+    def verify(self, name: str) -> bool:
+        """True when the snapshot's content matches its recorded checksum.
+        Unrecorded (pre-hardening) snapshots pass with a warning — there is
+        nothing to verify against, and refusing to load them would turn the
+        upgrade into a data loss."""
+        path = os.path.join(self.directory, name)
+        if not os.path.isdir(path):
+            return False
+        record = self._meta.get("snapshots", {}).get(name)
+        if record is None:
+            logger.warning("snapshot %s has no recorded checksum "
+                           "(pre-hardening?); restoring unverified", name)
+            return True
+        return snapshot_checksum(path) == record["sha256"]
+
+    def _snapshot_epoch(self, name: str) -> int:
+        record = self._meta.get("snapshots", {}).get(name)
+        if record is not None:
+            return int(record["epoch"])
+        m = _EPOCH_NAME_RE.match(name)
+        if m:
+            return int(m.group(1))
+        if name == "last":
+            return int(self._meta.get("last_epoch", -1))
+        if name == "best":
+            return int(self._meta.get("best_epoch", -1))
+        return -1
+
+    def _fallback_order(self, requested: str) -> List[str]:
+        """Requested name first, then every other on-disk snapshot by
+        descending epoch (ties: last > epoch_N > best) — THE documented
+        checksum-fallback order (README "Fault tolerance")."""
+        on_disk = [
+            d for d in sorted(os.listdir(self.directory))
+            if os.path.isdir(os.path.join(self.directory, d))
+            and (d in ("best", "last") or _EPOCH_NAME_RE.match(d))
+        ]
+        pref = {"last": 0, "best": 2}
+
+        def rank(name: str) -> Tuple:
+            return (-self._snapshot_epoch(name), pref.get(name, 1), name)
+
+        rest = sorted((d for d in on_disk if d != requested), key=rank)
+        head = [requested] if requested in on_disk else []
+        return head + rest
+
+    def _resolve_intact(self, name: str) -> str:
+        candidates = self._fallback_order(name)
+        for cand in candidates:
+            if self.verify(cand):
+                if cand != name:
+                    logger.error(
+                        "snapshot %s failed integrity verification; falling "
+                        "back to %s (epoch %d)", name, cand,
+                        self._snapshot_epoch(cand),
+                    )
+                return cand
+        raise CheckpointError(
+            f"no intact snapshot under {self.directory} "
+            f"(requested {name!r}, tried {candidates})"
+        )
+
+    # -- reads -------------------------------------------------------------
 
     def restore(self, name: str, target: Any) -> Any:
-        path = os.path.join(self.directory, name)
-        return self._ckpt.restore(path, target=jax.device_get(target))
+        """Verified restore: checksum-checked, with automatic fallback to
+        the newest intact snapshot when the requested one is damaged.
+        ``last_restored`` records what was loaded.
+
+        A snapshot that was never written is a caller error, not damage —
+        that still raises ``FileNotFoundError`` rather than silently
+        loading something else."""
+        if not self.has(name):
+            raise FileNotFoundError(
+                f"no checkpoint {name!r} under {self.directory}"
+            )
+        candidates = self._fallback_order(name)
+        last_err: Optional[Exception] = None
+        for cand in candidates:
+            if not self.verify(cand):
+                logger.error("snapshot %s failed integrity verification; "
+                             "trying the next fallback", cand)
+                continue
+            try:
+                restored = self._ckpt.restore(
+                    os.path.join(self.directory, cand),
+                    target=jax.device_get(target),
+                )
+            except Exception as e:
+                # Checksums catch bit damage; this catches structural rot
+                # (legacy snapshot with no checksum, half-written tree).
+                logger.warning("restore of snapshot %s failed (%s); trying "
+                               "the next fallback", cand, e)
+                last_err = e
+                continue
+            self.last_restored = {
+                "name": cand,
+                "epoch": self._snapshot_epoch(cand),
+                "fallback": cand != name,
+            }
+            if cand != name:
+                logger.error("restored fallback snapshot %s (epoch %d) in "
+                             "place of %s", cand,
+                             self.last_restored["epoch"], name)
+            return restored
+        raise CheckpointError(
+            f"no intact snapshot under {self.directory} "
+            f"(requested {name!r}, tried {candidates})"
+        ) from last_err
 
     def restore_params(self, name: str = "best") -> Any:
         """Restore just the model variables of a saved state — the
@@ -84,13 +284,19 @@ class CheckpointManager:
         states (``TrainState``/``TextTrainState`` — params under the
         ``params`` key) and the params-only dicts ``cmd_fit_text`` writes.
         Returns the apply-ready variables dict (``{"params": ...}``).
+        Damaged snapshots fall back like :meth:`restore`.
         """
-        path = os.path.join(self.directory, name)
         if not self.has(name):
             raise FileNotFoundError(
                 f"no checkpoint {name!r} under {self.directory}"
             )
-        restored = self._ckpt.restore(path)
+        used = self._resolve_intact(name)
+        self.last_restored = {
+            "name": used,
+            "epoch": self._snapshot_epoch(used),
+            "fallback": used != name,
+        }
+        restored = self._ckpt.restore(os.path.join(self.directory, used))
         if isinstance(restored, dict):
             inner = restored.get("params")
             if isinstance(inner, dict) and "params" in inner:
@@ -101,7 +307,8 @@ class CheckpointManager:
                 # Already the apply-ready variables dict.
                 return restored
         raise ValueError(
-            f"checkpoint {path} holds no recognizable variables dict "
+            f"checkpoint {os.path.join(self.directory, used)} holds no "
+            "recognizable variables dict "
             "(expected a trainer state or a {{'params': ...}} tree)"
         )
 

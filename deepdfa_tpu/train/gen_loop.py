@@ -12,6 +12,8 @@ mesh, and generation uses models/t5_generate.py.
 from __future__ import annotations
 
 import dataclasses
+import logging
+import math
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -25,7 +27,10 @@ from flax import struct
 from deepdfa_tpu.core.config import TransformerTrainConfig
 from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
 from deepdfa_tpu.models.t5_generate import generate
+from deepdfa_tpu.resilience import inject
 from deepdfa_tpu.train.text_loop import make_schedule, make_text_optimizer
+
+logger = logging.getLogger(__name__)
 
 
 @struct.dataclass
@@ -425,7 +430,17 @@ def fit_gen(
             )["codebleu"]
         return metrics, pred_texts
 
+    if cfg.anomaly_policy not in ("raise", "rollback"):
+        raise ValueError(
+            f"anomaly_policy must be 'raise' or 'rollback', "
+            f"got {cfg.anomaly_policy!r}"
+        )
+    detect_anomaly = cfg.detect_anomaly or cfg.anomaly_policy == "rollback"
+    anomaly_budget = cfg.anomaly_retry_budget
+    anomaly_rollbacks = 0
     for epoch in range(cfg.max_epochs):
+        inject.fire("train.epoch_start", index=epoch)
+        epoch_start_state = state
         losses = []
         for src, tgt, _ in _batches(
             train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
@@ -433,9 +448,28 @@ def fit_gen(
             state, loss = step(
                 state, _lift_rows(src, mesh, host), _lift_rows(tgt, mesh, host)
             )
-            losses.append(loss)
+            losses.append(inject.corrupt_loss(loss))
         record = {"epoch": epoch,
                   "train_loss": float(np.mean(jax.device_get(losses)))}
+        # Epoch-granular anomaly handling: the mean above is the one host
+        # transfer that already exists; NaN/inf propagates through it.
+        if detect_anomaly and not math.isfinite(record["train_loss"]):
+            if cfg.anomaly_policy != "rollback":
+                raise FloatingPointError(f"non-finite loss in epoch {epoch}")
+            if anomaly_budget <= 0:
+                raise FloatingPointError(
+                    f"non-finite loss in epoch {epoch} "
+                    "(anomaly retry budget exhausted)"
+                )
+            anomaly_budget -= 1
+            anomaly_rollbacks += 1
+            logger.warning(
+                "non-finite loss in epoch %d: rolling back to the "
+                "epoch-start state and continuing (%d retries left)",
+                epoch, anomaly_budget,
+            )
+            state = epoch_start_state
+            record["rolled_back"] = True
         if eval_bleu:
             metrics, pred_texts = bleu_eval(state)
             record.update(metrics)
@@ -491,6 +525,8 @@ def fit_gen(
            "history": history, "eval_loss": r["eval_loss"],
            "exact_match": r["exact_match"], "bleu": r["bleu"],
            "bleu_em": r["bleu_em"]}
+    if anomaly_rollbacks:
+        out["anomaly_rollbacks"] = anomaly_rollbacks
     if "codebleu" in r:
         out["codebleu"] = r["codebleu"]
     return out

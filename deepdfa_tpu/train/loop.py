@@ -35,6 +35,7 @@ from deepdfa_tpu.graphs.batch import (
 )
 from deepdfa_tpu.models.flowgnn import FlowGNN
 from deepdfa_tpu.parallel.mesh import DATA_AXIS, batch_sharding, make_mesh, replicated
+from deepdfa_tpu.resilience import inject
 
 logger = logging.getLogger(__name__)
 
@@ -429,6 +430,11 @@ def fit(
     reporting and assessor-driven trial termination (the reference's NNI
     protocol, base_module.py:346 + main_cli.py:110-121).
     """
+    if train_cfg.anomaly_policy not in ("raise", "rollback"):
+        raise ValueError(
+            f"anomaly_policy must be 'raise' or 'rollback', "
+            f"got {train_cfg.anomaly_policy!r}"
+        )
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
     use_tile = model.config.message_impl == "tile"
@@ -484,23 +490,54 @@ def fit(
     best_state = state
     start_epoch = 0
     if resume and checkpointer is not None and checkpointer.has("last"):
+        from deepdfa_tpu.train.checkpoint import CheckpointError
+
         meta = checkpointer.best_meta
-        state = checkpointer.restore("last", state)
-        if "last_epoch" not in meta or int(meta["last_epoch"]) < 0:
-            logger.warning(
-                "resume: checkpoint dir has a 'last' snapshot but no "
-                "last_epoch in meta.json (written by an older version?) — "
-                "restarting the epoch schedule at 0 on top of the restored "
-                "weights"
+        try:
+            state = checkpointer.restore("last", state)
+        except CheckpointError:
+            # Every snapshot is damaged: the self-healing posture is to
+            # retrain from scratch (loudly), not to refuse to run.
+            logger.exception(
+                "resume: no intact snapshot under %s; restarting from "
+                "scratch", checkpointer.directory,
             )
-        start_epoch = int(meta.get("last_epoch", -1)) + 1
-        history["best_epoch"] = int(meta.get("best_epoch", -1))
-        history["best_val_loss"] = float(meta.get("best_val_loss", float("inf")))
-        best_state = (
-            checkpointer.restore("best", state) if checkpointer.has("best") else state
-        )
-        logger.info("resuming from epoch %d (best val_loss %.4f @ epoch %d)",
-                    start_epoch, history["best_val_loss"], history["best_epoch"])
+        else:
+            if "last_epoch" not in meta or int(meta["last_epoch"]) < 0:
+                logger.warning(
+                    "resume: checkpoint dir has a 'last' snapshot but no "
+                    "last_epoch in meta.json (written by an older version?) "
+                    "— restarting the epoch schedule at 0 on top of the "
+                    "restored weights"
+                )
+            start_epoch = int(meta.get("last_epoch", -1)) + 1
+            restored = checkpointer.last_restored or {}
+            if restored.get("fallback"):
+                # The 'last' snapshot was damaged (preemption mid-write,
+                # disk rot): the verified fallback decides where the epoch
+                # schedule restarts, or the run would skip the epochs
+                # between the fallback and the corrupt snapshot.
+                start_epoch = min(start_epoch,
+                                  int(restored.get("epoch", -1)) + 1)
+                logger.warning(
+                    "resume: restored fallback snapshot %s; restarting at "
+                    "epoch %d", restored.get("name"), start_epoch,
+                )
+            history["best_epoch"] = int(meta.get("best_epoch", -1))
+            history["best_val_loss"] = float(meta.get("best_val_loss",
+                                                      float("inf")))
+            try:
+                best_state = (
+                    checkpointer.restore("best", state)
+                    if checkpointer.has("best") else state
+                )
+            except CheckpointError:
+                logger.exception("resume: no intact 'best' snapshot; "
+                                 "tracking best from the restored state")
+                best_state = state
+            logger.info("resuming from epoch %d (best val_loss %.4f @ epoch %d)",
+                        start_epoch, history["best_val_loss"],
+                        history["best_epoch"])
 
     tb_writer = None
     if train_cfg.tensorboard_dir:
@@ -525,15 +562,49 @@ def fit(
             tb_writer.close()
 
 
-def _check_anomaly(train_cfg, bad_step, epoch: int) -> None:
-    """Lightning detect_anomaly parity: fail at (the first) step that
-    produced a non-finite loss, identified by the device-accumulated index."""
-    if train_cfg.detect_anomaly:
+class _AnomalyGuard:
+    """Non-finite-loss handling at window granularity (one window =
+    ``log_every`` steps, where the rate-limited host sync already happens).
+
+    ``anomaly_policy="raise"`` keeps Lightning detect_anomaly parity: fail
+    at (the first) step that produced a non-finite loss, identified by the
+    device-accumulated index. ``"rollback"`` self-heals instead: restore
+    the window-start state and accumulators (dropping the poisoned window's
+    updates — the batches themselves are skipped, not replayed) and keep
+    training, at most ``anomaly_retry_budget`` times per fit.
+    """
+
+    def __init__(self, train_cfg):
+        self.policy = train_cfg.anomaly_policy
+        self.active = train_cfg.detect_anomaly or self.policy == "rollback"
+        self.budget = train_cfg.anomaly_retry_budget
+
+    def check(self, epoch, bad_step, snapshot, current, history):
+        """At a window boundary (modulo-guarded call sites — the one host
+        read per window). Returns (rolled_back, window_state) where
+        window_state is ``current`` advanced or ``snapshot`` restored."""
+        if not self.active:
+            return False, current
         first = int(bad_step)
-        if first >= 0:
+        if first < 0:
+            return False, current
+        if self.policy != "rollback":
             raise FloatingPointError(
                 f"non-finite loss at epoch {epoch} step {first}"
             )
+        if self.budget <= 0:
+            raise FloatingPointError(
+                f"non-finite loss at epoch {epoch} step {first} "
+                "(anomaly retry budget exhausted)"
+            )
+        self.budget -= 1
+        history["anomaly_rollbacks"] = history.get("anomaly_rollbacks", 0) + 1
+        logger.warning(
+            "non-finite loss at epoch %d step %d: rolling back to the last "
+            "good state and skipping the window (%d retries left)",
+            epoch, first, self.budget,
+        )
+        return True, snapshot
 
 
 def _fit_epochs(
@@ -544,7 +615,12 @@ def _fit_epochs(
 ):
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
+    guard = _AnomalyGuard(train_cfg)
     for epoch in range(start_epoch, train_cfg.max_epochs):
+        # Fault hook: a `raise` fault here is a simulated preemption — the
+        # kill-and-resume determinism gate (tests/test_resilience.py) and
+        # the `cli chaos` soak drive it. No-op without an armed plan.
+        inject.fire("train.epoch_start", index=epoch)
         # Fresh undersample + reshuffle per epoch (reload_dataloaders_every_
         # n_epochs: 1 semantics).
         train_idx = splits["train"]
@@ -567,25 +643,50 @@ def _fit_epochs(
         # would serialize host and device every step, the pattern that
         # kills 10-hour transformer runs.
         bad_step = jnp.asarray(-1, jnp.int32)
-        n_batches = 0
+        # `seen` counts iterated batches (log cadence, anomaly indices);
+        # `n_batches` counts KEPT batches — a rollback rewinds it with the
+        # accumulators so the epoch averages cover only surviving windows.
+        n_batches = seen = 0
+        epoch_rolled = False
+        # Window-start snapshot for rollback: references to the functional
+        # state/accumulator values, so holding it costs nothing.
+        window = (state, loss_sum, stats, n_batches)
         for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
                               data_cfg.batch_size, n_shards, use_tile,
                               use_band, use_df, host):
             if host is not None:
                 batch = assemble_global_batch(batch, mesh)
             state, loss, bstats = train_step(state, batch)
-            if train_cfg.detect_anomaly:
+            loss = inject.corrupt_loss(loss)
+            if guard.active:
                 bad_step = jnp.where(
-                    (bad_step < 0) & ~jnp.isfinite(loss), n_batches, bad_step
+                    (bad_step < 0) & ~jnp.isfinite(loss), seen, bad_step
                 )
             loss_sum = loss_sum + loss
             stats = stats + bstats
             n_batches += 1
-            if n_batches % log_every == 0:
-                _check_anomaly(train_cfg, bad_step, epoch)
-                logger.info("epoch %d step %d loss %.4f", epoch, n_batches, float(loss))
-        _check_anomaly(train_cfg, bad_step, epoch)
-        epoch_loss = float(loss_sum)
+            seen += 1
+            if seen % log_every == 0:
+                rolled, (state, loss_sum, stats, n_batches) = guard.check(
+                    epoch, bad_step, window,
+                    (state, loss_sum, stats, n_batches), history,
+                )
+                if rolled:
+                    bad_step = jnp.asarray(-1, jnp.int32)
+                    epoch_rolled = True
+                else:
+                    logger.info("epoch %d step %d loss %.4f", epoch, seen,
+                                float(loss))
+                window = (state, loss_sum, stats, n_batches)
+        rolled, (state, loss_sum, stats, n_batches) = guard.check(
+            epoch, bad_step, window, (state, loss_sum, stats, n_batches),
+            history,
+        )
+        epoch_rolled = epoch_rolled or rolled
+        # An epoch whose every window rolled back kept no batches; nan is
+        # honest where 0/1 would fabricate a perfect-loss datapoint.
+        epoch_loss = (float("nan") if epoch_rolled and n_batches == 0
+                      else float(loss_sum))
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
         val = evaluate(eval_step, state, examples, splits["val"], data_cfg,
@@ -599,6 +700,10 @@ def _fit_epochs(
             "val_metrics": val.metrics,
             "seconds": time.time() - t0,
         }
+        if epoch_rolled:
+            # Parity with text_loop/gen_loop: per-epoch consumers must be
+            # able to tell a healed epoch from a healthy one.
+            record["rolled_back"] = True
         history["epochs"].append(record)
         logger.info(
             "epoch %d train_loss %.4f val_loss %.4f val_f1 %.4f (%.1fs)",

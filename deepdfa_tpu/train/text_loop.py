@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import time
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Tuple
 
@@ -28,6 +29,7 @@ from deepdfa_tpu.core.metrics import BinaryStats, binary_stats, compute_metrics
 from deepdfa_tpu.graphs.batch import GraphBatch, batch_graphs, pad_budget_for
 from deepdfa_tpu.models.linevul import LineVul, cross_entropy_loss
 from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
+from deepdfa_tpu.resilience import inject
 
 logger = logging.getLogger(__name__)
 
@@ -589,12 +591,23 @@ def fit_text(
         train_step = jax.jit(train_step)
         eval_step = jax.jit(eval_step)
 
+    if cfg.anomaly_policy not in ("raise", "rollback"):
+        raise ValueError(
+            f"anomaly_policy must be 'raise' or 'rollback', "
+            f"got {cfg.anomaly_policy!r}"
+        )
+    detect_anomaly = cfg.detect_anomaly or cfg.anomaly_policy == "rollback"
+    anomaly_budget = cfg.anomaly_retry_budget
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_f1": -1.0}
     best_state = state
     rng = np.random.default_rng(cfg.seed)
     for epoch in range(cfg.max_epochs):
+        inject.fire("train.epoch_start", index=epoch)
         t0 = time.time()
         stats = BinaryStats.zeros()
+        # Epoch-start reference for anomaly rollback (holding the
+        # functional state value costs nothing).
+        epoch_start_state = state
         # Loss accumulates on-device; one transfer per epoch keeps dispatch
         # running ahead of execution.
         loss_sum = jnp.zeros(())
@@ -609,10 +622,37 @@ def fit_text(
             if host is not None:
                 batch = _assemble_text(batch, mesh)
             state, loss, bstats = _run_step(train_step, state, batch)
+            loss = inject.corrupt_loss(loss)
             loss_sum = loss_sum + loss
             stats = stats + bstats
             n_batches += 1
         epoch_loss = float(loss_sum)
+        # Anomaly handling at epoch granularity: the per-epoch host
+        # transfer above is the one sync that already exists, so detection
+        # adds none. NaN/inf propagates through the sum, so a single
+        # poisoned step marks the whole epoch.
+        rolled_back = False
+        if detect_anomaly and not math.isfinite(epoch_loss):
+            if cfg.anomaly_policy != "rollback":
+                raise FloatingPointError(
+                    f"non-finite loss in epoch {epoch}"
+                )
+            if anomaly_budget <= 0:
+                raise FloatingPointError(
+                    f"non-finite loss in epoch {epoch} "
+                    "(anomaly retry budget exhausted)"
+                )
+            anomaly_budget -= 1
+            rolled_back = True
+            history["anomaly_rollbacks"] = (
+                history.get("anomaly_rollbacks", 0) + 1
+            )
+            logger.warning(
+                "non-finite loss in epoch %d: rolling back to the "
+                "epoch-start state and continuing (%d retries left)",
+                epoch, anomaly_budget,
+            )
+            state = epoch_start_state
         val = evaluate_text(
             eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
             graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
@@ -628,6 +668,8 @@ def fit_text(
             "num_missing": num_missing,
             "seconds": time.time() - t0,
         }
+        if rolled_back:
+            record["rolled_back"] = True
         history["epochs"].append(record)
         logger.info(
             "epoch %d train_loss %.4f val_f1 %.4f (%.1fs)",

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
+# covering five fault classes — simulated preemption (kill-and-resume must
+# be bit-for-bit deterministic), NaN loss (rollback self-healing),
+# checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
+# requeue), serving flush failure (one flush fails alone). Exits nonzero on
+# any missed recovery contract — the scripts/test.sh gate.
+#
+#   bash scripts/chaos.sh                      # the default soak
+#   bash scripts/chaos.sh --epochs 4           # deeper training scenarios
+# (custom fault plans arm via DEEPDFA_FAULT_PLAN against regular commands;
+#  the soak's scenarios arm their own plans)
+set -e
+cd "$(dirname "$0")/.."
+# CPU pin: the soak verifies *control-plane* behavior (resume, fallback,
+# retry) and its determinism gate compares runs within one process; the
+# tunneled TPU plugin adds nothing but variance here.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli chaos \
+  --out-dir "${CHAOS_DIR:-runs/chaos}" "$@"

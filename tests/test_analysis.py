@@ -472,6 +472,94 @@ def step(x):
 
 
 # ---------------------------------------------------------------------------
+# GL009 swallowed-device-exception
+# ---------------------------------------------------------------------------
+
+
+def test_gl009_bare_except_swallows_device_call():
+    src = """
+import jax
+
+def drive(params, batch):
+    try:
+        out = jax.device_get(params)
+    except:
+        out = None
+    return out
+"""
+    fs = findings_for(src, "GL009")
+    assert len(fs) == 1 and "swallow" in fs[0].message
+
+
+def test_gl009_except_exception_around_step_call():
+    src = """
+def drive(train_step, state, batches):
+    for batch in batches:
+        try:
+            state, loss = train_step(state, batch)
+        except Exception:
+            continue
+    return state
+"""
+    assert "GL009" in rules_of(src)
+
+
+def test_gl009_negative_handler_logs():
+    src = """
+import jax
+import logging
+
+logger = logging.getLogger(__name__)
+
+def drive(params):
+    try:
+        return jax.device_get(params)
+    except Exception:
+        logger.exception("device_get failed")
+        return None
+"""
+    assert "GL009" not in rules_of(src)
+
+
+def test_gl009_negative_handler_reraises():
+    src = """
+import jax
+
+def drive(params):
+    try:
+        return jax.device_get(params)
+    except Exception as e:
+        raise RuntimeError("restore failed") from e
+"""
+    assert "GL009" not in rules_of(src)
+
+
+def test_gl009_negative_no_device_calls_in_try():
+    src = """
+def parse(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        return None
+"""
+    assert "GL009" not in rules_of(src)
+
+
+def test_gl009_negative_narrow_handler():
+    src = """
+import jax
+
+def drive(params):
+    try:
+        return jax.device_get(params)
+    except ValueError:
+        return None
+"""
+    assert "GL009" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # CFG / dataflow plumbing
 # ---------------------------------------------------------------------------
 
@@ -636,12 +724,12 @@ def test_package_self_check_clean_and_fast():
 
 
 def test_self_check_covers_every_rule_implementation():
-    """All 8 hazard rule ids (plus the parse-error sentinel) are wired:
+    """All 9 hazard rule ids (plus the parse-error sentinel) are wired:
     each hazard has at least one firing fixture above; this guards the
     registry/implementation agreement."""
     from deepdfa_tpu.analysis.rules import RULES
 
-    assert set(RULES) == {f"GL00{i}" for i in range(0, 9)}
+    assert set(RULES) == {f"GL00{i}" for i in range(0, 10)}
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

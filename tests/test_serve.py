@@ -537,3 +537,99 @@ def test_http_score_metrics_and_cache():
         assert health["status"] == "ok" and health["warm_buckets"] == 2
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Injected faults: a failed flush fails alone (deepdfa_tpu/resilience)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_flush_fault_fails_only_that_flush():
+    """An engine raise mid-batch fails the flush's requests inline; the
+    queue keeps draining, later requests succeed, and no warmed executable
+    is lost (ServingStats.compiles stays flat)."""
+    from deepdfa_tpu.resilience import inject
+
+    clock = VirtualClock()
+    config = ServeConfig(batch_slots=4, queue_capacity=8)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config, clock=clock)
+    eng.warmup()
+    compiles = eng.stats.compiles
+    failures0 = eng.stats.failures
+
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "serve.batch", "kind": "raise", "at": 0,
+         "msg": "injected flush fault"},
+    ]})
+    gs = graphs_n(6, seed=11)
+    with inject.armed(plan):
+        first = eng.score_sync(gs[:3])
+        second = eng.score_sync(gs[3:])
+    assert all(r["error"] == "internal" for r in first), first
+    assert all("injected flush fault" in r["detail"] for r in first)
+    assert all(0.0 <= r["prob"] <= 1.0 for r in second), second
+    assert eng.stats.failures - failures0 == 3
+    assert eng.stats.compiles == compiles  # warmed buckets survive
+    assert eng.pending() == 0  # the queue drained despite the fault
+    # failed requests must never poison the content cache
+    replay = eng.score_sync(gs[:3])
+    assert all("prob" in r and not r["cached"] for r in replay), replay
+
+
+def test_http_500_for_failed_flush_then_recovers():
+    """HTTP surface of flush isolation: a POST whose every function died
+    in the failed micro-batch gets a 500 (errors inline); the next POST
+    succeeds with 200 and the stats expose the failure count."""
+    from deepdfa_tpu.resilience import inject
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, deadline_ms=40.0)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config), config=config)
+    eng.warmup()
+    compiles = eng.stats.compiles
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "serve.batch", "kind": "raise", "at": 0,
+         "msg": "injected flush fault"},
+    ]})
+    try:
+        gs = graphs_n(4, seed=13)
+        payload = [{"graph": {
+            "num_nodes": int(g["num_nodes"]),
+            "senders": np.asarray(g["senders"]).tolist(),
+            "receivers": np.asarray(g["receivers"]).tolist(),
+            "feats": {k: np.asarray(v).tolist()
+                      for k, v in g["feats"].items()},
+        }} for g in gs]
+        with inject.armed(plan):
+            status, out = post({"functions": payload[:2]})
+            assert status == 500, (status, out)
+            assert all(r["error"] == "internal" for r in out["results"])
+            status2, out2 = post({"functions": payload[2:]})
+        assert status2 == 200, (status2, out2)
+        assert all(0.0 <= r["prob"] <= 1.0 for r in out2["results"])
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["failures"] == 2
+        assert metrics["compiles"] == compiles
+    finally:
+        server.shutdown()

@@ -91,7 +91,12 @@ def test_greedy_matches_naive_decode():
 
 
 def test_beam_one_matches_greedy():
-    model, params, src = _setup(seed=2)
+    # Greedy and beam-1 compute the same argmax through different program
+    # shapes (beam flattens b*k rows), so on an untrained tiny model
+    # near-tied logits can break differently per XLA version/partitioning.
+    # seed=2 sat on such a tie (flaky across images); seed=0 has a clear
+    # margin at every decode step.
+    model, params, src = _setup(seed=0)
     max_len = 8
     g = greedy_decode(model, params, src, max_len)
     b, _ = beam_search(model, params, src, max_len, beam_size=1)

@@ -82,6 +82,8 @@ def test_fit_learns_synthetic_task():
 
 def test_fit_on_mesh_matches_shapes():
     """Same training loop jitted over an 8-device mesh must run and improve."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
     ex = synthetic_bigvul(120, SMALL.feature, positive_fraction=0.5, seed=2)
     splits = make_splits(ex, "random", seed=0)
     mesh = make_mesh()
