@@ -44,6 +44,15 @@ Rule catalogue (each backed by a positive+negative fixture in
                              vanish inside it, exactly the signals the
                              resilience layer (checkpoint fallback, retry,
                              rollback) needs to see.
+  GL010 unchecked-json-ingest  a ``json.load``/``json.loads`` result that
+                             flows into np/jnp array construction without
+                             passing through a ``contracts.validate_*``
+                             call — unvalidated foreign data becoming
+                             model-feed arrays is exactly the fail-silent
+                             path the data-contract layer
+                             (deepdfa_tpu/contracts) exists to close:
+                             out-of-range indices clamp inside segment ops
+                             and poison gradients instead of failing.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -79,6 +88,7 @@ RULES: Dict[str, str] = {
     "GL007": "key-reuse",
     "GL008": "nonstatic-python-scalar",
     "GL009": "swallowed-device-exception",
+    "GL010": "unchecked-json-ingest",
 }
 
 _JIT_NAMES = frozenset({
@@ -123,6 +133,32 @@ _LOG_CALLS = frozenset({
     "print", "warnings.warn", "traceback.print_exc",
     "traceback.print_exception", "traceback.format_exc",
 })
+# GL010: json ingestion sources, array-construction sinks, and the
+# contracts validators that clean the taint. Cleaner matching is by
+# resolved dotted name, so every import spelling of each validator is
+# enumerated (``from deepdfa_tpu.contracts import validate_example`` /
+# ``from deepdfa_tpu.contracts.schema import ...`` / module-qualified).
+_JSON_SOURCES = frozenset({"json.load", "json.loads"})
+_ARRAY_SINKS = frozenset({
+    "numpy.asarray", "numpy.array", "jax.numpy.asarray", "jax.numpy.array",
+})
+_VALIDATOR_FNS = (
+    "validate_example", "validate_joern_nodes", "validate_joern_edges",
+    "validate_cache_row", "load_examples_jsonl",
+)
+_INGEST_CLEANERS = frozenset(
+    form
+    for name in _VALIDATOR_FNS
+    for form in (
+        name,
+        f"contracts.{name}",
+        f"schema.{name}",
+        f"ingest.{name}",
+        f"deepdfa_tpu.contracts.{name}",
+        f"deepdfa_tpu.contracts.schema.{name}",
+        f"deepdfa_tpu.contracts.ingest.{name}",
+    )
+)
 
 
 @dataclasses.dataclass
@@ -321,6 +357,7 @@ class _FunctionChecker:
         self._check_jit_in_loop()
         self._check_key_reuse()
         self._check_swallowed_exceptions()
+        self._check_unchecked_ingest()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -556,6 +593,42 @@ class _FunctionChecker:
                 f"{', '.join(map(str, lines))}) — reused keys give "
                 "identical streams; jax.random.split per consumer")
 
+
+    # -- unchecked json ingestion (GL010) ------------------------------------
+
+    def _check_unchecked_ingest(self) -> None:
+        """json.load(s) results must pass a contracts.validate_* call
+        before reaching array construction (np/jnp asarray/array) — the
+        data-contract boundary rule. Runs in every scope: foreign data is
+        foreign whether or not the function is jitted."""
+
+        def seed(node: Node, call: ast.Call) -> Optional[str]:
+            if self.mod.resolve(call.func) in _JSON_SOURCES:
+                return "result of json.load(s)(…) is unvalidated ingest data"
+            return None
+
+        analysis = TaintAnalysis(self.mod.resolve, seed_call=seed,
+                                 cleaners=_INGEST_CLEANERS)
+        facts = analysis.solve(self.cfg)
+        for node in self.cfg.nodes:
+            fact = facts.get(node.idx, {})
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = self.mod.resolve(sub.func)
+                    if dotted not in _ARRAY_SINKS:
+                        continue
+                    args = list(sub.args) + [kw.value for kw in sub.keywords]
+                    taints = analysis._union(args, fact, node)
+                    if taints:
+                        self._report(
+                            "GL010", sub,
+                            f"json-ingested data flows into {dotted}() "
+                            "without a contracts.validate_* check — route "
+                            "it through deepdfa_tpu.contracts (schema "
+                            "validation + quarantine) before it becomes a "
+                            "model-feed array", taints)
 
     # -- swallowed device exceptions (GL009) ---------------------------------
 

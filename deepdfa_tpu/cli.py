@@ -189,18 +189,21 @@ def load_dataset(spec: str, feature: FeatureSpec, seed: int = 0,
         splits = make_splits(examples, mode=split_mode, seed=seed)
         return examples, splits
     if spec.endswith(".jsonl") and os.path.exists(spec):
-        examples = []
-        with open(spec) as f:
-            for i, line in enumerate(f):
-                ex = json.loads(line)
-                for key in ("senders", "receivers", "vuln"):
-                    ex[key] = np.asarray(ex[key], np.int32)
-                ex["feats"] = {
-                    k: np.asarray(v, np.int32) for k, v in ex["feats"].items()
-                }
-                ex.setdefault("id", i)
-                ex.setdefault("label", int(ex["vuln"].max()) if len(ex["vuln"]) else 0)
-                examples.append(ex)
+        # Schema-validated ingestion (deepdfa_tpu/contracts): rows that
+        # violate the example contract are moved to the corpus's
+        # quarantine/ sibling (manifest.jsonl records item id, boundary,
+        # reason code, offending fragment) and skipped — fail-closed, so a
+        # poisoned cache row can never reach batch_graphs or the model.
+        from deepdfa_tpu.contracts import load_examples_jsonl
+
+        examples, ingest_report = load_examples_jsonl(
+            spec, subkeys_for(feature))
+        if ingest_report["quarantined"]:
+            logger.warning(
+                "dataset %s: %d row(s) quarantined (%s) -> %s", spec,
+                ingest_report["quarantined"], ingest_report["by_reason"],
+                ingest_report["dir"],
+            )
         # A sibling splits.json (written by etl.pipeline export) pins the
         # partition the abstract-dataflow vocab was built on; re-splitting
         # would leak vocab-defining train examples into test.
@@ -1147,6 +1150,42 @@ def cmd_chaos(args) -> Dict[str, Any]:
     return report
 
 
+def cmd_validate(args) -> Dict[str, Any]:
+    """Schema-validate a cached corpus (deepdfa_tpu/contracts): every
+    ``*.jsonl`` under the cache dir runs through the example contract;
+    violating rows are quarantined under ``<cache>/quarantine/`` with a
+    reason-coded manifest and the command exits nonzero (fail-closed — a
+    dirty cache should fail a pipeline gate, not pass silently).
+
+    ``--smoke``: self-test instead — poison a tiny synthetic corpus across
+    every corruption class in the gauntlet and assert each one is repaired
+    or quarantined under its expected reason code (the scripts/test.sh
+    gate; seconds on CPU)."""
+    from deepdfa_tpu.contracts import gauntlet
+
+    if args.smoke:
+        report = gauntlet.smoke(seed=args.seed)
+        print(json.dumps(report))
+        return report
+    if not args.cache_dir:
+        raise ValueError("validate needs a cache dir/corpus (or --smoke)")
+    # Required subkeys follow the export's FeatureSpec: a single-subkey
+    # corpus (concat_all=False exports) must not quarantine for lacking
+    # the other three.
+    subkeys = (subkeys_for(FeatureSpec.parse_legacy(args.feature))
+               if args.feature else None)
+    report = gauntlet.validate_corpus(
+        args.cache_dir, max_nodes=args.max_nodes,
+        **({"subkeys": subkeys} if subkeys else {}))
+    # Contract taxonomy counters ride along: the per-boundary IngestStats
+    # snapshot is the machine-readable face of the validation pass.
+    from deepdfa_tpu.contracts import STATS
+
+    report["ingest_stats"] = STATS.snapshot()
+    print(json.dumps({k: v for k, v in report.items() if k != "reports"}))
+    return report
+
+
 def cmd_tune(args) -> Dict[str, Any]:
     """Random hyperparameter search (the NNI replacement): samples the
     published search space (paper Table 2 context), runs short fits, ranks
@@ -1477,6 +1516,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                       help="epochs per training scenario (>= 2)")
     p_ch.add_argument("--out-dir", default="runs/chaos")
     p_ch.set_defaults(func=cmd_chaos)
+
+    p_val = sub.add_parser(
+        "validate",
+        help="schema-validate a cached corpus through the data contracts "
+             "(deepdfa_tpu/contracts); violating rows move to "
+             "<cache>/quarantine/ with a reason-coded manifest; nonzero "
+             "exit when anything was quarantined")
+    p_val.add_argument("cache_dir", nargs="?", default=None,
+                       help="cache directory (every *.jsonl under it) or "
+                            "one corpus file")
+    p_val.add_argument("--smoke", action="store_true",
+                       help="seeded corrupt-corpus self-test: every "
+                            "corruption class must be repaired or "
+                            "quarantined under its expected reason code")
+    p_val.add_argument("--max-nodes", type=int, default=None,
+                       help="oversize-graph cap (default: no cap)")
+    p_val.add_argument("--feature", default=None,
+                       help="legacy feature name of the export (sets the "
+                            "required subkeys; default: all four)")
+    p_val.add_argument("--seed", type=int, default=0,
+                       help="--smoke corruption seed")
+    p_val.set_defaults(func=cmd_validate)
 
     p_tune = sub.add_parser("tune")
     common(p_tune)

@@ -1,0 +1,51 @@
+"""Data contracts enforced at every ingestion boundary.
+
+One validator family (schema.py) guards Joern JSON → CPG → cached JSONL →
+``batch_graphs`` inputs → serve admission; violations carry a reason code
+from the :data:`~deepdfa_tpu.contracts.schema.REASONS` taxonomy and land in
+the fail-closed quarantine sink (quarantine.py) instead of the model. The
+corrupt-corpus gauntlet (gauntlet.py) proves the property end to end:
+every seeded corruption class is repaired or quarantined, never trained on.
+"""
+
+from deepdfa_tpu.contracts.ingest import (
+    load_examples_jsonl,
+    write_examples_jsonl,
+)
+from deepdfa_tpu.contracts.quarantine import (
+    Quarantine,
+    quarantine_dir,
+    read_manifest,
+)
+from deepdfa_tpu.contracts.schema import (
+    CHECKSUM_KEY,
+    ContractError,
+    FATAL_REASONS,
+    REASONS,
+    REPAIRABLE_REASONS,
+    STATS,
+    row_checksum,
+    validate_cache_row,
+    validate_example,
+    validate_joern_edges,
+    validate_joern_nodes,
+)
+
+__all__ = [
+    "CHECKSUM_KEY",
+    "ContractError",
+    "FATAL_REASONS",
+    "REASONS",
+    "REPAIRABLE_REASONS",
+    "STATS",
+    "Quarantine",
+    "load_examples_jsonl",
+    "quarantine_dir",
+    "read_manifest",
+    "row_checksum",
+    "validate_cache_row",
+    "validate_example",
+    "validate_joern_edges",
+    "validate_joern_nodes",
+    "write_examples_jsonl",
+]

@@ -208,6 +208,46 @@ class ServingStats:
         return out
 
 
+class IngestStats:
+    """Per-boundary ingestion counters (the data-contract siblings of
+    :class:`ServingStats`, consumed by ``deepdfa_tpu/contracts``).
+
+    Boundaries are free-form strings ("joern", "cache", "serve", ...);
+    fields are ``seen`` / ``valid`` / ``rejected`` / ``repaired`` plus
+    dynamic ``reason:<code>`` and ``repair:<code>`` taxonomy counters.
+    Everything here is host-side Python on values that already crossed to
+    the host (ingestion runs before any device work), so bumping adds no
+    device sync. Thread-safe for the same reason ServingStats is: serve
+    admission validates on many transport threads at once.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: Dict[str, Dict[str, int]] = {}
+
+    def bump(self, boundary: str, field: str, by: int = 1) -> None:
+        with self._lock:
+            b = self._counts.setdefault(boundary, {})
+            b[field] = b.get(field, 0) + by
+
+    def get(self, boundary: str, field: str) -> int:
+        with self._lock:
+            return self._counts.get(boundary, {}).get(field, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """JSON-able per-boundary counter map (the ``cli validate`` /
+        metrics-endpoint body)."""
+        with self._lock:
+            return {b: dict(sorted(fields.items()))
+                    for b, fields in sorted(self._counts.items())}
+
+
 def classification_report_dict(
     probs: np.ndarray, labels: np.ndarray, threshold: float = 0.5
 ) -> Dict[str, Dict[str, float]]:

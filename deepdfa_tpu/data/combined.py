@@ -24,32 +24,27 @@ Text sources (``load_combined_dataset``):
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
-from deepdfa_tpu.core.config import FeatureSpec, subkeys_for
+from deepdfa_tpu.core.config import ALL_SUBKEYS, FeatureSpec, subkeys_for
 
 
-def read_examples_jsonl(path: str) -> List[Dict]:
+def read_examples_jsonl(path: str,
+                        feature: Optional[FeatureSpec] = None) -> List[Dict]:
     """Graph examples in the etl export format (one JSON object per line
-    with num_nodes/senders/receivers/vuln/feats[/label/id])."""
-    examples = []
-    with open(path) as f:
-        for i, line in enumerate(f):
-            ex = json.loads(line)
-            for key in ("senders", "receivers", "vuln"):
-                ex[key] = np.asarray(ex[key], np.int32)
-            ex["feats"] = {
-                k: np.asarray(v, np.int32) for k, v in ex["feats"].items()
-            }
-            ex.setdefault("id", i)
-            ex.setdefault(
-                "label", int(ex["vuln"].max()) if len(ex["vuln"]) else 0
-            )
-            examples.append(ex)
+    with num_nodes/senders/receivers/vuln/feats[/label/id]), read through
+    the shared ingestion contract: schema-violating rows are quarantined
+    into the corpus's ``quarantine/`` sibling and skipped, never joined
+    into a combined batch (deepdfa_tpu/contracts). The required subkeys
+    come from ``feature`` — a single-subkey export (concat_all=False) must
+    not be quarantined for lacking the other three."""
+    from deepdfa_tpu.contracts import load_examples_jsonl
+
+    subkeys = subkeys_for(feature) if feature is not None else ALL_SUBKEYS
+    examples, _ = load_examples_jsonl(path, subkeys)
     return examples
 
 
@@ -68,7 +63,7 @@ def load_graph_source(
             ex["id"] = i
         return examples
     if spec.endswith(".jsonl") and os.path.exists(spec):
-        return read_examples_jsonl(spec)
+        return read_examples_jsonl(spec, feature)
     if os.path.isdir(spec) and (
         os.path.exists(os.path.join(spec, "nodes.csv"))
         or os.path.exists(os.path.join(spec, "nodes_sample.csv"))

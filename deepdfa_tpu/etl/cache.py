@@ -14,6 +14,12 @@ Parity with the reference's two caching layers:
   carry line numbers, and contain dataflow edges — checked once per id and
   remembered in a CSV so re-runs of the export stage skip known-bad graphs
   without re-parsing them.
+
+Data-contract posture (deepdfa_tpu/contracts): JSONL cache rows are written
+with a per-row ``__sha1__`` content digest, and the reader skip-and-counts
+corrupt/truncated/checksum-mismatched lines into the cache's ``quarantine/``
+sibling instead of raising mid-corpus — one torn row costs that row, not
+the whole (expensive) cached prepare.
 """
 
 from __future__ import annotations
@@ -93,9 +99,14 @@ def _write_cache(base: Path, rows: List[Dict]) -> None:
         logger.info("parquet cache unavailable (%s); using jsonl.gz", exc)
         import gzip
 
+        from deepdfa_tpu.contracts.schema import CHECKSUM_KEY, row_checksum
+
         with gzip.open(_sib(base, ".jsonl.gz"), "wt") as f:
             for row in rows:
-                f.write(json.dumps(row) + "\n")
+                # Per-row content digest: bitrot in a cached row must read
+                # as checksum_mismatch at load, not as silent bad data.
+                f.write(json.dumps(
+                    dict(row, **{CHECKSUM_KEY: row_checksum(row)})) + "\n")
         _sib(base, ".parquet").unlink(missing_ok=True)
 
 
@@ -108,13 +119,74 @@ def _read_cache(base: Path) -> Optional[List[Dict]]:
 
             return _decode(pd.read_parquet(pq).to_dict("records"))
         if jl.exists():
-            import gzip
-
-            with gzip.open(jl, "rt") as f:
-                return [json.loads(line) for line in f]
+            return _decode(_read_jsonl_cache(jl))
     except Exception as exc:
         logger.warning("cache read failed (%s); rebuilding", exc)
     return None
+
+
+def _read_jsonl_cache(jl: Path) -> List[Dict]:
+    """Read a gzip-JSONL cache, skip-and-counting bad rows.
+
+    Corrupt/truncated lines (including a gzip stream cut mid-record) and
+    checksum-mismatched rows are quarantined into the cache directory's
+    ``quarantine/`` sibling and skipped — the surviving rows are served
+    instead of raising mid-corpus and forcing a full re-prepare.
+    """
+    import gzip
+
+    from deepdfa_tpu.contracts import ContractError, Quarantine
+    from deepdfa_tpu.contracts.quarantine import quarantine_dir
+    from deepdfa_tpu.contracts.schema import validate_cache_row
+
+    rows: List[Dict] = []
+    sink: Optional[Quarantine] = None
+
+    def quarantine(err: ContractError, raw) -> None:
+        nonlocal sink
+        if sink is None:
+            sink = Quarantine(quarantine_dir(jl))
+        sink.put(err, raw=raw)
+
+    with gzip.open(jl, "rt") as f:
+        i = 0
+        while True:
+            try:
+                line = f.readline()
+            except (EOFError, OSError) as e:
+                # The gzip stream itself was cut: everything already read
+                # is intact; the tail is one truncated record.
+                quarantine(ContractError(
+                    "truncated_json", f"gzip stream truncated: {e}",
+                    boundary="cache", item_id=i), raw="")
+                break
+            if not line:
+                break
+            if line.strip():
+                try:
+                    doc = json.loads(line)
+                    rows.append(validate_cache_row(
+                        doc, boundary="cache",
+                        item_id=doc.get("id", i)
+                        if isinstance(doc, dict) else i))
+                except json.JSONDecodeError as e:
+                    quarantine(ContractError(
+                        "truncated_json", f"row {i}: {e}",
+                        boundary="cache", item_id=i), raw=line)
+                except ContractError as e:
+                    quarantine(e, raw=line)
+            i += 1
+    if sink is not None and sink.total:
+        if not rows:
+            # Every row was corrupt: serving [] would read as a valid
+            # "0-row cache hit" upstream. The source of truth still
+            # exists — fail the read so minimal_cache rebuilds.
+            raise ValueError(
+                f"all {sink.total} cache rows corrupt (quarantined "
+                f"-> {sink.root})")
+        logger.warning("cache %s: %d corrupt row(s) quarantined -> %s",
+                       jl, sink.total, sink.root)
+    return rows
 
 
 # List-valued fields (added/removed line numbers) ride JSON-encoded inside
@@ -155,16 +227,21 @@ def check_validity(
     """check_validity parity (datasets.py:295-330): exports parse, at least
     one node carries a lineNumber (warn / fail per flag), and the edge set
     contains dataflow (REACHING_DEF or CDG) edges (warn / fail per flag)."""
+    from deepdfa_tpu.contracts.schema import (
+        validate_joern_edges,
+        validate_joern_nodes,
+    )
+
     stem = Path(stem)
     try:
         with open(stem.with_suffix(".c.nodes.json")) as f:
-            nodes = json.load(f)
+            nodes = validate_joern_nodes(json.load(f), item_id=str(stem))
         if not any("lineNumber" in n for n in nodes):
             logger.warning("valid (%s): no line number", stem)
             if require_line_number:
                 return False
         with open(stem.with_suffix(".c.edges.json")) as f:
-            edges = json.load(f)
+            edges = validate_joern_edges(json.load(f), item_id=str(stem))
         etypes = {e[2] for e in edges if len(e) > 2}
         if "REACHING_DEF" not in etypes and "CDG" not in etypes:
             logger.warning("valid (%s): no dataflow", stem)

@@ -25,6 +25,12 @@ import json
 from pathlib import Path
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
+from deepdfa_tpu.contracts.schema import (
+    ContractError,
+    validate_joern_edges,
+    validate_joern_nodes,
+)
+
 DROPPED_NODE_LABELS = frozenset({"COMMENT", "FILE"})
 DROPPED_EDGE_TYPES = frozenset(
     {"CONTAINS", "SOURCE_FILE", "DOMINATE", "POST_DOMINATE"}
@@ -118,6 +124,7 @@ def _to_int(value, default: int = -1) -> int:
 def from_joern_json(
     nodes_json: Sequence[Mapping],
     edges_json: Sequence[Sequence],
+    validate: bool = True,
 ) -> CPG:
     """Build a filtered :class:`CPG` from Joern export payloads.
 
@@ -128,7 +135,18 @@ def from_joern_json(
     reference builds its analysis graph as (outnode, innode) pairs,
     dataflow.py:242-244). Edges here are stored in semantic
     source->target direction: ``src = row[1]``, ``dst = row[0]``.
+
+    Both payloads pass the Joern ingestion contract first
+    (``contracts.validate_joern_nodes/edges``): mis-typed records and
+    duplicated node ids raise :class:`~deepdfa_tpu.contracts.ContractError`
+    here, at the boundary, instead of surfacing as a KeyError three stages
+    later (or not at all). ``validate=False`` skips the pass for callers
+    that already ran it with a better item id (:func:`load_joern_export`)
+    — one validation per export, not two.
     """
+    if validate:
+        nodes_json = validate_joern_nodes(nodes_json)
+        edges_json = validate_joern_edges(edges_json)
     nodes: Dict[int, CPGNode] = {}
     for rec in nodes_json:
         label = str(rec.get("_label", ""))
@@ -151,7 +169,10 @@ def from_joern_json(
             n.code = n.name
 
     if not any(n.label == "METHOD" for n in nodes.values()):
-        raise ValueError("empty graph: no METHOD node")
+        # ContractError subclasses ValueError: pre-contract callers that
+        # caught ValueError here keep working, new callers get the reason.
+        raise ContractError("no_method_node", "empty graph: no METHOD node",
+                            boundary="joern")
 
     edges: List[Tuple[int, int, str]] = []
     seen = set()
@@ -177,13 +198,17 @@ def from_joern_json(
 
 
 def load_joern_export(stem: str | Path) -> CPG:
-    """Read ``<stem>.nodes.json`` + ``<stem>.edges.json`` from disk."""
+    """Read ``<stem>.nodes.json`` + ``<stem>.edges.json`` from disk,
+    through the Joern ingestion contract (a truncated or mis-typed export
+    raises :class:`~deepdfa_tpu.contracts.ContractError`/JSONDecodeError at
+    this boundary — the export driver's per-item fault handling quarantines
+    it instead of aborting the corpus)."""
     stem = str(stem)
     with open(stem + ".nodes.json") as f:
-        nodes_json = json.load(f)
+        nodes_json = validate_joern_nodes(json.load(f), item_id=stem)
     with open(stem + ".edges.json") as f:
-        edges_json = json.load(f)
-    return from_joern_json(nodes_json, edges_json)
+        edges_json = validate_joern_edges(json.load(f), item_id=stem)
+    return from_joern_json(nodes_json, edges_json, validate=False)
 
 
 def reduce_graph(cpg: CPG, gtype: str) -> CPG:

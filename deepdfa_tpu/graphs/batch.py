@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from deepdfa_tpu.contracts.schema import ContractError
+
 
 
 @struct.dataclass
@@ -188,6 +190,31 @@ def batch_graphs(
     """
     if len(graphs) > n_graphs:
         raise ValueError(f"{len(graphs)} graphs > {n_graphs} slots")
+
+    # Endpoint contract, enforced BEFORE node-offsetting (and before the
+    # native batcher copies anything): a dangling endpoint used to clamp
+    # inside the masked segment ops and silently poison gradients. The
+    # check is allocation-free for valid input — np.asarray of an existing
+    # array is a view, min/max are O(E) reads with scalar results.
+    for gi, g in enumerate(graphs):
+        n = int(g["num_nodes"])
+        s = np.asarray(g["senders"])
+        r = np.asarray(g["receivers"])
+        if s.shape != r.shape or s.ndim != 1:
+            raise ContractError(
+                "edge_shape",
+                f"graph {gi} (id {g.get('id', '?')}): senders/receivers "
+                "must be equal-length 1-d",
+                boundary="batch", item_id=g.get("id", gi))
+        if s.size and (int(s.min()) < 0 or int(r.min()) < 0
+                       or int(s.max()) >= n or int(r.max()) >= n):
+            raise ContractError(
+                "dangling_endpoint",
+                f"graph {gi} (id {g.get('id', '?')}): edge endpoint out of "
+                f"range for {n} nodes "
+                f"(senders [{int(s.min())}, {int(s.max())}], receivers "
+                f"[{int(r.min())}, {int(r.max())}])",
+                boundary="batch", item_id=g.get("id", gi))
 
     graph_mask = np.zeros(n_graphs, bool)
     graph_ids = np.full(n_graphs, -1, np.int64)

@@ -20,6 +20,13 @@ tiny synthetic workload sized for seconds on CPU:
 * ``serve_flush_fault`` — an injected raise inside a serving micro-batch
   fails only that flush; later requests succeed and the compile count
   stays flat (no warmed-executable loss).
+* ``poison_corpus`` — the corrupt-corpus gauntlet (deepdfa_tpu/contracts):
+  a seeded fuzzer damages a synthetic corpus across every corruption
+  class; training on the poisoned corpus must complete, the quarantine
+  manifest must list every poisoned item under its expected reason code
+  (zero false quarantines), and the final history must be **bit-for-bit
+  identical** to a run on the pre-corruption clean subset — data faults
+  cost the poisoned rows, never the numerics of the surviving ones.
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -254,6 +261,74 @@ def scenario_serve_flush_fault(n_examples: int = 6) -> Dict[str, Any]:
                 engine.stats.compiles == compiles_after_warmup}
 
 
+def scenario_poison_corpus(out_dir: str, n_examples: int,
+                           epochs: int) -> Dict[str, Any]:
+    """The data-contract gauntlet as a chaos scenario (ISSUE 4 headline):
+    train on a seeded poisoned corpus, then on its pre-corruption clean
+    subset, and demand (a) a complete, correctly reason-coded quarantine
+    manifest with zero false quarantines and (b) bit-for-bit identical
+    training histories — quarantine+repair must be exactly equivalent to
+    never having seen the corruption."""
+    from deepdfa_tpu.contracts import Quarantine, load_examples_jsonl, read_manifest
+    from deepdfa_tpu.contracts import gauntlet, quarantine as cq
+    from deepdfa_tpu.core.config import ALL_SUBKEYS
+    from deepdfa_tpu.data.splits import make_splits
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import fit
+
+    examples, _ = _dataset(n_examples, seed=3)
+    root = os.path.join(out_dir, "poison")
+    plan = gauntlet.poison_corpus(examples, root, seed=0)
+
+    sink = Quarantine(os.path.join(root, cq.DIRNAME))
+    cq.clear(sink.root)  # fresh manifest per soak: the grade below is exact
+    poisoned, report = load_examples_jsonl(
+        os.path.join(root, "corpus.jsonl"), ALL_SUBKEYS,
+        max_nodes=gauntlet.GAUNTLET_MAX_NODES, quarantine=sink)
+    clean_sink = Quarantine(os.path.join(root, "quarantine_clean"))
+    cq.clear(clean_sink.root)
+    clean, _ = load_examples_jsonl(
+        os.path.join(root, "clean_subset.jsonl"), ALL_SUBKEYS,
+        max_nodes=gauntlet.GAUNTLET_MAX_NODES, quarantine=clean_sink)
+
+    grade = gauntlet.check_manifest(plan, read_manifest(sink.root),
+                                    [ex["id"] for ex in poisoned])
+
+    def run(exs):
+        cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0)
+        splits = make_splits(exs, "random", seed=0)
+        return fit(FlowGNN(TINY), exs, splits, cfg, DATA)
+
+    _, hist_poisoned = run(poisoned)
+    _, hist_clean = run(clean)
+    match = (
+        len(hist_poisoned["epochs"]) == len(hist_clean["epochs"]) == epochs
+        and all(_records_match(a, b)
+                for a, b in zip(hist_poisoned["epochs"],
+                                hist_clean["epochs"]))
+        and hist_poisoned["best_val_loss"] == hist_clean["best_val_loss"]
+        and hist_poisoned["best_epoch"] == hist_clean["best_epoch"]
+    )
+    ok = bool(
+        grade["ok"]
+        and clean_sink.total == 0          # the clean subset is truly clean
+        and len(poisoned) == len(clean)    # survivors == clean subset
+        and report["repaired"] >= grade["repairable_victims"]
+        and match
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["data-corrupt"],
+        "classes": len(plan["classes"]),
+        "quarantined": report["quarantined"],
+        "by_reason": report["by_reason"],
+        "repaired": report["repaired"],
+        "manifest_grade": grade,
+        "survivors": len(poisoned),
+        "bitwise_match": match,
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -267,12 +342,15 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
         out_dir, n_examples, epochs)
     scenarios["etl_retry"] = scenario_etl_retry()
     scenarios["serve_flush_fault"] = scenario_serve_flush_fault()
+    scenarios["poison_corpus"] = scenario_poison_corpus(
+        out_dir, n_examples, epochs)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
                "corrupt_restore": "checkpoint-corrupt",
                "etl_retry": "etl-item-raise",
-               "serve_flush_fault": "serve-batch-raise"}
+               "serve_flush_fault": "serve-batch-raise",
+               "poison_corpus": "data-corrupt"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu import contracts
 from deepdfa_tpu.core.config import subkeys_for
 from deepdfa_tpu.core.metrics import ServingStats
 from deepdfa_tpu.resilience import inject
@@ -212,40 +213,22 @@ class ServeEngine:
     # -- admission ---------------------------------------------------------
 
     def _normalize_graph(self, graph: Mapping) -> Dict:
-        """Validate + canonicalize one request graph (raises
-        BadRequestError on malformed payloads — the HTTP 400 class, kept
-        distinct from capacity rejections)."""
+        """Validate + canonicalize one request graph — the SAME contract
+        the offline loaders enforce (``contracts.validate_example``), so
+        online and offline ingestion cannot drift. ContractError maps to
+        BadRequestError (the HTTP 400 class, kept distinct from capacity
+        rejections); the validator reproduces the historic 400
+        message classes byte-for-byte, asserted by the regression test in
+        tests/test_serve.py."""
+        union = sorted({k for lane in self._lanes.values()
+                        for k in lane.subkeys})
         try:
-            n = int(graph["num_nodes"])
-            senders = np.asarray(graph["senders"], np.int32)
-            receivers = np.asarray(graph["receivers"], np.int32)
-            feats = {k: np.asarray(v, np.int32)
-                     for k, v in graph["feats"].items()}
-        except (KeyError, TypeError, ValueError) as e:
-            raise BadRequestError(f"malformed graph payload: {e}")
-        if n < 1:
-            raise BadRequestError("graph needs at least one node")
-        if senders.shape != receivers.shape or senders.ndim != 1:
-            raise BadRequestError("senders/receivers must be equal-length 1-d")
-        if len(senders) and (senders.min() < 0 or receivers.min() < 0
-                             or senders.max() >= n or receivers.max() >= n):
-            raise BadRequestError("edge endpoint out of range")
-        union = set()
-        for lane in self._lanes.values():
-            union.update(lane.subkeys)
-        for key in union:
-            if key not in feats:
-                raise BadRequestError(f"missing feature subkey {key!r}")
-            if feats[key].shape != (n,):
-                raise BadRequestError(
-                    f"feats[{key!r}] must have shape ({n},)"
-                )
-        out = {"num_nodes": n, "senders": senders, "receivers": receivers,
-               "feats": feats,
-               "vuln": np.zeros(n, np.int32)}  # labels don't exist at serve
-        if "id" in graph:
-            out["id"] = int(graph["id"])
-        return out
+            return contracts.validate_example(graph, union,
+                                              with_label=False,
+                                              boundary="serve",
+                                              stats=contracts.STATS)
+        except contracts.ContractError as e:
+            raise BadRequestError(str(e))
 
     def submit(self, graph: Mapping, code: Optional[str] = None,
                deadline_ms: Optional[float] = None) -> ServeRequest:

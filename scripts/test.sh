@@ -13,10 +13,15 @@ bash scripts/lint.sh
 CHECKPOINT_DIR= COMBINED_DIR= bash scripts/serve.sh --smoke 8 \
   --batch-slots 4 --port 0 \
   --set model.hidden_dim=8 --set model.n_steps=2
-# Chaos soak: five injected fault classes against a tiny run — resume
+# Data-contract smoke (deepdfa_tpu/contracts): a seeded corrupt corpus is
+# ingested and every corruption class must be repaired or quarantined
+# under its expected reason code — seconds, fail-closed.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli validate --smoke
+# Chaos soak: six injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
-# serving flush isolation. Fails in under a minute if a recovery contract
-# regressed; the eval below would never notice.
+# serving flush isolation, corrupt-corpus quarantine+bitwise-clean
+# training. Fails in minutes if a recovery contract regressed; the eval
+# below would never notice.
 bash scripts/chaos.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

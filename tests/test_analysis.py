@@ -472,6 +472,83 @@ def step(x):
 
 
 # ---------------------------------------------------------------------------
+# GL010 unchecked-json-ingest
+# ---------------------------------------------------------------------------
+
+
+def test_gl010_json_into_asarray():
+    src = """
+import json
+import numpy as np
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return np.asarray(doc["senders"])
+"""
+    fs = findings_for(src, "GL010")
+    assert len(fs) == 1
+    assert "contracts.validate_" in fs[0].message
+    assert any("json.load" in step for step in fs[0].trace)
+
+
+def test_gl010_jsonl_loop_into_np_array():
+    src = """
+import json
+import numpy as np
+
+def load(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            ex = json.loads(line)
+            out.append(np.array(ex["vuln"], np.int32))
+    return out
+"""
+    assert "GL010" in rules_of(src)
+
+
+def test_gl010_negative_validated_between():
+    src = """
+import json
+import numpy as np
+from deepdfa_tpu.contracts import validate_example
+
+def load(path, subkeys):
+    with open(path) as f:
+        doc = json.load(f)
+    ex = validate_example(doc, subkeys, with_label=True)
+    return np.asarray(ex["senders"])
+"""
+    assert "GL010" not in rules_of(src)
+
+
+def test_gl010_negative_module_qualified_validator():
+    src = """
+import json
+import numpy as np
+from deepdfa_tpu import contracts
+
+def load(path):
+    with open(path) as f:
+        nodes = contracts.validate_joern_nodes(json.load(f))
+    return np.asarray([n["id"] for n in nodes])
+"""
+    assert "GL010" not in rules_of(src)
+
+
+def test_gl010_negative_no_array_sink():
+    src = """
+import json
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)["config"]
+"""
+    assert "GL010" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -724,12 +801,12 @@ def test_package_self_check_clean_and_fast():
 
 
 def test_self_check_covers_every_rule_implementation():
-    """All 9 hazard rule ids (plus the parse-error sentinel) are wired:
+    """All 10 hazard rule ids (plus the parse-error sentinel) are wired:
     each hazard has at least one firing fixture above; this guards the
     registry/implementation agreement."""
     from deepdfa_tpu.analysis.rules import RULES
 
-    assert set(RULES) == {f"GL00{i}" for i in range(0, 10)}
+    assert set(RULES) == {f"GL00{i}" for i in range(0, 10)} | {"GL010"}
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

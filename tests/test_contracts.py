@@ -1,0 +1,470 @@
+"""Data contracts (deepdfa_tpu/contracts): validator taxonomy, quarantine
+sink, the two-tier JSONL loader, and the corrupt-corpus gauntlet.
+
+The end-to-end headline (training on a poisoned corpus is bitwise
+equivalent to training on its clean subset) lives with the other chaos
+scenarios in tests/test_resilience.py; here the contracts themselves are
+pinned: every reason code has a firing fixture, repairs are
+value-preserving, and the seeded fuzz property holds — every corruption
+class is repaired or quarantined, never loaded.
+"""
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.contracts import (
+    CHECKSUM_KEY,
+    ContractError,
+    FATAL_REASONS,
+    Quarantine,
+    REASONS,
+    REPAIRABLE_REASONS,
+    load_examples_jsonl,
+    read_manifest,
+    row_checksum,
+    validate_cache_row,
+    validate_example,
+    validate_joern_edges,
+    validate_joern_nodes,
+    write_examples_jsonl,
+)
+from deepdfa_tpu.contracts import gauntlet
+from deepdfa_tpu.core.config import ALL_SUBKEYS, FeatureSpec
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+
+
+def good_graph(n=4, with_label=True):
+    g = {
+        "num_nodes": n,
+        "senders": list(range(n - 1)),
+        "receivers": list(range(1, n)),
+        "feats": {k: [2] * n for k in ALL_SUBKEYS},
+    }
+    if with_label:
+        g["vuln"] = [0] * (n - 1) + [1]
+        g["label"] = 1
+    return g
+
+
+def reason_of(graph, **kw):
+    with pytest.raises(ContractError) as ei:
+        validate_example(graph, ALL_SUBKEYS, **kw)
+    return ei.value.reason
+
+
+# ---------------------------------------------------------------------------
+# validate_example: every fatal reason fires; messages keep the serve
+# 400-class wording (byte-compat asserted per class in test_serve.py)
+# ---------------------------------------------------------------------------
+
+
+def test_valid_graph_normalizes():
+    out = validate_example(good_graph(), ALL_SUBKEYS, with_label=True)
+    assert out["num_nodes"] == 4 and out["label"] == 1
+    assert out["senders"].dtype == np.int32
+    assert all(out["feats"][k].dtype == np.int32 for k in ALL_SUBKEYS)
+    np.testing.assert_array_equal(out["vuln"], [0, 0, 0, 1])
+
+
+def test_serve_shape_zeroes_vuln():
+    out = validate_example(good_graph(with_label=False), ALL_SUBKEYS,
+                           with_label=False)
+    np.testing.assert_array_equal(out["vuln"], np.zeros(4, np.int32))
+    assert "label" not in out
+
+
+def test_empty_graph():
+    g = good_graph()
+    g["num_nodes"] = 0
+    for key in ("senders", "receivers", "vuln"):
+        g[key] = []
+    g["feats"] = {k: [] for k in ALL_SUBKEYS}
+    assert reason_of(g, with_label=True) == "empty_graph"
+
+
+def test_oversize_graph_checked_before_shapes():
+    g = good_graph()
+    g["num_nodes"] = 10_000  # arrays deliberately NOT resized
+    assert reason_of(g, with_label=True, max_nodes=512) == "oversize_graph"
+
+
+def test_dangling_endpoint():
+    g = good_graph()
+    g["senders"][0] = 99
+    assert reason_of(g, with_label=True) == "dangling_endpoint"
+    g = good_graph()
+    g["receivers"][0] = -1
+    assert reason_of(g, with_label=True) == "dangling_endpoint"
+
+
+def test_edge_shape():
+    g = good_graph()
+    g["receivers"] = g["receivers"][:-1]
+    assert reason_of(g, with_label=True) == "edge_shape"
+
+
+def test_missing_subkey_and_missing_field():
+    g = good_graph()
+    del g["feats"]["api"]
+    assert reason_of(g, with_label=True) == "missing_subkey"
+    g = good_graph()
+    del g["num_nodes"]
+    err = pytest.raises(ContractError, validate_example, g, ALL_SUBKEYS,
+                        with_label=True).value
+    assert err.reason == "missing_field"
+    assert str(err) == "malformed graph payload: 'num_nodes'"
+
+
+def test_feat_length_and_negative_and_nan():
+    g = good_graph()
+    g["feats"]["api"] = g["feats"]["api"][:-1]
+    assert reason_of(g, with_label=True) == "feat_length"
+    g = good_graph()
+    g["feats"]["api"][1] = -3
+    assert reason_of(g, with_label=True) == "negative_feature"
+    g = good_graph()
+    g["feats"]["api"] = [float("nan")] * g["num_nodes"]
+    assert reason_of(g, with_label=True) == "nan_feature"
+
+
+def test_label_and_vuln_domain():
+    g = good_graph()
+    g["label"] = 7
+    assert reason_of(g, with_label=True) == "label_domain"
+    g = good_graph()
+    g["vuln"][0] = 5
+    assert reason_of(g, with_label=True) == "label_domain"
+
+
+def test_mistyped_field():
+    g = good_graph()
+    g["senders"] = "zzz"
+    assert reason_of(g, with_label=True) == "mistyped_field"
+    g = good_graph()
+    g["feats"]["api"] = [1.5] * g["num_nodes"]  # non-integral floats
+    assert reason_of(g, with_label=True) == "mistyped_field"
+
+
+def test_int32_overflow_cannot_wrap_into_range():
+    """astype wraps silently (2**32 -> 0): a corrupt 64-bit endpoint must
+    reject as mistyped, never wrap back into [0, n) and validate."""
+    g = good_graph()
+    g["senders"][0] = 2 ** 32  # wraps to 0 under a bare astype(int32)
+    assert reason_of(g, with_label=True) == "mistyped_field"
+    g = good_graph()
+    g["feats"]["api"][0] = float(2 ** 35)  # float path wraps too
+    assert reason_of(g, with_label=True) == "mistyped_field"
+
+
+def test_single_subkey_corpus_not_quarantined(tmp_path):
+    """A concat_all=False export carries ONE subkey; validating it against
+    its own FeatureSpec must load clean (only the required subkeys are
+    demanded; extras are validated when present)."""
+    exs = _synthetic(4)
+    for ex in exs:
+        ex["feats"] = {"datatype": ex["feats"]["datatype"]}
+    path = tmp_path / "c.jsonl"
+    write_examples_jsonl(exs, path, checksum=False)
+    loaded, rep = load_examples_jsonl(path, ("datatype",),
+                                      quarantine=Quarantine(tmp_path / "q"))
+    assert rep["quarantined"] == 0 and rep["loaded"] == 4
+    from deepdfa_tpu.data.combined import read_examples_jsonl
+
+    assert len(read_examples_jsonl(
+        str(path), FeatureSpec(subkey="datatype", concat_all=False))) == 4
+
+
+def test_duplicate_node_id():
+    g = good_graph()
+    g["node_ids"] = [10, 10, 12, 13]
+    assert reason_of(g, with_label=True) == "duplicate_node_id"
+
+
+def test_repair_is_value_preserving_and_recorded():
+    g = good_graph()
+    g["feats"]["api"] = [float(v) for v in g["feats"]["api"]]
+    g["label"] = 1.0
+    repairs = []
+    out = validate_example(g, ALL_SUBKEYS, with_label=True, repairs=repairs)
+    assert "float_field" in repairs
+    assert out["label"] == 1
+    np.testing.assert_array_equal(
+        out["feats"]["api"], np.asarray([2] * 4, np.int32))
+
+
+def test_label_defaults_to_vuln_max():
+    g = good_graph()
+    del g["label"]
+    out = validate_example(g, ALL_SUBKEYS, with_label=True)
+    assert out["label"] == 1
+
+
+def test_taxonomy_severities_cover_reasons():
+    assert FATAL_REASONS | REPAIRABLE_REASONS == set(REASONS)
+    assert not FATAL_REASONS & REPAIRABLE_REASONS
+
+
+# ---------------------------------------------------------------------------
+# Joern + cache-row contracts
+# ---------------------------------------------------------------------------
+
+
+def test_joern_validators():
+    nodes = [{"id": 1, "_label": "METHOD"}, {"id": 2}]
+    edges = [[2, 1, "AST", ""]]
+    assert validate_joern_nodes(nodes) is nodes
+    assert validate_joern_edges(edges) is edges
+    with pytest.raises(ContractError) as ei:
+        validate_joern_nodes([{"id": 1}, {"id": 1}])
+    assert ei.value.reason == "duplicate_node_id"
+    with pytest.raises(ContractError):
+        validate_joern_nodes([{"no_id": 1}])
+    with pytest.raises(ContractError):
+        validate_joern_edges([[1, 2]])  # no etype
+    with pytest.raises(ContractError):
+        validate_joern_edges({"not": "a list"})
+
+
+def test_cache_row_checksum():
+    row = {"a": 1, "b": [1, 2]}
+    stamped = dict(row, **{CHECKSUM_KEY: row_checksum(row)})
+    assert validate_cache_row(stamped) == row
+    stamped["a"] = 2  # bitrot under a stale digest
+    with pytest.raises(ContractError) as ei:
+        validate_cache_row(stamped)
+    assert ei.value.reason == "checksum_mismatch"
+    assert validate_cache_row(row) == row  # digest-free rows pass through
+
+
+# ---------------------------------------------------------------------------
+# Quarantine sink
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_manifest_layout(tmp_path):
+    sink = Quarantine(tmp_path / "quarantine")
+    sink.put(ContractError("dangling_endpoint", "edge endpoint out of range",
+                           boundary="cache", item_id=7, fragment="[99]"),
+             raw='{"bad": "row"}')
+    sink.put(ContractError("label_domain", "label 7 outside {0, 1}",
+                           boundary="cache", item_id=9))
+    entries = read_manifest(sink.root)
+    assert [e["item_id"] for e in entries] == [7, 9]
+    assert entries[0]["reason"] == "dangling_endpoint"
+    assert entries[0]["boundary"] == "cache"
+    assert entries[0]["fragment"] == "[99]"
+    assert [e["ordinal"] for e in entries] == [0, 1]
+    items = [json.loads(line) for line in
+             (sink.root / "items.jsonl").read_text().splitlines()]
+    assert items[0]["raw"] == '{"bad": "row"}'
+    assert sink.counts == {"dangling_endpoint": 1, "label_domain": 1}
+
+
+# ---------------------------------------------------------------------------
+# The two-tier loader
+# ---------------------------------------------------------------------------
+
+
+def _synthetic(n, seed=0):
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+    return synthetic_bigvul(n, FEAT, positive_fraction=0.5, seed=seed)
+
+
+def test_loader_roundtrip_fast_and_checksummed_agree(tmp_path):
+    """The fast path (no digests) and the full validator (digests) must
+    produce identical examples — the loader's two tiers cannot drift."""
+    exs = _synthetic(8)
+    plain = tmp_path / "plain.jsonl"
+    stamped = tmp_path / "stamped.jsonl"
+    write_examples_jsonl(exs, plain, checksum=False)
+    write_examples_jsonl(exs, stamped, checksum=True)
+    a, ra = load_examples_jsonl(plain, ALL_SUBKEYS,
+                                quarantine=Quarantine(tmp_path / "qa"))
+    b, rb = load_examples_jsonl(stamped, ALL_SUBKEYS,
+                                quarantine=Quarantine(tmp_path / "qb"))
+    assert ra["quarantined"] == rb["quarantined"] == 0
+    assert ra["fast_path"] == 8 and rb["fast_path"] == 0
+    assert len(a) == len(b) == 8
+    for ea, eb in zip(a, b):
+        assert ea["id"] == eb["id"] and ea["label"] == eb["label"]
+        for key in ("senders", "receivers", "vuln"):
+            assert ea[key].dtype == eb[key].dtype == np.int32
+            np.testing.assert_array_equal(ea[key], eb[key])
+        for k in ALL_SUBKEYS:
+            np.testing.assert_array_equal(ea["feats"][k], eb["feats"][k])
+
+
+def test_loader_truncated_line_mid_corpus(tmp_path):
+    exs = _synthetic(5)
+    path = tmp_path / "c.jsonl"
+    write_examples_jsonl(exs, path, checksum=False)
+    lines = path.read_text().splitlines()
+    lines[2] = lines[2][: len(lines[2]) // 2]  # torn write mid-record
+    path.write_text("\n".join(lines) + "\n")
+    loaded, rep = load_examples_jsonl(path, ALL_SUBKEYS,
+                                      quarantine=Quarantine(tmp_path / "q"))
+    assert rep["loaded"] == 4 and rep["by_reason"] == {"truncated_json": 1}
+    assert [m["item_id"] for m in read_manifest(tmp_path / "q")] == [2]
+
+
+def test_loader_fast_path_catches_domain_violations(tmp_path):
+    """Corruption in NON-checksummed rows (the structural fast path +
+    bulk negativity pass) still quarantines with exact reason codes."""
+    exs = _synthetic(6)
+    path = tmp_path / "c.jsonl"
+    write_examples_jsonl(exs, path, checksum=False)
+    lines = path.read_text().splitlines()
+
+    def mutate(i, fn):
+        row = json.loads(lines[i])
+        fn(row)
+        lines[i] = json.dumps(row)
+
+    mutate(0, lambda r: r["senders"].__setitem__(0, r["num_nodes"] + 5))
+    mutate(1, lambda r: r["feats"]["api"].__setitem__(0, -2))
+    mutate(2, lambda r: r["receivers"].__setitem__(0, -4))
+    mutate(3, lambda r: r["vuln"].__setitem__(0, 3))
+    path.write_text("\n".join(lines) + "\n")
+    loaded, rep = load_examples_jsonl(path, ALL_SUBKEYS,
+                                      quarantine=Quarantine(tmp_path / "q"))
+    assert rep["loaded"] == 2
+    got = {m["item_id"]: m["reason"] for m in read_manifest(tmp_path / "q")}
+    assert got == {0: "dangling_endpoint", 1: "negative_feature",
+                   2: "dangling_endpoint", 3: "label_domain"}
+    assert all(int(ex["id"]) in (4, 5) for ex in loaded)
+
+
+# ---------------------------------------------------------------------------
+# The gauntlet: seeded fuzz property
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_every_class_repaired_or_quarantined(tmp_path, seed):
+    """Property: for any seed, every corruption class is either repaired
+    (value-preserving) or quarantined under its expected reason code;
+    survivors batch cleanly (corruption can never reach batch_graphs)."""
+    from deepdfa_tpu.graphs.batch import batch_graphs, pad_budget_for
+
+    exs = _synthetic(30, seed=seed)
+    plan = gauntlet.poison_corpus(exs, tmp_path, seed=seed)
+    assert len(plan["classes"]) >= 10  # the ISSUE floor
+    sink = Quarantine(tmp_path / "quarantine")
+    loaded, rep = load_examples_jsonl(
+        tmp_path / "corpus.jsonl", ALL_SUBKEYS,
+        max_nodes=gauntlet.GAUNTLET_MAX_NODES, quarantine=sink)
+    grade = gauntlet.check_manifest(plan, read_manifest(sink.root),
+                                    [ex["id"] for ex in loaded])
+    assert grade["ok"], grade
+    n_fatal = grade["fatal_victims"]
+    assert rep["loaded"] == 30 - n_fatal
+    assert rep["repaired"] == grade["repairable_victims"] == 2
+    # Fatal victims never load; survivors all reach batch_graphs fine.
+    fatal_ids = {p["index"] for p in plan["victims"]
+                 if p["expected_reason"] is not None}
+    assert fatal_ids.isdisjoint({int(ex["id"]) for ex in loaded})
+    budget = pad_budget_for(loaded, n_graphs=len(loaded))
+    batch = batch_graphs(loaded, len(loaded), budget["max_nodes"],
+                         budget["max_edges"], ALL_SUBKEYS)
+    assert int(np.asarray(batch.graph_mask).sum()) == len(loaded)
+
+
+def test_smoke_is_green_and_seeded(tmp_path):
+    a = gauntlet.smoke(tmp_path / "a", seed=5)
+    b = gauntlet.smoke(tmp_path / "b", seed=5)
+    assert a["ok"] and b["ok"]
+    assert a["ingest"]["by_reason"] == b["ingest"]["by_reason"]  # seeded
+
+
+def test_loader_fast_path_repairs_float_label(tmp_path):
+    """1.0 == 1 in Python: the fast path must exact-type-probe the label
+    so a float label takes the slow path's repair, keeping both tiers in
+    agreement (int labels out, repair counted)."""
+    exs = _synthetic(3)
+    path = tmp_path / "c.jsonl"
+    write_examples_jsonl(exs, path, checksum=False)
+    lines = path.read_text().splitlines()
+    row = json.loads(lines[1])
+    row["label"] = float(row["label"])
+    lines[1] = json.dumps(row)
+    path.write_text("\n".join(lines) + "\n")
+    loaded, rep = load_examples_jsonl(path, ALL_SUBKEYS,
+                                      quarantine=Quarantine(tmp_path / "q"))
+    assert rep["loaded"] == 3 and rep["repaired"] == 1
+    assert all(type(ex["label"]) is int for ex in loaded)
+
+
+def test_validate_corpus_recurses_into_subdirs(tmp_path):
+    exs = _synthetic(4)
+    write_examples_jsonl(exs, tmp_path / "run1" / "examples.jsonl",
+                         checksum=False)
+    lines = (tmp_path / "run1" / "examples.jsonl").read_text().splitlines()
+    row = json.loads(lines[0])
+    row["label"] = 9
+    lines[0] = json.dumps(row)
+    (tmp_path / "run1" / "examples.jsonl").write_text(
+        "\n".join(lines) + "\n")
+    report = gauntlet.validate_corpus(tmp_path)
+    assert report["exit_code"] == 1
+    assert report["by_reason"] == {"label_domain": 1}
+
+
+def test_validate_corpus_dir_fail_closed(tmp_path):
+    exs = _synthetic(6)
+    write_examples_jsonl(exs, tmp_path / "examples.jsonl", checksum=False)
+    report = gauntlet.validate_corpus(tmp_path)
+    assert report["exit_code"] == 0 and report["loaded"] == 6
+    # poison one row -> nonzero exit
+    lines = (tmp_path / "examples.jsonl").read_text().splitlines()
+    row = json.loads(lines[0])
+    row["label"] = 9
+    lines[0] = json.dumps(row)
+    (tmp_path / "examples.jsonl").write_text("\n".join(lines) + "\n")
+    report = gauntlet.validate_corpus(tmp_path)
+    assert report["exit_code"] == 1
+    assert report["by_reason"] == {"label_domain": 1}
+
+
+# ---------------------------------------------------------------------------
+# Checksummed gzip cache (etl/cache.py): truncated mid-record
+# ---------------------------------------------------------------------------
+
+
+def test_gzip_cache_skips_truncated_and_mismatched_rows(tmp_path):
+    from deepdfa_tpu.etl.cache import _read_jsonl_cache
+
+    rows = [{"id": i, "before": f"int f{i}() {{}}", "vul": i % 2}
+            for i in range(4)]
+    stamped = [json.dumps(dict(r, **{CHECKSUM_KEY: row_checksum(r)}))
+               for r in rows]
+    bad = dict(rows[1], **{CHECKSUM_KEY: row_checksum(rows[1])})
+    bad["vul"] = 1 - bad["vul"]  # bitrot under a stale digest
+    stamped[1] = json.dumps(bad)
+    stamped[3] = stamped[3][: len(stamped[3]) // 2]  # truncated mid-record
+    jl = tmp_path / "cache_minimal.jsonl.gz"
+    with gzip.open(jl, "wt") as f:
+        f.write("\n".join(stamped) + "\n")
+    out = _read_jsonl_cache(jl)
+    assert [r["id"] for r in out] == [0, 2]
+    reasons = sorted(m["reason"]
+                     for m in read_manifest(tmp_path / "quarantine"))
+    assert reasons == ["checksum_mismatch", "truncated_json"]
+
+
+def test_gzip_cache_all_rows_corrupt_forces_rebuild(tmp_path):
+    """A cache where EVERY row is corrupt must fail the read (so
+    minimal_cache rebuilds from source), not serve a '0-row cache hit'."""
+    from deepdfa_tpu.etl.cache import _read_cache, _read_jsonl_cache
+
+    jl = tmp_path / "dead_minimal.jsonl.gz"
+    with gzip.open(jl, "wt") as f:
+        f.write('{"truncated\n{"also": truncated\n')
+    with pytest.raises(ValueError):
+        _read_jsonl_cache(jl)
+    # _read_cache's caller contract: None -> rebuild via the loader.
+    assert _read_cache(tmp_path / "dead_minimal") is None

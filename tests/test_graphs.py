@@ -66,6 +66,32 @@ def test_batch_layout_and_self_loops():
     np.testing.assert_allclose(np.asarray(labels), [1.0, 0.0, 0.0, 0.0])
 
 
+def test_batch_endpoint_contract():
+    """Edge endpoints out of [0, num_nodes) raise ContractError BEFORE
+    node-offsetting — they used to clamp inside the masked segment ops and
+    silently poison gradients (ISSUE 4 satellite)."""
+    from deepdfa_tpu.contracts import ContractError
+
+    over = make_graph(3, [(0, 5)])  # receiver 5 >= 3 nodes
+    with pytest.raises(ContractError) as ei:
+        batch_graphs([over], n_graphs=2, max_nodes=16, max_edges=32,
+                     subkeys=SUBKEYS)
+    assert ei.value.reason == "dangling_endpoint"
+    neg = make_graph(3, [(0, 1)])
+    neg["senders"] = np.array([-1])
+    with pytest.raises(ContractError):
+        batch_graphs([neg], n_graphs=2, max_nodes=16, max_edges=32,
+                     subkeys=SUBKEYS)
+    ragged = make_graph(3, [(0, 1)])
+    ragged["receivers"] = np.array([1, 2])
+    with pytest.raises(ContractError) as ei:
+        batch_graphs([ragged], n_graphs=2, max_nodes=16, max_edges=32,
+                     subkeys=SUBKEYS)
+    assert ei.value.reason == "edge_shape"
+    # ContractError subclasses ValueError: pre-contract callers keep working
+    assert issubclass(ContractError, ValueError)
+
+
 def test_batch_overflow_raises():
     g = make_graph(10, [(0, 1)])
     with pytest.raises(ValueError):

@@ -494,3 +494,20 @@ def test_scenario_serve_flush_fault():
     # tests/test_serve.py (engine + HTTP); this re-checks the soak's view.
     report = scenario_serve_flush_fault()
     assert report["ok"], report
+
+
+def test_scenario_poison_corpus_bitwise_clean(tmp_path):
+    """THE data-contract acceptance gate (ISSUE 4): training on a corpus
+    seeded with every corruption class completes, the quarantine manifest
+    lists every poisoned item under its expected reason code (zero false
+    quarantines of clean items), and the final history is bit-for-bit
+    identical to a run on the pre-corruption clean subset."""
+    from deepdfa_tpu.resilience.chaos import scenario_poison_corpus
+
+    report = scenario_poison_corpus(str(tmp_path), n_examples=48, epochs=2)
+    assert report["classes"] >= 10, report  # the ISSUE corruption floor
+    assert report["manifest_grade"]["ok"], report
+    assert report["quarantined"] == report["manifest_grade"]["fatal_victims"]
+    assert report["repaired"] >= 1, report
+    assert report["bitwise_match"], report
+    assert report["ok"], report

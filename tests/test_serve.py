@@ -329,6 +329,74 @@ def test_bad_request_rejected(eng4):
         eng.submit(missing)
 
 
+def test_bad_request_message_classes_unchanged(eng4):
+    """Admission now runs the shared contracts validator; the HTTP 400
+    error-message classes are an API clients match on, so each historic
+    message must survive the dedupe byte-for-byte (ISSUE 4 satellite)."""
+    eng, clock = eng4
+
+    def message_for(mutate):
+        g = {k: (dict(v) if isinstance(v, dict) else v)
+             for k, v in graphs_n(1)[0].items()}
+        mutate(g)
+        with pytest.raises(BadRequestError) as ei:
+            eng.submit(g)
+        return str(ei.value)
+
+    def set_endpoint(g):
+        g["senders"] = np.asarray([999], np.int32)
+        g["receivers"] = np.asarray([0], np.int32)
+
+    assert message_for(set_endpoint) == "edge endpoint out of range"
+
+    def zero_nodes(g):
+        g["num_nodes"] = 0
+        g["senders"] = np.zeros(0, np.int32)
+        g["receivers"] = np.zeros(0, np.int32)
+        g["feats"] = {k: np.zeros(0, np.int64) for k in g["feats"]}
+
+    assert message_for(zero_nodes) == "graph needs at least one node"
+
+    def ragged_edges(g):
+        g["receivers"] = np.asarray(g["receivers"])[:-1]
+
+    assert message_for(ragged_edges) == \
+        "senders/receivers must be equal-length 1-d"
+
+    def drop_subkey(g):
+        del g["feats"]["api"]
+
+    assert message_for(drop_subkey) == "missing feature subkey 'api'"
+
+    def short_feats(g):
+        g["feats"]["api"] = np.asarray(g["feats"]["api"])[:-1]
+
+    n = int(graphs_n(1)[0]["num_nodes"])
+    assert message_for(short_feats) == f"feats['api'] must have shape ({n},)"
+
+    def drop_num_nodes(g):
+        del g["num_nodes"]
+
+    assert message_for(drop_num_nodes) == \
+        "malformed graph payload: 'num_nodes'"
+
+    def mistype_senders(g):
+        g["senders"] = "zzz"
+
+    assert message_for(mistype_senders).startswith(
+        "malformed graph payload: ")
+
+    # Admission records per-boundary ingest counters (contracts.STATS).
+    from deepdfa_tpu import contracts
+
+    before = contracts.STATS.get("serve", "rejected")
+    with pytest.raises(BadRequestError):
+        eng.submit({"num_nodes": 0, "senders": [], "receivers": [],
+                    "feats": {}})
+    assert contracts.STATS.get("serve", "rejected") == before + 1
+    assert contracts.STATS.get("serve", "reason:empty_graph") >= 1
+
+
 # ---------------------------------------------------------------------------
 # Degradation (combined -> GNN-only when the tokenizer path errors)
 # ---------------------------------------------------------------------------
