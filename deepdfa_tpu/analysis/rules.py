@@ -53,6 +53,16 @@ Rule catalogue (each backed by a positive+negative fixture in
                              (deepdfa_tpu/contracts) exists to close:
                              out-of-range indices clamp inside segment ops
                              and poison gradients instead of failing.
+  GL011 naive-wallclock-timing  a ``time.time()``/``perf_counter()``/
+                             ``monotonic()`` delta wrapped around a jitted
+                             dispatch (a step-shaped or jit-wrapped call)
+                             with no ``block_until_ready``-class barrier in
+                             between — XLA dispatches asynchronously, so
+                             the delta measures dispatch, not execution:
+                             the timing is a lie. Explicit transfers
+                             (``jax.block_until_ready``, ``jax.device_get``,
+                             ``np.asarray``) and telemetry span fencing
+                             (``sp.fence(x)``) are accepted barriers.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -89,6 +99,7 @@ RULES: Dict[str, str] = {
     "GL008": "nonstatic-python-scalar",
     "GL009": "swallowed-device-exception",
     "GL010": "unchecked-json-ingest",
+    "GL011": "naive-wallclock-timing",
 }
 
 _JIT_NAMES = frozenset({
@@ -146,6 +157,13 @@ _VALIDATOR_FNS = (
     "validate_example", "validate_joern_nodes", "validate_joern_edges",
     "validate_cache_row", "load_examples_jsonl",
 )
+# GL011: wall-clock sources, and the barrier calls that make a delta
+# around a jitted dispatch honest. ``fence`` is the telemetry span's
+# explicit block_until_ready hook (deepdfa_tpu/telemetry/spans.py).
+_CLOCK_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic",
+})
+_BARRIER_ATTRS = frozenset({"fence", "block_until_ready"})
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -354,6 +372,7 @@ class _FunctionChecker:
             self._check_jit_scope()
         else:
             self._check_step_loops()
+            self._check_naive_timing()
         self._check_jit_in_loop()
         self._check_key_reuse()
         self._check_swallowed_exceptions()
@@ -513,6 +532,76 @@ class _FunctionChecker:
                             "loop — blocks dispatch every iteration; "
                             "accumulate on device and read once after the "
                             "loop (or rate-limit with a `% k` guard)", live)
+
+    # -- naive wall-clock timing (GL011) -------------------------------------
+
+    def _is_dispatch_call(self, call: ast.Call) -> bool:
+        """Does this call dispatch jitted work? Step-shaped names (the
+        make_*step protocol) and module-level jit-wrapped defs count —
+        the same dispatch heuristics GL004/GL009 use."""
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name is not None and (
+            name in self.mod.jit_wrapped or bool(_STEP_CALL_RE.match(name))
+        )
+
+    def _is_barrier_call(self, call: ast.Call) -> bool:
+        """Explicit transfers, span fencing, and the host syncs GL004
+        itself defines (float()/int()/.item()/.tolist()/.numpy() force a
+        device wait) all make a following clock read honest."""
+        dotted = self.mod.resolve(call.func)
+        if dotted in _CLEANERS or (dotted in _HOST_CASTS and call.args):
+            return True
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr in (_BARRIER_ATTRS | _SYNC_METHODS))
+
+    def _check_naive_timing(self) -> None:
+        """``t0 = clock(); ...step(...)...; clock() - t0`` with no barrier
+        between: under async dispatch the delta times the *dispatch*, not
+        the work. Lexical line-interval analysis — clock-var definitions,
+        dispatch calls, and barrier calls are bucketed by line, and a
+        delta is flagged when its interval back to the nearest t0
+        definition contains a dispatch but no barrier."""
+        clock_defs: Dict[str, List[int]] = {}
+        dispatch_lines: List[int] = []
+        barrier_lines: List[int] = []
+        deltas: List[Tuple[ast.AST, str, int]] = []
+        for node in _walk_skip_defs(self.fi.node.body):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if (self.mod.resolve(node.value.func) in _CLOCK_CALLS
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    clock_defs.setdefault(node.targets[0].id, []).append(
+                        node.lineno)
+            if isinstance(node, ast.Call):
+                if self._is_dispatch_call(node):
+                    dispatch_lines.append(node.lineno)
+                if self._is_barrier_call(node):
+                    barrier_lines.append(node.lineno)
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, ast.Name)):
+                left_is_clock = (
+                    isinstance(node.left, ast.Call)
+                    and self.mod.resolve(node.left.func) in _CLOCK_CALLS
+                ) or isinstance(node.left, ast.Name)
+                if left_is_clock:
+                    deltas.append((node, node.right.id, node.lineno))
+        for at, var, line in deltas:
+            defs = [d for d in clock_defs.get(var, []) if d < line]
+            if not defs:
+                continue
+            t0 = max(defs)
+            if (any(t0 < d < line for d in dispatch_lines)
+                    and not any(t0 <= b <= line for b in barrier_lines)):
+                self._report(
+                    "GL011", at,
+                    f"wall-clock delta over `{var}` (defined line {t0}) "
+                    "wraps a jitted dispatch with no block_until_ready/"
+                    "device_get barrier in between — async dispatch makes "
+                    "this time the dispatch, not the execution; fence the "
+                    "result (jax.block_until_ready / telemetry span "
+                    ".fence) before reading the clock")
 
     # -- recompilation (GL006) -----------------------------------------------
 

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepdfa_tpu import telemetry
 from deepdfa_tpu.core.config import (
     DataConfig,
     FeatureSpec,
@@ -280,7 +281,9 @@ def cmd_fit(args) -> Dict[str, Any]:
     run_dir = args.checkpoint_dir or train_cfg.checkpoint_dir or "runs/default"
     train_cfg = dataclasses.replace(train_cfg, checkpoint_dir=run_dir)
     log_path, handler = _setup_run_logging(run_dir)
-    with _CrashLog(log_path, handler):
+    # Telemetry rides the run dir: runs/<run>/telemetry/{events.jsonl,
+    # trace.json}, summarized offline by `cli trace report <run>`.
+    with _CrashLog(log_path, handler), telemetry.run_scope(run_dir):
         examples, splits = load_dataset(args.dataset, model_cfg.feature,
                                         seed=train_cfg.seed,
                                         split_mode=args.split_mode)
@@ -576,7 +579,7 @@ def cmd_fit_text(args) -> Dict[str, Any]:
     combined = args.graphs is not None
     run_dir = args.checkpoint_dir
     log_path, handler = _setup_run_logging(run_dir)
-    with _CrashLog(log_path, handler):
+    with _CrashLog(log_path, handler), telemetry.run_scope(run_dir):
         tcfg = TransformerTrainConfig(
             learning_rate=args.learning_rate,
             max_epochs=args.epochs,
@@ -1021,21 +1024,32 @@ def cmd_serve(args) -> Dict[str, Any]:
     recompiles), content-hash caching, 429 backpressure, GNN-only
     degradation. ``--smoke N`` self-drives the full stack with N synthetic
     requests and exits — the scripts/test.sh gate."""
+    import contextlib
+
     from deepdfa_tpu.serve.http import serve_forever
 
-    engine, model_cfg = _build_serve_engine(args)
-    if not args.no_warmup:
-        n = engine.warmup()
-        logger.info("warmed %d bucket shapes", n)
-    if args.smoke is not None:
-        report = _smoke_http(engine, args.host, args.port, args.smoke,
-                             model_cfg.feature)
-        print(json.dumps(report))
-        if not report["ok"]:
-            report["exit_code"] = 1
-        return report
-    serve_forever(engine, args.host, args.port)
-    return {}
+    # Telemetry sink: --run-dir (default runs/serve_smoke under --smoke);
+    # without one, live serving runs untraced (hooks stay no-ops).
+    run_dir = args.run_dir or ("runs/serve_smoke"
+                               if args.smoke is not None else None)
+    scope = (telemetry.run_scope(run_dir) if run_dir
+             else contextlib.nullcontext())
+    with scope:
+        engine, model_cfg = _build_serve_engine(args)
+        if not args.no_warmup:
+            n = engine.warmup()
+            logger.info("warmed %d bucket shapes", n)
+        if args.smoke is not None:
+            report = _smoke_http(engine, args.host, args.port, args.smoke,
+                                 model_cfg.feature)
+            if run_dir:
+                report["telemetry"] = os.path.join(run_dir, "telemetry")
+            print(json.dumps(report))
+            if not report["ok"]:
+                report["exit_code"] = 1
+            return report
+        serve_forever(engine, args.host, args.port)
+        return {}
 
 
 def cmd_score(args) -> Dict[str, Any]:
@@ -1183,6 +1197,64 @@ def cmd_validate(args) -> Dict[str, Any]:
 
     report["ingest_stats"] = STATS.snapshot()
     print(json.dumps({k: v for k, v in report.items() if k != "reports"}))
+    return report
+
+
+def cmd_trace(args) -> Dict[str, Any]:
+    """Telemetry tooling (deepdfa_tpu/telemetry).
+
+    ``cli trace report <run>`` summarizes ``runs/<run>/telemetry/
+    events.jsonl`` offline: step-time p50/p99, host-dispatch vs
+    device-execute split, post-warmup compile count, retry/fault/
+    quarantine totals. ``cli trace --smoke`` runs a tiny instrumented fit
+    and asserts the report round-trips — the scripts/test.sh gate.
+    """
+    from deepdfa_tpu.telemetry.report import trace_report
+
+    if args.smoke:
+        from deepdfa_tpu.core.config import DataConfig, TrainConfig
+        from deepdfa_tpu.data.splits import make_splits
+        from deepdfa_tpu.data.synthetic import synthetic_bigvul
+        from deepdfa_tpu.models.flowgnn import FlowGNN
+        from deepdfa_tpu.train.loop import fit
+
+        run_dir = args.out_dir
+        model_cfg = FlowGNNConfig(hidden_dim=8, n_steps=2)
+        examples = synthetic_bigvul(32, model_cfg.feature,
+                                    positive_fraction=0.5, seed=args.seed)
+        for i, ex in enumerate(examples):
+            ex["label"] = int(np.asarray(ex["vuln"]).max())
+            ex["id"] = i
+        splits = make_splits(examples, seed=args.seed)
+        with telemetry.run_scope(run_dir):
+            fit(FlowGNN(model_cfg), examples, splits,
+                TrainConfig(max_epochs=2, seed=args.seed),
+                DataConfig(batch_size=8, eval_batch_size=8), log_every=2)
+        report = trace_report(run_dir)
+        trace_json = os.path.join(run_dir, "telemetry", "trace.json")
+        with open(trace_json) as f:
+            trace_doc = json.load(f)
+        checks = {
+            "steps_recorded": report["train"]["steps"] > 0,
+            "fenced_windows": report["train"]["fenced_windows"] > 0,
+            "host_device_split": report["train"]["host_frac"] is not None,
+            "compiles_captured": report["compiles"]["total"] > 0,
+            "warmup_marker": report["compiles"]["warmup_marker"],
+            "no_faults": report["faults"]["total"] == 0,
+            "no_drops": report["telemetry_drops"] == 0,
+            "trace_json_valid": bool(trace_doc.get("traceEvents")),
+        }
+        out = {"smoke": True, "ok": all(checks.values()), "checks": checks,
+               "report": report}
+        print(json.dumps(out))
+        if not out["ok"]:
+            out["exit_code"] = 1
+        return out
+    if args.action != "report" or not args.run_dir:
+        raise ValueError("usage: cli trace report <run-dir> | "
+                         "cli trace --smoke")
+    report = trace_report(args.run_dir)
+    print(json.dumps(report))
     return report
 
 
@@ -1465,6 +1537,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_srv.add_argument("--smoke", type=int, default=None, metavar="N",
                        help="self-drive the full HTTP stack with N "
                             "synthetic requests, print the report, exit")
+    p_srv.add_argument("--run-dir", default=None,
+                       help="telemetry sink directory (events.jsonl + "
+                            "trace.json; --smoke defaults to "
+                            "runs/serve_smoke)")
     serve_knobs(p_srv)
     p_srv.set_defaults(func=cmd_serve)
 
@@ -1538,6 +1614,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_val.add_argument("--seed", type=int, default=0,
                        help="--smoke corruption seed")
     p_val.set_defaults(func=cmd_validate)
+
+    p_tr = sub.add_parser(
+        "trace",
+        help="telemetry tooling: `trace report <run>` summarizes a run's "
+             "events.jsonl (step p50/p99, host/device split, post-warmup "
+             "compiles, retry/fault/quarantine totals); `trace --smoke` "
+             "runs a tiny instrumented fit and round-trips the report")
+    p_tr.add_argument("action", nargs="?", choices=["report"],
+                      help="report: summarize one run directory")
+    p_tr.add_argument("run_dir", nargs="?", default=None,
+                      help="run directory holding telemetry/events.jsonl")
+    p_tr.add_argument("--smoke", action="store_true",
+                      help="tiny instrumented fit + report round-trip "
+                           "(the scripts/test.sh gate)")
+    p_tr.add_argument("--out-dir", default="runs/trace_smoke",
+                      help="--smoke run directory")
+    p_tr.add_argument("--seed", type=int, default=0)
+    p_tr.set_defaults(func=cmd_trace)
 
     p_tune = sub.add_parser("tune")
     common(p_tune)

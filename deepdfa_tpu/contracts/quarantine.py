@@ -77,6 +77,13 @@ class Quarantine:
             }) + "\n")
         self._ordinal += 1
         self.counts[error.reason] += 1
+        # Trace-visible quarantine: the run report counts these from
+        # events.jsonl alone (import deferred — contracts stays importable
+        # standalone; the hook is a no-op without an active run).
+        from deepdfa_tpu import telemetry
+
+        telemetry.event("quarantine", boundary=error.boundary,
+                        reason=error.reason, item_id=error.item_id)
 
     @property
     def total(self) -> int:

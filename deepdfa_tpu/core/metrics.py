@@ -15,6 +15,8 @@ from typing import Dict, NamedTuple, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu.telemetry.registry import REGISTRY, sanitize
+
 
 class BinaryStats(NamedTuple):
     """Sufficient statistics for binary classification metrics.
@@ -161,6 +163,10 @@ class ServingStats:
             raise ValueError(f"unknown serving counter {counter!r}")
         with self._lock:
             setattr(self, counter, getattr(self, counter) + by)
+        # Publish into the process-wide telemetry registry (this snapshot
+        # API stays the per-engine view; the registry aggregates across
+        # engines for Prometheus and the offline report).
+        REGISTRY.counter(f"serve_{counter}_total").inc(by)
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -168,12 +174,16 @@ class ServingStats:
                 self._latency_count % self._latency_window
             ] = seconds * 1000.0
             self._latency_count += 1
+        REGISTRY.histogram("serve_latency_ms").observe(seconds * 1000.0)
 
     def record_batch(self, n_real: int, n_slots: int) -> None:
         with self._lock:
             self.batches += 1
             self.occupancy_used += n_real
             self.occupancy_slots += n_slots
+        REGISTRY.counter("serve_batches_total").inc()
+        REGISTRY.counter("serve_slots_occupied_total").inc(n_real)
+        REGISTRY.counter("serve_slots_padded_total").inc(n_slots - n_real)
 
     @property
     def latencies_ms(self) -> np.ndarray:
@@ -231,6 +241,12 @@ class IngestStats:
         with self._lock:
             b = self._counts.setdefault(boundary, {})
             b[field] = b.get(field, 0) + by
+        # Mirror into the process registry (reason-code fields like
+        # "reason:v1" sanitize into legal metric names); the per-boundary
+        # snapshot stays this class's view.
+        REGISTRY.counter(
+            f"ingest_{sanitize(boundary)}_{sanitize(field)}_total"
+        ).inc(by)
 
     def get(self, boundary: str, field: str) -> int:
         with self._lock:

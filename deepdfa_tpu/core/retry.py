@@ -110,15 +110,27 @@ def retry_call(
                 and delay is not None
                 and elapsed + delay > policy.deadline_s
             )
+            # Telemetry (import deferred: core.retry must stay importable
+            # with zero package siblings loaded): each retry and each
+            # giveup is an event in the run trace and a registry counter.
+            from deepdfa_tpu import telemetry
+
+            fn_name = getattr(fn, "__name__", "call")
             if delay is None or over_deadline:
                 why = ("deadline exceeded" if over_deadline
                        else "attempts exhausted")
+                telemetry.REGISTRY.counter("retry_giveups_total").inc()
+                telemetry.event("retry.giveup", fn=fn_name, attempts=attempt,
+                                why=why, error=type(exc).__name__)
                 raise GiveUp(
-                    f"{getattr(fn, '__name__', 'call')} failed after "
+                    f"{fn_name} failed after "
                     f"{attempt} attempt(s) in {elapsed:.2f}s ({why}): "
                     f"{type(exc).__name__}: {exc}",
                     last=exc, attempts=attempt, elapsed_s=elapsed,
                 ) from exc
+            telemetry.REGISTRY.counter("retry_attempts_total").inc()
+            telemetry.event("retry", fn=fn_name, attempt=attempt,
+                            delay_s=delay, error=type(exc).__name__)
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             sleep(delay)

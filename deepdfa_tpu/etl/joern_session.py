@@ -23,6 +23,7 @@ import time
 from pathlib import Path
 from typing import Callable, List, Mapping, Optional
 
+from deepdfa_tpu import telemetry
 from deepdfa_tpu.resilience import inject
 
 logger = logging.getLogger(__name__)
@@ -63,6 +64,7 @@ class JoernSession:
                 "(reference scripts/install_joern.sh) to run CPG extraction"
             )
         self.timeout_s = timeout_s
+        self.worker_id = worker_id
         self.workspace = Path(workspace_root) / f"worker_{worker_id}"
         self.workspace.mkdir(parents=True, exist_ok=True)
         import pty
@@ -129,8 +131,9 @@ class JoernSession:
             if spec.kind == "kill":
                 self._proc.kill()
                 self._proc.wait()
-        os.write(self._master, (line + "\n").encode())
-        out = self._read_until_prompt()
+        with telemetry.span("joern.send", worker=self.worker_id):
+            os.write(self._master, (line + "\n").encode())
+            out = self._read_until_prompt()
         # Strip the echoed command and the trailing prompt.
         body = out.split("\n", 1)[-1]
         return body.rsplit(PROMPT, 1)[0].strip()
@@ -205,6 +208,8 @@ def extract_cpg_batch(
             "retrying in %.2fs)", worker_id, type(exc).__name__, exc,
             attempt, delay,
         )
+        telemetry.event("joern.restart", worker=worker_id, attempt=attempt,
+                        error=type(exc).__name__)
         new_session()
 
     def run_item(path: Path) -> None:
@@ -220,8 +225,10 @@ def extract_cpg_batch(
     try:
         for path in c_files:
             try:
-                retry_call(run_item, (path,), policy=policy,
-                           on_retry=restart)
+                with telemetry.span("joern.item", worker=worker_id,
+                                    item=str(path)):
+                    retry_call(run_item, (path,), policy=policy,
+                               on_retry=restart)
                 done.append(path)
             except Exception as exc:  # per-item fault tolerance (incl. GiveUp)
                 logger.warning("joern worker %d: giving up on %s (%s)",

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from deepdfa_tpu import telemetry
 from deepdfa_tpu.resilience import inject
 
 logger = logging.getLogger(__name__)
@@ -125,9 +126,20 @@ def pmap(
     parity; scheduling is per-item (ETL payloads are seconds each, so
     chunking never paid for itself).
     """
-    global _ACTIVE_FN
     attempts = max(attempts, 1)
     indexed = list(enumerate(items))
+    # Telemetry: the map itself is one span; per-item events are emitted
+    # from the PARENT as results land (forked workers' in-memory rings die
+    # with them — the parent is the only durable writer).
+    with telemetry.span("etl.pmap", n_items=len(items), workers=workers,
+                        desc=desc or "pmap") as pmap_span:
+        return _pmap_locked(fn, indexed, items, workers, desc, failed_log,
+                            attempts, pmap_span)
+
+
+def _pmap_locked(fn, indexed, items, workers, desc, failed_log, attempts,
+                 pmap_span):
+    global _ACTIVE_FN
     with _ACTIVE_LOCK:  # RLock: threads serialize, same-thread nesting enters
         prev = _ACTIVE_FN  # save/restore so a nested serial pmap doesn't
         _ACTIVE_FN = fn    # null the outer call's function
@@ -175,6 +187,8 @@ def pmap(
                 logger.warning("%s: retrying %d failed item(s) (attempt "
                                "%d/%d)", desc or "pmap", len(failed_idx),
                                retry + 2, attempts)
+                telemetry.event("etl.requeue", n=len(failed_idx),
+                                attempt=retry + 2, desc=desc or "pmap")
                 for i in failed_idx:
                     results[i] = (_call(indexed[i]) if serial
                                   else _run_isolated(indexed[i]))
@@ -183,12 +197,21 @@ def pmap(
 
     out: List[Any] = []
     failures = []
-    for r in results:
+    for i, r in enumerate(results):
         if _is_failure(r):
             failures.append((r[1], r[2]))
             out.append(None)
+            telemetry.event("etl.item", index=i, ok=False, error=r[2][:200],
+                            desc=desc or "pmap")
         else:
             out.append(r)
+            telemetry.event("etl.item", index=i, ok=True,
+                            desc=desc or "pmap")
+        if (i + 1) % 4096 == 0:
+            # Corpus-scale maps emit more per-item events than one ring
+            # holds (65536); flush on a cadence so the tail survives.
+            telemetry.flush()
+    pmap_span.set(n_failed=len(failures))
     if failures:
         logger.warning("%s: %d/%d items failed", desc or "pmap",
                        len(failures), len(items))

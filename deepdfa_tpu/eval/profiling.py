@@ -19,12 +19,14 @@ LineVul/linevul/linevul_main.py:332-394). The TPU-native instruments:
 from __future__ import annotations
 
 import contextlib
-import json
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.telemetry.export import append_jsonl
 
 
 def count_params(params: Any) -> int:
@@ -88,6 +90,12 @@ class ProfileRecorder:
     (base_module.py:282-291): profile records
     ``{"step", "flops", "params", "macs", "batch_size"}`` and time records
     ``{"step", "duration", "batch_size"}``.
+
+    One clock, one writer (ISSUE 5): every record goes through the
+    telemetry JSONL writer AND is mirrored verbatim into the active
+    telemetry run (``profile.step`` / ``profile.time`` events), so
+    ``profiledata.jsonl``/``timedata.jsonl`` and ``events.jsonl`` carry
+    the SAME measured values — they cannot disagree.
     """
 
     def __init__(
@@ -111,15 +119,16 @@ class ProfileRecorder:
             "macs": macs,
             "batch_size": batch_size,
         }
-        with open(self.profile_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        append_jsonl(self.profile_path, rec)
+        telemetry.event("profile.step", **rec)
 
     def record_time(self, duration_s: float, batch_size: int) -> None:
         if self.time_path is None:
             return
-        rec = {"step": self._step, "duration": duration_s, "batch_size": batch_size}
-        with open(self.time_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        rec = {"step": self._step, "duration": duration_s,
+               "batch_size": batch_size}
+        append_jsonl(self.time_path, rec)
+        telemetry.event("profile.time", **rec)
 
     def next_step(self) -> None:
         self._step += 1

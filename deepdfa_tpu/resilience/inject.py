@@ -186,6 +186,13 @@ class FaultPlan:
             if spec.matches(idx, self.rng):
                 spec.fired += 1
                 hits.append(spec)
+                # Every fired fault is a first-class trace event BEFORE it
+                # acts (a `raise` fault must still appear in events.jsonl)
+                # — the chaos-coverage gate matches these on site + seed.
+                from deepdfa_tpu import telemetry
+
+                telemetry.event("fault.fired", site=site, kind=spec.kind,
+                                index=idx, seed=self.seed)
         for spec in hits:
             if spec.kind == "raise":
                 raise spec.exception()
@@ -217,6 +224,9 @@ def install(plan: FaultPlan) -> FaultPlan:
     global _PLAN, _ENV_CHECKED
     _PLAN = plan
     _ENV_CHECKED = True
+    from deepdfa_tpu import telemetry
+
+    telemetry.event("fault.armed", specs=len(plan.faults), seed=plan.seed)
     return plan
 
 

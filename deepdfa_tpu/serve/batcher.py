@@ -30,6 +30,7 @@ from typing import Deque, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.config import ServeConfig
 
 
@@ -62,6 +63,7 @@ class ServeRequest:
     lane: str                     # "gnn" | "combined"
     arrival: float                # engine-clock seconds
     deadline_s: float
+    t_submit: float = 0.0         # telemetry clock (perf_counter seconds)
     input_ids: Optional[np.ndarray] = None   # combined lane only
     degraded: bool = False        # tokenizer failed -> gnn fallback
     result: Optional[Dict] = None
@@ -126,6 +128,11 @@ class MicroBatcher:
                     / 1000.0
                 )
             self._pending[req.lane].append(req)
+            depth = sum(len(q) for q in self._pending.values())
+        # Outside the lock: the enqueue step of the per-request trace
+        # (admission -> enqueue -> flush -> respond), rid threaded through.
+        telemetry.event("serve.enqueue", rid=req.rid, lane=req.lane,
+                        depth=depth)
 
     def due(self, now: float) -> Optional[str]:
         """The lane to flush at ``now``, or None.

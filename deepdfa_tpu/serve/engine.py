@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepdfa_tpu import contracts
+from deepdfa_tpu import contracts, telemetry
 from deepdfa_tpu.core.config import subkeys_for
 from deepdfa_tpu.core.metrics import ServingStats
 from deepdfa_tpu.resilience import inject
@@ -180,6 +180,12 @@ class ServeEngine:
         before = self.stats.compiles
         for lane, slots in self.warm_buckets():
             self._executable(lane, slots)
+        # The trace's warmup marker: any jax.compile event after this is
+        # a silent recompile, and `cli trace report` must say so (the
+        # compiles-after-warmup-must-be-0 gate for serve traces).
+        telemetry.event("serve.warmup_done",
+                        warmed=self.stats.compiles - before,
+                        buckets=self.n_warm)
         return self.stats.compiles - before
 
     def _executable(self, lane: str, slots: int):
@@ -193,13 +199,14 @@ class ServeEngine:
     def _compile(self, lane_name: str, slots: int):
         lane = self._lanes[lane_name]
         t0 = time.perf_counter()
-        empty = self._graph_batch(lane, [], slots)
-        if lane_name == "combined":
-            ids = jnp.zeros((slots, self.config.block_size), jnp.int32)
-            lowered = jax.jit(lane.infer).lower(lane.params, ids, empty)
-        else:
-            lowered = jax.jit(lane.infer).lower(lane.params, empty)
-        exe = lowered.compile()
+        with telemetry.span("serve.compile", lane=lane_name, slots=slots):
+            empty = self._graph_batch(lane, [], slots)
+            if lane_name == "combined":
+                ids = jnp.zeros((slots, self.config.block_size), jnp.int32)
+                lowered = jax.jit(lane.infer).lower(lane.params, ids, empty)
+            else:
+                lowered = jax.jit(lane.infer).lower(lane.params, empty)
+            exe = lowered.compile()
         self.stats.bump("compiles")
         logger.info("compiled %s bucket slots=%d in %.2fs", lane_name, slots,
                     time.perf_counter() - t0)
@@ -266,6 +273,7 @@ class ServeEngine:
             deadline_s=(deadline_ms if deadline_ms is not None
                         else self.config.deadline_ms) / 1000.0,
             input_ids=input_ids, degraded=degraded,
+            t_submit=telemetry.now(),
         )
         cached = self.cache.get(key)
         if cached is not None:
@@ -274,6 +282,8 @@ class ServeEngine:
             self.stats.observe_latency(0.0)
             req.finish(dict(cached, rid=req.rid, cached=True,
                             degraded=req.degraded))
+            telemetry.record_span("serve.request", req.t_submit,
+                                  rid=req.rid, lane=lane, cached=True)
             return req
         try:
             self.batcher.admit(req)
@@ -325,25 +335,31 @@ class ServeEngine:
         lane = self._lanes[lane_name]
         slots = self.config.bucket_for(len(reqs))
         exe = self._executable(lane_name, slots)
+        ordinal = next(self._flush_ordinal)
         w0 = time.perf_counter()
+        flush_span = telemetry.span("serve.flush", lane=lane_name,
+                                    n=len(reqs), slots=slots,
+                                    ordinal=ordinal)
         try:
-            # Fault hook (index = flush ordinal): a `raise` here simulates
-            # an executable/device failure mid-flush.
-            inject.fire("serve.batch", index=next(self._flush_ordinal))
-            gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
-            if lane_name == "combined":
-                pad_id = int(self.tokenizer.pad_token_id)
-                ids = np.full((slots, self.config.block_size), pad_id,
-                              np.int32)
-                for i, r in enumerate(reqs):
-                    ids[i] = r.input_ids
-                probs = exe(lane.params, jnp.asarray(ids), gb)
-            else:
-                probs = exe(lane.params, gb)
-            # One host transfer per micro-batch; everything after this
-            # indexes numpy (GL004: per-request reads must not ride on
-            # device buffers).
-            p = np.asarray(probs)
+            with flush_span:
+                # Fault hook (index = flush ordinal): a `raise` here
+                # simulates an executable/device failure mid-flush.
+                inject.fire("serve.batch", index=ordinal)
+                gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
+                if lane_name == "combined":
+                    pad_id = int(self.tokenizer.pad_token_id)
+                    ids = np.full((slots, self.config.block_size), pad_id,
+                                  np.int32)
+                    for i, r in enumerate(reqs):
+                        ids[i] = r.input_ids
+                    probs = exe(lane.params, jnp.asarray(ids), gb)
+                else:
+                    probs = exe(lane.params, gb)
+                # One host transfer per micro-batch; everything after this
+                # indexes numpy (GL004: per-request reads must not ride on
+                # device buffers). It is also the span's honest device
+                # barrier — the flush duration includes execution.
+                p = np.asarray(probs)
         except Exception as e:
             # Flush isolation: THIS micro-batch's requests fail (HTTP 500
             # class), the queue keeps draining, and later flushes run on
@@ -357,6 +373,9 @@ class ServeEngine:
                 r.finish({"rid": r.rid, "error": "internal",
                           "detail": detail, "cached": False,
                           "degraded": r.degraded})
+                telemetry.record_span("serve.request", r.t_submit,
+                                      rid=r.rid, lane=lane_name,
+                                      cached=False, error=type(e).__name__)
             return
         # Virtual clocks (replay/bench) expose advance(): credit them with
         # this batch's measured wall time so recorded latencies include
@@ -366,6 +385,7 @@ class ServeEngine:
         if advance is not None:
             advance(time.perf_counter() - w0)
         done = self._clock()
+        t_done = telemetry.now()
         self.stats.record_batch(len(reqs), slots)
         for i, r in enumerate(reqs):
             # The cache line holds only content-derived values; "degraded"
@@ -377,6 +397,15 @@ class ServeEngine:
                           degraded=r.degraded))
             self.stats.bump("completed")
             self.stats.observe_latency(done - r.arrival)
+            # The admission->respond span, rid threaded through; queue_ms
+            # is the pre-flush share of it (both ends on the telemetry
+            # clock — never the engine's virtual clock).
+            telemetry.record_span(
+                "serve.request", r.t_submit, t_done, rid=r.rid,
+                lane=lane_name, cached=False, degraded=r.degraded,
+                queue_ms=max(w0 - r.t_submit, 0.0) * 1e3,
+                flush_ordinal=ordinal,
+            )
 
     # -- offline client ----------------------------------------------------
 

@@ -33,6 +33,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
 from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
 
@@ -58,6 +59,10 @@ class _PumpThread(threading.Thread):
         while not self._halt.is_set():
             try:
                 self.engine.pump()
+                # Keep events.jsonl current for live scrapes; a no-op
+                # with no active run or empty rings. Inside the guard:
+                # a full disk must cost the trace, never the serving.
+                telemetry.flush()
             except Exception:
                 logger.exception("pump failed")
             horizon = self.engine.next_flush_time()
@@ -92,15 +97,39 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self) -> None:
         engine = self.server.engine
         if self.path == "/healthz":
             self._send_json(200, {
                 "status": "ok",
                 "warm_buckets": engine.n_warm,
+                # Observability health: a nonzero drop count means the
+                # telemetry rings overflowed and the trace is incomplete.
+                "telemetry_drops": telemetry.drop_count(),
             })
         elif self.path == "/metrics":
-            self._send_json(200, engine.snapshot())
+            # Content negotiation: Prometheus scrapers ask for text/plain
+            # (or OpenMetrics) and get the text exposition — the process
+            # registry plus this engine's snapshot as gauges. Everyone
+            # else gets the historic JSON body, byte-compatible
+            # (regression-tested).
+            accept = self.headers.get("Accept", "") or ""
+            if "text/plain" in accept or "openmetrics" in accept:
+                body = telemetry.REGISTRY.prometheus_text(
+                    extra={f"serve_{k}": v
+                           for k, v in engine.snapshot().items()}
+                )
+                self._send_text(200, body, "text/plain; version=0.0.4")
+            else:
+                self._send_json(200, engine.snapshot())
         else:
             self._send_json(404, {"error": "not_found"})
 
@@ -124,56 +153,62 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         engine = self.server.engine
         submitted, results = [], []
-        for fn in functions:
-            entry: Dict = {}
-            try:
-                req = engine.submit(fn["graph"], code=fn.get("code"),
-                                    deadline_ms=deadline_ms)
-                submitted.append((req, entry))
-            except RejectedError as e:
-                entry.update(error="rejected",
-                             retry_after_s=e.retry_after_s)
-            except OversizedError as e:
-                entry.update(error="oversized", detail=str(e))
-            except BadRequestError as e:
-                entry.update(error="bad_request", detail=str(e))
-            except KeyError as e:
-                entry.update(error="bad_request",
-                             detail=f"missing field {e}")
-            except (TypeError, AttributeError) as e:
-                # e.g. a null or string where a function object belongs —
-                # the inline-error contract covers malformed entries too.
-                entry.update(error="bad_request", detail=str(e))
-            results.append(entry)
+        with telemetry.span("http.post", n_functions=len(functions)) as hs:
+            for fn in functions:
+                entry: Dict = {}
+                try:
+                    req = engine.submit(fn["graph"], code=fn.get("code"),
+                                        deadline_ms=deadline_ms)
+                    submitted.append((req, entry))
+                except RejectedError as e:
+                    entry.update(error="rejected",
+                                 retry_after_s=e.retry_after_s)
+                except OversizedError as e:
+                    entry.update(error="oversized", detail=str(e))
+                except BadRequestError as e:
+                    entry.update(error="bad_request", detail=str(e))
+                except KeyError as e:
+                    entry.update(error="bad_request",
+                                 detail=f"missing field {e}")
+                except (TypeError, AttributeError) as e:
+                    # e.g. a null or string where a function object
+                    # belongs — the inline-error contract covers
+                    # malformed entries too.
+                    entry.update(error="bad_request", detail=str(e))
+                results.append(entry)
 
-        if not submitted and all(r.get("error") == "rejected"
-                                 for r in results):
-            retry = max(r["retry_after_s"] for r in results)
-            # Header per RFC 7231: integer delay-seconds (urllib3 et al.
-            # int() it); the JSON body keeps the precise float.
-            self._send_json(429, {"error": "rejected",
-                                  "retry_after_s": retry},
-                            headers={"Retry-After":
-                                     str(max(int(-(-retry // 1)), 1))})
-            return
+            if not submitted and all(r.get("error") == "rejected"
+                                     for r in results):
+                retry = max(r["retry_after_s"] for r in results)
+                # Header per RFC 7231: integer delay-seconds (urllib3 et
+                # al. int() it); the JSON body keeps the precise float.
+                hs.set(status=429)
+                self._send_json(429, {"error": "rejected",
+                                      "retry_after_s": retry},
+                                headers={"Retry-After":
+                                         str(max(int(-(-retry // 1)), 1))})
+                return
 
-        # Block until the pump thread answers each admitted request; the
-        # timeout is generous (deadline covers queueing + compute, and a
-        # stuck pump must surface as an error, not a hang).
-        wait_s = ((deadline_ms or engine.config.deadline_ms) / 1000.0) * 10 \
-            + 30.0
-        for req, entry in submitted:
-            if req.event.wait(timeout=wait_s) and req.result is not None:
-                entry.update(req.result)
-            else:
-                entry.update(error="timeout")
-        # Flush-failure surface: when EVERY function in this POST died in
-        # a failed micro-batch (engine flush isolation), the response is a
-        # 500 — the per-request errors stay inline either way, and a batch
-        # with any successful function keeps the 200 + inline-error shape.
-        status = 500 if (results and all(r.get("error") == "internal"
-                                         for r in results)) else 200
-        self._send_json(status, {"results": results})
+            # Block until the pump thread answers each admitted request;
+            # the timeout is generous (deadline covers queueing + compute,
+            # and a stuck pump must surface as an error, not a hang).
+            wait_s = ((deadline_ms or engine.config.deadline_ms) / 1000.0) \
+                * 10 + 30.0
+            for req, entry in submitted:
+                if req.event.wait(timeout=wait_s) and req.result is not None:
+                    entry.update(req.result)
+                else:
+                    entry.update(error="timeout")
+            # Flush-failure surface: when EVERY function in this POST died
+            # in a failed micro-batch (engine flush isolation), the
+            # response is a 500 — the per-request errors stay inline
+            # either way, and a batch with any successful function keeps
+            # the 200 + inline-error shape.
+            status = 500 if (results and all(r.get("error") == "internal"
+                                             for r in results)) else 200
+            hs.set(status=status,
+                   rids=[req.rid for req, _ in submitted[:64]])
+            self._send_json(status, {"results": results})
 
 
 class ServeHTTPServer(ThreadingHTTPServer):

@@ -1,0 +1,61 @@
+"""Unified telemetry: run-scoped spans, one metrics registry, exporters.
+
+The observability layer shared by ETL, training, and serving (ISSUE 5):
+
+* :mod:`~deepdfa_tpu.telemetry.spans` — nestable ``span()`` context
+  managers over lock-cheap per-thread ring buffers, with explicit
+  ``block_until_ready`` fencing for honest host/device attribution and
+  ``jax.monitoring``-based compile-event capture.
+* :mod:`~deepdfa_tpu.telemetry.registry` — the one counter/gauge/
+  histogram registry every subsystem publishes into (``ServingStats``,
+  ``IngestStats``, ``contracts.STATS``, the retry loop), with a
+  Prometheus text exposition.
+* :mod:`~deepdfa_tpu.telemetry.export` — per-run
+  ``runs/<run>/telemetry/{events.jsonl,trace.json}`` (Chrome
+  trace-event format, loadable in Perfetto).
+* :mod:`~deepdfa_tpu.telemetry.report` — the offline summary behind
+  ``cli trace report <run>``.
+
+``DEEPDFA_TELEMETRY=0`` disables everything; with no run active every
+hook is a cheap no-op, so instrumentation lives in production code paths.
+"""
+
+from deepdfa_tpu.telemetry.registry import REGISTRY, Registry, sanitize
+from deepdfa_tpu.telemetry.spans import (
+    ENV_VAR,
+    Span,
+    TelemetryRun,
+    current_run,
+    drop_count,
+    enabled,
+    end_run,
+    event,
+    flush,
+    now,
+    record_span,
+    run_scope,
+    set_enabled,
+    span,
+    start_run,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "REGISTRY",
+    "Registry",
+    "Span",
+    "TelemetryRun",
+    "current_run",
+    "drop_count",
+    "enabled",
+    "end_run",
+    "event",
+    "flush",
+    "now",
+    "record_span",
+    "run_scope",
+    "sanitize",
+    "set_enabled",
+    "span",
+    "start_run",
+]

@@ -1,0 +1,84 @@
+"""Exporters: Chrome trace-event JSON (Perfetto-loadable) and JSONL.
+
+``events.jsonl`` is the source of truth (the report and every acceptance
+gate read it alone); ``trace.json`` is a *view* generated from it in the
+Chrome trace-event format, so ``chrome://tracing`` / https://ui.perfetto.dev
+can render the same run the report summarizes — they cannot disagree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def append_jsonl(path: str, record: Dict[str, Any]) -> None:
+    """THE JSONL writer every telemetry-adjacent file goes through
+    (``events.jsonl`` flushes batch their own writes; per-step profile
+    records come one at a time)."""
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def read_events(events_path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    with open(events_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def events_to_chrome_trace(events: Iterable[Dict[str, Any]],
+                           wall_start: Optional[float] = None,
+                           ) -> Dict[str, Any]:
+    """Telemetry records -> Chrome trace-event document.
+
+    Spans become complete (``ph: "X"``) events, instants become
+    ``ph: "i"`` — both with microsecond timestamps, which is what the
+    format specifies and Perfetto expects.
+    """
+    trace_events: List[Dict[str, Any]] = []
+    pid = os.getpid()
+    for rec in events:
+        base: Dict[str, Any] = {
+            "name": rec.get("name", "?"),
+            "pid": pid,
+            "tid": rec.get("tid", 0),
+            "ts": float(rec.get("ts", 0.0)) * 1e6,
+            "cat": rec.get("kind", "event"),
+        }
+        args = dict(rec.get("attrs") or {})
+        for k in ("host_ms", "fenced", "error", "parent", "depth"):
+            if k in rec:
+                args[k] = rec[k]
+        if args:
+            base["args"] = args
+        if rec.get("kind") == "span":
+            base["ph"] = "X"
+            base["dur"] = float(rec.get("dur_ms", 0.0)) * 1e3
+        else:
+            base["ph"] = "i"
+            base["s"] = "t"  # thread-scoped instant
+        trace_events.append(base)
+    doc: Dict[str, Any] = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+    }
+    if wall_start is not None:
+        doc["otherData"] = {"wall_start_unix_s": wall_start}
+    return doc
+
+
+def write_chrome_trace(events_path: str, trace_path: str,
+                       wall_start: Optional[float] = None) -> int:
+    """events.jsonl -> trace.json; returns the trace-event count."""
+    events = read_events(events_path) if os.path.exists(events_path) else []
+    doc = events_to_chrome_trace(events, wall_start=wall_start)
+    tmp = trace_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, trace_path)
+    return len(doc["traceEvents"])

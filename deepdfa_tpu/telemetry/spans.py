@@ -1,0 +1,435 @@
+"""Run-scoped tracing spans over per-thread ring buffers.
+
+The observability contract (ISSUE 5):
+
+* **Nestable spans.** ``with span("train.step", step=n) as sp: ...``
+  records one timed event into the *current thread's* ring buffer —
+  appends take that thread's own uncontended lock (contended only while
+  the exporter drains), so instrumentation stays in production code
+  paths. Nesting is tracked per thread; every span record carries its
+  ``parent`` and ``depth`` for offline attribution.
+* **Honest device attribution.** Wall-clock deltas around a jitted call
+  measure *dispatch*, not execution (XLA runs async). ``sp.fence(x)``
+  marks the dispatch boundary and ``jax.block_until_ready(x)`` at span
+  exit, so fenced spans split into ``host_ms`` (dispatch) and total
+  duration (device-inclusive) — graftlint GL011 exists because timings
+  without this fence are lies. The fence runs whether or not a run is
+  active: it is measurement semantics at the call site, and blocking
+  changes no values (the bit-identical-history guarantee).
+* **Compile events.** A ``jax.monitoring`` listener forwards every
+  backend compile into the active run as a ``jax.compile`` event —
+  silent recompiles in train/serve become first-class, countable
+  events (the post-warmup-compiles-must-be-0 gate).
+* **Run scoping.** ``start_run(run_dir)`` / ``end_run()`` (or the
+  ``run_scope`` context manager) bind the process to one
+  ``<run_dir>/telemetry/`` sink. With no run active — or with
+  ``DEEPDFA_TELEMETRY=0`` — every hook is a cheap no-op and nothing is
+  written anywhere.
+
+Full drops are counted, never silent: a ring at capacity drops the new
+event and bumps the ring's drop counter, surfaced in ``/healthz`` and
+the flush summary event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "DEEPDFA_TELEMETRY"
+RING_ENV_VAR = "DEEPDFA_TELEMETRY_RING"
+DEFAULT_RING_CAPACITY = 65536
+
+_ENABLED: Optional[bool] = None  # tri-state: None = read the env lazily
+
+
+def enabled() -> bool:
+    """Master switch: ``DEEPDFA_TELEMETRY=0`` disables spans, events,
+    runs, and exports entirely (fences at call sites still run — they
+    are timing semantics, not telemetry)."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = os.environ.get(ENV_VAR, "1") != "0"
+    return _ENABLED
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Override the env switch (``None`` re-reads the env) — the
+    bench A/B and test hook."""
+    global _ENABLED
+    _ENABLED = value
+
+
+# ---------------------------------------------------------------------------
+# Per-thread ring buffers
+# ---------------------------------------------------------------------------
+
+
+class _Ring:
+    """Bounded event buffer owned by one thread.
+
+    ``append`` takes this ring's own lock — uncontended except while the
+    exporter swaps the buffer out (the "lock-cheap" design: no global
+    lock anywhere near the hot path)."""
+
+    def __init__(self, tid: int, capacity: int):
+        self.tid = tid
+        self.capacity = capacity
+        self.lock = threading.Lock()
+        self.events: List[Dict[str, Any]] = []
+        self.drops = 0
+
+    def append(self, record: Dict[str, Any]) -> None:
+        with self.lock:
+            if len(self.events) >= self.capacity:
+                self.drops += 1
+                return
+            self.events.append(record)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            out, self.events = self.events, []
+            return out
+
+
+class _ThreadState(threading.local):
+    def __init__(self):
+        self.ring: Optional[_Ring] = None
+        self.stack: List[str] = []  # open span names, outermost first
+
+
+_TLS = _ThreadState()
+_RINGS: List[_Ring] = []
+_RINGS_LOCK = threading.Lock()
+_REAPED_DROPS = 0  # drop counts carried over from reaped dead-thread rings
+
+
+def _ring() -> _Ring:
+    ring = _TLS.ring
+    if ring is None:
+        capacity = int(os.environ.get(RING_ENV_VAR, DEFAULT_RING_CAPACITY))
+        ring = _Ring(threading.get_ident(), capacity)
+        _TLS.ring = ring
+        with _RINGS_LOCK:
+            _RINGS.append(ring)
+    return ring
+
+
+def _reap_dead_rings() -> None:
+    """Drop rings whose owner thread is gone (one HTTP handler thread per
+    connection would otherwise leak a ring per request, and every flush/
+    drop_count walk would grow with total requests served). Callers drain
+    first; only the drop counter survives, folded into the global."""
+    global _REAPED_DROPS
+    live = {t.ident for t in threading.enumerate()}
+    with _RINGS_LOCK:
+        kept = []
+        for ring in _RINGS:
+            if ring.tid in live:
+                kept.append(ring)
+            else:
+                _REAPED_DROPS += ring.drops
+        _RINGS[:] = kept
+
+
+def drop_count() -> int:
+    """Events dropped by full rings, process-wide (the /healthz field)."""
+    with _RINGS_LOCK:
+        rings = list(_RINGS)
+        reaped = _REAPED_DROPS
+    return reaped + sum(r.drops for r in rings)
+
+
+# ---------------------------------------------------------------------------
+# The active run
+# ---------------------------------------------------------------------------
+
+
+class TelemetryRun:
+    """One run's sink: ``<run_dir>/telemetry/{events.jsonl,trace.json}``.
+
+    All timestamps are seconds on ONE clock — ``time.perf_counter()``
+    relative to ``t0`` (run start). ``flush()`` drains every thread's
+    ring and appends to ``events.jsonl`` (a single writer under one
+    lock); ``close()`` flushes, writes the Chrome-trace view, and emits
+    a final summary event carrying the drop count.
+    """
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.dir = os.path.join(run_dir, "telemetry")
+        os.makedirs(self.dir, exist_ok=True)
+        self.events_path = os.path.join(self.dir, "events.jsonl")
+        self.trace_path = os.path.join(self.dir, "trace.json")
+        self.t0 = time.perf_counter()
+        self.wall_start = time.time()
+        self.drops0 = drop_count()  # ring drops are process-lifetime;
+        # the run reports its own delta
+        self.n_written = 0
+        self._write_lock = threading.Lock()
+        # Fresh files per run: a resumed run dir must not interleave two
+        # runs' clocks in events.jsonl, and a stale trace.json from the
+        # previous run must not pose as a view of the new one (it is
+        # regenerated at close()).
+        open(self.events_path, "w").close()
+        if os.path.exists(self.trace_path):
+            os.remove(self.trace_path)
+
+    def now(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def flush(self) -> int:
+        """Drain all rings into events.jsonl; returns events written."""
+        with _RINGS_LOCK:
+            rings = list(_RINGS)
+        batch: List[Dict[str, Any]] = []
+        for ring in rings:
+            batch.extend(ring.drain())
+        _reap_dead_rings()
+        if not batch:
+            return 0
+        batch.sort(key=lambda r: r.get("ts", 0.0))
+        with self._write_lock:
+            with open(self.events_path, "a") as f:
+                for rec in batch:
+                    f.write(json.dumps(rec) + "\n")
+            self.n_written += len(batch)
+        return len(batch)
+
+    def close(self) -> None:
+        event("telemetry.flush", drops=drop_count() - self.drops0,
+              events=self.n_written)
+        self.flush()
+        from deepdfa_tpu.telemetry.export import write_chrome_trace
+
+        write_chrome_trace(self.events_path, self.trace_path,
+                           wall_start=self.wall_start)
+
+
+_RUN: Optional[TelemetryRun] = None
+_JAX_LISTENER_INSTALLED = False
+_JAX_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _install_jax_listener() -> None:
+    """Forward backend compiles into the active run (idempotent; the
+    listener itself is process-lifetime — jax has no unregister)."""
+    global _JAX_LISTENER_INSTALLED
+    if _JAX_LISTENER_INSTALLED:
+        return
+    _JAX_LISTENER_INSTALLED = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(name: str, duration: float, **kw: Any) -> None:
+            if name == _JAX_COMPILE_EVENT and _RUN is not None:
+                event("jax.compile", dur_ms=duration * 1e3)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # pragma: no cover - monitoring API drift
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "jax.monitoring unavailable; compile events will not be "
+            "captured", exc_info=True)
+
+
+def current_run() -> Optional[TelemetryRun]:
+    return _RUN
+
+
+def start_run(run_dir: str) -> Optional[TelemetryRun]:
+    """Bind the process to one run sink. No-op (returns None) when
+    telemetry is disabled; nested runs are an error — end the previous
+    one first (``run_scope`` does)."""
+    global _RUN
+    if not enabled():
+        return None
+    if _RUN is not None:
+        raise RuntimeError(
+            f"telemetry run already active ({_RUN.run_dir}); end it first"
+        )
+    _install_jax_listener()
+    _RUN = TelemetryRun(run_dir)
+    event("telemetry.start", run_dir=run_dir)
+    return _RUN
+
+
+def end_run() -> None:
+    global _RUN
+    run = _RUN
+    if run is None:
+        return
+    try:
+        # close() emits the final summary event, so the run must still be
+        # current while it runs.
+        run.close()
+    finally:
+        _RUN = None
+
+
+@contextlib.contextmanager
+def run_scope(run_dir: str):
+    """``with run_scope(run_dir): ...`` — the command-level entry."""
+    run = start_run(run_dir)
+    try:
+        yield run
+    finally:
+        if run is not None:
+            end_run()
+
+
+def flush() -> int:
+    """Drain rings into the active run's events.jsonl (0 when none)."""
+    run = _RUN
+    return run.flush() if run is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# Spans and events
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One timed region. Always measures (two perf_counter reads — the
+    call-site contract that ``dur_s`` is usable even when no run is
+    active); emits only into an active run."""
+
+    __slots__ = ("name", "attrs", "_t0", "_fence", "dur_s", "host_s")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+        self._fence: Any = None
+        self.dur_s = 0.0   # total duration (device-inclusive when fenced)
+        self.host_s: Optional[float] = None  # dispatch-only, fenced spans
+
+    def fence(self, value: Any) -> None:
+        """Block on ``value`` at span exit: the span then measures
+        dispatch AND device execution, split into host_ms / dur_ms."""
+        self._fence = value
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        _TLS.stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.perf_counter()
+        if self._fence is not None:
+            import jax
+
+            jax.block_until_ready(self._fence)
+            t2 = time.perf_counter()
+            self.host_s = t1 - self._t0
+            self.dur_s = t2 - self._t0
+        else:
+            self.dur_s = t1 - self._t0
+        stack = _TLS.stack
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        run = _RUN
+        if run is not None:
+            rec: Dict[str, Any] = {
+                "kind": "span",
+                "name": self.name,
+                "ts": self._t0 - run.t0,
+                "dur_ms": self.dur_s * 1e3,
+                "tid": threading.get_ident(),
+                "depth": len(stack),
+            }
+            if stack:
+                rec["parent"] = stack[-1]
+            if self.host_s is not None:
+                rec["host_ms"] = self.host_s * 1e3
+                rec["fenced"] = True
+            if exc_type is not None:
+                rec["error"] = exc_type.__name__
+            if self.attrs:
+                rec["attrs"] = self.attrs
+            _ring().append(rec)
+        return False
+
+
+class _NullSpan:
+    """The disabled-path span: every method a no-op, ``dur_s`` stays 0
+    (disabled means *disabled* — not even the clock is read)."""
+
+    __slots__ = ()
+    dur_s = 0.0
+    host_s: Optional[float] = None
+
+    def fence(self, value: Any) -> None:
+        pass
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **attrs: Any):
+    """``with span("train.step", step=n) as sp:`` — nestable timed
+    region. Cheap no-op object when telemetry is disabled."""
+    if not enabled():
+        return _NULL_SPAN
+    return Span(name, attrs)
+
+
+def record_span(name: str, start_s: float, end_s: Optional[float] = None,
+                **attrs: Any) -> None:
+    """Retroactive span from explicit perf_counter timestamps — for
+    regions whose start and end live on different threads (a serving
+    request's submit -> finish)."""
+    run = _RUN
+    if run is None or not enabled():
+        return
+    end_s = time.perf_counter() if end_s is None else end_s
+    rec: Dict[str, Any] = {
+        "kind": "span",
+        "name": name,
+        "ts": start_s - run.t0,
+        "dur_ms": (end_s - start_s) * 1e3,
+        "tid": threading.get_ident(),
+        "depth": 0,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    _ring().append(rec)
+
+
+def event(name: str, **attrs: Any) -> None:
+    """Instant event into the active run (no-op without one)."""
+    run = _RUN
+    if run is None or not enabled():
+        return
+    rec: Dict[str, Any] = {
+        "kind": "event",
+        "name": name,
+        "ts": run.now(),
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    _ring().append(rec)
+
+
+def now() -> float:
+    """THE telemetry clock (perf_counter seconds) — call sites that
+    stamp retroactive spans must use this, not their own clock."""
+    return time.perf_counter()

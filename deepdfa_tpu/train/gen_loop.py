@@ -29,6 +29,7 @@ from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
 from deepdfa_tpu.models.t5_generate import generate
 from deepdfa_tpu.resilience import inject
 from deepdfa_tpu.train.text_loop import make_schedule, make_text_optimizer
+from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -442,13 +443,21 @@ def fit_gen(
         inject.fire("train.epoch_start", index=epoch)
         epoch_start_state = state
         losses = []
-        for src, tgt, _ in _batches(
-            train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
-        ):
-            state, loss = step(
-                state, _lift_rows(src, mesh, host), _lift_rows(tgt, mesh, host)
-            )
-            losses.append(inject.corrupt_loss(loss))
+        # Same fenced-epoch / dispatch-step span pairing as loop.py —
+        # the report's host/device split works for the gen loop too.
+        with telemetry.span("train.epoch", epoch=epoch, loop="gen") as ep:
+            for src, tgt, _ in _batches(
+                train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
+            ):
+                with telemetry.span("train.step", epoch=epoch,
+                                    step=len(losses)):
+                    state, loss = step(
+                        state, _lift_rows(src, mesh, host),
+                        _lift_rows(tgt, mesh, host)
+                    )
+                losses.append(inject.corrupt_loss(loss))
+            ep.fence(losses)
+            ep.set(steps=len(losses))
         record = {"epoch": epoch,
                   "train_loss": float(np.mean(jax.device_get(losses)))}
         # Epoch-granular anomaly handling: the mean above is the one host
@@ -470,6 +479,7 @@ def fit_gen(
             )
             state = epoch_start_state
             record["rolled_back"] = True
+            telemetry.event("train.rollback", epoch=epoch, loop="gen")
         if eval_bleu:
             metrics, pred_texts = bleu_eval(state)
             record.update(metrics)
@@ -479,6 +489,11 @@ def fit_gen(
                                       src_texts[: len(pred_texts)])
         else:
             record["eval_loss"] = loss_only_eval()
+        if epoch == 0:
+            telemetry.event("train.warmup_done", epoch=epoch, loop="gen")
+        telemetry.event("train.epoch_end", epoch=epoch, loop="gen",
+                        train_loss=record["train_loss"])
+        telemetry.flush()  # epoch cadence: don't ride the ring until close
         history.append(record)
         if log:
             log(f"epoch {epoch}: " + " ".join(

@@ -36,6 +36,7 @@ from deepdfa_tpu.graphs.batch import (
 from deepdfa_tpu.models.flowgnn import FlowGNN
 from deepdfa_tpu.parallel.mesh import DATA_AXIS, batch_sharding, make_mesh, replicated
 from deepdfa_tpu.resilience import inject
+from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -651,33 +652,47 @@ def _fit_epochs(
         # Window-start snapshot for rollback: references to the functional
         # state/accumulator values, so holding it costs nothing.
         window = (state, loss_sum, stats, n_batches)
-        for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
-                              data_cfg.batch_size, n_shards, use_tile,
-                              use_band, use_df, host):
-            if host is not None:
-                batch = assemble_global_batch(batch, mesh)
-            state, loss, bstats = train_step(state, batch)
-            loss = inject.corrupt_loss(loss)
-            if guard.active:
-                bad_step = jnp.where(
-                    (bad_step < 0) & ~jnp.isfinite(loss), seen, bad_step
-                )
-            loss_sum = loss_sum + loss
-            stats = stats + bstats
-            n_batches += 1
-            seen += 1
-            if seen % log_every == 0:
-                rolled, (state, loss_sum, stats, n_batches) = guard.check(
-                    epoch, bad_step, window,
-                    (state, loss_sum, stats, n_batches), history,
-                )
-                if rolled:
-                    bad_step = jnp.asarray(-1, jnp.int32)
-                    epoch_rolled = True
-                else:
-                    logger.info("epoch %d step %d loss %.4f", epoch, seen,
-                                float(loss))
-                window = (state, loss_sum, stats, n_batches)
+        # Epoch span, FENCED on the device loss accumulator: its duration
+        # covers dispatch AND device execution (the honest wall time the
+        # GL011 rule exists to enforce), while the per-step spans inside
+        # it measure host-dispatch only — the report derives the
+        # host/device split from exactly this pairing. window_steps
+        # counts the steps the fenced span covers.
+        window_steps = 0
+        with telemetry.span("train.epoch", epoch=epoch) as ep:
+            for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
+                                  data_cfg.batch_size, n_shards, use_tile,
+                                  use_band, use_df, host):
+                if host is not None:
+                    batch = assemble_global_batch(batch, mesh)
+                with telemetry.span("train.step", epoch=epoch, step=seen):
+                    state, loss, bstats = train_step(state, batch)
+                loss = inject.corrupt_loss(loss)
+                if guard.active:
+                    bad_step = jnp.where(
+                        (bad_step < 0) & ~jnp.isfinite(loss), seen, bad_step
+                    )
+                loss_sum = loss_sum + loss
+                stats = stats + bstats
+                n_batches += 1
+                seen += 1
+                window_steps += 1
+                if seen % log_every == 0:
+                    rolled, (state, loss_sum, stats, n_batches) = guard.check(
+                        epoch, bad_step, window,
+                        (state, loss_sum, stats, n_batches), history,
+                    )
+                    if rolled:
+                        bad_step = jnp.asarray(-1, jnp.int32)
+                        epoch_rolled = True
+                        telemetry.event("train.rollback", epoch=epoch,
+                                        step=seen)
+                    else:
+                        logger.info("epoch %d step %d loss %.4f", epoch, seen,
+                                    float(loss))
+                    window = (state, loss_sum, stats, n_batches)
+            ep.fence(loss_sum)
+            ep.set(steps=window_steps)
         rolled, (state, loss_sum, stats, n_batches) = guard.check(
             epoch, bad_step, window, (state, loss_sum, stats, n_batches),
             history,
@@ -689,9 +704,15 @@ def _fit_epochs(
                       else float(loss_sum))
         train_metrics = {k: float(v) for k, v in compute_metrics(stats).items()}
 
-        val = evaluate(eval_step, state, examples, splits["val"], data_cfg,
-                       subkeys, n_shards, use_tile, use_df, host, mesh,
-                       build_band_adj=use_band)
+        with telemetry.span("train.eval", epoch=epoch):
+            val = evaluate(eval_step, state, examples, splits["val"],
+                           data_cfg, subkeys, n_shards, use_tile, use_df,
+                           host, mesh, build_band_adj=use_band)
+        if epoch == start_epoch:
+            # Every jitted shape this fit dispatches has now compiled
+            # (train step + eval step); any jax.compile event after this
+            # marker is a silent recompile the trace report must surface.
+            telemetry.event("train.warmup_done", epoch=epoch)
         record = {
             "epoch": epoch,
             "train_loss": epoch_loss / max(n_batches, 1),
@@ -705,6 +726,14 @@ def _fit_epochs(
             # able to tell a healed epoch from a healthy one.
             record["rolled_back"] = True
         history["epochs"].append(record)
+        telemetry.event("train.epoch_end", epoch=epoch,
+                        train_loss=record["train_loss"], val_loss=val.loss,
+                        val_f1=val.metrics["f1"],
+                        seconds=record["seconds"],
+                        rolled_back=epoch_rolled)
+        # Epoch-cadence flush: long runs must not ride the ring buffer
+        # until close (a >ring-capacity fit would drop its whole tail).
+        telemetry.flush()
         logger.info(
             "epoch %d train_loss %.4f val_loss %.4f val_f1 %.4f (%.1fs)",
             epoch, record["train_loss"], val.loss, val.metrics["f1"], record["seconds"],

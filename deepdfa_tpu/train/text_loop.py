@@ -30,6 +30,7 @@ from deepdfa_tpu.graphs.batch import GraphBatch, batch_graphs, pad_budget_for
 from deepdfa_tpu.models.linevul import LineVul, cross_entropy_loss
 from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
 from deepdfa_tpu.resilience import inject
+from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -612,20 +613,28 @@ def fit_text(
         # running ahead of execution.
         loss_sum = jnp.zeros(())
         n_batches, num_missing = 0, 0
-        for batch in text_graph_batches(
-            data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
-            graph_budget, shuffle_rng=rng, pad_id=pad_id,
-            build_tile_adj=build_tile_adj, build_band_adj=build_band_adj,
-            n_shards=n_shards, host=host,
-        ):
-            num_missing += batch.n_missing
-            if host is not None:
-                batch = _assemble_text(batch, mesh)
-            state, loss, bstats = _run_step(train_step, state, batch)
-            loss = inject.corrupt_loss(loss)
-            loss_sum = loss_sum + loss
-            stats = stats + bstats
-            n_batches += 1
+        # Fenced epoch span (device-inclusive wall, host/device split);
+        # per-step spans inside measure host dispatch only — same
+        # pairing as train/loop.py, same report semantics.
+        with telemetry.span("train.epoch", epoch=epoch, loop="text") as ep:
+            for batch in text_graph_batches(
+                data, splits["train"], cfg.batch_size, graphs_by_id, subkeys,
+                graph_budget, shuffle_rng=rng, pad_id=pad_id,
+                build_tile_adj=build_tile_adj, build_band_adj=build_band_adj,
+                n_shards=n_shards, host=host,
+            ):
+                num_missing += batch.n_missing
+                if host is not None:
+                    batch = _assemble_text(batch, mesh)
+                with telemetry.span("train.step", epoch=epoch,
+                                    step=n_batches):
+                    state, loss, bstats = _run_step(train_step, state, batch)
+                loss = inject.corrupt_loss(loss)
+                loss_sum = loss_sum + loss
+                stats = stats + bstats
+                n_batches += 1
+            ep.fence(loss_sum)
+            ep.set(steps=n_batches)
         epoch_loss = float(loss_sum)
         # Anomaly handling at epoch granularity: the per-epoch host
         # transfer above is the one sync that already exists, so detection
@@ -653,12 +662,17 @@ def fit_text(
                 epoch, anomaly_budget,
             )
             state = epoch_start_state
-        val = evaluate_text(
-            eval_step, state, data, splits["val"], cfg, graphs_by_id, subkeys,
-            graph_budget, pad_id=pad_id, build_tile_adj=build_tile_adj,
-            build_band_adj=build_band_adj, n_shards=n_shards, host=host,
-            mesh=mesh,
-        )
+            telemetry.event("train.rollback", epoch=epoch, loop="text")
+        with telemetry.span("train.eval", epoch=epoch, loop="text"):
+            val = evaluate_text(
+                eval_step, state, data, splits["val"], cfg, graphs_by_id,
+                subkeys, graph_budget, pad_id=pad_id,
+                build_tile_adj=build_tile_adj,
+                build_band_adj=build_band_adj, n_shards=n_shards, host=host,
+                mesh=mesh,
+            )
+        if epoch == 0:
+            telemetry.event("train.warmup_done", epoch=epoch, loop="text")
         record = {
             "epoch": epoch,
             "train_loss": epoch_loss / max(n_batches, 1),
@@ -671,6 +685,12 @@ def fit_text(
         if rolled_back:
             record["rolled_back"] = True
         history["epochs"].append(record)
+        telemetry.event("train.epoch_end", epoch=epoch, loop="text",
+                        train_loss=record["train_loss"],
+                        val_f1=val["metrics"]["f1"],
+                        seconds=record["seconds"],
+                        rolled_back=rolled_back)
+        telemetry.flush()  # epoch cadence: don't ride the ring until close
         logger.info(
             "epoch %d train_loss %.4f val_f1 %.4f (%.1fs)",
             epoch, record["train_loss"], val["metrics"]["f1"], record["seconds"],

@@ -17,6 +17,11 @@ CHECKPOINT_DIR= COMBINED_DIR= bash scripts/serve.sh --smoke 8 \
 # ingested and every corruption class must be repaired or quarantined
 # under its expected reason code — seconds, fail-closed.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli validate --smoke
+# Telemetry smoke (deepdfa_tpu/telemetry): a tiny instrumented fit writes
+# runs/<run>/telemetry/{events.jsonl,trace.json} and `trace report` must
+# round-trip step timings, the host/device split, compile capture
+# (post-warmup compiles 0), and a valid Perfetto-loadable trace.json.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
 # Chaos soak: six injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
 # serving flush isolation, corrupt-corpus quarantine+bitwise-clean

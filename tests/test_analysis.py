@@ -549,6 +549,99 @@ def load(path):
 
 
 # ---------------------------------------------------------------------------
+# GL011 naive-wallclock-timing
+# ---------------------------------------------------------------------------
+
+
+def test_gl011_delta_around_step_without_barrier():
+    src = """
+import time
+
+def run(train_step, state, batches):
+    t0 = time.perf_counter()
+    for b in batches:
+        state, loss = train_step(state, b)
+    return time.perf_counter() - t0
+"""
+    found = findings_for(src, "GL011")
+    assert len(found) == 1
+    assert found[0].line == 8
+    assert "block_until_ready" in found[0].message
+
+
+def test_gl011_time_time_variant_and_var_minus_var():
+    src = """
+import time
+
+def run(step, state, batch):
+    t0 = time.time()
+    state, loss = step(state, batch)
+    t1 = time.time()
+    return t1 - t0
+"""
+    assert len(findings_for(src, "GL011")) == 1
+
+
+def test_gl011_negative_block_until_ready_between():
+    src = """
+import time
+import jax
+
+def run(train_step, state, batches):
+    t0 = time.perf_counter()
+    for b in batches:
+        state, loss = train_step(state, b)
+    jax.block_until_ready(loss)
+    return time.perf_counter() - t0
+"""
+    assert "GL011" not in rules_of(src)
+
+
+def test_gl011_negative_telemetry_fence_between():
+    src = """
+import time
+from deepdfa_tpu import telemetry
+
+def run(train_step, state, batches):
+    t0 = time.perf_counter()
+    with telemetry.span("train.epoch") as ep:
+        for b in batches:
+            state, loss = train_step(state, b)
+        ep.fence(loss)
+    return time.perf_counter() - t0
+"""
+    assert "GL011" not in rules_of(src)
+
+
+def test_gl011_negative_float_sync_between():
+    # float() on a device value forces the wait (GL004's own sync
+    # definition), so a delta after it is honest.
+    src = """
+import time
+
+def run(train_step, state, batches):
+    t0 = time.perf_counter()
+    for b in batches:
+        state, loss = train_step(state, b)
+    l = float(loss)
+    return l, time.perf_counter() - t0
+"""
+    assert "GL011" not in rules_of(src)
+
+
+def test_gl011_negative_no_dispatch_between():
+    src = """
+import time
+
+def run(load, paths):
+    t0 = time.perf_counter()
+    rows = [load(p) for p in paths]
+    return rows, time.perf_counter() - t0
+"""
+    assert "GL011" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -801,12 +894,14 @@ def test_package_self_check_clean_and_fast():
 
 
 def test_self_check_covers_every_rule_implementation():
-    """All 10 hazard rule ids (plus the parse-error sentinel) are wired:
+    """All 11 hazard rule ids (plus the parse-error sentinel) are wired:
     each hazard has at least one firing fixture above; this guards the
     registry/implementation agreement."""
     from deepdfa_tpu.analysis.rules import RULES
 
-    assert set(RULES) == {f"GL00{i}" for i in range(0, 10)} | {"GL010"}
+    assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
+                          | {"GL010", "GL011"})
+    assert len(RULES) == 12
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

@@ -1,0 +1,497 @@
+"""Telemetry layer (deepdfa_tpu/telemetry): span nesting/attribution,
+registry thread-safety under serving-style concurrency, Chrome-trace
+validity, compile-event capture, fault/retry/quarantine visibility in
+events.jsonl, the Prometheus exposition, and the disabled-path
+bit-identity guarantee."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+)
+from deepdfa_tpu.data.splits import make_splits
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.telemetry.report import summarize, trace_report
+from deepdfa_tpu.train.loop import fit
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_run_state():
+    """No test may leak an active run or an enabled-override into the
+    next one (the run global is process-wide by design)."""
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+    yield
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+
+
+def _dataset(n=24, seed=0):
+    examples = synthetic_bigvul(n, FEAT, positive_fraction=0.5, seed=seed)
+    for i, ex in enumerate(examples):
+        ex["label"] = int(np.asarray(ex["vuln"]).max())
+        ex["id"] = i
+    return examples, make_splits(examples, seed=seed)
+
+
+def _events(run_dir):
+    path = os.path.join(run_dir, "telemetry", "events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Spans: nesting, attribution, fencing, rings
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_and_depth(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("outer"):
+            with telemetry.span("inner", k=1):
+                pass
+        with telemetry.span("solo"):
+            pass
+    by_name = {e["name"]: e for e in _events(str(tmp_path))
+               if e["kind"] == "span"}
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["inner"]["attrs"] == {"k": 1}
+    assert by_name["outer"]["depth"] == 0
+    assert "parent" not in by_name["solo"]
+    # children close before parents, so inner's duration nests inside
+    # outer's window
+    assert by_name["inner"]["dur_ms"] <= by_name["outer"]["dur_ms"]
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+
+
+def test_fenced_span_splits_host_and_total(tmp_path):
+    import jax.numpy as jnp
+
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("work") as sp:
+            out = jax.jit(lambda x: x * 2)(jnp.ones(8))
+            sp.fence(out)
+    (rec,) = [e for e in _events(str(tmp_path))
+              if e["kind"] == "span" and e["name"] == "work"]
+    assert rec["fenced"] is True
+    assert 0.0 <= rec["host_ms"] <= rec["dur_ms"]
+
+
+def test_span_records_error_type(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("x")
+    (rec,) = [e for e in _events(str(tmp_path)) if e["name"] == "boom"]
+    assert rec["error"] == "ValueError"
+
+
+def test_ring_overflow_drops_and_is_counted(tmp_path, monkeypatch):
+    # Force a tiny ring on a fresh thread (rings are per-thread, created
+    # on first use with the env capacity).
+    monkeypatch.setenv("DEEPDFA_TELEMETRY_RING", "4")
+    before = telemetry.drop_count()
+    with telemetry.run_scope(str(tmp_path)):
+        def spam():
+            for i in range(16):
+                telemetry.event("spam", i=i)
+
+        t = threading.Thread(target=spam)
+        t.start()
+        t.join()
+    assert telemetry.drop_count() - before == 12
+    names = [e["name"] for e in _events(str(tmp_path))]
+    assert names.count("spam") == 4
+    # The close-time summary event carries the drop count forward into
+    # the offline report.
+    report = summarize(_events(str(tmp_path)))
+    assert report["telemetry_drops"] >= 12
+
+
+def test_dead_thread_rings_are_reaped_on_flush(tmp_path):
+    from deepdfa_tpu.telemetry import spans as spans_mod
+
+    with telemetry.run_scope(str(tmp_path)):
+        threads = [threading.Thread(target=lambda: telemetry.event("t"))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with spans_mod._RINGS_LOCK:
+            n_before = len(spans_mod._RINGS)
+        drops_before = telemetry.drop_count()
+        telemetry.flush()  # drains, then reaps the 4 dead threads' rings
+        with spans_mod._RINGS_LOCK:
+            n_after = len(spans_mod._RINGS)
+    assert n_after <= n_before - 4
+    # reaping must never lose the drop accounting
+    assert telemetry.drop_count() == drops_before
+    names = [e["name"] for e in _events(str(tmp_path))]
+    assert names.count("t") == 4
+
+
+def test_no_run_and_disabled_paths_are_noops(tmp_path):
+    # No active run: spans still measure, nothing is written.
+    with telemetry.span("x") as sp:
+        pass
+    assert sp.dur_s >= 0.0
+    # Disabled entirely: the null span does not even read the clock.
+    telemetry.set_enabled(False)
+    assert telemetry.start_run(str(tmp_path)) is None
+    with telemetry.span("y") as sp:
+        pass
+    assert sp.dur_s == 0.0
+    assert not os.path.exists(os.path.join(str(tmp_path), "telemetry",
+                                           "events.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Registry: thread-safety under serving-style concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_registry_exact_under_concurrent_bumps():
+    from deepdfa_tpu.core.metrics import ServingStats
+    from deepdfa_tpu.telemetry.registry import REGISTRY
+
+    stats = ServingStats(latency_window=64)
+    c0 = REGISTRY.counter("serve_submitted_total").value
+    h0 = REGISTRY.histogram("serve_latency_ms").value["count"]
+    n_threads, per_thread = 8, 250
+
+    def hammer():
+        for _ in range(per_thread):
+            stats.bump("submitted")
+            stats.observe_latency(0.001)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Both the per-engine view and the registry mirror count exactly —
+    # a lost increment anywhere fails this.
+    assert stats.submitted == n_threads * per_thread
+    assert (REGISTRY.counter("serve_submitted_total").value - c0
+            == n_threads * per_thread)
+    assert (REGISTRY.histogram("serve_latency_ms").value["count"] - h0
+            == n_threads * per_thread)
+
+
+def test_registry_kind_conflict_and_sanitize():
+    from deepdfa_tpu.telemetry.registry import Registry, sanitize
+
+    reg = Registry()
+    reg.counter("a_total").inc(2)
+    with pytest.raises(TypeError):
+        reg.gauge("a_total")
+    assert sanitize("reason:v1") == "reason_v1"
+    text = reg.prometheus_text(extra={"p99 ms": 1.5})
+    assert "# TYPE deepdfa_a_total counter" in text
+    assert "deepdfa_a_total 2" in text
+    assert "deepdfa_p99_ms 1.5" in text
+
+
+def test_ingest_stats_mirror_into_registry():
+    from deepdfa_tpu.core.metrics import IngestStats
+    from deepdfa_tpu.telemetry.registry import REGISTRY
+
+    stats = IngestStats()
+    before = REGISTRY.counter("ingest_cache_reason_v1_total").value
+    stats.bump("cache", "reason:v1", by=3)
+    assert stats.get("cache", "reason:v1") == 3
+    assert (REGISTRY.counter("ingest_cache_reason_v1_total").value
+            - before == 3)
+
+
+# ---------------------------------------------------------------------------
+# trace.json: Chrome trace-event validity
+# ---------------------------------------------------------------------------
+
+
+def test_trace_json_is_valid_chrome_trace(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("a", step=0):
+            telemetry.event("mark", x=1)
+    path = os.path.join(str(tmp_path), "telemetry", "trace.json")
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events, "trace must carry events"
+    for ev in events:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        else:
+            assert ev["s"] == "t"
+    # span duration round-trips in microseconds
+    (a,) = [e for e in events if e["name"] == "a"]
+    assert a["args"]["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Compile capture
+# ---------------------------------------------------------------------------
+
+
+def test_compile_events_catch_bucket_missing_shape(tmp_path):
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock
+
+    config = ServeConfig(batch_slots=4, queue_capacity=4)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+    with telemetry.run_scope(str(tmp_path)):
+        eng = ServeEngine(model, params, config=config,
+                          clock=VirtualClock())
+        eng.warmup()
+        telemetry.flush()
+        n_before = len([e for e in _events(str(tmp_path))
+                        if e["name"] == "jax.compile"])
+        assert n_before > 0, "warmup compiles must be captured"
+        # A shape outside the warmed (lane, slot-bucket) ladder: slots=3
+        # is not a power-of-two bucket, so this compile is exactly the
+        # silent-recompile class the trace must surface.
+        eng._executable("gnn", 3)
+    events = _events(str(tmp_path))
+    report = summarize(events)
+    assert report["compiles"]["warmup_marker"] is True
+    assert report["compiles"]["after_warmup"] >= 1
+    # and the serve.compile span names the offending bucket
+    missing = [e for e in events if e["name"] == "serve.compile"
+               and (e.get("attrs") or {}).get("slots") == 3]
+    assert len(missing) == 1
+
+
+def test_warmed_replay_has_zero_post_warmup_compiles(tmp_path):
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock, bursty_trace, replay
+
+    config = ServeConfig(batch_slots=4, queue_capacity=64)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+    with telemetry.run_scope(str(tmp_path)):
+        clock = VirtualClock()
+        eng = ServeEngine(model, params, config=config, clock=clock)
+        eng.warmup()
+        replay(eng, bursty_trace(24, FEAT, seed=0), clock)
+    report = summarize(_events(str(tmp_path)))
+    assert report["compiles"]["after_warmup"] == 0
+    assert report["serve"]["requests"] > 0
+    assert report["serve"]["flushes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Faults, retries, quarantine in events.jsonl
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_faults_appear_in_events_with_seed_and_site(tmp_path):
+    from deepdfa_tpu.resilience import inject
+
+    examples, splits = _dataset()
+    plan = inject.FaultPlan.from_doc({
+        "seed": 7,
+        "faults": [
+            {"site": "train.epoch_start", "kind": "raise", "at": 1},
+            {"site": "train.loss", "kind": "nan", "at": 0},
+        ],
+    })
+    run_dir = str(tmp_path / "chaos")
+    with telemetry.run_scope(run_dir):
+        with inject.armed(plan):
+            with pytest.raises(inject.FaultError):
+                fit(FlowGNN(TINY), examples, splits,
+                    TrainConfig(max_epochs=3, seed=0,
+                                anomaly_policy="rollback",
+                                anomaly_retry_budget=2),
+                    DataConfig(batch_size=8, eval_batch_size=8),
+                    log_every=2)
+    fired = [e for e in _events(run_dir) if e["name"] == "fault.fired"]
+    # EVERY fired fault appears, with the plan's seed and its site —
+    # including the `raise` that killed the run.
+    assert {(e["attrs"]["site"], e["attrs"]["seed"]) for e in fired} == {
+        ("train.loss", 7), ("train.epoch_start", 7),
+    }
+    assert all(e["attrs"]["seed"] == plan.seed for e in fired)
+    by_site = summarize(_events(run_dir))["faults"]["by_site"]
+    assert by_site == {"train.loss": 1, "train.epoch_start": 1}
+
+
+def test_retry_events_land_in_run(tmp_path):
+    from deepdfa_tpu.core.retry import GiveUp, RetryPolicy, retry_call
+
+    def flaky():
+        raise OSError("down")
+
+    with telemetry.run_scope(str(tmp_path)):
+        with pytest.raises(GiveUp):
+            retry_call(flaky, policy=RetryPolicy(max_attempts=3,
+                                                 base_delay_s=0.0),
+                       sleep=lambda s: None)
+    report = summarize(_events(str(tmp_path)))
+    assert report["retries"] == 2
+    assert report["retry_giveups"] == 1
+
+
+def test_quarantine_events_land_in_run(tmp_path):
+    from deepdfa_tpu.contracts import ContractError, Quarantine
+
+    with telemetry.run_scope(str(tmp_path / "run")):
+        q = Quarantine(tmp_path / "quarantine")
+        q.put(ContractError("missing_field", "bad row", boundary="cache",
+                            item_id=3))
+    report = summarize(_events(str(tmp_path / "run")))
+    assert report["quarantined"] == 1
+    (ev,) = [e for e in _events(str(tmp_path / "run"))
+             if e["name"] == "quarantine"]
+    assert ev["attrs"]["boundary"] == "cache"
+    assert ev["attrs"]["reason"] == "missing_field"
+    assert ev["attrs"]["item_id"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Instrumented fit: report round-trip + disabled bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _strip_seconds(history):
+    out = json.loads(json.dumps(history))
+    for rec in out["epochs"]:
+        rec.pop("seconds", None)
+    return out
+
+
+def test_fit_report_roundtrip_and_disabled_history_is_identical(tmp_path):
+    examples, splits = _dataset()
+    cfg = TrainConfig(max_epochs=2, seed=0)
+    data = DataConfig(batch_size=8, eval_batch_size=8)
+
+    run_dir = str(tmp_path / "run")
+    with telemetry.run_scope(run_dir):
+        _, hist_on = fit(FlowGNN(TINY), examples, splits, cfg, data,
+                         log_every=2)
+    report = trace_report(run_dir)
+    assert report["train"]["steps"] > 0
+    assert report["train"]["step_dispatch_ms_p99"] >= \
+        report["train"]["step_dispatch_ms_p50"] > 0
+    assert report["train"]["fenced_windows"] == 2  # one per epoch
+    assert report["train"]["host_frac"] is not None
+    assert report["compiles"]["warmup_marker"] is True
+    assert report["faults"]["total"] == 0
+
+    # Fully disabled: the SAME fit must produce a bit-identical history
+    # (wall-clock "seconds" excluded — no two runs share a clock).
+    telemetry.set_enabled(False)
+    _, hist_off = fit(FlowGNN(TINY), examples, splits, cfg, data,
+                      log_every=2)
+    assert json.dumps(_strip_seconds(hist_on), sort_keys=True) == \
+        json.dumps(_strip_seconds(hist_off), sort_keys=True)
+    assert not os.path.exists(os.path.join(str(tmp_path), "run2"))
+
+
+def test_cli_trace_smoke_and_report(tmp_path, capsys):
+    from deepdfa_tpu import cli
+
+    rc = cli.main(["trace", "--smoke",
+                   "--out-dir", str(tmp_path / "smoke")])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] is True and all(out["checks"].values())
+    # the one-command acceptance surface: report reproduces from
+    # events.jsonl alone
+    rc = cli.main(["trace", "report", str(tmp_path / "smoke")])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["train"]["steps"] > 0
+    assert rep["compiles"]["after_warmup"] == 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: Prometheus negotiation, JSON compat, healthz drops
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, queue_capacity=8)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config)
+    eng.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield eng, f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _get(url, accept=None):
+    req = urllib.request.Request(
+        url, headers={"Accept": accept} if accept else {})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.getheader("Content-Type"), resp.read()
+
+
+def test_metrics_json_stays_byte_compatible(http_server):
+    eng, base = http_server
+    ctype, body = _get(f"{base}/metrics")
+    assert ctype == "application/json"
+    parsed = json.loads(body)
+    # Byte-compatibility regression: the body is exactly the historic
+    # json.dumps(snapshot) encoding (key order, separators, floats).
+    assert body == json.dumps(parsed).encode()
+    assert set(parsed) >= {"completed", "compiles", "queue_depth",
+                           "latency_p99_ms"}
+
+
+def test_metrics_prometheus_negotiation(http_server):
+    eng, base = http_server
+    ctype, body = _get(f"{base}/metrics", accept="text/plain")
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE deepdfa_serve_compiles gauge" in text
+    assert "deepdfa_serve_compiles" in text
+    # the registry counters ride along (warmup bumped them)
+    assert "deepdfa_serve_compiles_total" in text
+    # openmetrics spelling negotiates text too
+    ctype2, _ = _get(f"{base}/metrics",
+                     accept="application/openmetrics-text")
+    assert ctype2.startswith("text/plain")
+
+
+def test_healthz_reports_telemetry_drops(http_server):
+    eng, base = http_server
+    _, body = _get(f"{base}/healthz")
+    doc = json.loads(body)
+    assert doc["status"] == "ok"
+    assert doc["telemetry_drops"] == telemetry.drop_count()
