@@ -63,6 +63,20 @@ Rule catalogue (each backed by a positive+negative fixture in
                              (``jax.block_until_ready``, ``jax.device_get``,
                              ``np.asarray``) and telemetry span fencing
                              (``sp.fence(x)``) are accepted barriers.
+  GL013 blocking-checkpoint-in-step  synchronous snapshot work inside a
+                             step-shaped loop (a loop that also dispatches
+                             jitted steps): ``pickle.dump``/``os.fsync``
+                             inline, or ``save_*``/``maybe_save_periodic``
+                             on a receiver whose reaching definitions
+                             construct the synchronous
+                             ``CheckpointManager`` — every save then stalls
+                             the loop on a device→host copy plus fsync.
+                             The async handoff
+                             (``AsyncCheckpointManager`` /
+                             ``make_checkpoint_manager``) is the fix;
+                             receivers of unknown provenance (parameters,
+                             factories) stay unflagged — precision over
+                             recall, the empty-baseline contract.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -100,6 +114,7 @@ RULES: Dict[str, str] = {
     "GL009": "swallowed-device-exception",
     "GL010": "unchecked-json-ingest",
     "GL011": "naive-wallclock-timing",
+    "GL013": "blocking-checkpoint-in-step",
 }
 
 _JIT_NAMES = frozenset({
@@ -164,6 +179,11 @@ _CLOCK_CALLS = frozenset({
     "time.time", "time.perf_counter", "time.monotonic",
 })
 _BARRIER_ATTRS = frozenset({"fence", "block_until_ready"})
+# GL013: inline serialization that blocks a step loop, the save-method
+# shapes, and the one receiver class with positive synchronous evidence.
+_BLOCKING_IO_CALLS = frozenset({"pickle.dump", "os.fsync"})
+_SAVE_METHOD_RE = re.compile(r"^(save|save_[a-z0-9_]+|maybe_save_periodic)$")
+_SYNC_MANAGER_LEAF = "CheckpointManager"
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -373,6 +393,7 @@ class _FunctionChecker:
         else:
             self._check_step_loops()
             self._check_naive_timing()
+            self._check_blocking_checkpoint()
         self._check_jit_in_loop()
         self._check_key_reuse()
         self._check_swallowed_exceptions()
@@ -602,6 +623,76 @@ class _FunctionChecker:
                     "this time the dispatch, not the execution; fence the "
                     "result (jax.block_until_ready / telemetry span "
                     ".fence) before reading the clock")
+
+    # -- blocking checkpoint in the step loop (GL013) ------------------------
+
+    def _sync_manager_def_line(self, name: str, node: Node, defs) -> Optional[int]:
+        """The construction line when ``name``'s reaching definitions
+        include a synchronous ``CheckpointManager(...)`` call; None for
+        parameters, factories, and the Async manager (unknown provenance
+        stays unflagged — flagging a parameter would force every caller
+        to prove a negative)."""
+        for d in defs.get(node.idx, {}).get(name, frozenset()):
+            stmt = self.cfg.nodes[d].stmt
+            if (not isinstance(stmt, ast.Assign)
+                    or not isinstance(stmt.value, ast.Call)):
+                continue
+            dotted = self.mod.resolve(stmt.value.func)
+            if dotted is not None and dotted.rsplit(".", 1)[-1] == _SYNC_MANAGER_LEAF:
+                return getattr(stmt, "lineno", 0)
+        return None
+
+    def _check_blocking_checkpoint(self) -> None:
+        """Synchronous snapshot work inside a step-shaped loop: the loop
+        both dispatches jitted steps and serializes/fsyncs inline, so
+        every save stalls dispatch for the full device→host copy + write.
+        The fix is an async handoff (AsyncCheckpointManager) — a save on
+        a receiver constructed as the synchronous manager, or a bare
+        ``pickle.dump``/``os.fsync``, is the hazard."""
+        dispatch_loops: Set[int] = set()
+        for node in self.cfg.nodes:
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Call) and self._is_dispatch_call(sub):
+                        dispatch_loops.update(node.loop_stack)
+        if not dispatch_loops:
+            return
+        defs = None
+        for node in self.cfg.nodes:
+            if not set(node.loop_stack) & dispatch_loops:
+                continue
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = self.mod.resolve(sub.func)
+                    if dotted in _BLOCKING_IO_CALLS:
+                        self._report(
+                            "GL013", sub,
+                            f"{dotted}(…) inside the step loop — inline "
+                            "serialization/fsync blocks dispatch every "
+                            "iteration; hand the write to an async writer "
+                            "(AsyncCheckpointManager / a writer thread) "
+                            "and keep only the device→host copy start on "
+                            "the loop")
+                        continue
+                    if (isinstance(sub.func, ast.Attribute)
+                            and _SAVE_METHOD_RE.match(sub.func.attr)
+                            and isinstance(sub.func.value, ast.Name)):
+                        if defs is None:
+                            defs = reaching_definitions(self.cfg)
+                        line = self._sync_manager_def_line(
+                            sub.func.value.id, node, defs)
+                        if line is not None:
+                            self._report(
+                                "GL013", sub,
+                                f".{sub.func.attr}() on a synchronous "
+                                f"CheckpointManager (constructed line "
+                                f"{line}) inside the step loop — the save "
+                                "blocks the loop on device→host copy + "
+                                "fsync; use AsyncCheckpointManager / "
+                                "make_checkpoint_manager for the async "
+                                "handoff")
 
     # -- recompilation (GL006) -----------------------------------------------
 

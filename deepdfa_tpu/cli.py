@@ -556,7 +556,7 @@ def cmd_fit_text(args) -> Dict[str, Any]:
 
     from deepdfa_tpu.core.config import TransformerTrainConfig
     from deepdfa_tpu.data.combined import load_combined_dataset
-    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.checkpoint import make_checkpoint_manager
     from deepdfa_tpu.train.text_loop import (
         evaluate_text,
         fit_text,
@@ -624,17 +624,22 @@ def cmd_fit_text(args) -> Dict[str, Any]:
             from deepdfa_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(n_data=args.n_devices)
+        # One manager for the whole run (async by default): fit_text
+        # snapshots ``last`` per epoch so a preempted fine-tune resumes,
+        # and the final ``best`` write below rides the same writer.
+        ckpt = make_checkpoint_manager(run_dir)
         best_state, history = fit_text(
             model, data, splits, tcfg, graphs_by_id=graphs_by_id,
             subkeys=subkeys, graph_budget=budget, init_params=init_params,
             mesh=mesh, pad_id=pad_id,
             freeze_submodules=("flowgnn",) if args.freeze_graph else (),
+            checkpointer=ckpt,
         )
-        ckpt = CheckpointManager(run_dir)
         # Params only: the eval-time restore must not depend on the
         # optimizer tree, whose structure changes with --freeze-graph.
         ckpt.save_best({"params": best_state.params}, history["best_epoch"],
                        metrics={"val_f1": history["best_val_f1"]})
+        ckpt.drain()
         descriptor = {
             "model": args.model,
             "tiny": args.tiny,
@@ -1138,11 +1143,13 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
 
 
 def cmd_chaos(args) -> Dict[str, Any]:
-    """Chaos soak (deepdfa_tpu/resilience): provoke five fault classes —
+    """Chaos soak (deepdfa_tpu/resilience): provoke seven fault classes —
     simulated preemption, NaN loss, checkpoint corruption, ETL item
-    failure, serving flush failure — against a tiny synthetic workload and
-    verify every recovery contract, including the bit-for-bit
-    kill-and-resume determinism gate. Exits nonzero on any miss.
+    failure, serving flush failure, corrupt-corpus poisoning, and a
+    mid-epoch kill under async checkpointing resumed on a different
+    device count — against a tiny synthetic workload and verify every
+    recovery contract, including the bit-for-bit kill-and-resume
+    determinism gate. Exits nonzero on any miss.
 
     (Custom fault plans don't belong here — the soak's scenarios arm
     their own; arm ``DEEPDFA_FAULT_PLAN`` against a regular command

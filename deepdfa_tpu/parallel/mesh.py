@@ -82,6 +82,42 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def snapshot_layout(mesh: Optional[Mesh]) -> dict:
+    """The logical DP layout a checkpoint records (ISSUE 6): enough to
+    decide, at restore time, whether the resuming topology matches the
+    one that wrote the snapshot. ``mesh=None`` is the unsharded
+    single-device loop (n_shards 1)."""
+    n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
+    return {
+        "n_shards": n_shards,
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+
+
+def reshard_state(state, mesh: Optional[Mesh]):
+    """Topology-independent restore placement: put a restored (host-side)
+    train state onto the *current* mesh, whatever mesh wrote it.
+
+    Train states are replicated over the data axis, so resharding is a
+    replicated ``device_put`` — the snapshot itself is topology-free
+    (orbax restores to host numpy) and the DP width lives entirely in how
+    the step functions shard their *batches*. A run checkpointed on 8
+    devices therefore resumes on 1/2/4 (and vice versa): the batch math
+    keeps the same global example order and budgets, only the per-shard
+    packing (and hence floating-point reduction order) moves — metrics
+    are bit-tracked when the shard count is unchanged and
+    tolerance-bounded across reshapes (README "Elastic training").
+    """
+    host = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x,
+        state,
+    )
+    if mesh is None:
+        return jax.device_put(host)
+    return jax.device_put(host, replicated(mesh))
+
+
 def shard_concat(
     shards: Sequence[GraphBatch],
     base_shard: int = 0,

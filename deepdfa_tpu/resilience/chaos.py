@@ -27,6 +27,13 @@ tiny synthetic workload sized for seconds on CPU:
   (zero false quarantines), and the final history must be **bit-for-bit
   identical** to a run on the pre-corruption clean subset — data faults
   cost the poisoned rows, never the numerics of the surviving ones.
+* ``elastic_resume`` — a fit killed MID-epoch under async checkpointing
+  (with the writer thread itself crashed mid-serialize on one snapshot)
+  must resume on a *different* DP device count: verified restore from
+  the last committed snapshot, the torn write never winning the
+  fallback order, the recorded layout driving a reshard, and the loss
+  curve continuing bit-for-bit (same shard count) or within the
+  documented tolerance (across a reshape).
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -180,11 +187,14 @@ def scenario_corrupt_restore(out_dir: str, n_examples: int,
     ckpt_dir = os.path.join(out_dir, "corrupt")
     cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0,
                       checkpoint_dir=ckpt_dir, checkpoint_every_epochs=1)
-    # Damage the FINAL 'last' snapshot right after its checksum lands —
-    # the preemption-mid-write shape verification exists for.
+    # Damage EVERY 'last' write right after its checksum lands — the
+    # preemption-mid-write shape verification exists for. (Every write,
+    # not an index-targeted one: the async writer may supersede a queued
+    # 'last' with a newer one, so physical-write ordinals are not stable
+    # across manager flavors.)
     plan = inject.FaultPlan.from_doc({"faults": [
         {"site": "checkpoint.saved", "kind": "corrupt", "name": "last",
-         "at": epochs - 1},
+         "every": 1, "times": 0},
     ]})
     with inject.armed(plan):
         fit(FlowGNN(TINY), examples, splits, cfg, DATA)
@@ -329,6 +339,163 @@ def scenario_poison_corpus(out_dir: str, n_examples: int,
     }
 
 
+def scenario_elastic_resume(out_dir: str, n_examples: int,
+                            epochs: int) -> Dict[str, Any]:
+    """THE elastic/async acceptance scenario (ISSUE 6): a fit is killed
+    *mid-epoch* while checkpointing asynchronously — with the writer
+    thread itself crashed mid-serialize on the first snapshot — and
+    resumed on a *different* data-parallel device count. Demands:
+
+    * the mid-epoch kill and the torn writer never leave a corrupt
+      snapshot winning ``_fallback_order`` — the resumed run restores a
+      **verified** ``last`` from the final completed epoch;
+    * the snapshot records the DP layout it was written under, and the
+      resumed run reshards onto the new topology instead of refusing;
+    * loss-curve continuity: the resumed epochs match the uninterrupted
+      run bit-for-bit when the shard count is unchanged, and within a
+      documented tolerance (FP reduction order moves with the per-shard
+      packing) across a reshape.
+    """
+    import math
+    import time
+
+    import jax
+
+    from deepdfa_tpu.core.config import subkeys_for
+    from deepdfa_tpu.data.sampling import epoch_indices
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.parallel.mesh import make_mesh
+    from deepdfa_tpu.train.checkpoint import AsyncCheckpointManager, CheckpointManager
+    from deepdfa_tpu.train.loop import _batches, fit
+
+    d = jax.device_count()
+    from_n = 4 if d >= 4 else (2 if d >= 2 else 1)
+    to_n = max(from_n // 2, 1)
+    mesh_from = make_mesh(n_data=from_n) if from_n > 1 else None
+    mesh_to = make_mesh(n_data=to_n) if to_n > 1 else None
+
+    examples, splits = _dataset(n_examples)
+    labels = [int(ex["label"]) for ex in examples]
+    ckpt_dir = os.path.join(out_dir, "elastic")
+    cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0)
+    walls: Dict[str, float] = {}
+
+    def run(mesh, checkpointer=None, resume=False, key=""):
+        t0 = time.perf_counter()
+        try:
+            return fit(FlowGNN(TINY), examples, splits, cfg, DATA,
+                       mesh=mesh, checkpointer=checkpointer, resume=resume)
+        finally:
+            walls[key] = time.perf_counter() - t0
+
+    # Uninterrupted reference on the original topology.
+    _, ref_hist = run(mesh_from, key="full")
+
+    # The kill must land MID-epoch 1 (after epoch 0's snapshots, before
+    # epoch 1 completes): count epoch 0's actual step dispatches with the
+    # loop's own packer, then aim the train.loss raise one step past it.
+    train_idx = splits["train"]
+    idx0 = epoch_indices(
+        [labels[i] for i in train_idx], 0, seed=DATA.seed,
+        undersample_factor=DATA.undersample_factor,
+        oversample_factor=DATA.oversample_factor,
+    )
+    steps_ep0 = sum(1 for _ in _batches(
+        examples, train_idx[idx0], DATA, subkeys_for(TINY.feature),
+        DATA.batch_size, n_shards=from_n))
+    kill_at = steps_ep0 + 1  # the second step of epoch 1
+
+    mgr = AsyncCheckpointManager(ckpt_dir)
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "checkpoint.async_write", "kind": "truncate", "at": 0,
+         "msg": "chaos: writer killed mid-serialize"},
+        {"site": "train.loss", "kind": "raise", "at": kill_at,
+         "msg": "chaos: simulated mid-epoch preemption"},
+    ]})
+    preempted = False
+    with inject.armed(plan):
+        try:
+            run(mesh_from, checkpointer=mgr, key="part")
+        except inject.FaultError:
+            preempted = True
+    writer_crashes = len(mgr.errors)
+
+    # Post-mortem before resume: the completed epoch's 'last' must be on
+    # disk, verified, and tagged with the original DP layout; the torn
+    # write must never be the resume candidate. The torn 'best' (write
+    # seq 0 — fit saves best before last) was a FIRST write of its name,
+    # so the crashed writer must have removed the partial bytes outright:
+    # with no meta record, verification would have nothing to fail them
+    # against, and an unrecorded partial dir must never be restorable.
+    probe = CheckpointManager(ckpt_dir)
+    last_verified = probe.verify("last")
+    torn_best_removed = not probe.has("best")
+    layout_before = probe.snapshot_layout("last") or {}
+    resume_candidate = probe.resume_candidate()
+
+    # Resume on the RESHAPED topology.
+    mgr2 = AsyncCheckpointManager(ckpt_dir)
+    _, res_hist = run(mesh_to, checkpointer=mgr2, resume=True,
+                      key="part_resume")
+    layout_after = (CheckpointManager(ckpt_dir).snapshot_layout("last")
+                    or {})
+
+    # Loss-curve continuity against the uninterrupted run's tail.
+    tail = ref_hist["epochs"][1:]
+    resumed = res_hist["epochs"]
+    deltas = [
+        abs(a[k] - b[k]) / max(abs(b[k]), 1e-12)
+        for a, b in zip(resumed, tail) for k in ("train_loss", "val_loss")
+        if math.isfinite(a[k]) and math.isfinite(b[k])
+    ]
+    max_rel_delta = max(deltas) if deltas else float("inf")
+    if from_n == to_n:
+        continuity = (len(resumed) == len(tail)
+                      and all(_records_match(a, b)
+                              for a, b in zip(resumed, tail)))
+        tolerance = 0.0
+    else:
+        # The reshape moves per-shard packing, hence FP reduction order:
+        # bit-equality is not on offer, a bounded drift is (README
+        # "Elastic training & async checkpoints").
+        tolerance = 2e-3
+        continuity = (len(resumed) == len(tail)
+                      and max_rel_delta <= tolerance)
+
+    ok = bool(
+        preempted
+        and writer_crashes >= 1           # the torn write really happened
+        and last_verified                 # ...and never reached 'last'
+        and torn_best_removed             # ...and its partial bytes are gone
+        and resume_candidate == "last"
+        and layout_before.get("n_shards") == from_n
+        and layout_after.get("n_shards") == to_n
+        and [e["epoch"] for e in resumed] == [e["epoch"] for e in tail]
+        and continuity
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["raise", "truncate"],
+        "preempted": preempted,
+        "kill_step": kill_at,
+        "writer_crashes": writer_crashes,
+        "last_verified": last_verified,
+        "torn_best_removed": torn_best_removed,
+        "resume_candidate": resume_candidate,
+        "from_shards": from_n,
+        "to_shards": to_n,
+        "layout_recorded": layout_before,
+        "layout_after_resume": layout_after,
+        "resumed_epochs": [e["epoch"] for e in resumed],
+        "continuity": continuity,
+        "continuity_tolerance": tolerance,
+        "max_rel_loss_delta": max_rel_delta,
+        "resume_overhead_s": (walls.get("part", 0.0)
+                              + walls.get("part_resume", 0.0)
+                              - walls.get("full", 0.0)),
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -344,13 +511,16 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
     scenarios["serve_flush_fault"] = scenario_serve_flush_fault()
     scenarios["poison_corpus"] = scenario_poison_corpus(
         out_dir, n_examples, epochs)
+    scenarios["elastic_resume"] = scenario_elastic_resume(
+        out_dir, n_examples, epochs)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
                "corrupt_restore": "checkpoint-corrupt",
                "etl_retry": "etl-item-raise",
                "serve_flush_fault": "serve-batch-raise",
-               "poison_corpus": "data-corrupt"}
+               "poison_corpus": "data-corrupt",
+               "elastic_resume": "elastic-reshape"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

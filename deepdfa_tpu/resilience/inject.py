@@ -28,6 +28,16 @@ Spec fields:
     ``checkpoint.saved``      after each snapshot write; ``name`` filters on
                               the snapshot name; ``kind=corrupt|truncate``
                               damages the on-disk snapshot
+    ``checkpoint.async_write``  inside the async writer thread, between the
+                              snapshot byte write and the checksum/meta
+                              commit; index = write ordinal; ``kind=raise``
+                              is the writer dying mid-serialize (bytes on
+                              disk, meta.json still pointing at the
+                              previous intact snapshot)
+    ``checkpoint.supersede``  after an async save is submitted; ``name``
+                              filters, index = epoch; ``kind=raise``
+                              simulates the submitting thread dying right
+                              after the handoff
     ``joern.send``            before each Joern REPL command; ``kind=kill``
                               kills the child JVM, ``kind=hang`` simulates an
                               unresponsive REPL (raises ``TimeoutError``)
@@ -314,3 +324,32 @@ def corrupt_path(path: str, mode: str = "corrupt") -> str:
             f.seek(0)
             f.write(data)
     return target
+
+
+def tear_snapshot(path: str, frac: float) -> int:
+    """Simulate a writer killed after ``frac`` of a snapshot's byte stream
+    landed: walking files in the deterministic checksum order
+    (sorted relative paths), keep every byte before the cut, truncate the
+    file straddling it, and remove everything after — the torn-write shape
+    the byte-boundary-quantile tests replay. Returns the cut offset."""
+    if not 0.0 <= frac <= 1.0:
+        raise ValueError(f"frac must be in [0, 1], got {frac}")
+    files: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            files.append(os.path.join(dirpath, fn))
+    total = sum(os.path.getsize(f) for f in files)
+    cut = int(total * frac)
+    written = 0
+    for f in files:
+        size = os.path.getsize(f)
+        if written + size <= cut:
+            written += size  # fully landed before the kill
+        elif written >= cut:
+            os.remove(f)     # never reached
+        else:
+            with open(f, "r+b") as fh:
+                fh.truncate(cut - written)
+            written = cut
+    return cut

@@ -110,6 +110,47 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "flushes": len(flushes),
     }
 
+    # --- checkpointing: async overlap + supersede/drain accounting ------
+    # ckpt.copy is the step-blocking portion (the submit-side host-copy
+    # start); ckpt.write/ckpt.commit run on the writer thread. A write
+    # span whose run-relative interval intersects a train.step span is
+    # the overlap the async layer exists for — the acceptance evidence
+    # that serialization rode alongside training instead of stalling it.
+    copies = named(spans, ("ckpt.copy",))
+    writes = named(spans, ("ckpt.write",))
+    commits = named(spans, ("ckpt.commit",))
+
+    def _interval(s):
+        t0 = float(s.get("ts", 0.0))
+        return t0, t0 + float(s.get("dur_ms", 0.0)) / 1e3
+
+    step_ivs = [_interval(s) for s in steps]
+
+    def _overlaps_steps(s):
+        t0, t1 = _interval(s)
+        return any(a < t1 and t0 < b for a, b in step_ivs)
+
+    copy_ms = [float(s.get("dur_ms", 0.0)) for s in copies]
+    write_ms = [float(s.get("dur_ms", 0.0)) for s in writes]
+    drains = named(instants, ("ckpt.drain",))
+    drain_ms = [float((e.get("attrs") or {}).get("wait_ms", 0.0))
+                for e in drains]
+    checkpoint = {
+        "copies": len(copies),
+        "copy_ms_p50": round(_quantile(copy_ms, 0.50), 4),
+        "copy_ms_p99": round(_quantile(copy_ms, 0.99), 4),
+        "writes": len(writes),
+        "write_ms_total": round(sum(write_ms), 3),
+        "writes_overlapping_steps": sum(1 for s in writes
+                                        if _overlaps_steps(s)),
+        "commits": len(commits),
+        "superseded": len(named(instants, ("ckpt.superseded",))),
+        "write_errors": len(named(instants, ("ckpt.write_error",))),
+        "reshapes": len(named(instants, ("ckpt.reshape",))),
+        "drains": len(drains),
+        "drain_wait_ms_max": round(max(drain_ms, default=0.0), 3),
+    }
+
     # --- bookkeeping ----------------------------------------------------
     flush_events = named(instants, ("telemetry.flush",))
     drops = max((int((e.get("attrs") or {}).get("drops", 0))
@@ -119,6 +160,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "events": len(events),
         "train": train,
         "compiles": compile_report,
+        "checkpoint": checkpoint,
         "retries": len(retries),
         "retry_giveups": len(giveups),
         "faults": {"total": len(faults), "by_site": by_site},

@@ -20,6 +20,29 @@ Robustness contract (the preemptible-TPU posture, tests/test_resilience.py):
   then every other recorded snapshot by descending epoch, ties broken
   ``last`` > ``epoch_N`` > ``best``. ``last_restored`` reports what was
   actually loaded so resume can restart from the surviving epoch.
+
+Elastic/async extensions (ISSUE 6):
+
+* :class:`AsyncCheckpointManager` moves everything but the device→host
+  copy *start* off the step loop: ``save_*`` begins a non-blocking
+  host copy and enqueues the write; a dedicated writer thread
+  serializes, checksums, and commits ``meta.json`` with the same
+  atomicity/verified-restore/fallback guarantees as the sync path. At
+  most one write per snapshot name is pending: a newer save of the same
+  name supersedes a still-queued older one (``ckpt.superseded``).
+  ``drain()`` is the barrier callers take at fit-exit and before any
+  ``best``-dependent decision. A writer-thread crash costs at most the
+  in-flight snapshot — the committed ``meta.json`` still references the
+  previous intact bytes, so a torn write can never *win* a restore.
+* Snapshots record the logical DP layout (``set_layout``), so restore
+  can detect a device-count change and the training loop reshards
+  (``parallel/mesh.py:reshard_state``) instead of refusing to resume.
+* ``verify`` caches content digests keyed by the snapshot's stat
+  signature (per-file size + mtime), so fallback resolution does not
+  re-read gigabyte-class snapshots on every call.
+* ``DEEPDFA_ASYNC_CKPT=0`` is the escape hatch:
+  :func:`make_checkpoint_manager` then returns the synchronous manager
+  and training behaves bit-identically to the pre-async layer.
 """
 
 from __future__ import annotations
@@ -29,16 +52,34 @@ import json
 import logging
 import os
 import re
+import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from deepdfa_tpu.resilience import inject
+from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
 _EPOCH_NAME_RE = re.compile(r"^epoch_(\d+)$")
+
+ASYNC_ENV_VAR = "DEEPDFA_ASYNC_CKPT"
+
+
+def async_enabled() -> bool:
+    """``DEEPDFA_ASYNC_CKPT=0`` forces the synchronous manager everywhere
+    (the bit-identical escape hatch); anything else keeps async on."""
+    return os.environ.get(ASYNC_ENV_VAR, "1") != "0"
+
+
+def make_checkpoint_manager(directory: str, periodic_every: int = 25):
+    """THE manager factory the training loops use: async by default,
+    synchronous under ``DEEPDFA_ASYNC_CKPT=0``."""
+    cls = AsyncCheckpointManager if async_enabled() else CheckpointManager
+    return cls(directory, periodic_every=periodic_every)
 
 
 class CheckpointError(RuntimeError):
@@ -73,6 +114,14 @@ class CheckpointManager:
             "best_epoch": -1, "best_val_loss": float("inf"),
             "last_epoch": -1,
         }
+        # Logical DP layout recorded with every snapshot (set_layout):
+        # restore compares it against the resuming topology and reshards.
+        self._layout: Optional[Dict[str, Any]] = None
+        # verify() digest cache: name -> (stat signature, sha256). Fallback
+        # resolution calls verify per candidate, sometimes repeatedly — a
+        # gigabyte-class snapshot must not be re-read when its bytes
+        # haven't changed (signature = sorted per-file size+mtime_ns).
+        self._digest_cache: Dict[str, Tuple[Tuple, str]] = {}
         # What the latest restore() actually loaded ({"name", "epoch",
         # "fallback"}) — resume reads this to restart from the snapshot
         # that survived, not the one that was asked for.
@@ -104,15 +153,28 @@ class CheckpointManager:
         path = os.path.join(self.directory, name)
         self._ckpt.save(path, jax.device_get(state), force=True)
         self._ckpt.wait_until_finished()
-        self._meta.setdefault("snapshots", {})[name] = {
-            "epoch": int(epoch),
-            "sha256": snapshot_checksum(path),
-        }
+        self._record_snapshot(name, path, epoch)
+
+    def _record_snapshot(self, name: str, path: str, epoch: int) -> None:
+        """Checksum the written snapshot into the in-memory meta (caller
+        commits), prime the digest cache, and run the damage fault hook."""
+        digest = snapshot_checksum(path)
+        record: Dict[str, Any] = {"epoch": int(epoch), "sha256": digest}
+        if self._layout is not None:
+            record["layout"] = dict(self._layout)
+        self._meta.setdefault("snapshots", {})[name] = record
+        self._digest_cache[name] = (self._snapshot_sig(path), digest)
         # Fault hook AFTER the checksum is recorded: injected damage is
         # exactly what verification must catch on restore.
         for spec in inject.fire("checkpoint.saved", name=name):
             if spec.kind in ("corrupt", "truncate"):
                 damaged = inject.corrupt_path(path, mode=spec.kind)
+                # The cached digest describes the pre-damage bytes; drop it
+                # so the next verify re-reads the damaged content (the stat
+                # signature would usually catch this, but injected damage
+                # must be caught deterministically, not modulo mtime
+                # granularity).
+                self._digest_cache.pop(name, None)
                 logger.warning("injected %s of snapshot %s (%s)",
                                spec.kind, name, damaged)
 
@@ -164,11 +226,31 @@ class CheckpointManager:
     def has(self, name: str) -> bool:
         return os.path.isdir(os.path.join(self.directory, name))
 
+    @staticmethod
+    def _snapshot_sig(path: str) -> Tuple:
+        """Cheap stat signature of a snapshot directory: sorted relative
+        paths with sizes and mtimes, no file reads. Any byte-level change
+        that goes through the filesystem bumps it."""
+        sig = []
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                p = os.path.join(dirpath, fn)
+                st = os.stat(p)
+                sig.append((os.path.relpath(p, path), st.st_size,
+                            st.st_mtime_ns))
+        return tuple(sig)
+
     def verify(self, name: str) -> bool:
         """True when the snapshot's content matches its recorded checksum.
         Unrecorded (pre-hardening) snapshots pass with a warning — there is
         nothing to verify against, and refusing to load them would turn the
-        upgrade into a data loss."""
+        upgrade into a data loss.
+
+        The content digest is cached per (name, stat signature): fallback
+        resolution may verify the same snapshot several times per restore,
+        and re-hashing gigabytes for each call would make the verified
+        path cost O(candidates × size) instead of O(size)."""
         path = os.path.join(self.directory, name)
         if not os.path.isdir(path):
             return False
@@ -177,7 +259,42 @@ class CheckpointManager:
             logger.warning("snapshot %s has no recorded checksum "
                            "(pre-hardening?); restoring unverified", name)
             return True
-        return snapshot_checksum(path) == record["sha256"]
+        sig = self._snapshot_sig(path)
+        cached = self._digest_cache.get(name)
+        if cached is not None and cached[0] == sig:
+            digest = cached[1]
+        else:
+            digest = snapshot_checksum(path)
+            self._digest_cache[name] = (sig, digest)
+        return digest == record["sha256"]
+
+    # -- layout / elastic resume -------------------------------------------
+
+    def set_layout(self, layout: Optional[Dict[str, Any]]) -> None:
+        """Record the logical DP layout (``parallel.mesh.snapshot_layout``)
+        with every subsequent snapshot — what topology-independent restore
+        compares against."""
+        self._layout = dict(layout) if layout else None
+
+    def snapshot_layout(self, name: str) -> Optional[Dict[str, Any]]:
+        record = self._meta.get("snapshots", {}).get(name)
+        if record is None:
+            return None
+        return record.get("layout")
+
+    def resume_candidate(self) -> Optional[str]:
+        """The snapshot a resume should start from: ``last`` when it is on
+        disk, else the newest recorded snapshot (a writer that died between
+        deleting the old ``last`` and committing the new one must cost one
+        epoch, not the whole run). None when nothing restorable exists."""
+        order = self._fallback_order("last")
+        return order[0] if order else None
+
+    def drain(self, timeout: Optional[float] = None) -> float:
+        """Barrier for pending asynchronous writes. The synchronous
+        manager has none — a no-op so call sites never branch on the
+        manager flavor."""
+        return 0.0
 
     def _snapshot_epoch(self, name: str) -> int:
         record = self._meta.get("snapshots", {}).get(name)
@@ -314,6 +431,262 @@ class CheckpointManager:
 
     @property
     def best_meta(self) -> dict:
+        return dict(self._meta)
+
+
+class _PendingWrite:
+    """One queued snapshot write: the state (host copy already started),
+    plus the meta fields to commit once the bytes are durable."""
+
+    __slots__ = ("name", "state", "epoch", "meta_update", "submitted_s")
+
+    def __init__(self, name: str, state: Any, epoch: int,
+                 meta_update: Dict[str, Any]):
+        self.name = name
+        self.state = state
+        self.epoch = epoch
+        self.meta_update = meta_update
+        self.submitted_s = time.perf_counter()
+
+
+class AsyncCheckpointManager(CheckpointManager):
+    """Checkpointing that charges the step loop only the device→host copy
+    *start*.
+
+    ``save_*`` begins a non-blocking host copy of every array leaf
+    (``ckpt.copy`` span — the step-blocking portion, what
+    ``bench.ckpt_async_blocking_ms`` measures) and enqueues the write. A
+    dedicated writer thread serializes + fsyncs (``ckpt.write`` span, the
+    ``checkpoint.async_write`` fault site), checksums, and commits
+    ``meta.json`` atomically (``ckpt.commit`` span) — so training overlaps
+    the expensive part instead of stalling on it.
+
+    Queue discipline: at most one pending write per snapshot name. A newer
+    save of a name supersedes a still-queued older one (the
+    ``checkpoint.supersede`` fault site; counted in
+    ``ckpt_superseded_total``) — a stalled disk can delay snapshots but
+    never queue unbounded work behind the step loop.
+
+    Failure posture: a writer-thread crash is logged, counted
+    (``ckpt_async_errors_total``), recorded in :attr:`errors`, and costs at
+    most that snapshot — ``meta.json`` is only committed after the bytes
+    are durable, so the previous intact snapshot keeps winning
+    ``_fallback_order`` and a torn write can never become ``last``.
+
+    Reads (``verify``/``restore``/``restore_params``/``best_meta``/
+    ``resume_candidate``) and fit-exit take the :meth:`drain` barrier
+    first, so every ``best``-dependent decision sees committed state.
+    """
+
+    def __init__(self, directory: str, periodic_every: int = 25):
+        super().__init__(directory, periodic_every=periodic_every)
+        self._cv = threading.Condition()
+        self._queue: List[_PendingWrite] = []
+        self._active: Optional[str] = None
+        self._write_seq = 0  # ordinal fed to the async_write fault site
+        self.errors: List[Tuple[str, BaseException]] = []
+        # Test hook: when set, the writer blocks before each write until
+        # the event is set — the supersede tests need a stalled writer.
+        self.write_gate: Optional[threading.Event] = None
+        self._writer = threading.Thread(
+            target=self._writer_loop, name=f"ckpt-writer:{directory}",
+            daemon=True,
+        )
+        self._writer.start()
+
+    # -- submission (the step-loop side) -----------------------------------
+
+    @staticmethod
+    def _start_host_copy(state: Any) -> Any:
+        """Kick off the device→host transfer without blocking on it: the
+        writer's ``jax.device_get`` then mostly finds the bytes already
+        landed. Non-array leaves pass through untouched."""
+
+        def start(x):
+            if hasattr(x, "copy_to_host_async"):
+                try:
+                    x.copy_to_host_async()
+                except Exception:  # committed arrays on exotic backends
+                    pass  # device_get in the writer still works, just colder
+            return x
+
+        return jax.tree_util.tree_map(start, state)
+
+    def _submit(self, name: str, state: Any, epoch: int,
+                meta_update: Dict[str, Any]) -> None:
+        with telemetry.span("ckpt.copy", snapshot=name, epoch=int(epoch)):
+            state = self._start_host_copy(state)
+        pending = _PendingWrite(name, state, int(epoch), meta_update)
+        with self._cv:
+            for i, queued in enumerate(self._queue):
+                if queued.name == name:
+                    # Supersede the stalled same-name write: the newer
+                    # state is strictly more recent, and the queue stays
+                    # bounded at one pending write per name.
+                    self._queue[i] = pending
+                    telemetry.REGISTRY.counter(
+                        "ckpt_superseded_total").inc()
+                    telemetry.event("ckpt.superseded", snapshot=name,
+                                    epoch=int(epoch),
+                                    superseded_epoch=queued.epoch)
+                    self._cv.notify_all()
+                    break
+            else:
+                self._queue.append(pending)
+                self._cv.notify_all()
+        # Fault hook outside the lock: a `raise` spec here simulates the
+        # submitting thread dying right after handing off the snapshot.
+        inject.fire("checkpoint.supersede", name=name, index=int(epoch))
+
+    def save_best(self, state: Any, epoch: int,
+                  val_loss: Optional[float] = None,
+                  metrics: Optional[dict] = None) -> None:
+        update: Dict[str, Any] = {"best_epoch": int(epoch)}
+        if val_loss is not None:
+            update["best_val_loss"] = val_loss
+        if metrics:
+            update["best_metrics"] = {k: float(v) for k, v in metrics.items()}
+        self._submit("best", state, epoch, update)
+
+    def save_last(self, state: Any, epoch: int) -> None:
+        self._submit("last", state, epoch, {"last_epoch": int(epoch)})
+
+    def maybe_save_periodic(self, state: Any, epoch: int) -> None:
+        if self.periodic_every and (epoch + 1) % self.periodic_every == 0:
+            self._submit(f"epoch_{epoch}", state, epoch, {})
+
+    # -- the writer thread -------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                gate = self.write_gate
+            if gate is not None:
+                gate.wait()
+            with self._cv:
+                if not self._queue:
+                    continue
+                item = self._queue.pop(0)
+                self._active = item.name
+                seq = self._write_seq
+                self._write_seq += 1
+            try:
+                self._write_one(item, seq)
+                telemetry.REGISTRY.counter("ckpt_async_writes_total").inc()
+            except BaseException as e:  # the writer must survive any write
+                self.errors.append((item.name, e))
+                telemetry.REGISTRY.counter("ckpt_async_errors_total").inc()
+                telemetry.event("ckpt.write_error", snapshot=item.name,
+                                epoch=item.epoch, error=type(e).__name__)
+                logger.exception(
+                    "async checkpoint write of %s (epoch %d) failed; the "
+                    "previous intact snapshot remains authoritative",
+                    item.name, item.epoch,
+                )
+                if item.name not in self._meta.get("snapshots", {}):
+                    # A failed FIRST write of this name has no recorded
+                    # checksum for verification to fail it against, so the
+                    # pre-hardening grace path would bless the partial
+                    # bytes on restore. Remove them: an absent snapshot
+                    # can never win the fallback order. (With a committed
+                    # record, the stale-checksum mismatch already damns
+                    # the bytes — leave them for forensics.)
+                    import shutil
+
+                    shutil.rmtree(
+                        os.path.join(self.directory, item.name),
+                        ignore_errors=True,
+                    )
+            finally:
+                with self._cv:
+                    self._active = None
+                    self._cv.notify_all()
+
+    def _write_one(self, item: _PendingWrite, seq: int) -> None:
+        path = os.path.join(self.directory, item.name)
+        with telemetry.span("ckpt.write", snapshot=item.name, epoch=item.epoch):
+            host_state = jax.device_get(item.state)
+            self._ckpt.save(path, host_state, force=True)
+            self._ckpt.wait_until_finished()
+            # Fault site between the byte write and the checksum/meta
+            # commit: a `raise` here is the writer dying mid-save — bytes
+            # possibly on disk, meta.json still pointing at the previous
+            # intact snapshot (which therefore keeps winning restores).
+            # `corrupt`/`truncate` additionally damage the written bytes
+            # first (the torn-write shape), then crash the same way.
+            for spec in inject.fire("checkpoint.async_write", index=seq,
+                                    name=item.name):
+                if spec.kind in ("corrupt", "truncate"):
+                    damaged = inject.corrupt_path(path, mode=spec.kind)
+                    logger.warning(
+                        "injected async-write %s of snapshot %s (%s)",
+                        spec.kind, item.name, damaged)
+                    raise inject.FaultError(
+                        f"injected writer crash mid-serialize of "
+                        f"{item.name}")
+        with telemetry.span("ckpt.commit", snapshot=item.name, epoch=item.epoch):
+            self._record_snapshot(item.name, path, item.epoch)
+            self._meta.update(item.meta_update)
+            self._write_meta()
+
+    # -- the drain barrier -------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> float:
+        """Block until every submitted write has committed (or failed).
+        Returns the wait in ms; observed into ``ckpt_drain_wait_ms``.
+        Raises ``TimeoutError`` when ``timeout`` (seconds) elapses first —
+        leaving writes pending is exactly what the caller asked to rule
+        out."""
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + timeout
+        with self._cv:
+            if not self._queue and self._active is None:
+                return 0.0  # nothing pending: don't pollute the wait stats
+            while self._queue or self._active is not None:
+                if not self._writer.is_alive():
+                    break  # interpreter teardown: nothing will ever finish
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"checkpoint drain timed out after {timeout}s "
+                            f"(pending: {[p.name for p in self._queue]}, "
+                            f"active: {self._active})"
+                        )
+                self._cv.wait(timeout=remaining)
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        telemetry.REGISTRY.histogram("ckpt_drain_wait_ms").observe(wait_ms)
+        telemetry.event("ckpt.drain", wait_ms=wait_ms)
+        return wait_ms
+
+    # -- reads: always behind the barrier ----------------------------------
+
+    def has(self, name: str) -> bool:
+        self.drain()
+        return super().has(name)
+
+    def verify(self, name: str) -> bool:
+        self.drain()
+        return super().verify(name)
+
+    def restore(self, name: str, target: Any) -> Any:
+        self.drain()
+        return super().restore(name, target)
+
+    def restore_params(self, name: str = "best") -> Any:
+        self.drain()
+        return super().restore_params(name)
+
+    def resume_candidate(self) -> Optional[str]:
+        self.drain()
+        return super().resume_candidate()
+
+    @property
+    def best_meta(self) -> dict:
+        self.drain()
         return dict(self._meta)
 
 

@@ -339,6 +339,7 @@ def fit_gen(
     output_dir: Optional[str] = None,
     codebleu_lang: Optional[str] = None,
     eval_bleu: bool = True,
+    checkpointer=None,
 ) -> Dict[str, Any]:
     """run_gen's training protocol: per-epoch dev eval computing loss (the
     ppl track) AND generation BLEU+EM, checkpoint selection on the
@@ -439,91 +440,122 @@ def fit_gen(
     detect_anomaly = cfg.detect_anomaly or cfg.anomaly_policy == "rollback"
     anomaly_budget = cfg.anomaly_retry_budget
     anomaly_rollbacks = 0
-    for epoch in range(cfg.max_epochs):
-        inject.fire("train.epoch_start", index=epoch)
-        epoch_start_state = state
-        losses = []
-        # Same fenced-epoch / dispatch-step span pairing as loop.py —
-        # the report's host/device split works for the gen loop too.
-        with telemetry.span("train.epoch", epoch=epoch, loop="gen") as ep:
-            for src, tgt, _ in _batches(
-                train_data, cfg.batch_size, rng, pad_tail=True, pad_id=pad_id
-            ):
-                with telemetry.span("train.step", epoch=epoch,
-                                    step=len(losses)):
-                    state, loss = step(
-                        state, _lift_rows(src, mesh, host),
-                        _lift_rows(tgt, mesh, host)
+    if checkpointer is not None:
+        # Same preemption-survival posture as train/loop.py: ``last``
+        # every epoch, ``best`` on selection improvement, layout recorded
+        # for topology-independent restore, drained before returning.
+        from deepdfa_tpu.parallel.mesh import snapshot_layout
+
+        checkpointer.set_layout(snapshot_layout(mesh))
+    try:
+        for epoch in range(cfg.max_epochs):
+            inject.fire("train.epoch_start", index=epoch)
+            epoch_start_state = state
+            losses = []
+            # Same fenced-epoch / dispatch-step span pairing as loop.py —
+            # the report's host/device split works for the gen loop too.
+            with telemetry.span("train.epoch", epoch=epoch, loop="gen") as ep:
+                for src, tgt, _ in _batches(
+                    train_data, cfg.batch_size, rng, pad_tail=True,
+                    pad_id=pad_id
+                ):
+                    with telemetry.span("train.step", epoch=epoch,
+                                        step=len(losses)):
+                        state, loss = step(
+                            state, _lift_rows(src, mesh, host),
+                            _lift_rows(tgt, mesh, host)
+                        )
+                    losses.append(inject.corrupt_loss(loss))
+                ep.fence(losses)
+                ep.set(steps=len(losses))
+            record = {"epoch": epoch,
+                      "train_loss": float(np.mean(jax.device_get(losses)))}
+            # Epoch-granular anomaly handling: the mean above is the one
+            # host transfer that already exists; NaN/inf propagates
+            # through it.
+            if detect_anomaly and not math.isfinite(record["train_loss"]):
+                if cfg.anomaly_policy != "rollback":
+                    raise FloatingPointError(
+                        f"non-finite loss in epoch {epoch}")
+                if anomaly_budget <= 0:
+                    raise FloatingPointError(
+                        f"non-finite loss in epoch {epoch} "
+                        "(anomaly retry budget exhausted)"
                     )
-                losses.append(inject.corrupt_loss(loss))
-            ep.fence(losses)
-            ep.set(steps=len(losses))
-        record = {"epoch": epoch,
-                  "train_loss": float(np.mean(jax.device_get(losses)))}
-        # Epoch-granular anomaly handling: the mean above is the one host
-        # transfer that already exists; NaN/inf propagates through it.
-        if detect_anomaly and not math.isfinite(record["train_loss"]):
-            if cfg.anomaly_policy != "rollback":
-                raise FloatingPointError(f"non-finite loss in epoch {epoch}")
-            if anomaly_budget <= 0:
-                raise FloatingPointError(
-                    f"non-finite loss in epoch {epoch} "
-                    "(anomaly retry budget exhausted)"
+                anomaly_budget -= 1
+                anomaly_rollbacks += 1
+                logger.warning(
+                    "non-finite loss in epoch %d: rolling back to the "
+                    "epoch-start state and continuing (%d retries left)",
+                    epoch, anomaly_budget,
                 )
-            anomaly_budget -= 1
-            anomaly_rollbacks += 1
-            logger.warning(
-                "non-finite loss in epoch %d: rolling back to the "
-                "epoch-start state and continuing (%d retries left)",
-                epoch, anomaly_budget,
-            )
-            state = epoch_start_state
-            record["rolled_back"] = True
-            telemetry.event("train.rollback", epoch=epoch, loop="gen")
-        if eval_bleu:
-            metrics, pred_texts = bleu_eval(state)
-            record.update(metrics)
-            if output_dir and (host is None or host[0] == 0):
-                _dump_gen_predictions(output_dir, f"dev_e{epoch}", pred_texts,
-                                      gold_texts[: len(pred_texts)],
-                                      src_texts[: len(pred_texts)])
-        else:
-            record["eval_loss"] = loss_only_eval()
-        if epoch == 0:
-            telemetry.event("train.warmup_done", epoch=epoch, loop="gen")
-        telemetry.event("train.epoch_end", epoch=epoch, loop="gen",
-                        train_loss=record["train_loss"])
-        telemetry.flush()  # epoch cadence: don't ride the ring until close
-        history.append(record)
-        if log:
-            log(f"epoch {epoch}: " + " ".join(
-                f"{k}={v:.4f}" for k, v in record.items()
-                if k != "epoch" and isinstance(v, float)))
-        # Two independent stall counters; a trailing epoch must beat BOTH
-        # to keep training past the patience (run_gen.py:283-356). Without
-        # the bleu track, best-ppl selects and the loss patience alone
-        # stops.
-        if record["eval_loss"] < best_loss:
-            best_loss, not_loss_dec = record["eval_loss"], 0
-            if not eval_bleu:
-                best = {"state": state, "bleu_em": -1.0, "epoch": epoch,
-                        "record": record}
-        else:
-            not_loss_dec += 1
-        if eval_bleu:
-            if record["bleu_em"] > best["bleu_em"]:
-                best = {"state": state, "bleu_em": record["bleu_em"],
-                        "epoch": epoch, "record": record}
-                not_bleu_em_inc = 0
+                state = epoch_start_state
+                record["rolled_back"] = True
+                telemetry.event("train.rollback", epoch=epoch, loop="gen")
+            if eval_bleu:
+                metrics, pred_texts = bleu_eval(state)
+                record.update(metrics)
+                if output_dir and (host is None or host[0] == 0):
+                    _dump_gen_predictions(output_dir, f"dev_e{epoch}",
+                                          pred_texts,
+                                          gold_texts[: len(pred_texts)],
+                                          src_texts[: len(pred_texts)])
             else:
-                not_bleu_em_inc += 1
-        if (cfg.early_stop_patience is not None
-                and not_loss_dec > cfg.early_stop_patience
-                and (not eval_bleu
-                     or not_bleu_em_inc > cfg.early_stop_patience)):
+                record["eval_loss"] = loss_only_eval()
+            if epoch == 0:
+                telemetry.event("train.warmup_done", epoch=epoch, loop="gen")
+            telemetry.event("train.epoch_end", epoch=epoch, loop="gen",
+                            train_loss=record["train_loss"])
+            telemetry.flush()  # epoch cadence: don't ride the ring to close
+            history.append(record)
             if log:
-                log(f"early stop at epoch {epoch} (best {best['epoch']})")
-            break
+                log(f"epoch {epoch}: " + " ".join(
+                    f"{k}={v:.4f}" for k, v in record.items()
+                    if k != "epoch" and isinstance(v, float)))
+            if checkpointer is not None and (host is None or host[0] == 0):
+                checkpointer.save_last(state, epoch)
+                checkpointer.maybe_save_periodic(state, epoch)
+            # Two independent stall counters; a trailing epoch must beat
+            # BOTH to keep training past the patience (run_gen.py:283-356).
+            # Without the bleu track, best-ppl selects and the loss
+            # patience alone stops.
+            if record["eval_loss"] < best_loss:
+                best_loss, not_loss_dec = record["eval_loss"], 0
+                if not eval_bleu:
+                    best = {"state": state, "bleu_em": -1.0, "epoch": epoch,
+                            "record": record}
+                    if checkpointer is not None and (host is None
+                                                     or host[0] == 0):
+                        checkpointer.save_best(
+                            state, epoch,
+                            metrics={"eval_loss": record["eval_loss"]})
+            else:
+                not_loss_dec += 1
+            if eval_bleu:
+                if record["bleu_em"] > best["bleu_em"]:
+                    best = {"state": state, "bleu_em": record["bleu_em"],
+                            "epoch": epoch, "record": record}
+                    not_bleu_em_inc = 0
+                    if checkpointer is not None and (host is None
+                                                     or host[0] == 0):
+                        checkpointer.save_best(
+                            state, epoch,
+                            metrics={"bleu_em": record["bleu_em"]})
+                else:
+                    not_bleu_em_inc += 1
+            if (cfg.early_stop_patience is not None
+                    and not_loss_dec > cfg.early_stop_patience
+                    and (not eval_bleu
+                         or not_bleu_em_inc > cfg.early_stop_patience)):
+                if log:
+                    log(f"early stop at epoch {epoch} "
+                        f"(best {best['epoch']})")
+                break
+    finally:
+        if checkpointer is not None:
+            # Fit-exit drain barrier: every submitted snapshot commits (or
+            # records its failure) before the caller can act on the run.
+            checkpointer.drain()
 
     r = dict(best["record"] or {"eval_loss": float("nan")})
     if "bleu" not in r:

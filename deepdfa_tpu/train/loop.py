@@ -463,11 +463,20 @@ def fit(
     del example_batch
 
     if checkpointer is None and train_cfg.checkpoint_dir:
-        from deepdfa_tpu.train.checkpoint import CheckpointManager
+        # Async by default: the step loop pays only the device→host copy
+        # start; serialization/fsync/checksum/meta-commit ride the writer
+        # thread (DEEPDFA_ASYNC_CKPT=0 restores the synchronous manager).
+        from deepdfa_tpu.train.checkpoint import make_checkpoint_manager
 
-        checkpointer = CheckpointManager(
+        checkpointer = make_checkpoint_manager(
             train_cfg.checkpoint_dir, periodic_every=train_cfg.checkpoint_every_epochs
         )
+    if checkpointer is not None:
+        # Snapshots record the logical DP layout so restore can detect a
+        # topology change and reshard instead of refusing to resume.
+        from deepdfa_tpu.parallel.mesh import snapshot_layout
+
+        checkpointer.set_layout(snapshot_layout(mesh))
 
     train_step = make_train_step(model, tx, train_cfg)
     eval_step = make_eval_step(model, train_cfg)
@@ -490,12 +499,15 @@ def fit(
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_loss": float("inf")}
     best_state = state
     start_epoch = 0
-    if resume and checkpointer is not None and checkpointer.has("last"):
+    candidate = checkpointer.resume_candidate() if (
+        resume and checkpointer is not None) else None
+    if candidate is not None:
+        from deepdfa_tpu.parallel.mesh import reshard_state, snapshot_layout
         from deepdfa_tpu.train.checkpoint import CheckpointError
 
         meta = checkpointer.best_meta
         try:
-            state = checkpointer.restore("last", state)
+            state = checkpointer.restore(candidate, state)
         except CheckpointError:
             # Every snapshot is damaged: the self-healing posture is to
             # retrain from scratch (loudly), not to refuse to run.
@@ -504,26 +516,57 @@ def fit(
                 "scratch", checkpointer.directory,
             )
         else:
-            if "last_epoch" not in meta or int(meta["last_epoch"]) < 0:
+            restored = checkpointer.last_restored or {}
+            if candidate != "last":
+                # The 'last' snapshot never landed (a writer killed between
+                # deleting the old bytes and committing the new): resume
+                # from the newest intact snapshot instead of from scratch.
                 logger.warning(
-                    "resume: checkpoint dir has a 'last' snapshot but no "
-                    "last_epoch in meta.json (written by an older version?) "
+                    "resume: no 'last' snapshot on disk; resuming from "
+                    "%s (epoch %d)", candidate, int(restored.get("epoch", -1)),
+                )
+            restored_epoch = int(restored.get("epoch", -1))
+            if restored_epoch < 0 and (
+                    "last_epoch" not in meta or int(meta["last_epoch"]) < 0):
+                logger.warning(
+                    "resume: checkpoint dir has a snapshot but no epoch "
+                    "record in meta.json (written by an older version?) "
                     "— restarting the epoch schedule at 0 on top of the "
                     "restored weights"
                 )
-            start_epoch = int(meta.get("last_epoch", -1)) + 1
-            restored = checkpointer.last_restored or {}
+            # The VERIFIED snapshot that actually loaded decides where the
+            # epoch schedule restarts — never the one that was asked for
+            # (a damaged 'last' must not skip the epochs between the
+            # surviving fallback and itself).
+            start_epoch = restored_epoch + 1
             if restored.get("fallback"):
-                # The 'last' snapshot was damaged (preemption mid-write,
-                # disk rot): the verified fallback decides where the epoch
-                # schedule restarts, or the run would skip the epochs
-                # between the fallback and the corrupt snapshot.
-                start_epoch = min(start_epoch,
-                                  int(restored.get("epoch", -1)) + 1)
                 logger.warning(
                     "resume: restored fallback snapshot %s; restarting at "
                     "epoch %d", restored.get("name"), start_epoch,
                 )
+            # Topology-independent restore: compare the snapshot's
+            # recorded DP layout with the resuming mesh and reshard. Same
+            # shard count => bit-tracked metrics; a reshape moves the
+            # per-shard packing (FP reduction order), tolerance-documented
+            # in README "Elastic training & async checkpoints".
+            prev_layout = checkpointer.snapshot_layout(
+                restored.get("name", candidate)) or {}
+            cur_layout = snapshot_layout(mesh)
+            if prev_layout and prev_layout.get("n_shards") != cur_layout["n_shards"]:
+                logger.warning(
+                    "resume: resharding from DP layout %s to %s "
+                    "(metrics tolerance-bounded across the reshape)",
+                    prev_layout, cur_layout,
+                )
+                telemetry.event(
+                    "ckpt.reshape",
+                    from_shards=int(prev_layout.get("n_shards", -1)),
+                    to_shards=cur_layout["n_shards"],
+                    from_devices=int(prev_layout.get("device_count", -1)),
+                    to_devices=cur_layout["device_count"],
+                )
+            with telemetry.span("ckpt.reshard"):
+                state = reshard_state(state, mesh)
             history["best_epoch"] = int(meta.get("best_epoch", -1))
             history["best_val_loss"] = float(meta.get("best_val_loss",
                                                       float("inf")))
@@ -536,6 +579,9 @@ def fit(
                 logger.exception("resume: no intact 'best' snapshot; "
                                  "tracking best from the restored state")
                 best_state = state
+            else:
+                if checkpointer.has("best"):
+                    best_state = reshard_state(best_state, mesh)
             logger.info("resuming from epoch %d (best val_loss %.4f @ epoch %d)",
                         start_epoch, history["best_val_loss"],
                         history["best_epoch"])
@@ -561,6 +607,12 @@ def fit(
         # is exactly when the buffered loss curve matters
         if tb_writer is not None:
             tb_writer.close()
+        if checkpointer is not None:
+            # The fit-exit drain barrier: every submitted snapshot commits
+            # (or records its failure) before the caller can act on the
+            # run — including the preempted path, where the pending 'last'
+            # is exactly what the resume needs.
+            checkpointer.drain()
 
 
 class _AnomalyGuard:

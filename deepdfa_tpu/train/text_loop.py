@@ -530,6 +530,7 @@ def fit_text(
     mesh=None,
     pad_id: int = 1,
     freeze_submodules: Tuple[str, ...] = (),
+    checkpointer=None,
 ) -> Tuple[TextTrainState, Dict[str, Any]]:
     """Fine-tune, keeping the best state by val F1 (linevul_main.py:217-242).
 
@@ -537,7 +538,13 @@ def fit_text(
     held at their init/loaded values via masked zero-updates — the
     ``--freeze_graph`` flow where a pretrained DDFA encoder is loaded with
     ``load_encoder_params`` and only the text side trains
-    (main_cli.py:136-144)."""
+    (main_cli.py:136-144).
+
+    ``checkpointer``: optional ``CheckpointManager``-shaped manager; when
+    given the loop snapshots ``last`` each epoch and ``best`` on val-F1
+    improvement (the preemption-survival posture of train/loop.py — a
+    10-hour combined fine-tune must resume, not restart), draining any
+    async writes before returning."""
     # ceil: the padded partial batch is a real optimizer step, and the LR
     # schedule must cover it (the reference sizes by len(train_dataloader)).
     steps_per_epoch = max(-(-len(splits["train"]) // cfg.batch_size), 1)
@@ -602,6 +609,31 @@ def fit_text(
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_f1": -1.0}
     best_state = state
     rng = np.random.default_rng(cfg.seed)
+    if checkpointer is not None:
+        from deepdfa_tpu.parallel.mesh import snapshot_layout
+
+        checkpointer.set_layout(snapshot_layout(mesh))
+    try:
+        best_state, history = _fit_text_epochs(
+            model, data, splits, cfg, graphs_by_id, subkeys, graph_budget,
+            mesh, pad_id, checkpointer, build_tile_adj, build_band_adj,
+            n_shards, host, train_step, eval_step, state, best_state,
+            history, rng, detect_anomaly, anomaly_budget,
+        )
+    finally:
+        if checkpointer is not None:
+            # Fit-exit drain barrier (the async-manager contract): every
+            # submitted snapshot commits before the caller sees the run.
+            checkpointer.drain()
+    return best_state, history
+
+
+def _fit_text_epochs(
+    model, data, splits, cfg, graphs_by_id, subkeys, graph_budget, mesh,
+    pad_id, checkpointer, build_tile_adj, build_band_adj, n_shards, host,
+    train_step, eval_step, state, best_state, history, rng, detect_anomaly,
+    anomaly_budget,
+):
     for epoch in range(cfg.max_epochs):
         inject.fire("train.epoch_start", index=epoch)
         t0 = time.time()
@@ -695,10 +727,19 @@ def fit_text(
             "epoch %d train_loss %.4f val_f1 %.4f (%.1fs)",
             epoch, record["train_loss"], val["metrics"]["f1"], record["seconds"],
         )
+        # Multi-controller: only process 0 writes — every host shares the
+        # run dir, and racing orbax saves + meta commits would tear it
+        # (same gating as gen_loop's checkpoint wiring).
+        if checkpointer is not None and (host is None or host[0] == 0):
+            checkpointer.save_last(state, epoch)
+            checkpointer.maybe_save_periodic(state, epoch)
         if val["metrics"]["f1"] > history["best_val_f1"]:
             history["best_val_f1"] = val["metrics"]["f1"]
             history["best_epoch"] = epoch
             best_state = state
+            if checkpointer is not None and (host is None or host[0] == 0):
+                checkpointer.save_best(state, epoch,
+                                       metrics={"val_f1": val["metrics"]["f1"]})
         elif (
             cfg.early_stop_patience is not None
             and epoch - history["best_epoch"] >= cfg.early_stop_patience

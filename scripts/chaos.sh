@@ -1,10 +1,12 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering five fault classes — simulated preemption (kill-and-resume must
+# covering seven fault classes — simulated preemption (kill-and-resume must
 # be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
-# requeue), serving flush failure (one flush fails alone). Exits nonzero on
-# any missed recovery contract — the scripts/test.sh gate.
+# requeue), serving flush failure (one flush fails alone), corrupt-corpus
+# quarantine, and a mid-epoch kill under ASYNC checkpointing resumed on a
+# different device count (elastic reshape). Exits nonzero on any missed
+# recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak
 #   bash scripts/chaos.sh --epochs 4           # deeper training scenarios
@@ -14,6 +16,14 @@ set -e
 cd "$(dirname "$0")/.."
 # CPU pin: the soak verifies *control-plane* behavior (resume, fallback,
 # retry) and its determinism gate compares runs within one process; the
-# tunneled TPU plugin adds nothing but variance here.
-JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli chaos \
+# tunneled TPU plugin adds nothing but variance here. The virtual 8-device
+# mesh (same recipe as tests/conftest.py) gives the elastic scenario a real
+# 4 -> 2 data-parallel reshape to resume across.
+_xla_flags="${XLA_FLAGS:-}"
+case "$_xla_flags" in
+  *xla_force_host_platform_device_count*) ;;
+  *) _xla_flags="$_xla_flags --xla_force_host_platform_device_count=8" ;;
+esac
+JAX_PLATFORMS=cpu XLA_FLAGS="$_xla_flags" PALLAS_AXON_POOL_IPS= \
+  python -m deepdfa_tpu.cli chaos \
   --out-dir "${CHAOS_DIR:-runs/chaos}" "$@"

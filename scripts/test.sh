@@ -22,11 +22,12 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli validate --smoke
 # round-trip step timings, the host/device split, compile capture
 # (post-warmup compiles 0), and a valid Perfetto-loadable trace.json.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
-# Chaos soak: six injected fault classes against a tiny run — resume
+# Chaos soak: seven injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
 # serving flush isolation, corrupt-corpus quarantine+bitwise-clean
-# training. Fails in minutes if a recovery contract regressed; the eval
-# below would never notice.
+# training, and a mid-epoch kill under async checkpointing resumed on a
+# different device count. Fails in minutes if a recovery contract
+# regressed; the eval below would never notice.
 bash scripts/chaos.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

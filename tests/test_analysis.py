@@ -642,6 +642,99 @@ def run(load, paths):
 
 
 # ---------------------------------------------------------------------------
+# GL013 blocking-checkpoint-in-step
+# ---------------------------------------------------------------------------
+
+
+def test_gl013_sync_manager_save_in_step_loop():
+    src = """
+from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+def fit(train_step, state, batches):
+    mgr = CheckpointManager("runs/x")
+    for epoch, b in enumerate(batches):
+        state, loss = train_step(state, b)
+        mgr.save_last(state, epoch)
+"""
+    found = findings_for(src, "GL013")
+    assert len(found) == 1
+    assert found[0].line == 8
+    assert "AsyncCheckpointManager" in found[0].message
+
+
+def test_gl013_pickle_dump_and_fsync_in_step_loop():
+    src = """
+import os
+import pickle
+
+def fit(train_step, state, batches, f):
+    for b in batches:
+        state, loss = train_step(state, b)
+        pickle.dump(state, f)
+        os.fsync(f.fileno())
+"""
+    assert len(findings_for(src, "GL013")) == 2
+
+
+def test_gl013_negative_async_manager():
+    src = """
+from deepdfa_tpu.train.checkpoint import AsyncCheckpointManager
+
+def fit(train_step, state, batches):
+    mgr = AsyncCheckpointManager("runs/x")
+    for epoch, b in enumerate(batches):
+        state, loss = train_step(state, b)
+        mgr.save_last(state, epoch)
+"""
+    assert "GL013" not in rules_of(src)
+
+
+def test_gl013_negative_factory_and_parameter_receivers():
+    # Unknown provenance (parameter) and the async-by-default factory both
+    # stay unflagged — precision over recall, the empty-baseline contract.
+    src = """
+from deepdfa_tpu.train.checkpoint import make_checkpoint_manager
+
+def fit(train_step, state, batches, checkpointer):
+    mgr = make_checkpoint_manager("runs/x")
+    for epoch, b in enumerate(batches):
+        state, loss = train_step(state, b)
+        checkpointer.save_last(state, epoch)
+        mgr.save_best(state, epoch)
+"""
+    assert "GL013" not in rules_of(src)
+
+
+def test_gl013_negative_no_dispatch_in_loop():
+    # A pure save loop (the bench's save-timing rep loop) dispatches no
+    # steps — nothing for the write to overlap with, nothing to flag.
+    src = """
+import pickle
+from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+def bench(states, f):
+    mgr = CheckpointManager("runs/x")
+    for i, s in enumerate(states):
+        mgr.save_last(s, i)
+        pickle.dump(s, f)
+"""
+    assert "GL013" not in rules_of(src)
+
+
+def test_gl013_negative_save_outside_loop():
+    src = """
+from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+def fit(train_step, state, batches):
+    mgr = CheckpointManager("runs/x")
+    for b in batches:
+        state, loss = train_step(state, b)
+    mgr.save_last(state, 0)
+"""
+    assert "GL013" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -894,14 +987,14 @@ def test_package_self_check_clean_and_fast():
 
 
 def test_self_check_covers_every_rule_implementation():
-    """All 11 hazard rule ids (plus the parse-error sentinel) are wired:
+    """All 12 hazard rule ids (plus the parse-error sentinel) are wired:
     each hazard has at least one firing fixture above; this guards the
     registry/implementation agreement."""
     from deepdfa_tpu.analysis.rules import RULES
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
-                          | {"GL010", "GL011"})
-    assert len(RULES) == 12
+                          | {"GL010", "GL011", "GL013"})
+    assert len(RULES) == 13
 
 
 def test_unparseable_file_is_a_finding(tmp_path):
