@@ -164,9 +164,11 @@ def _timed(call, warmup: int, calls: int, trials: int = 3) -> float:
     return dt
 
 
-# Peak dense bf16 matmul throughput per chip, for MFU. The tunneled device
-# reports kind "TPU v5 lite" (v5e): 197 TFLOP/s bf16.
-_PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12}
+# Peak dense bf16 matmul throughput per chip, for MFU — ONE table shared
+# with the roofline report (telemetry/costmodel.py), so bench MFU and
+# `cli trace report` MFU cannot disagree on the ceiling. The tunneled
+# device reports kind "TPU v5 lite" (v5e): 197 TFLOP/s bf16.
+from deepdfa_tpu.telemetry.costmodel import PEAK_FLOPS as _PEAK_FLOPS
 
 
 _warned_unknown_kind = False
@@ -253,7 +255,13 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
         return gps
 
     from deepdfa_tpu.eval.profiling import _costs_of_compiled
+    from deepdfa_tpu.telemetry import costmodel
 
+    # Register the K-unrolled program in the cost-model registry (the
+    # observatory's compiled-callable catalogue) — same executable that
+    # was timed, so the roofline numbers describe the measured program.
+    costmodel.capture_compiled(f"bench.ddfa_step.{dtype}.{impl}", step,
+                               steps_per_call=K)
     flops = _costs_of_compiled(step)["flops"] / K
     sec_per_step = dt / (calls * K)
     peak = _peak_flops()
@@ -699,7 +707,10 @@ def bench_combined_train(
     if not diagnostics:
         return eps
     from deepdfa_tpu.eval.profiling import _costs_of_compiled
+    from deepdfa_tpu.telemetry import costmodel
 
+    costmodel.capture_compiled(
+        f"bench.combined_step.{attention_impl}.t{seq_len}", step)
     flops = _costs_of_compiled(step)["flops"]
     if attention_impl == "flash":
         # XLA's cost analysis reports ~0 FLOPs for Pallas custom calls
@@ -796,6 +807,12 @@ def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
         return seq, seq[0, 0]
 
     step = jax.jit(decode).lower(params, src, jnp.zeros((), jnp.int32)).compile()
+    from deepdfa_tpu.telemetry import costmodel
+
+    # Decode is HBM-bound by construction (docstring above); the capture
+    # records the cost model's view of exactly that — bytes dominate.
+    costmodel.capture_compiled(f"bench.gen_decode.beam{beam_size}", step,
+                               steps_per_call=max_len)
     prev = jnp.zeros((), jnp.int32)
 
     def call():
@@ -1162,7 +1179,22 @@ def main() -> None:
             "max_len": 128,
         },
     ]
-    print(json.dumps(headline(extras)))
+    final = headline(extras)
+    print(json.dumps(final))
+
+    # Bench-regression observatory: every completed run appends one
+    # env-fingerprinted row to benchmarks/history.jsonl, the trajectory
+    # `cli bench diff` gates against. Never lets bookkeeping fail the
+    # measurement that just finished printing.
+    try:
+        from deepdfa_tpu import benchwatch
+
+        benchwatch.append_history(benchwatch.flatten_record(final),
+                                  source="bench.py")
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
 
 
 if __name__ == "__main__":

@@ -77,6 +77,19 @@ Rule catalogue (each backed by a positive+negative fixture in
                              receivers of unknown provenance (parameters,
                              factories) stay unflagged — precision over
                              recall, the empty-baseline contract.
+  GL014 unbounded-metric-cardinality  a registry metric creation
+                             (``.counter(...)``/``.gauge(...)``/
+                             ``.histogram(...)``) whose name is formatted
+                             from per-item loop data (an enclosing
+                             for-loop's target interpolated into an
+                             f-string/format/%%/concat, directly or one
+                             assignment away) — every distinct item mints
+                             a new metric, so the registry and the
+                             Prometheus exposition grow without bound
+                             (the classic label-cardinality explosion).
+                             Names formatted from parameters or iterated
+                             from static collections stay unflagged: the
+                             caller bounds those.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -115,6 +128,7 @@ RULES: Dict[str, str] = {
     "GL010": "unchecked-json-ingest",
     "GL011": "naive-wallclock-timing",
     "GL013": "blocking-checkpoint-in-step",
+    "GL014": "unbounded-metric-cardinality",
 }
 
 _JIT_NAMES = frozenset({
@@ -184,6 +198,9 @@ _BARRIER_ATTRS = frozenset({"fence", "block_until_ready"})
 _BLOCKING_IO_CALLS = frozenset({"pickle.dump", "os.fsync"})
 _SAVE_METHOD_RE = re.compile(r"^(save|save_[a-z0-9_]+|maybe_save_periodic)$")
 _SYNC_MANAGER_LEAF = "CheckpointManager"
+# GL014: the registry's metric-creating method names (the only metric
+# factory in the repo — telemetry/registry.py).
+_METRIC_FACTORY_ATTRS = frozenset({"counter", "gauge", "histogram"})
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -398,6 +415,7 @@ class _FunctionChecker:
         self._check_key_reuse()
         self._check_swallowed_exceptions()
         self._check_unchecked_ingest()
+        self._check_metric_cardinality()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -809,6 +827,118 @@ class _FunctionChecker:
                             "it through deepdfa_tpu.contracts (schema "
                             "validation + quarantine) before it becomes a "
                             "model-feed array", taints)
+
+    # -- unbounded metric cardinality (GL014) --------------------------------
+
+    @staticmethod
+    def _interpolated_names(expr: ast.expr) -> Tuple[List[ast.Name], bool]:
+        """(names interpolated into ``expr``, is-a-formatted-string).
+
+        Covers the string-building shapes a metric name can take:
+        f-strings, ``.format(...)``, ``%`` formatting, and ``+`` concat.
+        """
+        names: List[ast.Name] = []
+        if isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    names += [n for n in ast.walk(v.value)
+                              if isinstance(n, ast.Name)]
+            return names, True
+        if (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "format"):
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+                names += [n for n in ast.walk(a) if isinstance(n, ast.Name)]
+            return names, True
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op,
+                                                      (ast.Mod, ast.Add)):
+            names += [n for n in ast.walk(expr) if isinstance(n, ast.Name)]
+            return names, True
+        return names, False
+
+    @staticmethod
+    def _is_static_collection(expr: ast.expr) -> bool:
+        """A literal tuple/list/set of constants: iterating one bounds
+        the loop target by the code, not the data (the documented GL014
+        negative — formatted or not)."""
+        return (isinstance(expr, (ast.Tuple, ast.List, ast.Set))
+                and all(isinstance(e, ast.Constant) for e in expr.elts))
+
+    def _enclosing_loop_targets(self, node: Node) -> Dict[str, int]:
+        """{name: line} of every enclosing for-loop's iteration target
+        (static-literal iterables exempt — their targets are bounded)."""
+        targets: Dict[str, int] = {}
+        for h in node.loop_stack:
+            head = self.cfg.nodes[h]
+            if isinstance(head.stmt, (ast.For, ast.AsyncFor)):
+                if self._is_static_collection(head.stmt.iter):
+                    continue
+                for n in ast.walk(head.stmt.target):
+                    if isinstance(n, ast.Name):
+                        targets[n.id] = head.line
+        return targets
+
+    def _check_metric_cardinality(self) -> None:
+        """Registry metric creation named from per-item loop data: every
+        distinct item mints a new metric, so the registry (and the
+        Prometheus exposition built from it) grows with the data instead
+        of the code — the label-cardinality explosion. Parameters and
+        static-collection iteration stay unflagged: those names are
+        bounded by the caller, and flagging them would force every
+        snapshot mirror to prove a negative (precision over recall, the
+        empty-baseline contract)."""
+        defs = None
+        for node in self.cfg.nodes:
+            if not node.loop_stack:
+                continue
+            loop_targets = self._enclosing_loop_targets(node)
+            if not loop_targets:
+                continue
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in _METRIC_FACTORY_ATTRS):
+                        continue
+                    name_arg = sub.args[0] if sub.args else next(
+                        (kw.value for kw in sub.keywords
+                         if kw.arg == "name"), None)
+                    if name_arg is None:
+                        continue
+                    target: Optional[str] = None
+                    names, formatted = self._interpolated_names(name_arg)
+                    if formatted:
+                        target = next((n.id for n in names
+                                       if n.id in loop_targets), None)
+                    elif isinstance(name_arg, ast.Name):
+                        # One hop: the name was built from a loop target
+                        # by an assignment inside the same loop.
+                        if defs is None:
+                            defs = reaching_definitions(self.cfg)
+                        sites = defs.get(node.idx, {}).get(
+                            name_arg.id, frozenset())
+                        for d in sites:
+                            stmt = self.cfg.nodes[d].stmt
+                            if (not isinstance(stmt, ast.Assign)
+                                    or not set(self.cfg.nodes[d].loop_stack)
+                                    & set(node.loop_stack)):
+                                continue
+                            nm, fm = self._interpolated_names(stmt.value)
+                            target = next(
+                                (n.id for n in nm if n.id in loop_targets),
+                                None) if fm else None
+                            if target is not None:
+                                break
+                    if target is not None:
+                        self._report(
+                            "GL014", sub,
+                            f".{sub.func.attr}() metric name formatted "
+                            f"from loop item `{target}` (loop target, "
+                            f"line {loop_targets[target]}) — every "
+                            "distinct item creates a new metric series "
+                            "(unbounded cardinality); use a bounded "
+                            "enumeration for the name and put per-item "
+                            "detail in event attrs")
 
     # -- swallowed device exceptions (GL009) ---------------------------------
 

@@ -1,0 +1,357 @@
+"""Bench-regression observatory: env-fingerprinted history + variance-aware diff.
+
+The bench trajectory (BENCH_r01..r05) was, until now, compared by
+eyeball. This module gives it machinery:
+
+* **History** — every bench run appends one env-fingerprinted row to
+  ``benchmarks/history.jsonl`` (:func:`append_history`; ``bench.py
+  main()`` calls it with the final record). Rows from different
+  environments never compare: the fingerprint (device kind, backend,
+  device count) is the join key, because a CPU smoke number and a v5e
+  headline share nothing but a name.
+* **Diff** — :func:`diff` compares a fresh measurement against the
+  recorded trajectory per metric, *variance-aware*: both sides are
+  best-of-reps estimates (the ``_timed`` protocol bench.py measures
+  under — min wall time over trials, the estimator robust to contention
+  outliers), so the reference is the best historical value and the
+  tolerance widens to the observed historical spread when the history
+  shows more run-to-run variance than the base tolerance allows. A
+  regression is a move beyond that band in the metric's worse direction
+  (units decide direction: ms/s/% are lower-better).
+* **Smoke** — :func:`bench_smoke` is the seconds-sized measurement the
+  ``scripts/test.sh`` gate runs on every CI pass (tiny AOT-compiled GNN
+  step + contract-validated ingest), so the regression gate exercises
+  end to end on every change without the ~12-minute full bench.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import platform
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+HISTORY_PATH = os.path.join("benchmarks", "history.jsonl")
+
+# Units where smaller is better; everything else (graphs/s, examples/s,
+# tokens/s, rows/s) is a throughput.
+_LOWER_IS_BETTER_UNITS = frozenset({"ms", "s", "%"})
+
+# The fingerprint fields that must match for two rows to be comparable.
+_MATCH_KEYS = ("device_kind", "backend", "n_devices")
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """The environment identity a measurement is only comparable within.
+
+    Deliberately coarse: all CPU hosts share one fingerprint (JAX reports
+    the same kind everywhere), so CPU rows from differently-sized boxes
+    do compare — the wide base band plus spread-widening is the guard,
+    and ``host`` rides the row for forensics without fragmenting the
+    trajectory into per-container singletons that would never gate."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # pragma: no cover - no backend
+        logger.warning("no device for the bench fingerprint",
+                       exc_info=True)
+        kind = "unknown"
+    return {
+        "device_kind": kind,
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "jax": jax.__version__,
+        "python": platform.python_version(),
+        "host": platform.node(),
+    }
+
+
+def flatten_record(record: Mapping[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """One bench final-line dict -> {metric: {"value", "unit"}} covering
+    the headline and every ``extra`` entry."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def add(entry: Mapping[str, Any]) -> None:
+        name = entry.get("metric")
+        value = entry.get("value")
+        if isinstance(name, str) and isinstance(value, (int, float)) \
+                and not isinstance(value, bool):
+            out[name] = {"value": float(value),
+                         "unit": entry.get("unit", "")}
+
+    add(record)
+    for entry in record.get("extra", ()) or ():
+        if isinstance(entry, Mapping):
+            add(entry)
+    return out
+
+
+def parse_bench_file(path: str) -> Dict[str, Dict[str, Any]]:
+    """Metrics from a bench artifact: a raw bench stdout capture, a
+    driver ``BENCH_r*.json`` (whose ``tail`` holds the stdout), or a
+    single JSON record. The LAST parseable record wins — bench.py's
+    final complete line supersedes its provisional safety lines."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "tail" in doc:
+            text = doc["tail"]
+        elif isinstance(doc, dict) and "metric" in doc:
+            return flatten_record(doc)
+    except ValueError:
+        pass
+    last: Optional[Dict[str, Any]] = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            last = rec
+    if last is None:
+        raise ValueError(f"{path}: no bench record found")
+    return flatten_record(last)
+
+
+def read_history(path: str = HISTORY_PATH) -> List[Dict[str, Any]]:
+    """History rows, skip-and-counting unparseable lines: append_history
+    is a plain append (no atomic rename), so a process killed mid-write
+    can leave a torn trailing line — that must cost one datapoint, never
+    the CI gate (the same posture as the contracts layer's torn-JSONL
+    handling)."""
+    rows: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return rows
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+            else:
+                skipped += 1
+    if skipped:
+        logger.warning("%s: skipped %d unparseable history row(s) "
+                       "(torn append?)", path, skipped)
+    return rows
+
+
+def append_history(metrics: Mapping[str, Mapping[str, Any]],
+                   fingerprint: Optional[Mapping[str, Any]] = None,
+                   source: str = "bench.py",
+                   path: str = HISTORY_PATH) -> Dict[str, Any]:
+    """Append one fingerprinted row; returns it."""
+    row = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "source": source,
+        "fingerprint": dict(fingerprint if fingerprint is not None
+                            else env_fingerprint()),
+        "metrics": {k: dict(v) for k, v in metrics.items()},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def _comparable(row: Mapping[str, Any],
+                fingerprint: Mapping[str, Any]) -> bool:
+    fp = row.get("fingerprint") or {}
+    return all(fp.get(k) == fingerprint.get(k) for k in _MATCH_KEYS)
+
+
+def diff(current: Mapping[str, Mapping[str, Any]],
+         history: Sequence[Mapping[str, Any]],
+         fingerprint: Optional[Mapping[str, Any]] = None,
+         base_tolerance_pct: float = 10.0) -> Dict[str, Any]:
+    """Variance-aware comparison of ``current`` against the trajectory.
+
+    Per metric: the reference is the best historical value under the
+    metric's direction (both sides are best-of-reps estimates — the
+    ``_timed`` protocol); the tolerance is ``base_tolerance_pct`` widened
+    to the observed historical spread (max-min over median, when ≥ 3
+    samples show the environment is noisier than the base band). Metrics
+    with no comparable history are ``new`` — the first run in a fresh
+    environment seeds the trajectory instead of failing it.
+    """
+    if fingerprint is None:
+        fingerprint = env_fingerprint()
+    rows = [r for r in history if _comparable(r, fingerprint)]
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    stable: List[str] = []
+    new: List[str] = []
+    for name, cur in sorted(current.items()):
+        value = float(cur["value"])
+        hist = [float(r["metrics"][name]["value"]) for r in rows
+                if name in r.get("metrics", {})]
+        if not hist:
+            new.append(name)
+            continue
+        lower_better = cur.get("unit", "") in _LOWER_IS_BETTER_UNITS
+        best = min(hist) if lower_better else max(hist)
+        tol_pct = base_tolerance_pct
+        if len(hist) >= 3:
+            med = sorted(hist)[len(hist) // 2]
+            if med:
+                spread_pct = (max(hist) - min(hist)) / abs(med) * 100.0
+                tol_pct = max(tol_pct, min(spread_pct, 50.0))
+        band = abs(best) * tol_pct / 100.0
+        worse = (value - best) if lower_better else (best - value)
+        entry = {
+            "metric": name, "value": value, "best": best,
+            "unit": cur.get("unit", ""), "n_history": len(hist),
+            "tolerance_pct": round(tol_pct, 2),
+            "delta_pct": round((value - best) / abs(best) * 100.0, 2)
+            if best else None,
+        }
+        if worse > band:
+            regressions.append(entry)
+        elif -worse > band:
+            improvements.append(entry)
+        else:
+            stable.append(name)
+    return {
+        "ok": not regressions,
+        "fingerprint": {k: fingerprint.get(k) for k in _MATCH_KEYS},
+        "compared_rows": len(rows),
+        "regressions": regressions,
+        "improvements": improvements,
+        "stable": stable,
+        "new": new,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The smoke-sized measurement (the scripts/test.sh gate)
+# ---------------------------------------------------------------------------
+
+
+def _best_of(call, calls: int, reps: int) -> float:
+    """Best-of-reps wall seconds for ``calls`` dispatches — the bench
+    ``_timed`` protocol at smoke scale (min is the estimator robust to
+    shared-CI contention outliers)."""
+    import jax
+
+    out = None
+    for _ in range(2):  # warm both the executable and the dispatch path
+        out = call()
+    jax.device_get(out)
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            out = call()
+        jax.device_get(out)
+        dt = min(dt, time.perf_counter() - t0)
+    return dt
+
+
+def bench_smoke(n_steps: int = 40, n_rows: int = 400,
+                reps: int = 3) -> Dict[str, Dict[str, Any]]:
+    """Seconds-sized measurements for the CI regression gate:
+
+    * ``smoke_gnn_train_graphs_per_sec`` — an AOT-compiled tiny FlowGNN
+      train step (segment impl, the portable path) at batch 32;
+    * ``smoke_ingest_rows_per_sec`` — the contract-validated JSONL
+      loader over a small synthetic corpus.
+
+    Deliberately tiny shapes: the gate protects against *mechanism*
+    regressions (a host sync creeping into the step loop, a validator
+    going quadratic) on every CI pass; the full bench.py run remains the
+    headline trajectory.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deepdfa_tpu.contracts import (
+        Quarantine,
+        load_examples_jsonl,
+        write_examples_jsonl,
+    )
+    from deepdfa_tpu.core.config import (
+        ALL_SUBKEYS,
+        DataConfig,
+        FeatureSpec,
+        FlowGNNConfig,
+        TrainConfig,
+        subkeys_for,
+    )
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.train.loop import (
+        _batches,
+        make_train_state,
+        make_train_step,
+    )
+
+    feat = FeatureSpec(limit_all=20, limit_subkeys=20)
+    model_cfg = FlowGNNConfig(feature=feat, hidden_dim=16, n_steps=2,
+                              message_impl="segment")
+    data_cfg = DataConfig(batch_size=16, max_nodes_per_graph=64,
+                          max_edges_per_node=4)
+    examples = synthetic_bigvul(data_cfg.batch_size, feat,
+                                positive_fraction=0.5, seed=0)
+    import numpy as np
+
+    batch = next(_batches(examples, np.arange(len(examples)), data_cfg,
+                          subkeys_for(feat), data_cfg.batch_size))
+    model = FlowGNN(model_cfg)
+    state, tx = make_train_state(model, batch, TrainConfig())
+    step = jax.jit(make_train_step(model, tx, TrainConfig()),
+                   donate_argnums=(0,)).lower(state, batch).compile()
+
+    def call():
+        nonlocal state
+        state, loss, _ = step(state, batch)
+        return loss
+
+    dt = _best_of(call, n_steps, reps)
+    gps = n_steps * data_cfg.batch_size / dt
+
+    corpus = synthetic_bigvul(n_rows, FeatureSpec(), positive_fraction=0.5,
+                              seed=0)
+    tmp = tempfile.mkdtemp(prefix="bench_smoke_")
+    try:
+        path = os.path.join(tmp, "corpus.jsonl")
+        write_examples_jsonl(corpus, path, checksum=False)
+
+        def load():
+            exs, _ = load_examples_jsonl(
+                path, ALL_SUBKEYS,
+                quarantine=Quarantine(os.path.join(tmp, "q")))
+            return exs
+
+        load()  # warm imports/allocator
+        ingest_dt = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            load()
+            ingest_dt = min(ingest_dt, time.perf_counter() - t0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "smoke_gnn_train_graphs_per_sec": {
+            "value": round(gps, 1), "unit": "graphs/s"},
+        "smoke_ingest_rows_per_sec": {
+            "value": round(n_rows / ingest_dt, 1), "unit": "rows/s"},
+    }

@@ -970,7 +970,7 @@ def _build_serve_engine(args):
 
 
 def _smoke_http(engine, host: str, port: int, n: int,
-                feature) -> Dict[str, Any]:
+                feature, slo_monitor=None) -> Dict[str, Any]:
     """Self-drive the full HTTP stack with ``n`` synthetic functions
     (chunks exercise batching; a duplicated chunk exercises the cache)."""
     import threading
@@ -979,7 +979,7 @@ def _smoke_http(engine, host: str, port: int, n: int,
     from deepdfa_tpu.data.synthetic import synthetic_bigvul
     from deepdfa_tpu.serve.http import ServeHTTPServer
 
-    server = ServeHTTPServer((host, port), engine)
+    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor)
     server.start_pump()
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
@@ -1023,15 +1023,38 @@ def _smoke_http(engine, host: str, port: int, n: int,
         server.shutdown()
 
 
+def _apply_slo_gate(report: Dict[str, Any], trace_rep: Dict[str, Any],
+                    spec: str) -> Dict[str, Any]:
+    """The offline SLO gate shared by serve ``--smoke``, ``chaos``, and
+    ``trace report --slo``: evaluate ``spec`` against a trace report and
+    fold the verdict into ``report`` — a breach flips ``ok`` and sets
+    the nonzero ``exit_code``, so CI gates on the trace, not on
+    log-grepping. The verdict rides under ``slo_gate``: a trace report's
+    own ``slo`` section (the run's *live* breach summary) must survive
+    being gated."""
+    from deepdfa_tpu.telemetry import slo as slo_mod
+
+    result = slo_mod.evaluate_report(trace_rep, spec)
+    report["slo_gate"] = result
+    report["ok"] = bool(report.get("ok", True) and result["ok"])
+    if not report["ok"]:
+        report["exit_code"] = 1
+    return result
+
+
 def cmd_serve(args) -> Dict[str, Any]:
     """Serve scoring requests over HTTP (deepdfa_tpu/serve): deadline-aware
     bucketed micro-batching, AOT bucket warmup (zero steady-state
     recompiles), content-hash caching, 429 backpressure, GNN-only
     degradation. ``--smoke N`` self-drives the full stack with N synthetic
-    requests and exits — the scripts/test.sh gate."""
+    requests, checks the run's trace against the SLO spec (post-warmup
+    recompiles and p99 blowouts fail the smoke with a nonzero exit, not a
+    log line), and exits — the scripts/test.sh gate. Live serving runs
+    the same spec as a burn-rate monitor degrading ``/healthz``."""
     import contextlib
 
     from deepdfa_tpu.serve.http import serve_forever
+    from deepdfa_tpu.telemetry import slo as slo_mod
 
     # Telemetry sink: --run-dir (default runs/serve_smoke under --smoke);
     # without one, live serving runs untraced (hooks stay no-ops).
@@ -1039,6 +1062,8 @@ def cmd_serve(args) -> Dict[str, Any]:
                                if args.smoke is not None else None)
     scope = (telemetry.run_scope(run_dir) if run_dir
              else contextlib.nullcontext())
+    slo_monitor = (slo_mod.SLOMonitor(args.slo)
+                   if args.slo != "none" else None)
     with scope:
         engine, model_cfg = _build_serve_engine(args)
         if not args.no_warmup:
@@ -1046,15 +1071,26 @@ def cmd_serve(args) -> Dict[str, Any]:
             logger.info("warmed %d bucket shapes", n)
         if args.smoke is not None:
             report = _smoke_http(engine, args.host, args.port, args.smoke,
-                                 model_cfg.feature)
-            if run_dir:
-                report["telemetry"] = os.path.join(run_dir, "telemetry")
-            print(json.dumps(report))
-            if not report["ok"]:
-                report["exit_code"] = 1
-            return report
-        serve_forever(engine, args.host, args.port)
-        return {}
+                                 model_cfg.feature,
+                                 slo_monitor=slo_monitor)
+        else:
+            serve_forever(engine, args.host, args.port,
+                          slo_monitor=slo_monitor)
+            return {}
+    # Smoke path, run closed (events.jsonl complete): the offline SLO
+    # gate over the trace the smoke just produced. DEEPDFA_TELEMETRY=0
+    # leaves no trace — the observatory is fully disabled, and the smoke
+    # reports only its own functional checks.
+    if run_dir:
+        report["telemetry"] = os.path.join(run_dir, "telemetry")
+        if telemetry.enabled() and args.slo != "none":
+            from deepdfa_tpu.telemetry.report import trace_report
+
+            _apply_slo_gate(report, trace_report(run_dir), args.slo)
+    if not report["ok"]:
+        report["exit_code"] = 1
+    print(json.dumps(report))
+    return report
 
 
 def cmd_score(args) -> Dict[str, Any]:
@@ -1165,8 +1201,16 @@ def cmd_chaos(args) -> Dict[str, Any]:
     n = 48
     if args.dataset.startswith("synthetic") and ":" in args.dataset:
         n = int(args.dataset.split(":")[1])
-    report = chaos.run_soak(out_dir=args.out_dir, n_examples=n,
-                            epochs=args.epochs)
+    # The soak runs instrumented: every scenario's spans/faults land in
+    # one run, and the SLO gate below checks the observability substrate
+    # held up under fault load (nothing dropped, serve latency bounded).
+    with telemetry.run_scope(args.out_dir):
+        report = chaos.run_soak(out_dir=args.out_dir, n_examples=n,
+                                epochs=args.epochs)
+    if telemetry.enabled() and args.slo != "none":
+        from deepdfa_tpu.telemetry.report import trace_report
+
+        _apply_slo_gate(report, trace_report(args.out_dir), args.slo)
     print(json.dumps(report))
     return report
 
@@ -1261,6 +1305,48 @@ def cmd_trace(args) -> Dict[str, Any]:
         raise ValueError("usage: cli trace report <run-dir> | "
                          "cli trace --smoke")
     report = trace_report(args.run_dir)
+    if args.slo:
+        _apply_slo_gate(report, report, args.slo)
+    print(json.dumps(report))
+    return report
+
+
+def cmd_bench(args) -> Dict[str, Any]:
+    """Bench-regression observatory (deepdfa_tpu/benchwatch).
+
+    ``cli bench diff --smoke`` runs the seconds-sized smoke measurement,
+    compares it variance-aware against ``benchmarks/history.jsonl`` rows
+    from the same environment fingerprint, appends the new row, and
+    exits nonzero on a regression — the scripts/test.sh gate. ``--current
+    FILE`` diffs an existing bench artifact (raw stdout or a driver
+    BENCH_r*.json) against the trajectory instead."""
+    from deepdfa_tpu import benchwatch
+
+    if args.action != "diff":
+        raise ValueError("usage: cli bench diff [--smoke | --current FILE]")
+    history = benchwatch.read_history(args.history)
+    fingerprint = benchwatch.env_fingerprint()
+    if args.smoke:
+        metrics = benchwatch.bench_smoke()
+        source = "bench_smoke"
+    elif args.current:
+        metrics = benchwatch.parse_bench_file(args.current)
+        source = os.path.basename(args.current)
+    else:
+        raise ValueError("bench diff needs --smoke or --current FILE")
+    report = benchwatch.diff(metrics, history, fingerprint,
+                             base_tolerance_pct=args.tolerance_pct)
+    report["metrics"] = {k: v["value"] for k, v in metrics.items()}
+    report["history"] = args.history
+    # Append AFTER the comparison (a row must never compare against
+    # itself); only measurements append — replaying an artifact with
+    # --current is a query, not a new datapoint.
+    if args.smoke and not args.no_append:
+        benchwatch.append_history(metrics, fingerprint, source=source,
+                                  path=args.history)
+        report["appended"] = True
+    if not report["ok"]:
+        report["exit_code"] = 1
     print(json.dumps(report))
     return report
 
@@ -1548,6 +1634,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="telemetry sink directory (events.jsonl + "
                             "trace.json; --smoke defaults to "
                             "runs/serve_smoke)")
+    p_srv.add_argument("--slo", default="smoke",
+                       help="SLO spec: JSON file, built-in name (smoke/"
+                            "chaos/default), or 'none'. Live serving runs "
+                            "it as a burn-rate monitor degrading /healthz; "
+                            "--smoke additionally gates the run's trace "
+                            "(post-warmup recompiles, p99) with a nonzero "
+                            "exit")
     serve_knobs(p_srv)
     p_srv.set_defaults(func=cmd_serve)
 
@@ -1598,6 +1691,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_ch.add_argument("--epochs", type=int, default=3,
                       help="epochs per training scenario (>= 2)")
     p_ch.add_argument("--out-dir", default="runs/chaos")
+    p_ch.add_argument("--slo", default="chaos",
+                      help="SLO spec the soak's trace is gated on after "
+                           "the scenarios (JSON file, built-in name, or "
+                           "'none')")
     p_ch.set_defaults(func=cmd_chaos)
 
     p_val = sub.add_parser(
@@ -1638,7 +1735,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tr.add_argument("--out-dir", default="runs/trace_smoke",
                       help="--smoke run directory")
     p_tr.add_argument("--seed", type=int, default=0)
+    p_tr.add_argument("--slo", default=None, metavar="SPEC",
+                      help="evaluate the report against an SLO spec "
+                           "(JSON file or built-in name smoke/chaos/"
+                           "default); breaches exit nonzero")
     p_tr.set_defaults(func=cmd_trace)
+
+    p_bn = sub.add_parser(
+        "bench",
+        help="bench-regression observatory: `bench diff --smoke` measures "
+             "the seconds-sized smoke benchmarks, compares them variance-"
+             "aware against benchmarks/history.jsonl (same environment "
+             "fingerprint), appends the row, and exits nonzero on a "
+             "regression")
+    p_bn.add_argument("action", choices=["diff"],
+                      help="diff: compare a measurement against the "
+                           "recorded trajectory")
+    p_bn.add_argument("--history", default="benchmarks/history.jsonl",
+                      help="trajectory file (env-fingerprinted JSONL rows)")
+    p_bn.add_argument("--smoke", action="store_true",
+                      help="run the smoke-sized benchmarks as the current "
+                           "measurement (the scripts/test.sh gate)")
+    p_bn.add_argument("--current", default=None, metavar="FILE",
+                      help="diff an existing bench artifact instead (raw "
+                           "bench stdout or a driver BENCH_r*.json)")
+    p_bn.add_argument("--tolerance-pct", type=float, default=10.0,
+                      help="base regression band; widened to the observed "
+                           "historical spread when that is larger")
+    p_bn.add_argument("--no-append", action="store_true",
+                      help="do not record the smoke measurement into the "
+                           "history")
+    p_bn.set_defaults(func=cmd_bench)
 
     p_tune = sub.add_parser("tune")
     common(p_tune)

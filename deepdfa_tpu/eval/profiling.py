@@ -26,6 +26,10 @@ import jax
 import numpy as np
 
 from deepdfa_tpu import telemetry
+# ONE flops accounting for the whole stack (ISSUE 7): this module,
+# bench.py's diagnostics, and the roofline report all read
+# telemetry.costmodel.costs_of_compiled, so their numbers cannot drift.
+from deepdfa_tpu.telemetry.costmodel import costs_of_compiled as _costs_of_compiled
 from deepdfa_tpu.telemetry.export import append_jsonl
 
 
@@ -47,20 +51,6 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
     through when present.
     """
     return _costs_of_compiled(jax.jit(fn).lower(*args, **kwargs).compile())
-
-
-def _costs_of_compiled(compiled) -> Dict[str, float]:
-    raw = compiled.cost_analysis()
-    if isinstance(raw, (list, tuple)):  # older jax returns [dict]
-        raw = raw[0] if raw else {}
-    out: Dict[str, float] = {}
-    for k, v in (raw or {}).items():
-        if isinstance(v, (int, float)):
-            out[k] = float(v)
-    flops = out.get("flops", 0.0)
-    out["flops"] = flops
-    out["macs"] = flops / 2.0
-    return out
 
 
 def time_steps(

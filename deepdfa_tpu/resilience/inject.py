@@ -50,7 +50,9 @@ Spec fields:
 ``kind``
     ``raise`` (throw ``exc``), ``nan`` (poison a loss), ``corrupt`` /
     ``truncate`` (damage a snapshot file), ``kill`` / ``hang`` (child
-    process faults). Sites ignore kinds they don't understand.
+    process faults), ``delay`` (sleep ``seconds`` at the site — a pure
+    latency fault: the work completes, late; the SLO monitor's p99
+    injection). Sites ignore kinds they don't understand.
 ``at`` / ``every`` / ``p``
     Match conditions on the spec's occurrence index: exact index, a
     period, or a probability drawn from the plan's seeded RNG. With none
@@ -92,7 +94,7 @@ logger = logging.getLogger(__name__)
 
 ENV_VAR = "DEEPDFA_FAULT_PLAN"
 
-KINDS = ("raise", "nan", "corrupt", "truncate", "kill", "hang")
+KINDS = ("raise", "nan", "corrupt", "truncate", "kill", "hang", "delay")
 
 
 class FaultError(RuntimeError):
@@ -110,6 +112,7 @@ class FaultSpec:
     exc: str = "FaultError"
     msg: str = ""
     name: Optional[str] = None
+    seconds: float = 0.05  # delay-kind sleep
     # runtime state
     seen: int = 0   # filter-passing occurrences of this spec's site
     fired: int = 0  # times this spec actually fired
@@ -204,6 +207,12 @@ class FaultPlan:
                 telemetry.event("fault.fired", site=site, kind=spec.kind,
                                 index=idx, seed=self.seed)
         for spec in hits:
+            if spec.kind == "delay":
+                # Pure latency: the site's work still runs — afterwards,
+                # and late enough to blow a p99 SLO.
+                import time
+
+                time.sleep(spec.seconds)
             if spec.kind == "raise":
                 raise spec.exception()
             if spec.kind == "hang":

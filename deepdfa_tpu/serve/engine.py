@@ -131,6 +131,9 @@ class ServeEngine:
         # successes, which would pin a fault plan's index on failure).
         self._flush_ordinal = itertools.count()
         self._compiled: Dict[Tuple[str, int], Any] = {}
+        # Compile count recorded at the end of warmup(): the live SLO
+        # monitor's compiles_after_warmup baseline (None until warmed).
+        self.warmup_compiles: Optional[int] = None
 
         self._lanes: Dict[str, _Lane] = {
             "gnn": self._make_lane("gnn", make_gnn_infer(gnn_model),
@@ -186,7 +189,16 @@ class ServeEngine:
         telemetry.event("serve.warmup_done",
                         warmed=self.stats.compiles - before,
                         buckets=self.n_warm)
+        self.warmup_compiles = self.stats.compiles
         return self.stats.compiles - before
+
+    @property
+    def compiles_after_warmup(self) -> Optional[int]:
+        """Silent recompiles since warmup() finished (the must-stay-0
+        serving invariant, live); None before warmup."""
+        if self.warmup_compiles is None:
+            return None
+        return self.stats.compiles - self.warmup_compiles
 
     def _executable(self, lane: str, slots: int):
         key = (lane, slots)
@@ -207,6 +219,15 @@ class ServeEngine:
             else:
                 lowered = jax.jit(lane.infer).lower(lane.params, empty)
             exe = lowered.compile()
+        # Cost-model capture for the roofline report: this executable IS
+        # the AOT artifact, so the capture costs one cost_analysis read,
+        # no extra compile. Joined to serve.flush spans by (lane, slots).
+        from deepdfa_tpu.telemetry import costmodel
+
+        costmodel.capture_compiled(
+            f"serve.{lane_name}.s{slots}", exe, span="serve.flush",
+            lane=lane_name, slots=slots,
+        )
         self.stats.bump("compiles")
         logger.info("compiled %s bucket slots=%d in %.2fs", lane_name, slots,
                     time.perf_counter() - t0)

@@ -36,6 +36,8 @@ from typing import Dict, Optional, Tuple
 from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
 from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
+from deepdfa_tpu.telemetry.memory import SAMPLER
+from deepdfa_tpu.telemetry.slo import SLOMonitor
 
 logger = logging.getLogger(__name__)
 
@@ -44,21 +46,84 @@ logger = logging.getLogger(__name__)
 # tracked, long enough to not spin.
 _PUMP_MIN_SLEEP_S = 0.002
 _PUMP_MAX_SLEEP_S = 0.050
+# SLO/memory observation cadence on the pump thread — observability must
+# never become the pump's hot loop.
+_OBSERVE_INTERVAL_S = 1.0
+
+# PR-6 checkpoint counters, predeclared so the Prometheus exposition on
+# GET /metrics always carries them (a serve process that never
+# checkpointed would otherwise omit the series and break dashboards).
+_PREDECLARED_COUNTERS = (
+    "ckpt_superseded_total",
+    "ckpt_async_writes_total",
+    "ckpt_async_errors_total",
+)
+_PREDECLARED_HISTOGRAMS = ("ckpt_drain_wait_ms",)
+
+
+def _predeclare_metrics() -> None:
+    for name in _PREDECLARED_COUNTERS:
+        telemetry.REGISTRY.counter(name)
+    for name in _PREDECLARED_HISTOGRAMS:
+        telemetry.REGISTRY.histogram(name)
 
 
 class _PumpThread(threading.Thread):
-    def __init__(self, engine: ServeEngine):
+    def __init__(self, engine: ServeEngine,
+                 slo_monitor: Optional[SLOMonitor] = None):
         super().__init__(name="serve-pump", daemon=True)
         self.engine = engine
+        self.slo_monitor = slo_monitor
         self._halt = threading.Event()
+        self._last_observe = 0.0
 
     def stop(self) -> None:
         self._halt.set()
+
+    def _observe(self) -> None:
+        """SLO burn-rate + live HBM observation, at most once per
+        interval: registry snapshot (histograms expand, so dotted
+        ``serve_latency_ms.p99`` resolves) merged with this engine's
+        stats and the live compiles-after-warmup count."""
+        import time
+
+        now = time.monotonic()
+        if now - self._last_observe < _OBSERVE_INTERVAL_S:
+            return
+        self._last_observe = now
+        SAMPLER.sample()
+        if self.slo_monitor is None:
+            return
+        values = dict(telemetry.REGISTRY.snapshot())
+        eng_snap = self.engine.snapshot()
+        values.update(eng_snap)
+        # Trace-report-shaped aliases (compiles.after_warmup,
+        # serve.request_ms_p99): one spec — the built-in "smoke" — must
+        # resolve on both surfaces, the offline report and this live
+        # snapshot. The engine's submit→complete p99 is the live face of
+        # the report's admission→respond request p99. "compiles" becomes
+        # a namespace here, so the engine's total-compiles counter stays
+        # reachable at compiles.total (and serve_compiles).
+        caw = self.engine.compiles_after_warmup
+        if caw is not None:
+            values["compiles_after_warmup"] = caw
+        values["serve_compiles"] = eng_snap.get("compiles", 0)
+        values["compiles"] = {"after_warmup": caw,
+                              "total": eng_snap.get("compiles", 0)}
+        values["serve"] = {
+            "request_ms_p99": values.get("latency_p99_ms", 0.0),
+        }
+        values["telemetry_drops"] = telemetry.drop_count()
+        for breach in self.slo_monitor.observe(values):
+            logger.warning("SLO breach: %(metric)s=%(value)s over "
+                           "threshold %(threshold)s (burn %(burn_rate)s "
+                           "of budget %(budget)s)", breach)
 
     def run(self) -> None:
         while not self._halt.is_set():
             try:
                 self.engine.pump()
+                self._observe()
                 # Keep events.jsonl current for live scrapes; a no-op
                 # with no active run or empty rings. Inside the guard:
                 # a full disk must cost the trace, never the serving.
@@ -108,13 +173,27 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         engine = self.server.engine
         if self.path == "/healthz":
-            self._send_json(200, {
+            doc: Dict = {
                 "status": "ok",
                 "warm_buckets": engine.n_warm,
                 # Observability health: a nonzero drop count means the
                 # telemetry rings overflowed and the trace is incomplete.
                 "telemetry_drops": telemetry.drop_count(),
-            })
+            }
+            monitor = self.server.slo_monitor
+            if monitor is not None:
+                slo = monitor.status()
+                doc["slo"] = slo
+                if not slo["ok"]:
+                    # An SLO burning degrades health: orchestrators see a
+                    # failing check while the process keeps serving.
+                    doc["status"] = "degraded"
+            if SAMPLER.supported:
+                doc["device_bytes_in_use"] = telemetry.REGISTRY.gauge(
+                    "device_bytes_in_use").value
+                doc["device_peak_bytes_in_use"] = telemetry.REGISTRY.gauge(
+                    "device_peak_bytes_in_use").value
+            self._send_json(200 if doc["status"] == "ok" else 503, doc)
         elif self.path == "/metrics":
             # Content negotiation: Prometheus scrapers ask for text/plain
             # (or OpenMetrics) and get the text exposition — the process
@@ -214,10 +293,13 @@ class ServeHandler(BaseHTTPRequestHandler):
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, address: Tuple[str, int], engine: ServeEngine):
+    def __init__(self, address: Tuple[str, int], engine: ServeEngine,
+                 slo_monitor: Optional[SLOMonitor] = None):
         super().__init__(address, ServeHandler)
         self.engine = engine
-        self.pump_thread = _PumpThread(engine)
+        self.slo_monitor = slo_monitor
+        _predeclare_metrics()
+        self.pump_thread = _PumpThread(engine, slo_monitor=slo_monitor)
 
     def start_pump(self) -> None:
         self.pump_thread.start()
@@ -229,9 +311,10 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
 
 def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
-                  port: int = 8080) -> None:
+                  port: int = 8080,
+                  slo_monitor: Optional[SLOMonitor] = None) -> None:
     """Blocking entry: warm the buckets, start the pump, serve."""
-    server = ServeHTTPServer((host, port), engine)
+    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor)
     server.start_pump()
     logger.info("serving on %s:%d (%d warm buckets)", host,
                 server.server_address[1], engine.n_warm)

@@ -16,6 +16,20 @@ The observability layer shared by ETL, training, and serving (ISSUE 5):
 * :mod:`~deepdfa_tpu.telemetry.report` — the offline summary behind
   ``cli trace report <run>``.
 
+The performance observatory (ISSUE 7) extends the layer with:
+
+* :mod:`~deepdfa_tpu.telemetry.costmodel` — XLA cost-model capture of
+  compiled callables (``cost_analysis`` FLOPs + ``memory_analysis``
+  bytes at AOT/warmup time), joined to fenced spans by the report's
+  roofline section: per-kernel MFU, operational intensity, and a
+  compute-bound vs HBM-bound verdict.
+* :mod:`~deepdfa_tpu.telemetry.memory` — peak-HBM gauges from compiled
+  footprints plus a live ``device.memory_stats`` sampler where the
+  backend supports it.
+* :mod:`~deepdfa_tpu.telemetry.slo` — declarative SLO specs evaluated
+  as burn rates over registry snapshots (live, degrading ``/healthz``)
+  or against a trace report (``cli trace report --slo``).
+
 ``DEEPDFA_TELEMETRY=0`` disables everything; with no run active every
 hook is a cheap no-op, so instrumentation lives in production code paths.
 """

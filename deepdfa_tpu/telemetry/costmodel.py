@@ -1,0 +1,197 @@
+"""Cost-model capture: roofline/MFU attribution for compiled callables.
+
+The paper's evaluation hinges on exactly this accounting — DeepSpeed
+FlopsProfiler MACs joined to measured latency (Table 5) — and the
+ROADMAP's megakernel arc needs its prerequisite: knowing whether each hot
+path is compute-bound or HBM-bound *before* fusing anything. The
+instrument here is XLA's own cost model: for an AOT-compiled executable,
+``compiled.cost_analysis()`` counts post-fusion FLOPs and bytes for the
+exact HLO that runs, and ``compiled.memory_analysis()`` reports the
+temp/argument/output HBM footprint.
+
+:func:`capture_compiled` records one compiled callable into the
+process-wide :data:`CAPTURED` registry, mirrors its HBM footprint into
+the shared metrics registry (``telemetry/memory.py``), and — when a
+telemetry run is active — emits a ``cost.model`` event so the offline
+report can join FLOPs to the run's measured spans and compute per-kernel
+MFU, operational intensity, and a compute-vs-HBM-bound verdict from
+``events.jsonl`` alone.
+
+Availability is gated, never assumed: backends without
+``cost_analysis``/``memory_analysis`` (or the single-device CPU tier-1
+environment mid-API-drift) degrade to partial records, and a failed
+capture logs instead of failing the training run it instruments.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Peak dense bf16 matmul throughput and HBM bandwidth per device kind —
+# the roofline's two ceilings. The tunneled device reports kind
+# "TPU v5 lite" (v5e): 197 TFLOP/s bf16, 819 GB/s HBM. Unknown kinds
+# (the CPU tier-1 environment) report None and the roofline degrades to
+# FLOPs/bytes/intensity without an MFU or a verdict.
+PEAK_FLOPS: Dict[str, float] = {"TPU v5 lite": 197e12, "TPU v5e": 197e12}
+PEAK_HBM_BYTES_PER_SEC: Dict[str, float] = {
+    "TPU v5 lite": 819e9, "TPU v5e": 819e9,
+}
+
+
+def device_kind() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:  # pragma: no cover - no backend at all
+        logger.debug("no device available for cost-model peaks",
+                     exc_info=True)
+        return None
+
+
+def device_peaks(kind: Optional[str] = None,
+                 ) -> Tuple[Optional[float], Optional[float]]:
+    """(peak_flops, peak_hbm_bytes_per_sec) for ``kind`` (default: the
+    current backend's first device); (None, None) when unknown."""
+    if kind is None:
+        kind = device_kind()
+    if kind is None:
+        return None, None
+    return PEAK_FLOPS.get(kind), PEAK_HBM_BYTES_PER_SEC.get(kind)
+
+
+def costs_of_compiled(compiled) -> Dict[str, float]:
+    """XLA cost model of one AOT-compiled executable.
+
+    Returns at least ``{"flops": ..., "macs": ...}`` (macs = flops/2, the
+    DeepSpeed-comparison convention the profiling layer has always used);
+    backend-provided numeric keys (``bytes accessed``, utilization) pass
+    through. THE one flops accounting — ``eval/profiling.py``, bench.py
+    diagnostics, and the roofline report all read this function, so their
+    numbers cannot disagree.
+    """
+    raw = compiled.cost_analysis()
+    if isinstance(raw, (list, tuple)):  # older jax returns [dict]
+        raw = raw[0] if raw else {}
+    out: Dict[str, float] = {}
+    for k, v in (raw or {}).items():
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    flops = out.get("flops", 0.0)
+    out["flops"] = flops
+    out["macs"] = flops / 2.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The process-wide registry of captured callables
+# ---------------------------------------------------------------------------
+
+#: name -> the latest capture record for that callable (CLI/debug surface;
+#: the offline report reads the ``cost.model`` events, not this dict).
+CAPTURED: Dict[str, Dict[str, Any]] = {}
+_LOCK = threading.Lock()
+
+
+def reset() -> None:
+    """Drop captured records — test isolation only."""
+    with _LOCK:
+        CAPTURED.clear()
+
+
+def capture_compiled(name: str, compiled, steps_per_call: int = 1,
+                     span: Optional[str] = None,
+                     use_fenced_window: bool = False,
+                     **attrs: Any) -> Optional[Dict[str, Any]]:
+    """Record one compiled executable's cost model under ``name``.
+
+    ``steps_per_call``: logical steps one dispatch of this executable
+    runs (bench's K-unrolled GNN program); the report divides by it.
+    ``span``: the span name whose measured durations this kernel joins
+    to in the roofline report (default: ``name``); extra ``attrs`` must
+    be a subset of the joined spans' attrs (the serve lanes match on
+    ``lane``/``slots``). ``use_fenced_window``: tell the report to prefer
+    the fenced-window amortized step time over the dispatch-only span
+    p50 when computing MFU (the train loops' honest device-inclusive
+    per-step time).
+
+    Returns the record, or None when telemetry is fully disabled or the
+    backend supports neither analysis. Never raises: a cost-model gap
+    must not take down the run it observes.
+    """
+    from deepdfa_tpu import telemetry
+
+    if not telemetry.enabled():
+        return None
+    costs: Dict[str, float] = {}
+    try:
+        costs = costs_of_compiled(compiled)
+    except Exception:
+        logger.warning("cost_analysis unavailable for %s", name,
+                       exc_info=True)
+    from deepdfa_tpu.telemetry import memory as telemetry_memory
+
+    mem = telemetry_memory.compiled_memory(compiled)
+    if not costs and mem is None:
+        return None
+    kind = device_kind()
+    peak_flops, peak_bw = device_peaks(kind)
+    record: Dict[str, Any] = {
+        "name": name,
+        "span": span or name,
+        "steps_per_call": int(steps_per_call),
+        "use_fenced_window": bool(use_fenced_window),
+        "flops": costs.get("flops", 0.0),
+        "bytes_accessed": costs.get("bytes accessed", 0.0),
+        "device_kind": kind,
+        "peak_flops": peak_flops,
+        "peak_hbm_bytes_per_sec": peak_bw,
+    }
+    if mem is not None:
+        record["memory"] = mem
+        telemetry_memory.record_compiled(name, mem)
+    if attrs:
+        record["attrs"] = dict(attrs)
+    with _LOCK:
+        CAPTURED[name] = record
+    # Flat event attrs: the report rebuilds the record from events.jsonl
+    # alone (the round-trip contract), so everything rides the event.
+    ev: Dict[str, Any] = {k: v for k, v in record.items() if k != "memory"}
+    if mem is not None:
+        ev.update({f"mem_{k}": v for k, v in mem.items()})
+    if attrs:
+        ev.pop("attrs", None)
+        ev.update(attrs)
+    telemetry.event("cost.model", **ev)
+    return record
+
+
+def capture_jitted(name: str, jitted, *args: Any,
+                   steps_per_call: int = 1, span: Optional[str] = None,
+                   use_fenced_window: bool = False,
+                   **attrs: Any) -> Optional[Dict[str, Any]]:
+    """``lower(*args).compile()`` + :func:`capture_compiled` for a jitted
+    callable that was never AOT-compiled (the train loops jit in place).
+
+    Costs one extra compile of an already-warm program, so call sites gate
+    on an active telemetry run and fire once, at warmup time — before the
+    ``warmup_done`` marker, so the compile never trips the
+    post-warmup-compiles-must-be-0 gate. Never raises.
+    """
+    from deepdfa_tpu import telemetry
+
+    if not telemetry.enabled():
+        return None
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:
+        logger.warning("cost-model lower/compile failed for %s", name,
+                       exc_info=True)
+        return None
+    return capture_compiled(name, compiled, steps_per_call=steps_per_call,
+                            span=span, use_fenced_window=use_fenced_window,
+                            **attrs)

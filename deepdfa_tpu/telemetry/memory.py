@@ -1,0 +1,138 @@
+"""Memory telemetry: compiled-program HBM accounting + live device stats.
+
+Two instruments, both exported through the shared metrics registry (so
+the serve ``GET /metrics`` Prometheus exposition and ``/healthz`` carry
+them for free):
+
+* **Compiled footprint** — ``compiled.memory_analysis()`` splits one
+  executable's device memory into temp (XLA scratch), argument, and
+  output bytes. :func:`record_compiled` folds each capture into the
+  run-peak gauges (``hbm_peak_*_bytes``) and emits a
+  ``memory.analysis`` event per capture, so the offline report can name
+  the kernel that owns the watermark.
+* **Live device stats** — :class:`DeviceMemorySampler` polls
+  ``device.memory_stats()`` (bytes_in_use / peak_bytes_in_use) where the
+  backend supports it. CPU returns None; the sampler records itself
+  unsupported and every later call is a cheap no-op — availability is a
+  property of the backend, not an error.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+# memory_analysis() attribute -> the short key the events/report use.
+_MEM_FIELDS = {
+    "temp_size_in_bytes": "temp_bytes",
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "generated_code_size_in_bytes": "code_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+}
+# The gauges a capture can raise — statically enumerated names (GL014:
+# per-kernel detail rides the events, never per-kernel metric names).
+_PEAK_GAUGE_NAMES = {
+    "temp_bytes": "hbm_peak_temp_bytes",
+    "argument_bytes": "hbm_peak_argument_bytes",
+    "output_bytes": "hbm_peak_output_bytes",
+    "total_bytes": "hbm_peak_total_bytes",
+}
+
+_LOCK = threading.Lock()
+
+
+def compiled_memory(compiled) -> Optional[Dict[str, int]]:
+    """temp/argument/output/... byte split of one compiled executable,
+    plus ``total_bytes``; None when the backend has no memory analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out: Dict[str, int] = {}
+    for attr, key in _MEM_FIELDS.items():
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[key] = int(v)
+    if not out:
+        return None
+    out["total_bytes"] = (out.get("temp_bytes", 0)
+                          + out.get("argument_bytes", 0)
+                          + out.get("output_bytes", 0))
+    return out
+
+
+def record_compiled(name: str, mem: Dict[str, int]) -> None:
+    """Fold one capture into the run-peak gauges + emit its event."""
+    from deepdfa_tpu import telemetry
+
+    with _LOCK:
+        for key, gauge_name in _PEAK_GAUGE_NAMES.items():
+            if key in mem:
+                gauge = telemetry.REGISTRY.gauge(gauge_name)
+                if mem[key] > gauge.value:
+                    gauge.set(mem[key])
+    telemetry.event("memory.analysis", name=name, **mem)
+
+
+class DeviceMemorySampler:
+    """Rate-limited ``device.memory_stats()`` poller.
+
+    ``sample()`` reads the first addressable device's allocator stats,
+    sets the ``device_bytes_in_use`` / ``device_peak_bytes_in_use``
+    gauges, and emits a ``memory.sample`` event — at most once per
+    ``min_interval_s``. Returns the stats dict, or None when the backend
+    does not expose them (CPU) or the interval has not elapsed.
+    """
+
+    def __init__(self, min_interval_s: float = 1.0):
+        self.min_interval_s = min_interval_s
+        self.supported: Optional[bool] = None  # unknown until first poll
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def sample(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        from deepdfa_tpu import telemetry
+
+        if self.supported is False or not telemetry.enabled():
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last < self.min_interval_s:
+                return None
+            self._last = now
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            logger.debug("device memory_stats read failed", exc_info=True)
+            stats = None
+        if not stats:
+            if self.supported is None:
+                self.supported = False
+                logger.info("device memory_stats unsupported on this "
+                            "backend; live HBM sampling disabled")
+            return None
+        self.supported = True
+        out = {k: v for k, v in stats.items()
+               if isinstance(v, (int, float))}
+        if "bytes_in_use" in out:
+            telemetry.REGISTRY.gauge("device_bytes_in_use").set(
+                out["bytes_in_use"])
+        if "peak_bytes_in_use" in out:
+            telemetry.REGISTRY.gauge("device_peak_bytes_in_use").set(
+                out["peak_bytes_in_use"])
+        telemetry.event("memory.sample", **out)
+        return out
+
+
+#: The process sampler (serve pump + train epoch cadence share it, so the
+#: rate limit is global — one poll per interval no matter how many sites).
+SAMPLER = DeviceMemorySampler()

@@ -151,6 +151,20 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "drain_wait_ms_max": round(max(drain_ms, default=0.0), 3),
     }
 
+    # --- roofline: cost.model events joined to measured spans -----------
+    roofline = _roofline(spans, instants, train)
+
+    # --- memory: compiled HBM footprint + live device samples -----------
+    memory = _memory(instants)
+
+    # --- SLO breaches observed live during the run ----------------------
+    slo_breaches = named(instants, ("slo.breach",))
+    slo = {
+        "breaches": len(slo_breaches),
+        "breached_metrics": sorted({(e.get("attrs") or {}).get("metric", "?")
+                                    for e in slo_breaches}),
+    }
+
     # --- bookkeeping ----------------------------------------------------
     flush_events = named(instants, ("telemetry.flush",))
     drops = max((int((e.get("attrs") or {}).get("drops", 0))
@@ -166,8 +180,130 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "faults": {"total": len(faults), "by_site": by_site},
         "quarantined": len(quarantined),
         "serve": serve,
+        "roofline": roofline,
+        "memory": memory,
+        "slo": slo,
         "telemetry_drops": drops,
     }
+
+
+# cost.model event keys that are capture metadata, not span-join attrs.
+_CM_META = frozenset({
+    "name", "span", "steps_per_call", "use_fenced_window", "flops",
+    "bytes_accessed", "device_kind", "peak_flops",
+    "peak_hbm_bytes_per_sec",
+})
+
+
+def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
+              train: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-kernel roofline rows: XLA cost-model FLOPs/bytes (the
+    ``cost.model`` events the costmodel captures emit at warmup) joined
+    to the run's measured span durations — per-kernel MFU, operational
+    intensity, and a compute-bound vs HBM-bound verdict.
+
+    The time source is honest about attribution: fenced spans (or the
+    fenced-window amortized step time for the train step) measure
+    device-inclusive duration; dispatch-only span p50 is used — and
+    labelled — only when nothing fenced matched.
+    """
+    latest: Dict[str, Dict[str, Any]] = {}
+    for e in instants:
+        if e.get("name") == "cost.model":
+            attrs = e.get("attrs") or {}
+            if attrs.get("name"):
+                latest[attrs["name"]] = attrs  # last capture per kernel wins
+    rows: List[Dict[str, Any]] = []
+    for name, cm in sorted(latest.items()):
+        steps_per_call = max(int(cm.get("steps_per_call", 1)), 1)
+        join_attrs = {k: v for k, v in cm.items()
+                      if k not in _CM_META and not k.startswith("mem_")}
+        matched = [
+            s for s in spans
+            if s.get("name") == cm.get("span")
+            and all((s.get("attrs") or {}).get(k) == v
+                    for k, v in join_attrs.items())
+        ]
+        ms_per_call = _quantile(
+            [float(s.get("dur_ms", 0.0)) for s in matched], 0.50
+        ) if matched else None
+        time_source = "span_p50" if matched else None
+        if any(s.get("fenced") for s in matched):
+            fenced_ms = [float(s.get("dur_ms", 0.0)) for s in matched
+                         if s.get("fenced")]
+            ms_per_call = _quantile(fenced_ms, 0.50)
+            time_source = "fenced_span"
+        elif cm.get("use_fenced_window") and train.get("step_ms_fenced_mean"):
+            # The train loops' per-step spans are dispatch-only; the
+            # fenced epoch/window spans carry the device-inclusive time,
+            # amortized per step by the train section.
+            ms_per_call = train["step_ms_fenced_mean"] * steps_per_call
+            time_source = "fenced_window"
+        flops = float(cm.get("flops", 0.0)) / steps_per_call
+        bytes_accessed = float(cm.get("bytes_accessed", 0.0)) / steps_per_call
+        peak_flops = cm.get("peak_flops")
+        peak_bw = cm.get("peak_hbm_bytes_per_sec")
+        oi = flops / bytes_accessed if bytes_accessed else None
+        sec = ms_per_call / steps_per_call / 1e3 if ms_per_call else None
+        achieved = flops / sec if sec else None
+        row: Dict[str, Any] = {
+            "name": name,
+            "calls": len(matched),
+            "flops_per_step": flops,
+            "bytes_per_step": bytes_accessed,
+            "operational_intensity": round(oi, 3) if oi else None,
+            "ms_per_step": (round(ms_per_call / steps_per_call, 4)
+                            if ms_per_call else None),
+            "time_source": time_source,
+            "achieved_gflops_per_sec": (round(achieved / 1e9, 2)
+                                        if achieved else None),
+            "mfu": (round(achieved / peak_flops, 4)
+                    if achieved and peak_flops else None),
+            "hbm_frac": (round(bytes_accessed / sec / peak_bw, 4)
+                         if sec and peak_bw and bytes_accessed else None),
+            "device_kind": cm.get("device_kind"),
+        }
+        if oi and peak_flops and peak_bw:
+            # The roofline verdict: above the ridge point the kernel can
+            # saturate the MXU; below it HBM bandwidth is the ceiling —
+            # the prerequisite fact for the megakernel arc.
+            ridge = peak_flops / peak_bw
+            row["ridge_intensity"] = round(ridge, 3)
+            row["bound"] = ("compute-bound" if oi >= ridge else "hbm-bound")
+        else:
+            row["bound"] = None
+        if join_attrs:
+            row["attrs"] = join_attrs
+        rows.append(row)
+    return rows
+
+
+def _memory(instants: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Peak-HBM accounting from ``memory.analysis`` (compiled footprint,
+    per kernel) + ``memory.sample`` (live allocator stats) events."""
+    analyses = [e.get("attrs") or {} for e in instants
+                if e.get("name") == "memory.analysis"]
+    samples = [e.get("attrs") or {} for e in instants
+               if e.get("name") == "memory.sample"]
+    out: Dict[str, Any] = {"kernels": len(analyses),
+                           "device_samples": len(samples)}
+    for key in ("temp_bytes", "argument_bytes", "output_bytes",
+                "total_bytes"):
+        vals = [int(a[key]) for a in analyses if key in a]
+        out[f"peak_{key}"] = max(vals) if vals else None
+    ranked = sorted((a for a in analyses if a.get("total_bytes")),
+                    key=lambda a: -int(a["total_bytes"]))
+    out["top_kernels"] = [
+        {"name": a.get("name", "?"), "total_bytes": int(a["total_bytes"]),
+         "temp_bytes": int(a.get("temp_bytes", 0))}
+        for a in ranked[:5]
+    ]
+    if samples:
+        out["device_bytes_in_use_max"] = max(
+            int(s.get("bytes_in_use", 0)) for s in samples)
+        out["device_peak_bytes_in_use"] = max(
+            int(s.get("peak_bytes_in_use", 0)) for s in samples)
+    return out
 
 
 def events_path_of(run_dir: str) -> str:

@@ -383,7 +383,7 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
-def span(name: str, **attrs: Any):
+def span(name: str, /, **attrs: Any):
     """``with span("train.step", step=n) as sp:`` — nestable timed
     region. Cheap no-op object when telemetry is disabled."""
     if not enabled():
@@ -413,8 +413,10 @@ def record_span(name: str, start_s: float, end_s: Optional[float] = None,
     _ring().append(rec)
 
 
-def event(name: str, **attrs: Any) -> None:
-    """Instant event into the active run (no-op without one)."""
+def event(name: str, /, **attrs: Any) -> None:
+    """Instant event into the active run (no-op without one). The event
+    name is positional-only so an attr may itself be called ``name``
+    (the cost-model and memory events carry the kernel's)."""
     run = _RUN
     if run is None or not enabled():
         return

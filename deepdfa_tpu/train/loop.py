@@ -761,6 +761,17 @@ def _fit_epochs(
                            data_cfg, subkeys, n_shards, use_tile, use_df,
                            host, mesh, build_band_adj=use_band)
         if epoch == start_epoch:
+            # Cost-model capture (the roofline report's input): re-lower
+            # the already-warm step once and record XLA's FLOPs/bytes +
+            # HBM footprint. Instrumented runs only (an active telemetry
+            # run), single-controller only, and BEFORE the warmup marker
+            # — the extra compile must never read as a silent recompile.
+            if host is None and telemetry.current_run() is not None \
+                    and window_steps:
+                from deepdfa_tpu.telemetry import costmodel
+
+                costmodel.capture_jitted("train.step", train_step, state,
+                                         batch, use_fenced_window=True)
             # Every jitted shape this fit dispatches has now compiled
             # (train step + eval step); any jax.compile event after this
             # marker is a silent recompile the trace report must surface.
@@ -783,6 +794,11 @@ def _fit_epochs(
                         val_f1=val.metrics["f1"],
                         seconds=record["seconds"],
                         rolled_back=epoch_rolled)
+        # Live HBM watermark where the backend exposes allocator stats
+        # (no-op on CPU; the sampler is globally rate-limited).
+        from deepdfa_tpu.telemetry.memory import SAMPLER
+
+        SAMPLER.sample()
         # Epoch-cadence flush: long runs must not ride the ring buffer
         # until close (a >ring-capacity fit would drop its whole tail).
         telemetry.flush()

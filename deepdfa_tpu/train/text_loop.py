@@ -704,6 +704,23 @@ def _fit_text_epochs(
                 mesh=mesh,
             )
         if epoch == 0:
+            # Cost-model capture for the combined step (roofline input):
+            # one re-lower of the warm program, instrumented runs only,
+            # before the warmup marker — same contract as train/loop.py.
+            # NOTE: XLA's cost analysis reports ~0 FLOPs for Pallas
+            # custom calls, so a flash-attention step under-counts here;
+            # bench.py's analytic correction remains the MFU headline
+            # for that path (its module docstring).
+            if host is None and telemetry.current_run() is not None \
+                    and n_batches:
+                from deepdfa_tpu.telemetry import costmodel
+
+                costmodel.capture_jitted(
+                    "train.step", train_step, state,
+                    jnp.asarray(batch.input_ids),
+                    jnp.asarray(batch.labels),
+                    jnp.asarray(batch.example_mask),
+                    batch.graphs, use_fenced_window=True)
             telemetry.event("train.warmup_done", epoch=epoch, loop="text")
         record = {
             "epoch": epoch,

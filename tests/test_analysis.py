@@ -735,6 +735,96 @@ def fit(train_step, state, batches):
 
 
 # ---------------------------------------------------------------------------
+# GL014 unbounded-metric-cardinality
+# ---------------------------------------------------------------------------
+
+
+def test_gl014_fstring_loop_item_metric_name():
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+def score_all(items):
+    for item in items:
+        REGISTRY.counter(f"requests_{item.user}_total").inc()
+"""
+    found = findings_for(src, "GL014")
+    assert len(found) == 1
+    assert "item" in found[0].message
+    assert "cardinality" in found[0].message
+
+
+def test_gl014_one_hop_assignment_and_format_call():
+    # The name built one assignment away, and .format()-style building,
+    # are the same hazard.
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+def track(rows, reg):
+    for row in rows:
+        name = "lat_{}_ms".format(row)
+        reg.histogram(name).observe(1.0)
+"""
+    assert len(findings_for(src, "GL014")) == 1
+
+
+def test_gl014_negative_parameter_formatted_name():
+    # The snapshot-mirror idiom (core/metrics.py): names formatted from
+    # function parameters are bounded by the caller, not per-item data.
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+def bump(counter, by=1):
+    REGISTRY.counter(f"serve_{counter}_total").inc(by)
+
+def observe_all(rows):
+    for row in rows:
+        bump("completed")
+"""
+    assert "GL014" not in rules_of(src)
+
+
+def test_gl014_negative_static_enumeration_in_loop():
+    # Predeclaring a fixed tuple of names iterates loop data, but the
+    # names are the loop items themselves (a static collection), not
+    # formatted from them — bounded by the code.
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+NAMES = ("a_total", "b_total")
+
+def predeclare():
+    for name in NAMES:
+        REGISTRY.counter(name)
+"""
+    assert "GL014" not in rules_of(src)
+
+
+def test_gl014_negative_literal_name_in_loop():
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+def pump(batches):
+    for b in batches:
+        REGISTRY.counter("batches_total").inc()
+        REGISTRY.gauge("depth").set(len(b))
+"""
+    assert "GL014" not in rules_of(src)
+
+
+def test_gl014_negative_formatted_name_over_literal_collection():
+    # Formatting over a literal tuple of constants is still bounded by
+    # the code — the documented negative covers formatted names too.
+    src = """
+from deepdfa_tpu.telemetry import REGISTRY
+
+def predeclare():
+    for lane in ("gnn", "combined"):
+        REGISTRY.counter(f"serve_{lane}_compiles_total")
+"""
+    assert "GL014" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -993,8 +1083,8 @@ def test_self_check_covers_every_rule_implementation():
     from deepdfa_tpu.analysis.rules import RULES
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
-                          | {"GL010", "GL011", "GL013"})
-    assert len(RULES) == 13
+                          | {"GL010", "GL011", "GL013", "GL014"})
+    assert len(RULES) == 14
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

@@ -1,0 +1,707 @@
+"""Performance observatory (ISSUE 7): cost-model/roofline attribution,
+HBM accounting, SLO burn-rate monitoring, and the bench-regression gate.
+
+Cost-model availability is probed, not assumed (the tier-1 environment is
+single-device CPU — ``cost_analysis``/``memory_analysis`` work there
+today, but the probe keeps the suite honest across backend drift)."""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.config import (
+    DataConfig,
+    FeatureSpec,
+    FlowGNNConfig,
+    TrainConfig,
+)
+from deepdfa_tpu.data.splits import make_splits
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.telemetry import costmodel, slo
+from deepdfa_tpu.telemetry.export import read_events
+from deepdfa_tpu.telemetry.report import summarize, trace_report
+from deepdfa_tpu.train.loop import fit
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+def _probe_cost_analysis() -> bool:
+    try:
+        compiled = jax.jit(lambda x: (x @ x).sum()).lower(
+            jnp.ones((8, 8))).compile()
+        return costmodel.costs_of_compiled(compiled)["flops"] > 0
+    except Exception:
+        return False
+
+
+HAS_COST = _probe_cost_analysis()
+needs_cost = pytest.mark.skipif(
+    not HAS_COST, reason="backend exposes no compiled cost_analysis")
+
+
+@pytest.fixture(autouse=True)
+def _clean_run_state():
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+    yield
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+
+
+def _dataset(n=24, seed=0):
+    examples = synthetic_bigvul(n, FEAT, positive_fraction=0.5, seed=seed)
+    for i, ex in enumerate(examples):
+        ex["label"] = int(np.asarray(ex["vuln"]).max())
+        ex["id"] = i
+    return examples, make_splits(examples, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Cost-model capture
+# ---------------------------------------------------------------------------
+
+
+@needs_cost
+def test_capture_compiled_records_flops_bytes_and_event(tmp_path):
+    compiled = jax.jit(lambda x: (x @ x).sum()).lower(
+        jnp.ones((16, 16))).compile()
+    with telemetry.run_scope(str(tmp_path)):
+        rec = costmodel.capture_compiled("toy.matmul", compiled)
+    assert rec is not None
+    assert rec["flops"] > 0
+    assert rec["bytes_accessed"] > 0
+    assert costmodel.CAPTURED["toy.matmul"] is rec
+    events = read_events(os.path.join(str(tmp_path), "telemetry",
+                                      "events.jsonl"))
+    (cm,) = [e for e in events if e["name"] == "cost.model"]
+    assert cm["attrs"]["name"] == "toy.matmul"
+    assert cm["attrs"]["flops"] == rec["flops"]
+    # memory_analysis rides along where the backend has it
+    if "memory" in rec:
+        assert cm["attrs"]["mem_total_bytes"] == rec["memory"]["total_bytes"]
+        (ma,) = [e for e in events if e["name"] == "memory.analysis"]
+        assert ma["attrs"]["total_bytes"] == rec["memory"]["total_bytes"]
+
+
+def test_capture_disabled_is_fully_off(tmp_path):
+    telemetry.set_enabled(False)
+    compiled = jax.jit(lambda x: x + 1).lower(jnp.ones(4)).compile()
+    before = dict(costmodel.CAPTURED)
+    assert costmodel.capture_compiled("off.kernel", compiled) is None
+    assert "off.kernel" not in costmodel.CAPTURED
+    assert costmodel.CAPTURED == before
+
+
+@needs_cost
+def test_memory_peak_gauges_track_max(tmp_path):
+    from deepdfa_tpu.telemetry.memory import compiled_memory
+
+    big = jax.jit(lambda x: (x @ x)).lower(jnp.ones((64, 64))).compile()
+    mem = compiled_memory(big)
+    if mem is None:
+        pytest.skip("backend exposes no memory_analysis")
+    with telemetry.run_scope(str(tmp_path)):
+        costmodel.capture_compiled("toy.big", big)
+    assert telemetry.REGISTRY.gauge("hbm_peak_total_bytes").value \
+        >= mem["total_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# Roofline report: the instrumented DDFA fit
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ddfa_run(tmp_path_factory):
+    """One instrumented tiny fit, shared by the roofline assertions."""
+    run_dir = str(tmp_path_factory.mktemp("observatory_run"))
+    examples, splits = _dataset()
+    cfg = TrainConfig(max_epochs=2, seed=0)
+    data = DataConfig(batch_size=8, eval_batch_size=8)
+    telemetry.end_run()
+    with telemetry.run_scope(run_dir):
+        fit(FlowGNN(TINY), examples, splits, cfg, data, log_every=2)
+    return run_dir, examples, splits, cfg, data
+
+
+@needs_cost
+def test_roofline_section_has_train_step_with_fenced_window_time(ddfa_run):
+    run_dir = ddfa_run[0]
+    report = trace_report(run_dir)
+    rows = {r["name"]: r for r in report["roofline"]}
+    assert "train.step" in rows
+    row = rows["train.step"]
+    assert row["flops_per_step"] > 0
+    assert row["bytes_per_step"] > 0
+    assert row["operational_intensity"] > 0
+    # The train step's MFU time base is the fenced (device-inclusive)
+    # window, never the dispatch-only span p50.
+    assert row["time_source"] == "fenced_window"
+    assert row["ms_per_step"] > 0
+    assert row["achieved_gflops_per_sec"] > 0
+    # CPU has no peak entry: MFU and the verdict honestly report None
+    # instead of fabricating a ceiling.
+    if row["device_kind"] in costmodel.PEAK_FLOPS:
+        assert 0 < row["mfu"] <= 1.5
+        assert row["bound"] in ("compute-bound", "hbm-bound")
+    else:
+        assert row["mfu"] is None
+        assert row["bound"] is None
+
+
+@needs_cost
+def test_roofline_ddfa_flops_equal_bench_accounting(ddfa_run):
+    """The satellite gate: the roofline's DDFA FLOPs must equal the
+    bench.py accounting (``_costs_of_compiled`` of the same step at the
+    same config) — one cost model, no drift."""
+    run_dir, examples, splits, cfg, data = ddfa_run
+    from deepdfa_tpu.core.config import subkeys_for
+    from deepdfa_tpu.eval.profiling import _costs_of_compiled
+    from deepdfa_tpu.train.loop import (
+        _batches,
+        make_train_state,
+        make_train_step,
+    )
+
+    model = FlowGNN(TINY)
+    batch = next(_batches(examples, splits["train"][:data.batch_size],
+                          data, subkeys_for(FEAT), data.batch_size))
+    state, tx = make_train_state(model, batch, cfg)
+    step = jax.jit(make_train_step(model, tx, cfg))
+    bench_flops = _costs_of_compiled(step.lower(state, batch).compile())["flops"]
+
+    report = trace_report(run_dir)
+    (row,) = [r for r in report["roofline"] if r["name"] == "train.step"]
+    assert row["flops_per_step"] == pytest.approx(bench_flops, rel=1e-9)
+
+
+@needs_cost
+def test_report_roundtrips_from_events_jsonl_alone(ddfa_run):
+    run_dir = ddfa_run[0]
+    events = read_events(os.path.join(run_dir, "telemetry", "events.jsonl"))
+    report = summarize(events)
+    assert [r["name"] for r in report["roofline"]] == ["train.step"]
+    assert report["memory"]["kernels"] >= 1
+    assert report["memory"]["peak_total_bytes"] > 0
+    assert report["memory"]["top_kernels"][0]["name"] == "train.step"
+    # compiles stayed clean: the capture's extra compile lands BEFORE the
+    # warmup marker by construction.
+    assert report["compiles"]["after_warmup"] == 0
+
+
+def test_disabled_telemetry_keeps_history_bit_identical_with_capture():
+    """The observatory obeys the master switch: the same fit with
+    DEEPDFA_TELEMETRY=0 produces a bit-identical history (capture and
+    sampling never run)."""
+    examples, splits = _dataset()
+    cfg = TrainConfig(max_epochs=2, seed=0)
+    data = DataConfig(batch_size=8, eval_batch_size=8)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as run_dir:
+        with telemetry.run_scope(run_dir):
+            _, hist_on = fit(FlowGNN(TINY), examples, splits, cfg, data,
+                             log_every=2)
+    telemetry.set_enabled(False)
+    _, hist_off = fit(FlowGNN(TINY), examples, splits, cfg, data,
+                      log_every=2)
+
+    def strip(h):
+        out = json.loads(json.dumps(h))
+        for rec in out["epochs"]:
+            rec.pop("seconds", None)
+        return out
+
+    assert json.dumps(strip(hist_on), sort_keys=True) == \
+        json.dumps(strip(hist_off), sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Serve lanes in the roofline
+# ---------------------------------------------------------------------------
+
+
+@needs_cost
+def test_serve_lane_capture_joins_flush_spans(tmp_path):
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock, bursty_trace, replay
+
+    config = ServeConfig(batch_slots=4, queue_capacity=64)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+    with telemetry.run_scope(str(tmp_path)):
+        clock = VirtualClock()
+        eng = ServeEngine(model, params, config=config, clock=clock)
+        eng.warmup()
+        replay(eng, bursty_trace(24, FEAT, seed=0), clock)
+    report = summarize(read_events(os.path.join(str(tmp_path), "telemetry",
+                                                "events.jsonl")))
+    lanes = [r for r in report["roofline"]
+             if r["name"].startswith("serve.gnn.")]
+    assert lanes, "warmed serve lanes must appear in the roofline"
+    # At least one warmed bucket actually served flushes, joined by
+    # (lane, slots); unused buckets report calls == 0, not wrong joins.
+    served = [r for r in lanes if r["calls"] > 0]
+    assert served
+    for r in served:
+        assert r["attrs"]["lane"] == "gnn"
+        assert r["ms_per_step"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SLO: offline gate
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_report_breach_skip_and_required():
+    report = {"compiles": {"after_warmup": 2},
+              "serve": {"request_ms_p99": 12.0},
+              "telemetry_drops": 0}
+    res = slo.evaluate_report(report, "smoke")
+    assert not res["ok"]
+    (breach,) = res["breaches"]
+    assert breach["metric"] == "compiles.after_warmup"
+    assert breach["value"] == 2
+
+    clean = {"compiles": {"after_warmup": 0},
+             "serve": {"request_ms_p99": 12.0}, "telemetry_drops": 0}
+    assert slo.evaluate_report(clean, "smoke")["ok"]
+
+    # absent metrics skip unless required
+    spec = {"slos": [{"metric": "nope.missing", "max": 1}]}
+    res = slo.evaluate_report({}, spec)
+    assert res["ok"] and res["skipped"] == ["nope.missing"]
+    spec = {"slos": [{"metric": "nope.missing", "max": 1, "required": True}]}
+    assert not slo.evaluate_report({}, spec)["ok"]
+
+
+def test_load_spec_rejects_garbage(tmp_path):
+    with pytest.raises(ValueError):
+        slo.load_spec("no-such-spec")
+    with pytest.raises(ValueError):
+        slo.load_spec({"slos": []})
+    with pytest.raises(ValueError):
+        slo.load_spec({"slos": [{"metric": "x"}]})  # no threshold
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps({"slos": [{"metric": "a.b", "max": 1}]}))
+    assert slo.load_spec(str(path))["slos"][0]["metric"] == "a.b"
+
+
+# ---------------------------------------------------------------------------
+# SLO: live burn-rate monitor
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_burn_rate_budget_and_recovery(tmp_path):
+    clock = {"t": 0.0}
+    spec = {"slos": [{"metric": "p99_ms", "max": 10.0,
+                      "window_s": 60.0, "budget": 0.5}]}
+    with telemetry.run_scope(str(tmp_path)):
+        mon = slo.SLOMonitor(spec, clock=lambda: clock["t"])
+        # One bad of two observations = burn 0.5, NOT over the 0.5 budget.
+        mon.observe({"p99_ms": 50.0})
+        clock["t"] += 1
+        assert mon.observe({"p99_ms": 1.0}) == []
+        assert mon.status()["ok"]
+        # Second bad observation: burn 2/3 > 0.5 — breach fires once.
+        clock["t"] += 1
+        (breach,) = mon.observe({"p99_ms": 99.0})
+        assert breach["metric"] == "p99_ms" and breach["value"] == 99.0
+        assert not mon.status()["ok"]
+        assert telemetry.REGISTRY.gauge("slo_burning").value == 1
+        # Still burning: no duplicate event per polling tick.
+        clock["t"] += 1
+        assert mon.observe({"p99_ms": 98.0}) == []
+        # Old violations age out of the window: recovery.
+        clock["t"] += 120
+        for _ in range(3):
+            clock["t"] += 1
+            mon.observe({"p99_ms": 1.0})
+        assert mon.status()["ok"]
+    events = read_events(os.path.join(str(tmp_path), "telemetry",
+                                      "events.jsonl"))
+    assert len([e for e in events if e["name"] == "slo.breach"]) == 1
+    assert len([e for e in events if e["name"] == "slo.recovered"]) == 1
+    report = summarize(events)
+    assert report["slo"] == {"breaches": 1, "breached_metrics": ["p99_ms"]}
+
+
+def test_zero_budget_breaches_on_single_violation(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        mon = slo.SLOMonitor(
+            {"slos": [{"metric": "compiles_after_warmup", "max": 0}]},
+            clock=lambda: 0.0)
+        assert mon.observe({"compiles_after_warmup": 0}) == []
+        (breach,) = mon.observe({"compiles_after_warmup": 1})
+        assert breach["threshold"] == 0
+
+
+def test_two_rules_on_one_metric_keep_separate_burn_state():
+    # A max and a budgeted tier on the SAME metric must not share a
+    # violation deque: steady 200ms violates only the tight rule.
+    clock = {"t": 0.0}
+    mon = slo.SLOMonitor(
+        {"slos": [
+            {"metric": "p99_ms", "max": 100.0},
+            {"metric": "p99_ms", "max": 500.0,
+             "window_s": 60.0, "budget": 0.5},
+        ]}, clock=lambda: clock["t"])
+    breached = []
+    for _ in range(4):
+        clock["t"] += 1
+        breached += mon.observe({"p99_ms": 200.0})
+    assert [b["threshold"] for b in breached] == [100.0]
+    burning = mon.status()["burning"]
+    assert len(burning) == 1 and burning[0]["threshold"] == 100.0
+
+
+def test_pump_snapshot_resolves_builtin_smoke_spec():
+    # The serve pump's live snapshot carries trace-report-shaped aliases
+    # (compiles.after_warmup, serve.request_ms_p99), so the ONE built-in
+    # "smoke" spec resolves on both surfaces — a live recompile must
+    # degrade health, not be silently skipped as a missing metric.
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.http import _PumpThread
+
+    config = ServeConfig(batch_slots=2, queue_capacity=8)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config)
+    eng.warmup()
+    mon = slo.SLOMonitor("smoke")
+    pump = _PumpThread(eng, slo_monitor=mon)
+    pump._last_observe = -1e9
+    pump._observe()
+    # Every "smoke" rule resolved against the live snapshot (an
+    # unresolvable metric would leave its deque empty), and the warmed
+    # engine is clean.
+    assert all(len(d) == 1 for d in mon._obs)
+    assert mon.status()["ok"]
+    # A post-warmup recompile breaches live.
+    eng.stats.bump("compiles")
+    pump._last_observe = -1e9
+    pump._observe()
+    status = mon.status()
+    assert not status["ok"]
+    assert [b["metric"] for b in status["burning"]] \
+        == ["compiles.after_warmup"]
+
+
+# ---------------------------------------------------------------------------
+# SLO acceptance: injected recompile / latency fault -> nonzero exits,
+# degraded /healthz; clean runs pass
+# ---------------------------------------------------------------------------
+
+
+def test_injected_recompile_fails_trace_slo_gate(tmp_path, capsys):
+    from deepdfa_tpu import cli
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock, bursty_trace, replay
+
+    config = ServeConfig(batch_slots=4, queue_capacity=64)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+
+    def run(run_dir, recompile):
+        with telemetry.run_scope(run_dir):
+            clock = VirtualClock()
+            eng = ServeEngine(model, params, config=config, clock=clock)
+            eng.warmup()
+            replay(eng, bursty_trace(16, FEAT, seed=0), clock)
+            if recompile:
+                # A shape outside the warmed ladder: the silent-recompile
+                # class the SLO gate exists to catch.
+                eng._executable("gnn", 3)
+
+    clean_dir, bad_dir = str(tmp_path / "clean"), str(tmp_path / "bad")
+    run(clean_dir, recompile=False)
+    run(bad_dir, recompile=True)
+
+    assert cli.main(["trace", "report", clean_dir, "--slo", "smoke"]) == 0
+    capsys.readouterr()
+    rc = cli.main(["trace", "report", bad_dir, "--slo", "smoke"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not out["slo_gate"]["ok"]
+    assert out["slo_gate"]["breaches"][0]["metric"] \
+        == "compiles.after_warmup"
+    # The gate verdict must not clobber the report's own live-SLO
+    # summary section.
+    assert out["slo"] == {"breaches": 0, "breached_metrics": []}
+
+
+def test_injected_latency_fault_breaches_live_slo_and_degrades_healthz(
+        tmp_path):
+    from deepdfa_tpu.resilience import inject
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, queue_capacity=8, deadline_ms=30.0)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config), config=config)
+    monitor = slo.SLOMonitor(
+        {"slos": [{"metric": "latency_p99_ms", "max": 50.0,
+                   "window_s": 60.0, "budget": 0.0}]})
+    graphs = synthetic_bigvul(2, FEAT, positive_fraction=0.5, seed=0)
+
+    def payload(g):
+        return {"graph": {"num_nodes": int(g["num_nodes"]),
+                          "senders": np.asarray(g["senders"]).tolist(),
+                          "receivers": np.asarray(g["receivers"]).tolist(),
+                          "feats": {k: np.asarray(v).tolist()
+                                    for k, v in g["feats"].items()}}}
+
+    plan = inject.FaultPlan.from_doc({"faults": [
+        # Pure latency fault: every micro-batch completes, 300 ms late.
+        {"site": "serve.batch", "kind": "delay", "seconds": 0.3, "every": 1},
+    ]})
+    with telemetry.run_scope(str(tmp_path)):
+        eng.warmup()
+        server = ServeHTTPServer(("127.0.0.1", 0), eng, slo_monitor=monitor)
+        server.start_pump()
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with inject.armed(plan):
+                req = urllib.request.Request(
+                    f"{base}/score",
+                    data=json.dumps(
+                        {"functions": [payload(g) for g in graphs]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    doc = json.loads(resp.read())
+                assert all("prob" in r for r in doc["results"])
+                # The pump observes at most once a second; wait out one
+                # observation interval so the breach lands.
+                deadline = time.time() + 10.0
+                status = None
+                while time.time() < deadline:
+                    try:
+                        with urllib.request.urlopen(f"{base}/healthz",
+                                                    timeout=10) as resp:
+                            status = json.loads(resp.read())
+                    except urllib.error.HTTPError as e:
+                        status = json.loads(e.read())
+                        if e.code == 503:
+                            break
+                    time.sleep(0.2)
+        finally:
+            server.shutdown()
+    assert status is not None
+    assert status["status"] == "degraded"
+    assert status["slo"]["burning"][0]["metric"] == "latency_p99_ms"
+    events = read_events(os.path.join(str(tmp_path), "telemetry",
+                                      "events.jsonl"))
+    assert any(e["name"] == "slo.breach" for e in events)
+    assert any(e["name"] == "fault.fired"
+               and e["attrs"]["kind"] == "delay" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: PR-6 checkpoint counters predeclared
+# ---------------------------------------------------------------------------
+
+
+def test_serve_metrics_text_carries_ckpt_counters_and_json_unchanged():
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, queue_capacity=8)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config), config=config)
+    eng.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        req = urllib.request.Request(f"{base}/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            text = resp.read().decode()
+        # The PR-6 checkpoint counters are part of the exposition even in
+        # a serve process that never checkpointed. Presence, not value:
+        # the registry is process-wide, so checkpoint tests that ran
+        # earlier in the same pytest process may have bumped them.
+        assert "# TYPE deepdfa_ckpt_superseded_total counter" in text
+        assert re.search(r"^deepdfa_ckpt_async_writes_total \d+$", text,
+                         re.MULTILINE)
+        assert re.search(r"^deepdfa_ckpt_async_errors_total \d+$", text,
+                         re.MULTILINE)
+        assert "# TYPE deepdfa_ckpt_drain_wait_ms histogram" in text
+        assert "deepdfa_ckpt_drain_wait_ms_count" in text
+        # The default JSON body stays byte-compatible.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            body = resp.read()
+        assert body == json.dumps(json.loads(body)).encode()
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bench-regression observatory
+# ---------------------------------------------------------------------------
+
+
+def _fp(kind="cpu"):
+    return {"device_kind": kind, "backend": "cpu", "n_devices": 1}
+
+
+def _row(metrics, kind="cpu"):
+    return {"ts": "2026-01-01T00:00:00", "source": "test",
+            "fingerprint": _fp(kind),
+            "metrics": {k: {"value": v, "unit": u}
+                        for k, (v, u) in metrics.items()}}
+
+
+def test_diff_directions_tolerance_and_fingerprint_isolation():
+    from deepdfa_tpu import benchwatch
+
+    history = [
+        _row({"tput": (100.0, "graphs/s"), "lat": (10.0, "ms")}),
+        _row({"tput": (104.0, "graphs/s"), "lat": (9.5, "ms")}),
+        # A different environment's much faster row must NOT set the bar.
+        _row({"tput": (9999.0, "graphs/s")}, kind="TPU v5 lite"),
+    ]
+    # Throughput down 30% from best(104) -> regression; latency within
+    # band -> stable.
+    res = benchwatch.diff(
+        {"tput": {"value": 72.0, "unit": "graphs/s"},
+         "lat": {"value": 10.2, "unit": "ms"}},
+        history, _fp(), base_tolerance_pct=10.0)
+    assert not res["ok"]
+    (reg,) = res["regressions"]
+    assert reg["metric"] == "tput" and reg["best"] == 104.0
+    assert res["stable"] == ["lat"]
+
+    # Latency is lower-better: a 50% jump regresses even as tput improves.
+    res = benchwatch.diff(
+        {"tput": {"value": 140.0, "unit": "graphs/s"},
+         "lat": {"value": 15.0, "unit": "ms"}},
+        history, _fp(), base_tolerance_pct=10.0)
+    assert [r["metric"] for r in res["regressions"]] == ["lat"]
+    assert [r["metric"] for r in res["improvements"]] == ["tput"]
+
+    # No comparable history (fresh environment): everything is new, ok.
+    res = benchwatch.diff({"tput": {"value": 1.0, "unit": "graphs/s"}},
+                          history, _fp(kind="TPU v9"), base_tolerance_pct=10)
+    assert res["ok"] and res["new"] == ["tput"]
+
+
+def test_diff_widens_tolerance_to_observed_spread():
+    from deepdfa_tpu import benchwatch
+
+    # History spread is 40% of the median: a 20% drop from best is inside
+    # the variance band, not a regression.
+    history = [_row({"t": (v, "graphs/s")}) for v in (80.0, 100.0, 120.0)]
+    res = benchwatch.diff({"t": {"value": 96.0, "unit": "graphs/s"}},
+                          history, _fp(), base_tolerance_pct=10.0)
+    assert res["ok"] and res["stable"] == ["t"]
+
+
+def test_parse_bench_file_takes_final_line(tmp_path):
+    from deepdfa_tpu import benchwatch
+
+    # A driver-style BENCH_r*.json: tail with provisional + final lines.
+    tail = "\n".join([
+        json.dumps({"metric": "x_provisional", "value": 1.0, "unit": "g/s",
+                    "partial": True}),
+        json.dumps({"metric": "x", "value": 2.0, "unit": "g/s",
+                    "extra": [{"metric": "y", "value": 3.0, "unit": "ms"}]}),
+    ])
+    path = tmp_path / "BENCH_r99.json"
+    path.write_text(json.dumps({"n": 99, "rc": 0, "tail": tail}))
+    metrics = benchwatch.parse_bench_file(str(path))
+    assert metrics["x"]["value"] == 2.0
+    assert metrics["y"] == {"value": 3.0, "unit": "ms"}
+    assert "x_provisional" not in metrics
+
+
+def test_history_append_and_read_roundtrip(tmp_path):
+    from deepdfa_tpu import benchwatch
+
+    path = str(tmp_path / "history.jsonl")
+    row = benchwatch.append_history(
+        {"m": {"value": 5.0, "unit": "ms"}}, _fp(), source="t", path=path)
+    assert row["metrics"]["m"]["value"] == 5.0
+    (read,) = benchwatch.read_history(path)
+    assert read["fingerprint"]["device_kind"] == "cpu"
+    assert read["metrics"]["m"]["unit"] == "ms"
+
+
+def test_read_history_skips_torn_trailing_row(tmp_path):
+    # append_history is a plain append: a process killed mid-write
+    # leaves a torn last line, which must cost one datapoint, not the
+    # CI gate.
+    from deepdfa_tpu import benchwatch
+
+    path = str(tmp_path / "history.jsonl")
+    benchwatch.append_history(
+        {"m": {"value": 5.0, "unit": "ms"}}, _fp(), source="t", path=path)
+    with open(path, "a") as f:
+        f.write('{"ts": "2026-01-01", "metr')  # torn mid-append
+    (read,) = benchwatch.read_history(path)
+    assert read["metrics"]["m"]["value"] == 5.0
+
+
+def test_cli_bench_diff_current_artifact(tmp_path, capsys):
+    from deepdfa_tpu import benchwatch, cli
+
+    hist = str(tmp_path / "history.jsonl")
+    fp = benchwatch.env_fingerprint()
+    benchwatch.append_history({"z": {"value": 100.0, "unit": "graphs/s"}},
+                              fp, source="seed", path=hist)
+    cur = tmp_path / "run.json"
+    cur.write_text(json.dumps({"metric": "z", "value": 50.0,
+                               "unit": "graphs/s"}))
+    rc = cli.main(["bench", "diff", "--history", hist,
+                   "--current", str(cur)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and not out["ok"]
+    assert out["regressions"][0]["metric"] == "z"
+    # --current is a query: nothing appended.
+    assert len(benchwatch.read_history(hist)) == 1
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"metric": "z", "value": 101.0,
+                                "unit": "graphs/s"}))
+    assert cli.main(["bench", "diff", "--history", hist,
+                     "--current", str(good)]) == 0
+
+
+@pytest.mark.slow
+def test_cli_bench_diff_smoke_measures_and_appends(tmp_path, capsys):
+    from deepdfa_tpu import benchwatch, cli
+
+    hist = str(tmp_path / "history.jsonl")
+    rc = cli.main(["bench", "diff", "--smoke", "--history", hist])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["appended"]
+    assert out["metrics"]["smoke_gnn_train_graphs_per_sec"] > 0
+    assert out["metrics"]["smoke_ingest_rows_per_sec"] > 0
+    (row,) = benchwatch.read_history(hist)
+    assert set(row["metrics"]) == {"smoke_gnn_train_graphs_per_sec",
+                                   "smoke_ingest_rows_per_sec"}
