@@ -90,6 +90,26 @@ Rule catalogue (each backed by a positive+negative fixture in
                              Names formatted from parameters or iterated
                              from static collections stay unflagged: the
                              caller bounds those.
+  GL015 subprocess-without-timeout  an unbounded blocking wait on a child
+                             process: ``.communicate()``/``.wait()`` with
+                             no ``timeout=`` on a receiver whose reaching
+                             construction is ``subprocess.Popen``, a
+                             ``subprocess.run``-family one-shot with no
+                             ``timeout=``, or a blocking pipe read
+                             (``proc.stdout.read``/``os.read``) in a
+                             child-process-owning function with no
+                             ``select``-class deadline guard — a wedged
+                             child then wedges the worker forever, the
+                             hazard class the pooled Joern driver exists
+                             to avoid (its reads run under a
+                             ``select.select`` deadline loop and every
+                             plain ``.wait()`` follows a ``.kill()``).
+                             A ``.kill()``/``.terminate()`` on the same
+                             receiver before the wait bounds it (reaping
+                             a dead child returns); parameter receivers
+                             of unknown provenance stay unflagged —
+                             precision over recall, the empty-baseline
+                             contract.
 
 Jit scope is detected from decorators (``@jax.jit``, ``@partial(jax.jit,..)``,
 pjit, shard_map), module-level ``jax.jit(fn)`` wraps of a local def, and the
@@ -129,6 +149,7 @@ RULES: Dict[str, str] = {
     "GL011": "naive-wallclock-timing",
     "GL013": "blocking-checkpoint-in-step",
     "GL014": "unbounded-metric-cardinality",
+    "GL015": "subprocess-without-timeout",
 }
 
 _JIT_NAMES = frozenset({
@@ -201,6 +222,23 @@ _SYNC_MANAGER_LEAF = "CheckpointManager"
 # GL014: the registry's metric-creating method names (the only metric
 # factory in the repo — telemetry/registry.py).
 _METRIC_FACTORY_ATTRS = frozenset({"counter", "gauge", "histogram"})
+# GL015: the Popen construction leaf, the blocking-wait methods, the
+# one-shot helpers that accept timeout=, the pipe-read shapes, the calls
+# that bound a subsequent wait (a killed child reaps immediately), and
+# the deadline guards that make a raw pipe read honest.
+_POPEN_LEAF = "Popen"
+_SUBPROCESS_ONESHOTS = frozenset({
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+})
+_PIPE_STREAMS = frozenset({"stdout", "stderr"})
+_PIPE_READS = frozenset({"read", "readline", "readlines"})
+_PROC_KILLERS = frozenset({"kill", "terminate"})
+_SELECT_GUARDS = frozenset({
+    "select.select", "select.poll", "select.epoll", "select.kqueue",
+    "selectors.DefaultSelector",
+})
+_PTY_OPEN = "pty.openpty"
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -416,6 +454,7 @@ class _FunctionChecker:
         self._check_swallowed_exceptions()
         self._check_unchecked_ingest()
         self._check_metric_cardinality()
+        self._check_subprocess_timeout()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -711,6 +750,124 @@ class _FunctionChecker:
                                 "fsync; use AsyncCheckpointManager / "
                                 "make_checkpoint_manager for the async "
                                 "handoff")
+
+    # -- subprocess without timeout (GL015) ----------------------------------
+
+    def _popen_provenance(self) -> Tuple[Dict[str, int], bool, bool,
+                                         Dict[str, List[int]]]:
+        """Function-wide lexical facts for GL015: receiver texts assigned
+        a ``subprocess.Popen(...)`` construction (Name or attribute
+        targets — the ``self._proc`` idiom), whether the function owns
+        child-process machinery at all (a Popen or ``pty.openpty`` call),
+        whether a ``select``-class deadline guard is present, and the
+        lines where each receiver is killed/terminated."""
+        receivers: Dict[str, int] = {}
+        child_ctx = False
+        select_guard = False
+        killers: Dict[str, List[int]] = {}
+        for node in _walk_skip_defs(self.fi.node.body):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                dotted = self.mod.resolve(node.value.func)
+                if dotted is not None \
+                        and dotted.rsplit(".", 1)[-1] == _POPEN_LEAF:
+                    for t in node.targets:
+                        receivers[_expr_text(t)] = node.lineno
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self.mod.resolve(node.func)
+            if dotted is not None:
+                if dotted.rsplit(".", 1)[-1] == _POPEN_LEAF \
+                        or dotted == _PTY_OPEN:
+                    child_ctx = True
+                if dotted in _SELECT_GUARDS:
+                    select_guard = True
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _PROC_KILLERS:
+                killers.setdefault(_expr_text(node.func.value),
+                                   []).append(node.lineno)
+        return receivers, child_ctx, select_guard, killers
+
+    def _is_popen_receiver(self, value: ast.expr,
+                           receivers: Dict[str, int]) -> bool:
+        if _expr_text(value) in receivers:
+            return True
+        # The direct chain: subprocess.Popen(...).communicate()
+        if isinstance(value, ast.Call):
+            dotted = self.mod.resolve(value.func)
+            return (dotted is not None
+                    and dotted.rsplit(".", 1)[-1] == _POPEN_LEAF)
+        return False
+
+    def _check_subprocess_timeout(self) -> None:
+        """Unbounded blocking waits on child processes — the hazard class
+        the pooled Joern driver must never reintroduce: a long-lived
+        worker blocked forever on a wedged child wedges its pool slot.
+        Every wait needs a deadline (``timeout=``, a ``select`` loop, or
+        a preceding kill); receivers the function did not construct stay
+        unflagged (the caller owns their lifecycle)."""
+        receivers, child_ctx, select_guard, killers = \
+            self._popen_provenance()
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self.mod.resolve(node.func)
+            if dotted in _SUBPROCESS_ONESHOTS:
+                if not any(kw.arg == "timeout" for kw in node.keywords):
+                    self._report(
+                        "GL015", node,
+                        f"{dotted}(…) without timeout= — a wedged child "
+                        "blocks this call forever; pass timeout= and "
+                        "handle subprocess.TimeoutExpired")
+                continue
+            if dotted == "os.read" and child_ctx and not select_guard:
+                self._report(
+                    "GL015", node,
+                    "os.read(…) in a child-process-owning function with "
+                    "no select/poll deadline guard — a silent child "
+                    "blocks the read forever; wrap it in a "
+                    "select.select(..., timeout) deadline loop (the "
+                    "joern_session._read_until_prompt idiom)")
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if (func.attr in _PIPE_READS
+                    and isinstance(func.value, ast.Attribute)
+                    and func.value.attr in _PIPE_STREAMS
+                    and not select_guard
+                    and self._is_popen_receiver(func.value.value,
+                                                receivers)):
+                self._report(
+                    "GL015", node,
+                    f"blocking .{func.value.attr}.{func.attr}() on a "
+                    "Popen pipe with no select/poll deadline guard — a "
+                    "silent child blocks the worker forever; read under "
+                    "a select deadline loop or use .communicate("
+                    "timeout=...)")
+                continue
+            if func.attr not in ("wait", "communicate"):
+                continue
+            if not self._is_popen_receiver(func.value, receivers):
+                continue
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+            if func.attr == "wait":
+                has_timeout = has_timeout or bool(node.args)
+            else:
+                has_timeout = has_timeout or len(node.args) >= 2
+            if has_timeout:
+                continue
+            base = _expr_text(func.value)
+            if any(line <= node.lineno
+                   for line in killers.get(base, [])):
+                continue  # reaping a killed child returns promptly
+            self._report(
+                "GL015", node,
+                f".{func.attr}() with no timeout= on the Popen child "
+                f"constructed line {receivers.get(base, node.lineno)} — "
+                "a wedged child blocks the worker forever; pass "
+                "timeout= (handling subprocess.TimeoutExpired) or kill "
+                "the child first")
 
     # -- recompilation (GL006) -----------------------------------------------
 

@@ -970,25 +970,29 @@ def _build_serve_engine(args):
 
 
 def _smoke_http(engine, host: str, port: int, n: int,
-                feature, slo_monitor=None) -> Dict[str, Any]:
+                feature, slo_monitor=None,
+                scan_service=None) -> Dict[str, Any]:
     """Self-drive the full HTTP stack with ``n`` synthetic functions
-    (chunks exercise batching; a duplicated chunk exercises the cache)."""
+    (chunks exercise batching; a duplicated chunk exercises the cache).
+    With a scan service attached, one ``POST /scan`` round proves the
+    raw-source edge end-to-end over real HTTP."""
     import threading
     import urllib.request
 
     from deepdfa_tpu.data.synthetic import synthetic_bigvul
     from deepdfa_tpu.serve.http import ServeHTTPServer
 
-    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor)
+    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor,
+                             scan_service=scan_service)
     server.start_pump()
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     bound_port = server.server_address[1]
     base = f"http://{host}:{bound_port}"
 
-    def post(doc):
+    def post(doc, path="/score"):
         req = urllib.request.Request(
-            f"{base}/score", data=json.dumps(doc).encode(),
+            f"{base}{path}", data=json.dumps(doc).encode(),
             headers={"Content-Type": "application/json"},
         )
         with urllib.request.urlopen(req, timeout=120) as resp:
@@ -1013,14 +1017,54 @@ def _smoke_http(engine, host: str, port: int, n: int,
             )["results"]
         # Duplicate the first chunk: CI-scan traffic, must hit the cache.
         dup = post({"functions": payload[:chunk]})["results"]
+        scan_ok = None
+        if scan_service is not None:
+            # One POST /scan round-trip over real HTTP (raw source ->
+            # pooled Joern -> featurize -> the same warmed engine), then
+            # a replay that must come back entirely from the scan cache.
+            from deepdfa_tpu.scan.fake_joern import seeded_sources
+
+            sdoc = {"functions": [{"id": i, "source": s} for i, s in
+                                  enumerate(seeded_sources(3, seed=7))]}
+            first_scan = post(sdoc, path="/scan")["results"]
+            replay_scan = post(sdoc, path="/scan")["results"]
+            scan_ok = (all("prob" in r for r in first_scan)
+                       and all(r.get("cached") for r in replay_scan))
         with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
             metrics = json.loads(resp.read())
         ok = (all("prob" in r for r in results)
-              and all(r.get("cached") for r in dup))
-        return {"smoke": n, "ok": ok, "cached_replay": len(dup),
-                "metrics": metrics}
+              and all(r.get("cached") for r in dup)
+              and scan_ok is not False)
+        report = {"smoke": n, "ok": ok, "cached_replay": len(dup),
+                  "metrics": metrics}
+        if scan_ok is not None:
+            report["scan_ok"] = scan_ok
+            report["scan"] = scan_service.snapshot()
+        return report
     finally:
         server.shutdown()
+
+
+def _build_scan_service(engine, model_cfg, args):
+    """The streaming scan service behind ``POST /scan`` and ``cli scan``,
+    from the ``--scan-*`` knobs. ``--scan-transport none`` (the serve
+    default) returns None — /scan answers 501; ``fake`` is the hermetic
+    scripted subprocess (no JVM, the tier-1/smoke transport); anything
+    else is the Joern binary name/path. Env knobs DEEPDFA_SCAN_TRANSPORT /
+    DEEPDFA_SCAN_POOL override the argparse defaults (README "Streaming
+    scan service")."""
+    transport = getattr(args, "scan_transport", "none")
+    if transport == "none":
+        return None
+    from deepdfa_tpu.scan import ScanConfig, ScanService, fake_joern_command
+
+    command = fake_joern_command() if transport == "fake" else transport
+    config = ScanConfig(pool_size=args.scan_pool_size,
+                        timeout_s=args.scan_timeout_s,
+                        attempts=args.scan_attempts)
+    return ScanService(engine, model_cfg.feature,
+                       workdir=args.scan_workdir, config=config,
+                       command=command, cache_path=args.scan_cache)
 
 
 def _apply_slo_gate(report: Dict[str, Any], trace_rep: Dict[str, Any],
@@ -1069,14 +1113,21 @@ def cmd_serve(args) -> Dict[str, Any]:
         if not args.no_warmup:
             n = engine.warmup()
             logger.info("warmed %d bucket shapes", n)
-        if args.smoke is not None:
-            report = _smoke_http(engine, args.host, args.port, args.smoke,
-                                 model_cfg.feature,
-                                 slo_monitor=slo_monitor)
-        else:
-            serve_forever(engine, args.host, args.port,
-                          slo_monitor=slo_monitor)
-            return {}
+        scan_service = _build_scan_service(engine, model_cfg, args)
+        try:
+            if args.smoke is not None:
+                report = _smoke_http(engine, args.host, args.port,
+                                     args.smoke, model_cfg.feature,
+                                     slo_monitor=slo_monitor,
+                                     scan_service=scan_service)
+            else:
+                serve_forever(engine, args.host, args.port,
+                              slo_monitor=slo_monitor,
+                              scan_service=scan_service)
+                return {}
+        finally:
+            if scan_service is not None:
+                scan_service.close()
     # Smoke path, run closed (events.jsonl complete): the offline SLO
     # gate over the trace the smoke just produced. DEEPDFA_TELEMETRY=0
     # leaves no trace — the observatory is fully disabled, and the smoke
@@ -1124,6 +1175,158 @@ def cmd_score(args) -> Dict[str, Any]:
               "errors": errors[:10], "split": args.split,
               "out": os.path.join(args.out_dir, "score_predictions.csv"),
               "serving": engine.snapshot()}
+    print(json.dumps(report))
+    return report
+
+
+def _scan_smoke(engine, model_cfg, args, compiles0: int) -> Dict[str, Any]:
+    """The hermetic scan self-test (scripts/test.sh gate): sweep a seeded
+    mini-corpus through the full pool/featurize/score machinery on the
+    fake-Joern transport, edit ONE function, re-scan — exactly the
+    changed function may re-featurize (one cache miss), every untouched
+    verdict must come back cached and byte-identical, and the warmed
+    serve engine must not compile anything new."""
+    import shutil
+    import tempfile
+
+    from deepdfa_tpu.scan import ScanConfig, ScanService, fake_joern_command
+    from deepdfa_tpu.scan.fake_joern import edit_source, seeded_sources
+
+    n = args.smoke
+    tmp = tempfile.mkdtemp(prefix="scan_smoke_")
+    try:
+        corpus = os.path.join(tmp, "corpus")
+        os.makedirs(corpus)
+        paths = []
+        for i, source in enumerate(seeded_sources(n, seed=args.seed)):
+            p = os.path.join(corpus, f"fn_{i:03d}.c")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(source)
+            paths.append(p)
+        config = ScanConfig(pool_size=args.scan_pool_size,
+                            timeout_s=args.scan_timeout_s,
+                            attempts=args.scan_attempts)
+        with ScanService(engine, model_cfg.feature,
+                         workdir=os.path.join(tmp, "scan"), config=config,
+                         command=fake_joern_command()) as svc:
+            first = svc.scan_files(paths)
+            edited = paths[n // 2]
+            with open(edited, encoding="utf-8") as f:
+                text = f.read()
+            with open(edited, "w", encoding="utf-8") as f:
+                f.write(edit_source(text))
+            second = svc.scan_files(paths)
+            snap = svc.snapshot()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    misses = [r for r in second if not r.get("cached")]
+    stable = all(
+        b.get("cached") and a.get("prob") == b.get("prob")
+        and a.get("key") == b.get("key")
+        for a, b in zip(first, second) if a["id"] != edited
+    )
+    compiles_after = engine.stats.compiles - compiles0
+    ok = bool(
+        all("prob" in r for r in first)
+        and len(misses) == 1
+        and misses[0]["id"] == edited
+        and misses[0].get("featurized")
+        and stable
+        and compiles_after == 0
+    )
+    return {
+        "smoke": n, "ok": ok,
+        "first_misses": sum(1 for r in first if not r.get("cached")),
+        "rescan_misses": len(misses),
+        "changed_only_refeaturized":
+            len(misses) == 1 and misses[0]["id"] == edited,
+        "untouched_verdicts_stable": stable,
+        "compiles_after_warmup": compiles_after,
+        "scan": snap,
+    }
+
+
+def cmd_scan(args) -> Dict[str, Any]:
+    """Offline scan sweep (deepdfa_tpu/scan): raw C source files ->
+    pooled persistent Joern workers -> on-demand featurize -> the warmed
+    serve engine, with the incremental content-hash verdict cache.
+    Targets are files, directories (every ``*.c`` under them), or
+    ``--diff FILE`` (a unified diff — the post-image ``.c`` paths are the
+    work-list, the PR-diff mode). With a persistent ``--scan-cache``, a
+    re-sweep after a one-line edit re-analyzes ~one function.
+
+    ``--smoke N`` is the hermetic self-test on the fake-Joern transport
+    (no JVM, single device): seeded corpus, one edit, re-scan, exactly
+    the changed function re-featurized — the scripts/test.sh gate."""
+    import contextlib
+
+    from deepdfa_tpu.scan import changed_paths_from_diff
+
+    run_dir = args.run_dir or ("runs/scan_smoke"
+                               if args.smoke is not None else None)
+    scope = (telemetry.run_scope(run_dir) if run_dir
+             else contextlib.nullcontext())
+    with scope:
+        engine, model_cfg = _build_serve_engine(args)
+        engine.warmup()
+        compiles0 = engine.stats.compiles
+        if args.smoke is not None:
+            report = _scan_smoke(engine, model_cfg, args, compiles0)
+        else:
+            paths: List[str] = []
+            for target in args.targets:
+                if os.path.isdir(target):
+                    for root, _, names in sorted(os.walk(target)):
+                        paths += [os.path.join(root, x)
+                                  for x in sorted(names)
+                                  if x.endswith(".c")]
+                else:
+                    paths.append(target)
+            if args.diff:
+                text = (sys.stdin.read() if args.diff == "-"
+                        else open(args.diff, encoding="utf-8").read())
+                paths += [os.path.join(args.root, rel)
+                          for rel in changed_paths_from_diff(text)
+                          if rel.endswith(".c")]
+            if not paths:
+                raise ValueError(
+                    "scan: nothing to scan (pass files/dirs, --diff, or "
+                    "--smoke)")
+            svc = _build_scan_service(engine, model_cfg, args)
+            if svc is None:
+                raise ValueError("scan: --scan-transport none makes no "
+                                 "sense here (use 'fake' or a joern "
+                                 "binary)")
+            with svc:
+                verdicts = svc.scan_files(paths)
+                snap = svc.snapshot()
+            if args.out:
+                os.makedirs(os.path.dirname(args.out) or ".",
+                            exist_ok=True)
+                with open(args.out, "w", encoding="utf-8") as f:
+                    for r in verdicts:
+                        f.write(json.dumps(r) + "\n")
+            n_errors = sum(1 for r in verdicts if "error" in r)
+            report = {
+                "n_scanned": len(verdicts),
+                "n_errors": n_errors,
+                "cache_hits":
+                    sum(1 for r in verdicts if r.get("cached")),
+                "compiles_after_warmup":
+                    engine.stats.compiles - compiles0,
+                "scan": snap,
+                "results": verdicts if not args.out else None,
+                "out": args.out,
+                # A poisoned function is an inline error and costs
+                # itself; a sweep where NOTHING scored (e.g. no usable
+                # Joern — every worker dead) must not exit 0, or a CI
+                # gate passes while zero functions were analyzed.
+                "ok": n_errors < len(verdicts),
+            }
+    if run_dir:
+        report["telemetry"] = os.path.join(run_dir, "telemetry")
+    if not report.get("ok", True):
+        report["exit_code"] = 1
     print(json.dumps(report))
     return report
 
@@ -1179,13 +1382,14 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
 
 
 def cmd_chaos(args) -> Dict[str, Any]:
-    """Chaos soak (deepdfa_tpu/resilience): provoke seven fault classes —
+    """Chaos soak (deepdfa_tpu/resilience): provoke eight fault classes —
     simulated preemption, NaN loss, checkpoint corruption, ETL item
-    failure, serving flush failure, corrupt-corpus poisoning, and a
+    failure, serving flush failure, corrupt-corpus poisoning, a
     mid-epoch kill under async checkpointing resumed on a different
-    device count — against a tiny synthetic workload and verify every
-    recovery contract, including the bit-for-bit kill-and-resume
-    determinism gate. Exits nonzero on any miss.
+    device count, and pooled Joern workers killed mid-scan — against a
+    tiny synthetic workload and verify every recovery contract,
+    including the bit-for-bit kill-and-resume determinism gate. Exits
+    nonzero on any miss.
 
     (Custom fault plans don't belong here — the soak's scenarios arm
     their own; arm ``DEEPDFA_FAULT_PLAN`` against a regular command
@@ -1609,6 +1813,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.add_argument("--cache-capacity", type=int, default=4096,
                        help="content-hash result cache entries (0 = off)")
 
+    # Streaming scan: the raw-source edge (deepdfa_tpu/scan). Shared by
+    # `serve` (attaches POST /scan) and `scan` (offline sweeps). Env
+    # knobs override the defaults so a deployment can size the pool
+    # without re-plumbing flags (README "Streaming scan service").
+    def scan_knobs(p, default_transport):
+        p.add_argument(
+            "--scan-transport",
+            default=os.environ.get("DEEPDFA_SCAN_TRANSPORT",
+                                   default_transport),
+            help="CPG transport: 'fake' (hermetic scripted subprocess — "
+                 "no JVM, the tier-1/smoke transport), 'none' (serve "
+                 "only: POST /scan answers 501), or a joern binary "
+                 "name/path (env DEEPDFA_SCAN_TRANSPORT)")
+        p.add_argument("--scan-pool-size", type=int,
+                       default=int(os.environ.get("DEEPDFA_SCAN_POOL",
+                                                  "2")),
+                       help="persistent Joern workers (env "
+                            "DEEPDFA_SCAN_POOL)")
+        p.add_argument("--scan-timeout-s", type=float, default=120.0,
+                       help="per-REPL-command read deadline; a hung "
+                            "Joern is restarted when it fires")
+        p.add_argument("--scan-attempts", type=int, default=3,
+                       help="tries per function (session restart "
+                            "between) before the item fails typed")
+        p.add_argument("--scan-workdir", default="runs/scan",
+                       help="scan scratch: function files, Joern "
+                            "workspaces, quarantine, default cache")
+        p.add_argument("--scan-cache", default=None, metavar="FILE",
+                       help="persistent verdict cache JSONL (default "
+                            "<scan-workdir>/verdicts.jsonl); re-scans "
+                            "hit it across restarts")
+
     p_srv = sub.add_parser(
         "serve", help="HTTP scoring endpoint: deadline-aware bucketed "
                       "micro-batching over AOT-warmed shapes")
@@ -1642,7 +1878,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "(post-warmup recompiles, p99) with a nonzero "
                             "exit")
     serve_knobs(p_srv)
+    scan_knobs(p_srv, default_transport="none")
     p_srv.set_defaults(func=cmd_serve)
+
+    p_scan = sub.add_parser(
+        "scan", help="streaming scan: raw C source -> pooled persistent "
+                     "Joern -> DDFA verdicts through the warmed serving "
+                     "engine, with incremental content-hash caching")
+    p_scan.add_argument("targets", nargs="*",
+                        help="files and/or directories (every *.c under "
+                             "a directory, recursively)")
+    p_scan.add_argument("--diff", default=None, metavar="FILE",
+                        help="unified diff ('-' = stdin): scan its "
+                             "post-image .c paths (the PR-diff mode)")
+    p_scan.add_argument("--root", default=".",
+                        help="prefix for --diff paths")
+    p_scan.add_argument("--config", action="append", default=[])
+    p_scan.add_argument("--set", action="append", default=[],
+                        metavar="S.K=V")
+    p_scan.add_argument("--checkpoint-dir", default=None,
+                        help="cli fit run dir (omit for random-init "
+                             "smoke mode)")
+    p_scan.add_argument("--which", default="best")
+    p_scan.add_argument("--combined-checkpoint-dir", default=None,
+                        help="fit-text combined linevul run dir: scores "
+                             "through the DDFA+LineVul lane")
+    p_scan.add_argument("--combined-which", default="best")
+    p_scan.add_argument("--out", default=None, metavar="FILE",
+                        help="write per-function verdicts JSONL here "
+                             "instead of inlining them in the report")
+    p_scan.add_argument("--smoke", type=int, nargs="?", const=6,
+                        default=None, metavar="N",
+                        help="hermetic self-test (fake-Joern): seeded "
+                             "N-function corpus, one edit, re-scan, "
+                             "exactly the changed function re-featurized "
+                             "(the scripts/test.sh gate)")
+    p_scan.add_argument("--seed", type=int, default=0,
+                        help="--smoke corpus seed")
+    p_scan.add_argument("--run-dir", default=None,
+                        help="telemetry sink (--smoke defaults to "
+                             "runs/scan_smoke)")
+    serve_knobs(p_scan)
+    scan_knobs(p_scan, default_transport="joern")
+    p_scan.set_defaults(func=cmd_scan)
 
     p_sc = sub.add_parser(
         "score", help="offline batch client of the serving path (cache + "

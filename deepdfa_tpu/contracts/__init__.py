@@ -29,6 +29,7 @@ from deepdfa_tpu.contracts.schema import (
     validate_example,
     validate_joern_edges,
     validate_joern_nodes,
+    validate_scan_source,
 )
 
 __all__ = [
@@ -47,5 +48,6 @@ __all__ = [
     "validate_example",
     "validate_joern_edges",
     "validate_joern_nodes",
+    "validate_scan_source",
     "write_examples_jsonl",
 ]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import threading
 from pathlib import Path
 from typing import Dict, List
 
@@ -44,6 +45,11 @@ class Quarantine:
         self.root = Path(root)
         self.counts: collections.Counter = collections.Counter()
         self._ordinal = 0
+        # One sink may be fed from concurrent transport threads (the
+        # serve HTTP server handles each POST /scan on its own thread);
+        # ordinal assignment + the two appends must stay one atom or the
+        # manifest<->items ordinal join breaks.
+        self._lock = threading.Lock()
 
     @property
     def manifest_path(self) -> Path:
@@ -57,26 +63,28 @@ class Quarantine:
         """Record one violation. ``raw``: the offending payload as read
         (a JSONL line string or a structured item); defaults to the
         error's own fragment."""
-        self.root.mkdir(parents=True, exist_ok=True)
-        entry = {
-            "ordinal": self._ordinal,
-            "item_id": error.item_id,
-            "boundary": error.boundary,
-            "reason": error.reason,
-            "message": str(error),
-            "fragment": error.fragment,
-        }
-        with open(self.manifest_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(entry) + "\n")
-        with open(self.items_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps({
+        with self._lock:
+            self.root.mkdir(parents=True, exist_ok=True)
+            entry = {
                 "ordinal": self._ordinal,
                 "item_id": error.item_id,
-                "raw": raw if isinstance(raw, str) else fragment_of(
-                    raw if raw is not None else error.fragment, limit=4096),
-            }) + "\n")
-        self._ordinal += 1
-        self.counts[error.reason] += 1
+                "boundary": error.boundary,
+                "reason": error.reason,
+                "message": str(error),
+                "fragment": error.fragment,
+            }
+            with open(self.manifest_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(entry) + "\n")
+            with open(self.items_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps({
+                    "ordinal": self._ordinal,
+                    "item_id": error.item_id,
+                    "raw": raw if isinstance(raw, str) else fragment_of(
+                        raw if raw is not None else error.fragment,
+                        limit=4096),
+                }) + "\n")
+            self._ordinal += 1
+            self.counts[error.reason] += 1
         # Trace-visible quarantine: the run report counts these from
         # events.jsonl alone (import deferred — contracts stays importable
         # standalone; the hook is a no-op without an active run).

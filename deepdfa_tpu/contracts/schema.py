@@ -59,6 +59,8 @@ REASONS: Dict[str, str] = {
     "label_domain": "fatal",       # label / vuln bit outside {0, 1}
     "duplicate_node_id": "fatal",  # node id repeats in an export
     "no_method_node": "fatal",     # Joern graph without a METHOD node
+    "bad_source": "fatal",         # scan source text fails the API contract
+    "joern_failure": "fatal",      # CPG extraction gave up after retries
     "float_field": "repairable",   # integral floats / bools cast back exactly
 }
 
@@ -559,3 +561,67 @@ def validate_cache_row(row, *, boundary: str = "cache", item_id=None,
     if stats is not None:
         stats.bump(boundary, "valid")
     return dict(row)
+
+
+# ---------------------------------------------------------------------------
+# The scan-source contract (the POST /scan API edge, where attacker-
+# controlled raw C source enters the pipeline)
+# ---------------------------------------------------------------------------
+
+
+#: Upper bound on one scan item's source text. Single functions are a few
+#: KB; a megabyte of "function" is either a mistake or an attempt to feed
+#: the Joern pool unbounded work.
+MAX_SOURCE_BYTES = 262_144
+
+
+def validate_scan_source(source, *, boundary: str = "scan", item_id=None,
+                         max_bytes: int = MAX_SOURCE_BYTES,
+                         stats: Optional[IngestStats] = None) -> str:
+    """Validate one raw-source scan item (reason code ``bad_source``).
+
+    The source must be a non-empty text string, free of NUL bytes (Joern
+    reads it back from a file; an embedded NUL truncates what the parser
+    sees vs. what was hashed), decodable to UTF-8, and bounded in size —
+    the scan cache keys and the Joern pool's per-item budget both assume
+    function-sized inputs. Returns the source unchanged.
+    """
+    if stats is not None:
+        stats.bump(boundary, "seen")
+    try:
+        if not isinstance(source, str):
+            raise ContractError(
+                "bad_source",
+                f"scan source is {type(source).__name__}, expected a string",
+                boundary=boundary, item_id=item_id,
+                fragment=fragment_of(source))
+        if not source.strip():
+            raise ContractError(
+                "bad_source", "scan source is empty",
+                boundary=boundary, item_id=item_id)
+        if "\x00" in source:
+            raise ContractError(
+                "bad_source", "scan source contains NUL bytes",
+                boundary=boundary, item_id=item_id,
+                fragment=fragment_of(source[:64]))
+        try:
+            size = len(source.encode("utf-8"))
+        except UnicodeEncodeError as e:
+            raise ContractError(
+                "bad_source", f"scan source is not encodable: {e}",
+                boundary=boundary, item_id=item_id,
+                fragment=fragment_of(source[:64]))
+        if size > max_bytes:
+            raise ContractError(
+                "bad_source",
+                f"scan source is {size} bytes > cap {max_bytes}",
+                boundary=boundary, item_id=item_id,
+                fragment=fragment_of(source[:64]))
+    except ContractError as e:
+        if stats is not None:
+            stats.bump(boundary, "rejected")
+            stats.bump(boundary, f"reason:{e.reason}")
+        raise
+    if stats is not None:
+        stats.bump(boundary, "valid")
+    return source

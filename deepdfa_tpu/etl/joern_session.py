@@ -21,7 +21,7 @@ import shutil
 import subprocess
 import time
 from pathlib import Path
-from typing import Callable, List, Mapping, Optional
+from typing import Callable, List, Mapping, Optional, Sequence
 
 from deepdfa_tpu import telemetry
 from deepdfa_tpu.resilience import inject
@@ -42,6 +42,27 @@ def joern_available() -> bool:
     return shutil.which("joern") is not None
 
 
+def resolve_command(binary) -> List[str]:
+    """Normalize a session ``binary`` — a PATH name, an executable path, or
+    a full argv list (the scan layer's hermetic fake transport runs as
+    ``[sys.executable, fake_joern.py]``) — to the Popen argv. Raises the
+    historic "not found" RuntimeError when the executable is missing, so
+    callers keep one failure mode."""
+    argv = [str(binary)] if isinstance(binary, (str, Path)) else \
+        [str(part) for part in binary]
+    if not argv:
+        raise RuntimeError("empty joern command")
+    exe = argv[0]
+    if shutil.which(exe) is None and not os.path.exists(exe):
+        raise RuntimeError(
+            f"joern binary not found on PATH ({exe!r}); install Joern "
+            "v1.1.107 (reference scripts/install_joern.sh) to run CPG "
+            "extraction, or pass a transport command (e.g. the hermetic "
+            "fake-Joern: deepdfa_tpu.scan.fake_joern.fake_joern_command())"
+        )
+    return argv
+
+
 def shesc(value: str) -> str:
     """Escape a string for interpolation into a Scala string literal
     (joern_session.py:11-30)."""
@@ -56,13 +77,9 @@ class JoernSession:
         worker_id: int = 0,
         workspace_root: str | Path = "joern_workspaces",
         timeout_s: float = 600.0,
-        binary: str = "joern",
+        binary: "str | Sequence[str]" = "joern",
     ):
-        if not joern_available():
-            raise RuntimeError(
-                "joern binary not found on PATH; install Joern v1.1.107 "
-                "(reference scripts/install_joern.sh) to run CPG extraction"
-            )
+        argv = resolve_command(binary)
         self.timeout_s = timeout_s
         self.worker_id = worker_id
         self.workspace = Path(workspace_root) / f"worker_{worker_id}"
@@ -72,7 +89,7 @@ class JoernSession:
         self._master, slave = pty.openpty()
         try:
             self._proc = subprocess.Popen(
-                [binary],
+                argv,
                 stdin=slave,
                 stdout=slave,
                 stderr=slave,
@@ -149,6 +166,12 @@ class JoernSession:
 
     def import_code(self, path: str | Path) -> str:
         return self.send(f'importCode("{shesc(str(path))}")')
+
+    def alive(self) -> bool:
+        """Non-invasive liveness: has the child exited? (The scan pool's
+        cheap health check — a protocol-level probe would race the worker
+        thread that owns this REPL.)"""
+        return self._proc.poll() is None
 
     def close(self) -> None:
         try:

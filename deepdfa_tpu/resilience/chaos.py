@@ -1,7 +1,7 @@
 """Chaos soak: the ``cli chaos`` engine.
 
 One deterministic end-to-end run that provokes every fault class the
-resilience layer claims to survive (five distinct fault kinds — the
+resilience layer claims to survive (eight distinct fault kinds — the
 acceptance gate asks for >= 3) and verifies the recovery behavior, on a
 tiny synthetic workload sized for seconds on CPU:
 
@@ -34,6 +34,12 @@ tiny synthetic workload sized for seconds on CPU:
   fallback order, the recorded layout driving a reshard, and the loss
   curve continuing bit-for-bit (same shard count) or within the
   documented tolerance (across a reshape).
+* ``scan_joern_deaths`` — pooled Joern workers killed and hung mid-scan
+  (on the hermetic fake transport) while one function is a deterministic
+  quarantine poison: the sweep completes with every healthy function
+  scored, the poison is reason-coded in an exact manifest, restarts and
+  retries are asserted from the run's trace, and the warmed serving
+  executables survive untouched.
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -357,6 +363,7 @@ def scenario_elastic_resume(out_dir: str, n_examples: int,
       packing) across a reshape.
     """
     import math
+    import shutil
     import time
 
     import jax
@@ -377,6 +384,10 @@ def scenario_elastic_resume(out_dir: str, n_examples: int,
     examples, splits = _dataset(n_examples)
     labels = [int(ex["label"]) for ex in examples]
     ckpt_dir = os.path.join(out_dir, "elastic")
+    # The scenario asserts the torn `best` never survives; a snapshot dir
+    # left by a previous soak in the same out_dir would hand it an intact
+    # prior `best` and fail that check, so start from a clean slate.
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
     cfg = TrainConfig(max_epochs=epochs, learning_rate=2e-3, seed=0)
     walls: Dict[str, float] = {}
 
@@ -496,6 +507,142 @@ def scenario_elastic_resume(out_dir: str, n_examples: int,
     }
 
 
+def scenario_scan_joern_deaths(out_dir: str) -> Dict[str, Any]:
+    """The streaming-scan availability scenario (ISSUE 8): pooled Joern
+    workers are killed AND hung mid-sweep (faults injected at the REPL
+    protocol site, on the hermetic fake transport — no JVM), while one
+    function is a deterministic poison whose export has no METHOD node.
+    Demands:
+
+    * the sweep **completes**: every healthy function scores (a dead or
+      hung Joern costs one session restart and a re-run of its item,
+      never the pool, never the sweep);
+    * the poisoned function lands in the scan quarantine under its exact
+      reason code, with the manifest exact (one entry, zero false
+      quarantines) and an inline error verdict — not an aborted POST;
+    * restart/retry/quarantine totals are asserted from the run's
+      **trace** (events.jsonl via the report summarizer), not from
+      in-process state alone — the observability substrate must tell the
+      same story the pool counters do;
+    * the warmed serve engine's compile count stays flat: worker deaths
+      in L0 never invalidate the scoring executables.
+    """
+    import json as _json
+    import shutil
+    import tempfile
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.contracts import read_manifest
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.scan import ScanConfig, ScanService, fake_joern_command
+    from deepdfa_tpu.scan.fake_joern import POISON_TOKEN, seeded_sources
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.telemetry.report import events_path_of, summarize
+
+    def trace_totals():
+        """Retry/fault/quarantine totals from the active run's events so
+        far (None when untraced — DEEPDFA_TELEMETRY=0 runs the scenario
+        on its functional checks alone)."""
+        run = telemetry.current_run()
+        if run is None or not telemetry.enabled():
+            return None
+        telemetry.flush()
+        path = events_path_of(run.run_dir)
+        events = []
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                events = [_json.loads(line) for line in f if line.strip()]
+        rep = summarize(events)
+        return {"retries": rep["retries"],
+                "fault_total": rep["faults"]["total"],
+                "joern_faults":
+                    rep["faults"]["by_site"].get("joern.send", 0),
+                "quarantined": rep["quarantined"]}
+
+    config = ServeConfig(batch_slots=4)
+    model = FlowGNN(TINY)
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config)
+    engine.warmup()
+    compiles0 = engine.stats.compiles
+
+    sources = seeded_sources(8, seed=5)
+    items = [{"id": i, "source": s} for i, s in enumerate(sources)]
+    items.insert(3, {"id": "poison",
+                     "source": f"int bad(void) {{ {POISON_TOKEN}; }}\n"})
+
+    # One killed JVM and one hung REPL, mid-protocol (each item is two
+    # REPL commands, so ordinals 3 and 9 land inside the sweep).
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "joern.send", "kind": "kill", "at": 3},
+        {"site": "joern.send", "kind": "hang", "at": 9},
+    ]})
+
+    before = trace_totals()
+    tmp = tempfile.mkdtemp(prefix="chaos_scan_")
+    try:
+        with ScanService(
+            engine, TINY.feature, workdir=tmp,
+            config=ScanConfig(pool_size=2, timeout_s=60.0, attempts=3),
+            command=fake_joern_command(),
+        ) as svc:
+            with inject.armed(plan):
+                verdicts = svc.scan_sources(items)
+            restarts = svc.pool.restarts
+            alive = svc.pool.alive_workers
+            manifest = read_manifest(svc.quarantine.root)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    after = trace_totals()
+
+    by_id = {r["id"]: r for r in verdicts}
+    healthy_scored = all("prob" in by_id[i] for i in range(len(sources)))
+    poison = by_id.get("poison", {})
+    poison_quarantined = (
+        poison.get("error") == "no_method_node"
+        and len(manifest) == 1
+        and manifest[0].get("reason") == "no_method_node"
+    )
+    fired = {(s["site"], s["kind"]): s["fired"] for s in plan.report()}
+    both_fired = (fired.get(("joern.send", "kill")) == 1
+                  and fired.get(("joern.send", "hang")) == 1)
+
+    if before is not None and after is not None:
+        trace_ok = (
+            after["joern_faults"] - before["joern_faults"] == 2
+            # Each session-fatal fault is one retry of its item.
+            and after["retries"] - before["retries"] == 2
+            and after["quarantined"] - before["quarantined"] == 1
+        )
+    else:
+        trace_ok = None  # untraced run: functional checks only
+
+    ok = bool(
+        healthy_scored
+        and poison_quarantined
+        and both_fired
+        and restarts == 2            # one restart per injected death
+        and alive == 2               # the pool is whole again
+        and engine.stats.compiles == compiles0
+        and trace_ok is not False
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["kill", "hang"],
+        "n_functions": len(items),
+        "healthy_scored": healthy_scored,
+        "poison_quarantined": poison_quarantined,
+        "manifest_entries": len(manifest),
+        "pool_restarts": restarts,
+        "pool_alive": alive,
+        "compiles_flat": engine.stats.compiles == compiles0,
+        "trace_totals_ok": trace_ok,
+        "trace_delta": (None if before is None or after is None else
+                        {k: after[k] - before[k] for k in after}),
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -513,6 +660,7 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
         out_dir, n_examples, epochs)
     scenarios["elastic_resume"] = scenario_elastic_resume(
         out_dir, n_examples, epochs)
+    scenarios["scan_joern_deaths"] = scenario_scan_joern_deaths(out_dir)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
@@ -520,7 +668,8 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
                "etl_retry": "etl-item-raise",
                "serve_flush_fault": "serve-batch-raise",
                "poison_corpus": "data-corrupt",
-               "elastic_resume": "elastic-reshape"}
+               "elastic_resume": "elastic-reshape",
+               "scan_joern_deaths": "joern-worker-kill"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

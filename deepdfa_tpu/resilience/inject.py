@@ -155,9 +155,16 @@ class FaultPlan:
     """A parsed plan plus its per-spec runtime counters."""
 
     def __init__(self, faults: Sequence[FaultSpec], seed: int = 0):
+        import threading
+
         self.faults = list(faults)
         self.seed = seed
         self.rng = random.Random(seed)
+        # Sites may fire from concurrent threads (the scan layer's pooled
+        # Joern workers all pass through ``joern.send``); the counter
+        # read-modify-write must be exact or an ``at`` spec can double-
+        # fire or skip. Actions (sleep/raise) stay outside the lock.
+        self._lock = threading.Lock()
 
     @classmethod
     def from_doc(cls, doc: Dict) -> "FaultPlan":
@@ -188,24 +195,27 @@ class FaultPlan:
         """Advance counters; raise any matching ``raise``/``hang`` fault,
         return the other matching specs for the caller to act on."""
         hits: List[FaultSpec] = []
-        for spec in self.faults:
-            if spec.site != site or spec.exhausted():
-                continue
-            want_name = spec.name
-            if want_name is not None and ctx.get("name") != want_name:
-                continue
-            idx = index if index is not None else spec.seen
-            spec.seen += 1
-            if spec.matches(idx, self.rng):
-                spec.fired += 1
-                hits.append(spec)
-                # Every fired fault is a first-class trace event BEFORE it
-                # acts (a `raise` fault must still appear in events.jsonl)
-                # — the chaos-coverage gate matches these on site + seed.
-                from deepdfa_tpu import telemetry
+        with self._lock:
+            for spec in self.faults:
+                if spec.site != site or spec.exhausted():
+                    continue
+                want_name = spec.name
+                if want_name is not None and ctx.get("name") != want_name:
+                    continue
+                idx = index if index is not None else spec.seen
+                spec.seen += 1
+                if spec.matches(idx, self.rng):
+                    spec.fired += 1
+                    hits.append(spec)
+                    # Every fired fault is a first-class trace event BEFORE
+                    # it acts (a `raise` fault must still appear in
+                    # events.jsonl) — the chaos-coverage gate matches these
+                    # on site + seed.
+                    from deepdfa_tpu import telemetry
 
-                telemetry.event("fault.fired", site=site, kind=spec.kind,
-                                index=idx, seed=self.seed)
+                    telemetry.event("fault.fired", site=site,
+                                    kind=spec.kind, index=idx,
+                                    seed=self.seed)
         for spec in hits:
             if spec.kind == "delay":
                 # Pure latency: the site's work still runs — afterwards,

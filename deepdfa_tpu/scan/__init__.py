@@ -1,0 +1,41 @@
+"""Streaming scan service: raw C source -> pooled Joern -> DDFA verdicts.
+
+The subsystem that closes the loop a real user hits (ISSUE 8 / ROADMAP
+"End-to-end streaming scan service"):
+
+* :mod:`~deepdfa_tpu.scan.pool` — N pooled persistent Joern sessions
+  with health-checking, per-item deadlines, retry-with-restart, and a
+  typed give-up when every worker is gone;
+* :mod:`~deepdfa_tpu.scan.cache` — the incremental verdict cache keyed
+  by normalized function content hash (checksummed-JSONL persistence);
+* :mod:`~deepdfa_tpu.scan.featurize` — on-demand CPG -> abstract-
+  dataflow features for a single function, shaped for the warmed serve
+  engine (zero new compiles after warmup);
+* :mod:`~deepdfa_tpu.scan.service` — the composition behind
+  ``POST /scan`` and ``cli scan``;
+* :mod:`~deepdfa_tpu.scan.fake_joern` — the hermetic fake-Joern
+  transport (a scripted subprocess speaking the real session protocol),
+  so every tier-1 test and the smoke path run without a JVM.
+"""
+
+from deepdfa_tpu.scan.cache import ScanCache, normalize_source, source_key
+from deepdfa_tpu.scan.fake_joern import fake_joern_command, seeded_sources
+from deepdfa_tpu.scan.pool import JoernPool, PoolExhaustedError
+from deepdfa_tpu.scan.service import (
+    ScanConfig,
+    ScanService,
+    changed_paths_from_diff,
+)
+
+__all__ = [
+    "JoernPool",
+    "PoolExhaustedError",
+    "ScanCache",
+    "ScanConfig",
+    "ScanService",
+    "changed_paths_from_diff",
+    "fake_joern_command",
+    "normalize_source",
+    "seeded_sources",
+    "source_key",
+]

@@ -1,0 +1,178 @@
+"""Incremental scan cache: normalized-content hash -> verdict.
+
+The property the streaming scan service sells: a PR-diff scan
+re-analyzes only *changed* functions, and a whole-repo re-sweep after a
+one-line edit costs ~one Joern invocation. Keys are content hashes of
+the **normalized** source text (:func:`normalize_source` — the rule is
+documented in the README and must never drift silently: CRLF→LF,
+per-line trailing whitespace stripped, leading/trailing blank lines
+dropped, exactly one trailing newline), so formatting-only churn that
+the parser cannot see does not defeat the cache, while any token change
+does.
+
+Persistence follows the ``etl/cache.py`` checksummed-JSONL discipline:
+append-only rows carrying a per-row ``__sha1__`` digest, read back
+through ``contracts.validate_cache_row`` with skip-and-count — a torn or
+bit-rotted row costs that row (quarantined into the cache's
+``quarantine/`` sibling), never the store. Verdict values hold only
+content-derived fields (prob, model, key), mirroring the serve result
+cache's rule: per-request metadata must never ride a shared cache line.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def normalize_source(source: str) -> str:
+    """THE cache-key normalization rule (README "Streaming scan
+    service"): CRLF/CR to LF, trailing whitespace stripped per line,
+    leading/trailing blank lines dropped, one trailing newline."""
+    lines = [line.rstrip()
+             for line in source.replace("\r\n", "\n").replace("\r", "\n")
+             .split("\n")]
+    while lines and not lines[0]:
+        lines.pop(0)
+    while lines and not lines[-1]:
+        lines.pop()
+    return "\n".join(lines) + "\n"
+
+
+def source_key(source: str) -> str:
+    """Stable digest of one function's normalized source text."""
+    return hashlib.blake2b(normalize_source(source).encode("utf-8"),
+                           digest_size=16).hexdigest()
+
+
+class ScanCache:
+    """Thread-safe LRU of ``source_key -> verdict`` with optional
+    checksummed-JSONL persistence.
+
+    ``path=None`` keeps the cache in-memory (tests, one-shot sweeps);
+    with a path, rows append on every put and load back last-wins, so a
+    restarted scan service resumes warm. ``capacity`` bounds memory; the
+    on-disk log is append-only (compaction is a re-write of live
+    entries, done only at :meth:`compact`).
+    """
+
+    def __init__(self, path: "str | Path | None" = None,
+                 capacity: int = 65536):
+        self.path = Path(path) if path is not None else None
+        self.capacity = int(capacity)
+        self._entries: "OrderedDict[str, Dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.loaded_rows = 0
+        self.corrupt_rows = 0
+        if self.path is not None and self.path.exists():
+            self._load()
+
+    def _load(self) -> None:
+        from deepdfa_tpu.contracts import ContractError, Quarantine
+        from deepdfa_tpu.contracts.quarantine import quarantine_dir
+        from deepdfa_tpu.contracts.schema import validate_cache_row
+
+        sink: Optional[Quarantine] = None
+
+        def quarantine(err: ContractError, raw) -> None:
+            nonlocal sink
+            if sink is None:
+                sink = Quarantine(quarantine_dir(self.path))
+            sink.put(err, raw=raw)
+
+        with open(self.path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                try:
+                    row = validate_cache_row(json.loads(line),
+                                             boundary="scan_cache",
+                                             item_id=i)
+                    key = row["key"]
+                    verdict = row["verdict"]
+                    if not isinstance(key, str) \
+                            or not isinstance(verdict, dict):
+                        raise ContractError(
+                            "mistyped_field",
+                            "scan cache row lacks key/verdict",
+                            boundary="scan_cache", item_id=i)
+                except json.JSONDecodeError as e:
+                    self.corrupt_rows += 1
+                    quarantine(ContractError(
+                        "truncated_json", f"row {i}: {e}",
+                        boundary="scan_cache", item_id=i), raw=line)
+                    continue
+                except (ContractError, KeyError) as e:
+                    self.corrupt_rows += 1
+                    err = e if isinstance(e, ContractError) else \
+                        ContractError("missing_field",
+                                      f"scan cache row {i}: missing {e}",
+                                      boundary="scan_cache", item_id=i)
+                    quarantine(err, raw=line)
+                    continue
+                self._entries[key] = verdict
+                self._entries.move_to_end(key)
+                self.loaded_rows += 1
+        self._evict()
+        if self.corrupt_rows:
+            logger.warning("scan cache %s: %d corrupt row(s) quarantined",
+                           self.path, self.corrupt_rows)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: str) -> Optional[Dict]:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+            return value
+
+    def put(self, key: str, verdict: Dict) -> None:
+        from deepdfa_tpu.contracts.schema import CHECKSUM_KEY, row_checksum
+
+        with self._lock:
+            self._entries[key] = verdict
+            self._entries.move_to_end(key)
+            self._evict()
+        if self.path is None:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        row = {"key": key, "verdict": verdict}
+        row[CHECKSUM_KEY] = row_checksum(row)
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(row) + "\n")
+
+    def _evict(self) -> None:
+        # caller holds the lock
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def compact(self) -> int:
+        """Rewrite the log to the live entries only (atomic rename);
+        returns rows written."""
+        from deepdfa_tpu.contracts.schema import CHECKSUM_KEY, row_checksum
+
+        if self.path is None:
+            return 0
+        import os
+
+        with self._lock:
+            items = list(self._entries.items())
+            tmp = self.path.with_suffix(".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                for key, verdict in items:
+                    row = {"key": key, "verdict": verdict}
+                    row[CHECKSUM_KEY] = row_checksum(row)
+                    f.write(json.dumps(row) + "\n")
+            os.replace(tmp, self.path)
+        return len(items)

@@ -1,0 +1,248 @@
+"""Hermetic fake-Joern transport: a scripted subprocess speaking the real
+session protocol.
+
+The streaming scan service drives CPG extraction through
+:class:`~deepdfa_tpu.etl.joern_session.JoernSession` — a pty REPL that
+expects a ``joern>`` prompt, an ``import $file.`...``` line, and a
+``<stem>.exec(filename="...")`` call that leaves ``<file>.nodes.json`` /
+``<file>.edges.json`` next to the input. This module is a stdlib-only
+stand-in for the JVM side of that conversation: spawned as a child
+process (``fake_joern_command()``), it answers the same protocol and
+emits a *canned but content-derived* CPG, so every tier-1 test, the
+``cli scan --smoke`` path, and the chaos soak run the full pool /
+session / retry / cache machinery with no Joern install, single-device,
+in milliseconds per function.
+
+Determinism: the emitted graph is a pure function of the source text
+(same bytes -> same nodes/edges -> same features -> same verdict), which
+is what makes the incremental-cache headline test exact. Two scripted
+behaviors support fault testing without a fault plan:
+
+* a source containing :data:`POISON_TOKEN` exports a graph with no
+  METHOD node — the ingestion contract quarantines it deterministically
+  (reason ``no_method_node``);
+* ``FAKE_JOERN_STARTUP_FAIL=1`` in the environment makes the child exit
+  before printing its first prompt — the all-workers-dead scenario.
+
+IMPORTANT: this file must stay importable/runnable with the stdlib alone
+(it is executed by *path*, never via ``-m``), so the child process never
+pays the package/jax import cost — session startup is what the pool
+tests time against their deadlines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+PROMPT = "joern>"
+
+#: Magic token: a "function" carrying it exports a METHOD-less graph (the
+#: deterministic quarantine victim for chaos/death scenarios).
+POISON_TOKEN = "__JOERN_POISON__"
+
+# The fake keeps graphs comfortably inside the serve admission caps
+# (ServeConfig.max_nodes_per_graph=64 at 3 nodes per statement + METHOD).
+MAX_STATEMENTS = 12
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_EXEC_RE = re.compile(r'\.exec\(\s*filename="((?:[^"\\]|\\.)*)"')
+
+# Deterministic type assignment: hash of the statement's first identifier
+# picks from a tiny C-type palette, so edits that rename variables move
+# the datatype feature too (content-sensitive features, fixed vocab).
+_TYPES = ("int", "char *", "size_t", "float")
+
+
+def stable_hash(text: str) -> int:
+    """hashlib-free FNV-1a: stable across processes and PYTHONHASHSEED.
+
+    Shared with :mod:`~deepdfa_tpu.scan.featurize` (hashing-vocab bucket
+    assignment) — the one content-hash both sides of the fake transport
+    derive from. It lives here, not there, because this file must stay
+    importable with the stdlib alone (it runs as the child by path).
+    """
+    h = 2166136261
+    for b in text.encode("utf-8", "replace"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def fake_cpg(source: str) -> Tuple[List[Dict], List[List]]:
+    """(nodes_json, edges_json) in the Joern v1.1.107 export shape.
+
+    One METHOD node plus, per statement line (non-empty, not brace-only,
+    capped at :data:`MAX_STATEMENTS`), an assignment CALL with an
+    IDENTIFIER and a LITERAL child — enough structure for the abstract-
+    dataflow feature miner (``etl.absdf``) to produce per-node features
+    that vary with the text. Edge rows are ``[inNode, outNode, label,
+    ""]`` (TinkerPop order, exactly what ``etl.cpg.from_joern_json``
+    parses).
+    """
+    lines = source.splitlines()
+    poisoned = POISON_TOKEN in source
+
+    def node(nid, label, name="", code="", line=None, order=0, tfn=""):
+        return {"id": nid, "_label": label, "name": name, "code": code,
+                "lineNumber": line, "order": order, "typeFullName": tfn}
+
+    def edge(src, dst, etype):
+        return [dst, src, etype, ""]
+
+    nodes: List[Dict] = []
+    edges: List[List] = []
+
+    first_line = lines[0].strip() if lines else "int fn(void)"
+    m = _IDENT_RE.search(first_line.split("(")[0].split()[-1]
+                         if first_line.split("(")[0].split() else "fn")
+    method_name = m.group(0) if m else "fn"
+    if not poisoned:
+        nodes.append(node(1, "METHOD", name=method_name, code=first_line,
+                          line=1))
+
+    stmts: List[int] = []
+    nid = 10
+    for i, raw in enumerate(lines, start=1):
+        text = raw.strip()
+        if not text or text in ("{", "}", "};"):
+            continue
+        if len(stmts) >= MAX_STATEMENTS:
+            break
+        idents = _IDENT_RE.findall(text)
+        var = idents[0] if idents else f"v{i}"
+        lit = str(stable_hash(text) % 997)
+        call, ident, literal = nid, nid + 1, nid + 2
+        nid += 10
+        nodes.append(node(call, "CALL", name="<operator>.assignment",
+                          code=text, line=i))
+        nodes.append(node(ident, "IDENTIFIER", name=var, code=var, line=i,
+                          order=1, tfn=_TYPES[stable_hash(var) % len(_TYPES)]))
+        nodes.append(node(literal, "LITERAL", name=lit, code=lit, line=i,
+                          order=2))
+        edges.append(edge(call, ident, "AST"))
+        edges.append(edge(call, literal, "AST"))
+        edges.append(edge(call, ident, "ARGUMENT"))
+        edges.append(edge(call, literal, "ARGUMENT"))
+        if not poisoned:
+            edges.append(edge(1, call, "AST"))
+        stmts.append(call)
+
+    if not stmts:
+        # Whitespace/brace-only bodies still need one statement so the
+        # exported graph batches (empty graphs fail the example contract).
+        call, ident, literal = 10, 11, 12
+        nodes.append(node(call, "CALL", name="<operator>.assignment",
+                          code="x = 0", line=1))
+        nodes.append(node(ident, "IDENTIFIER", name="x", code="x", line=1,
+                          order=1, tfn="int"))
+        nodes.append(node(literal, "LITERAL", name="0", code="0", line=1,
+                          order=2))
+        edges += [edge(call, ident, "AST"), edge(call, literal, "AST"),
+                  edge(call, ident, "ARGUMENT"),
+                  edge(call, literal, "ARGUMENT")]
+        if not poisoned:
+            edges.append(edge(1, call, "AST"))
+        stmts = [call]
+
+    prev = 1 if not poisoned else None
+    for call in stmts:
+        if prev is not None:
+            edges.append(edge(prev, call, "CFG"))
+            if prev != 1:
+                edges.append(edge(prev, call, "REACHING_DEF"))
+        prev = call
+    return nodes, edges
+
+
+def export_file(filename: str) -> int:
+    """Write ``<filename>.nodes.json``/``.edges.json`` from the file's
+    text; returns the node count (the REPL's reply payload)."""
+    with open(filename, encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    nodes, edges = fake_cpg(source)
+    with open(filename + ".nodes.json", "w", encoding="utf-8") as f:
+        json.dump(nodes, f)
+    with open(filename + ".edges.json", "w", encoding="utf-8") as f:
+        json.dump(edges, f)
+    return len(nodes)
+
+
+def seeded_sources(n: int, seed: int = 0) -> List[str]:
+    """A deterministic mini-corpus of single-function C sources — the
+    seeded corpus behind ``cli scan --smoke``, the bench scan lane, and
+    the replay harness's edit/repeat traffic mix."""
+    import random
+
+    rng = random.Random(seed)
+    out: List[str] = []
+    for i in range(n):
+        n_stmts = rng.randint(2, 6)
+        body = [f"int fn_{seed}_{i}(int a, char *p) {{"]
+        for s in range(n_stmts):
+            var = rng.choice(("x", "y", "len", "count", "acc"))
+            body.append(f"  int {var}_{s} = a + {rng.randint(0, 99)};")
+        body.append(f"  return {rng.randint(0, 9)};")
+        body.append("}")
+        out.append("\n".join(body) + "\n")
+    return out
+
+
+def edit_source(source: str, salt: int = 1) -> str:
+    """A deterministic one-line edit (the PR-diff shape: one changed
+    function) that changes the content hash AND the canned graph."""
+    lines = source.splitlines()
+    for i, line in enumerate(lines):
+        if "=" in line:
+            lines[i] = line.rstrip(";") + f" + {1000 + salt};"
+            break
+    else:
+        lines.insert(len(lines) - 1 if lines else 0,
+                     f"  int edited = {1000 + salt};")
+    return "\n".join(lines) + "\n"
+
+
+def fake_joern_command() -> List[str]:
+    """The argv that spawns this module as the session child — by file
+    path, so the subprocess never imports the package (or jax)."""
+    return [sys.executable, os.path.abspath(__file__)]
+
+
+def main() -> int:
+    if os.environ.get("FAKE_JOERN_STARTUP_FAIL"):
+        # The pool's "factory keeps failing" scenario: die before the
+        # first prompt so session construction raises.
+        sys.stderr.write("fake-joern: injected startup failure\n")
+        return 3
+    die_after = int(os.environ.get("FAKE_JOERN_DIE_AFTER", "0"))
+    sys.stdout.write("fake joern v0 (hermetic transport)\n")
+    sys.stdout.write(PROMPT + " ")
+    sys.stdout.flush()
+    exports = 0
+    for line in sys.stdin:
+        line = line.strip()
+        if line == "exit":
+            break
+        m = _EXEC_RE.search(line)
+        if m:
+            filename = m.group(1).replace('\\"', '"').replace("\\\\", "\\")
+            try:
+                n = export_file(filename)
+                sys.stdout.write(f"exported {n} nodes\n")
+            except OSError as e:
+                sys.stdout.write(f"export failed: {e}\n")
+            exports += 1
+            if die_after and exports >= die_after:
+                # Mid-protocol death: exit WITHOUT a prompt — the driver
+                # sees EOF (JoernDiedError), exactly like a crashed JVM.
+                sys.stdout.flush()
+                return 4
+        sys.stdout.write(PROMPT + " ")
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

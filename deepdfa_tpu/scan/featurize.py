@@ -1,0 +1,94 @@
+"""On-demand featurization: one Joern export -> one serve-ready graph.
+
+The offline ETL builds abstract-dataflow vocabularies over a whole train
+split (``etl/absdf.build_all_vocabs``) and exports a corpus; the scan
+path needs the same CPG -> features transform for a *single* function,
+milliseconds after Joern produced its export, shaped exactly like a
+``POST /score`` graph so the warmed serve engine scores it with zero new
+compiles.
+
+Vocabulary: the ETL export stage does not persist its vocabs (ROADMAP
+notes this as remaining work for checkpoint-faithful scan verdicts), so
+the scan path ships a **deterministic hashing vocabulary** with the same
+index contract (0 = not a definition, 1 = reserved UNKNOWN, else
+``2 + stable_hash % limit_all`` — always inside the model's
+``input_dim == limit_all + 2`` embedding table). Hashing is
+content-derived and process-independent (FNV over the canonical feature
+hash string, never Python's seeded ``hash``), which is what makes scan
+verdicts reproducible across service restarts — the incremental-cache
+headline property.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.etl.absdf import (
+    SINGLE_SUBKEYS,
+    extract_decl_features,
+    node_feature_indices,
+    node_subkey_values,
+)
+from deepdfa_tpu.etl.cpg import CPG, load_joern_export, reduce_graph
+from deepdfa_tpu.scan.fake_joern import stable_hash
+
+
+@dataclasses.dataclass(frozen=True)
+class HashingDataflowVocab:
+    """Drop-in for ``AbstractDataflowVocab`` (same ``index_for``
+    contract) that needs no train split: feature hashes map to a stable
+    bucket in ``[2, limit_all + 1]``."""
+
+    subkey: str
+    limit_all: int
+
+    def index_for(self, fields) -> int:
+        if not fields:
+            return 0  # not a definition — the per-node zero-set contract
+        values = node_subkey_values(fields, self.subkey)
+        if self.subkey in SINGLE_SUBKEYS:
+            values = values[:1]
+        canon = json.dumps({self.subkey: sorted(set(values))})
+        return 2 + stable_hash(canon) % max(self.limit_all, 1)
+
+
+def hashing_vocabs(subkeys: Sequence[str],
+                   limit_all: int) -> Dict[str, HashingDataflowVocab]:
+    return {sk: HashingDataflowVocab(sk, limit_all) for sk in subkeys}
+
+
+def featurize_cpg(cpg: CPG, vocabs: Mapping, gtype: str = "cfg") -> Dict:
+    """CPG -> the serve-admission graph shape (``num_nodes`` / ``senders``
+    / ``receivers`` / ``feats``), dense-indexed by sorted Joern id like
+    ``etl/export.cpg_to_example`` — but WITHOUT label/line fields: a scan
+    request has no ground truth, and the serve contract
+    (``contracts.validate_example(with_label=False)``) is the gate it
+    must pass next."""
+    node_ids = sorted(cpg.nodes)
+    dense = {nid: i for i, nid in enumerate(node_ids)}
+    edges = reduce_graph(cpg, gtype).edges
+    features = extract_decl_features(cpg)
+    feats = {
+        subkey: np.asarray(idxs, np.int64)
+        for subkey, idxs in node_feature_indices(cpg, features,
+                                                 vocabs).items()
+    }
+    return {
+        "num_nodes": len(node_ids),
+        "senders": np.asarray([dense[s] for s, _, _ in edges], np.int32),
+        "receivers": np.asarray([dense[d] for _, d, _ in edges], np.int32),
+        "feats": feats,
+    }
+
+
+def featurize_export(stem: "str | Path", vocabs: Mapping,
+                     gtype: str = "cfg") -> Dict:
+    """``<stem>.nodes.json``/``.edges.json`` (a pool worker's output) ->
+    serve-ready graph. Raises ``ContractError``/``JSONDecodeError`` on a
+    malformed export — the scan service quarantines those per item."""
+    return featurize_cpg(load_joern_export(stem), vocabs, gtype=gtype)

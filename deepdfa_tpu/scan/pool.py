@@ -1,0 +1,350 @@
+"""Pooled persistent Joern workers for the streaming scan service.
+
+Generalizes the one-REPL-per-ETL-worker driver
+(``etl/joern_session.extract_cpg_batch``) into a long-lived pool: N
+worker threads, each owning one persistent :class:`JoernSession` (real
+JVM or the hermetic fake transport — same protocol), draining a shared
+work queue of single-function ``.c`` files. The pool holds the scan
+service's availability invariants:
+
+* **A killed Joern costs one restart, never the pool.** Each item runs
+  under ``core/retry`` (jittered backoff, per-item attempt cap); a dead
+  JVM (:class:`JoernDiedError`) or a hung REPL (the session read
+  deadline's ``TimeoutError``) restarts that worker's session between
+  attempts and re-runs the item on the fresh one.
+* **Per-item wall deadline.** Futures are waited with a budget derived
+  from the session timeout and attempt cap, so a pathological item can
+  never wedge a caller — it resolves to a typed failure instead.
+* **Typed give-up when the pool is gone.** A worker whose session
+  *factory* fails (binary vanished, startup crash-loop) dies and hands
+  its item to a surviving worker; when the last worker dies, everything
+  still queued resolves to :class:`PoolExhaustedError` — partial results
+  plus typed failures, never a hang.
+
+Fault sites: items fire ``scan.item`` before dispatch, and every REPL
+command inside the session fires the existing ``joern.send`` site — the
+``kill``/``hang`` fault kinds drive the restart/deadline paths without a
+real JVM. Restarts count into the shared registry
+(``scan_pool_restarts_total``) and emit ``scan.pool_restart`` events;
+per-item work is a ``scan.joern`` span.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.retry import GiveUp, RetryPolicy, retry_call
+from deepdfa_tpu.etl.joern_session import JoernDiedError, JoernSession
+from deepdfa_tpu.resilience import inject
+
+logger = logging.getLogger(__name__)
+
+_EXPORT_SCRIPT = (Path(__file__).parent.parent / "etl" / "scripts"
+                  / "export_cpg.sc")
+_SESSION_FATAL = (TimeoutError, JoernDiedError, OSError)
+
+
+class PoolExhaustedError(RuntimeError):
+    """Every pooled worker is dead (session factory keeps failing): the
+    typed give-up for items the pool can no longer run."""
+
+
+class _WorkerDeath(Exception):
+    """Internal: the session FACTORY failed — the worker cannot continue.
+    Distinct from an item failure (which costs the item, not the worker)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(f"session factory failed: {cause}")
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class _Job:
+    path: Path
+    future: Future
+    index: int
+    requeues: int = 0
+
+
+class JoernPool:
+    """N persistent Joern sessions behind one work queue.
+
+    ``session_factory(worker_id, workspace_root)`` builds one session
+    (default: :class:`JoernSession` on ``command`` — pass
+    ``fake_joern_command()`` for the hermetic transport). ``submit``
+    returns a Future resolving to the export stem (the ``.c`` path whose
+    ``.nodes.json``/``.edges.json`` now exist) or failing with the
+    terminal error. Thread-safe: transport threads may submit
+    concurrently.
+    """
+
+    def __init__(
+        self,
+        size: int = 2,
+        command: "str | Sequence[str]" = "joern",
+        session_factory: Optional[Callable[..., JoernSession]] = None,
+        workspace_root: "str | Path" = "runs/scan_ws",
+        timeout_s: float = 120.0,
+        attempts: int = 3,
+        script: "str | Path" = _EXPORT_SCRIPT,
+    ):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        self.size = size
+        self.timeout_s = timeout_s
+        self.attempts = max(int(attempts), 1)
+        self.script = Path(script)
+        self.workspace_root = Path(workspace_root)
+        self._factory = session_factory or (
+            lambda wid, root: JoernSession(wid, root, timeout_s=timeout_s,
+                                           binary=command)
+        )
+        self._queue: "queue.Queue[Optional[_Job]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._alive = 0
+        self._closed = False
+        self._restarts = 0
+        self._sessions: Dict[int, Optional[JoernSession]] = {}
+        self._threads: List[threading.Thread] = []
+        self._item_ordinal = 0
+        for wid in range(size):
+            t = threading.Thread(target=self._worker, args=(wid,),
+                                 name=f"joern-pool-{wid}", daemon=True)
+            self._alive += 1
+            self._threads.append(t)
+            t.start()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def alive_workers(self) -> int:
+        with self._lock:
+            return self._alive
+
+    @property
+    def restarts(self) -> int:
+        with self._lock:
+            return self._restarts
+
+    def health(self) -> List[bool]:
+        """Per-worker liveness: the worker thread runs AND its current
+        session's child process has not exited (workers with no session
+        yet — lazy start — count as healthy). Non-invasive by design: a
+        protocol-level probe would race the owning worker thread."""
+        out: List[bool] = []
+        with self._lock:
+            for wid, thread in enumerate(self._threads):
+                session = self._sessions.get(wid)
+                up = thread.is_alive() and (
+                    session is None or _session_alive(session))
+                out.append(up)
+        return out
+
+    def item_deadline_s(self) -> float:
+        """Wall budget for one item: every attempt may burn the session
+        read deadline, plus restart/backoff slack."""
+        return self.attempts * (self.timeout_s + 5.0) + 15.0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, path: "str | Path") -> Future:
+        """Queue one ``.c`` file for export; resolves to its Path."""
+        future: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("pool is closed")
+            dead = self._alive == 0
+            index = self._item_ordinal
+            self._item_ordinal += 1
+        if dead:
+            future.set_exception(PoolExhaustedError(
+                "all pooled Joern workers are dead"))
+            return future
+        self._queue.put(_Job(Path(path), future, index))
+        return future
+
+    def extract(self, paths: Sequence["str | Path"],
+                ) -> List["Path | BaseException"]:
+        """Run a batch through the pool; one entry per input, in order —
+        the Path on success, the terminal exception on failure. Bounded:
+        every wait carries the per-item deadline, so a wedged pool
+        surfaces as typed timeouts, not a hang."""
+        futures = [self.submit(p) for p in paths]
+        out: List["Path | BaseException"] = []
+        deadline = self.item_deadline_s()
+        for fut in futures:
+            try:
+                out.append(fut.result(timeout=deadline))
+            except BaseException as exc:  # typed per-item failure
+                out.append(exc)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for _ in self._threads:
+            self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=self.timeout_s + 10.0)
+        with self._lock:
+            leftovers = list(self._sessions.values())
+            self._sessions.clear()
+        for session in leftovers:
+            if session is not None:
+                try:
+                    session.close()
+                except Exception:
+                    logger.warning("pool: session close failed",
+                                   exc_info=True)
+
+    def __enter__(self) -> "JoernPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker internals ----------------------------------------------------
+
+    def _new_session(self, wid: int) -> JoernSession:
+        try:
+            session = self._factory(wid, self.workspace_root)
+        except Exception as exc:
+            raise _WorkerDeath(exc) from exc
+        with self._lock:
+            self._sessions[wid] = session
+        return session
+
+    def _drop_session(self, wid: int) -> None:
+        with self._lock:
+            session = self._sessions.pop(wid, None)
+        if session is not None:
+            try:
+                session.close()
+            except Exception:
+                logger.warning("pool worker %d: close of the dead session "
+                               "failed", wid, exc_info=True)
+
+    def _restart(self, wid: int, exc: BaseException) -> None:
+        """Replace a dead/hung session (raises :class:`_WorkerDeath` when
+        the factory itself fails — the worker-death path)."""
+        logger.warning("pool worker %d: %s: %s — restarting the session",
+                       wid, type(exc).__name__, exc)
+        self._drop_session(wid)
+        self._new_session(wid)
+        with self._lock:
+            self._restarts += 1
+        telemetry.REGISTRY.counter("scan_pool_restarts_total").inc()
+        telemetry.event("scan.pool_restart", worker=wid,
+                        error=type(exc).__name__)
+
+    def _run_item(self, wid: int, job: _Job) -> Path:
+        with self._lock:
+            session = self._sessions.get(wid)
+        if session is None:
+            session = self._new_session(wid)
+        session.run_script(self.script,
+                           {"filename": str(job.path.resolve())})
+        nodes = job.path.with_suffix(job.path.suffix + ".nodes.json")
+        if not nodes.exists():
+            raise RuntimeError(f"export produced no {nodes.name}")
+        return job.path
+
+    def _worker(self, wid: int) -> None:
+        policy = RetryPolicy(max_attempts=self.attempts, base_delay_s=0.05,
+                             retry_on=_SESSION_FATAL,
+                             giveup_on=(_WorkerDeath,))
+        while True:
+            job = self._queue.get()
+            if job is None:
+                break
+            if job.future.cancelled():
+                continue
+            try:
+                # Fault site: per-item hook, index = global submission
+                # ordinal (position-derived, so plans replay across pool
+                # sizes). A `hang` here surfaces as the item's failure.
+                inject.fire("scan.item", index=job.index)
+                with telemetry.span("scan.joern", worker=wid,
+                                    item=job.path.name):
+                    result = retry_call(
+                        self._run_item, (wid, job), policy=policy,
+                        on_retry=lambda a, e, d: self._restart(wid, e))
+                job.future.set_result(result)
+            except _WorkerDeath as death:
+                self._die(wid, job, death)
+                return
+            except GiveUp as exc:
+                job.future.set_exception(exc)
+                if isinstance(exc.last, _SESSION_FATAL):
+                    # retry_call only restarts BETWEEN attempts: the final
+                    # failure leaves the corpse in the slot and must not
+                    # poison the next item's budget.
+                    try:
+                        self._restart(wid, exc.last)
+                    except _WorkerDeath as death:
+                        self._die(wid, None, death)
+                        return
+            except Exception as exc:  # per-item fault tolerance
+                job.future.set_exception(exc)
+        self._drop_session(wid)
+        self._retire()
+
+    def _die(self, wid: int, job: Optional[_Job],
+             death: _WorkerDeath) -> None:
+        """Session factory failed: retire this worker, hand its item to a
+        survivor (or fail it typed when none remain)."""
+        logger.error("pool worker %d: dying (%s)", wid, death)
+        telemetry.event("scan.pool_worker_dead", worker=wid,
+                        error=type(death.cause).__name__)
+        self._drop_session(wid)
+        with self._lock:
+            self._alive -= 1
+            survivors = self._alive > 0
+        if job is not None and not job.future.done():
+            if survivors and job.requeues < self.size:
+                job.requeues += 1
+                self._queue.put(job)
+            else:
+                job.future.set_exception(PoolExhaustedError(
+                    f"all pooled Joern workers are dead "
+                    f"(last factory error: {death.cause})"))
+        if not survivors:
+            self._drain_dead()
+
+    def _retire(self) -> None:
+        """Clean shutdown bookkeeping (sentinel path)."""
+        with self._lock:
+            self._alive -= 1
+            last = self._alive == 0
+        if last:
+            self._drain_dead()
+
+    def _drain_dead(self) -> None:
+        """No workers remain: everything still queued resolves typed."""
+        while True:
+            try:
+                job = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if job is not None and not job.future.done():
+                job.future.set_exception(PoolExhaustedError(
+                    "all pooled Joern workers are dead"))
+
+
+def _session_alive(session) -> bool:
+    probe = getattr(session, "alive", None)
+    if probe is None:
+        return True  # test doubles without a child process
+    try:
+        return bool(probe())
+    except Exception:
+        return False

@@ -1,0 +1,312 @@
+"""The streaming scan service: raw C source -> DDFA verdict.
+
+Closes the loop the ROADMAP names as the missing scenario: POST raw
+source (or sweep files offline), extract a CPG through the pooled
+persistent Joern workers (:mod:`~deepdfa_tpu.scan.pool`), featurize on
+demand (:mod:`~deepdfa_tpu.scan.featurize`), and score through the
+existing warmed serve engine — zero new compiles after warmup, because
+the scan path reuses the engine's ``(lane, slot-bucket)`` executables
+unchanged.
+
+Contracts at every boundary: the source text itself is validated at the
+API edge (``contracts.validate_scan_source`` — attacker-controlled input
+enters here), Joern exports pass the Joern ingestion contract inside
+``featurize_export``, and the featurized graph passes the serve
+admission contract inside ``engine.submit``. Anything that fails lands
+in the scan quarantine (reason-coded manifest) and comes back as an
+inline error verdict — one poisoned function never aborts a sweep.
+
+Incrementality: verdicts cache by normalized content hash
+(:mod:`~deepdfa_tpu.scan.cache`), so a re-scan after a one-line edit
+re-runs Joern for exactly the changed function. Cache hits/misses, pool
+restarts, and featurize counts publish into the shared registry;
+``scan.request`` / ``scan.joern`` / ``scan.featurize`` / ``scan.score``
+spans thread the run trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from deepdfa_tpu import contracts, telemetry
+from deepdfa_tpu.contracts.schema import MAX_SOURCE_BYTES
+from deepdfa_tpu.scan.cache import ScanCache, normalize_source, source_key
+from deepdfa_tpu.scan.featurize import featurize_export, hashing_vocabs
+from deepdfa_tpu.scan.pool import JoernPool
+from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
+from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanConfig:
+    pool_size: int = 2          # persistent Joern workers
+    timeout_s: float = 120.0    # per-REPL-command read deadline
+    attempts: int = 3           # per-item tries (restart between)
+    gtype: str = "cfg"          # graph reduction fed to the model
+    max_source_bytes: int = MAX_SOURCE_BYTES
+    cache_capacity: int = 65536
+
+    def __post_init__(self):
+        if self.pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
+
+
+def changed_paths_from_diff(diff_text: str) -> List[str]:
+    """Post-image file paths named by a unified diff (``+++ b/...``
+    lines; ``/dev/null`` — deletions — skipped). The PR-diff scan's
+    work-list: scan only what changed."""
+    out: List[str] = []
+    for line in diff_text.splitlines():
+        if not line.startswith("+++ "):
+            continue
+        target = line[4:].split("\t")[0].strip()
+        if target in ("/dev/null", ""):
+            continue
+        if target.startswith(("a/", "b/")):
+            target = target[2:]
+        if target not in out:
+            out.append(target)
+    return out
+
+
+class ScanService:
+    """Pool + cache + featurize + warmed engine, behind one call.
+
+    ``engine`` must already be constructed (and ideally warmed);
+    ``feature`` is the graph model's FeatureSpec — it sizes the hashing
+    vocabulary to the embedding table. ``command``/``session_factory``
+    pick the transport (real ``joern`` or
+    ``fake_joern.fake_joern_command()``); tests may inject a prebuilt
+    ``pool``.
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        feature,
+        workdir: "str | Path" = "runs/scan",
+        config: Optional[ScanConfig] = None,
+        command: "str | Sequence[str]" = "joern",
+        session_factory=None,
+        pool: Optional[JoernPool] = None,
+        cache: Optional[ScanCache] = None,
+        cache_path: "str | Path | None" = None,
+    ):
+        self.engine = engine
+        self.config = config or ScanConfig()
+        self.workdir = Path(workdir)
+        (self.workdir / "functions").mkdir(parents=True, exist_ok=True)
+        self.pool = pool or JoernPool(
+            size=self.config.pool_size, command=command,
+            session_factory=session_factory,
+            workspace_root=self.workdir / "ws",
+            timeout_s=self.config.timeout_s,
+            attempts=self.config.attempts,
+        )
+        if cache is None and cache_path is None:
+            cache_path = self.workdir / "verdicts.jsonl"
+        self.cache = cache or ScanCache(cache_path,
+                                        capacity=self.config.cache_capacity)
+        self.quarantine = contracts.Quarantine(self.workdir / "quarantine")
+        self.vocabs = hashing_vocabs(engine.required_subkeys,
+                                     feature.limit_all)
+
+    # -- metrics -------------------------------------------------------------
+
+    @staticmethod
+    def _count(name: str, by: int = 1) -> None:
+        telemetry.REGISTRY.counter(name).inc(by)
+
+    def snapshot(self) -> Dict[str, Any]:
+        reg = telemetry.REGISTRY
+        return {
+            "cache_entries": len(self.cache),
+            "cache_hits": reg.counter("scan_cache_hits_total").value,
+            "cache_misses": reg.counter("scan_cache_misses_total").value,
+            "featurized": reg.counter("scan_featurized_total").value,
+            "errors": reg.counter("scan_errors_total").value,
+            "pool_restarts": self.pool.restarts,
+            "pool_alive": self.pool.alive_workers,
+            "pool_health": self.pool.health(),
+            "quarantined": self.quarantine.total,
+        }
+
+    # -- the scan ------------------------------------------------------------
+
+    def scan_sources(self, items: Sequence[Mapping], *,
+                     wait: str = "drain") -> List[Dict]:
+        """Score a batch of raw-source items, returning one verdict per
+        item in order.
+
+        Items are ``{"id"?: any, "source": str}``. ``wait="drain"`` is
+        the offline mode (this thread pumps the engine);
+        ``wait="event"`` is the transport mode (an external pump thread
+        flushes; this thread blocks on each request's event with a
+        bounded timeout). Verdicts are ``{"id", "key", "prob", "model",
+        "cached", "featurized"}`` or inline ``{"id", "error", "detail"}``
+        — a bad item costs itself, never the sweep.
+        """
+        results: List[Optional[Dict]] = [None] * len(items)
+        pending: List[Tuple[int, Any, str, Path, float]] = []
+        for i, item in enumerate(items):
+            item_id = item.get("id", i) if isinstance(item, Mapping) else i
+            raw = item.get("source") if isinstance(item, Mapping) else item
+            t0 = telemetry.now()
+            try:
+                source = contracts.validate_scan_source(
+                    raw, item_id=item_id,
+                    max_bytes=self.config.max_source_bytes,
+                    stats=contracts.STATS)
+            except contracts.ContractError as e:
+                results[i] = self._fail(item_id, e, raw, t0)
+                continue
+            key = source_key(source)
+            cached = self.cache.get(key)
+            if cached is not None:
+                self._count("scan_cache_hits_total")
+                results[i] = {"id": item_id, "key": key, **cached,
+                              "cached": True, "featurized": False}
+                telemetry.record_span("scan.request", t0, id=str(item_id),
+                                      cached=True)
+                continue
+            self._count("scan_cache_misses_total")
+            path = self.workdir / "functions" / f"{key}.c"
+            path.write_text(normalize_source(source), encoding="utf-8")
+            pending.append((i, item_id, key, path, t0))
+
+        outcomes = self.pool.extract([p for _, _, _, p, _ in pending]) \
+            if pending else []
+
+        scored: List[Tuple[int, Any, str, float, Any]] = []
+        for (i, item_id, key, path, t0), outcome in zip(pending, outcomes):
+            if isinstance(outcome, BaseException):
+                err = contracts.ContractError(
+                    "joern_failure",
+                    f"CPG extraction failed: {type(outcome).__name__}: "
+                    f"{outcome}",
+                    boundary="scan", item_id=item_id)
+                results[i] = self._fail(item_id, err, key, t0)
+                continue
+            try:
+                with telemetry.span("scan.featurize", item=key):
+                    graph = featurize_export(path, self.vocabs,
+                                             gtype=self.config.gtype)
+                self._count("scan_featurized_total")
+                req = self._submit(graph, wait)
+            except contracts.ContractError as e:
+                results[i] = self._fail(item_id, e, key, t0)
+                continue
+            except (BadRequestError, OversizedError, RejectedError,
+                    ValueError) as e:
+                err = contracts.ContractError(
+                    "joern_failure",
+                    f"featurized graph not admissible: "
+                    f"{type(e).__name__}: {e}",
+                    boundary="scan", item_id=item_id)
+                results[i] = self._fail(item_id, err, key, t0)
+                continue
+            scored.append((i, item_id, key, t0, req))
+
+        # The .c files and their Joern exports are one-shot featurize
+        # inputs; the verdict cache (and, for bad items, the quarantine's
+        # raw payload) is the durable artifact. A long-lived serve fed
+        # attacker-controlled sources must not grow workdir/functions
+        # without bound. Deduped: same-source items share one path.
+        for path in {p for _, _, _, p, _ in pending}:
+            self._discard_scratch(path)
+
+        with telemetry.span("scan.score", n=len(scored)):
+            if scored and wait == "drain":
+                self.engine.drain()
+            for i, item_id, key, t0, req in scored:
+                results[i] = self._collect(item_id, key, t0, req, wait)
+        return [r for r in results if r is not None]
+
+    def _submit(self, graph: Dict, wait: str):
+        try:
+            return self.engine.submit(graph)
+        except RejectedError as e:
+            # Offline: drain and retry (nowhere to shed load to).
+            # Transport mode: the pump thread is flushing — wait out one
+            # flush window and retry once.
+            if wait == "drain":
+                self.engine.drain()
+            else:
+                time.sleep(max(e.retry_after_s, 0.01))
+            return self.engine.submit(graph)
+
+    def _collect(self, item_id, key: str, t0: float, req, wait: str) -> Dict:
+        if wait != "drain":
+            wait_s = self.engine.config.deadline_ms / 1000.0 * 10 + 30.0
+            req.event.wait(timeout=wait_s)
+        res = req.result
+        if res is None or "error" in (res or {}):
+            self._count("scan_errors_total")
+            detail = (res or {}).get("detail", "scoring timed out")
+            telemetry.record_span("scan.request", t0, id=str(item_id),
+                                  cached=False, error="internal")
+            return {"id": item_id, "key": key, "error": "internal",
+                    "detail": detail}
+        verdict = {"prob": res["prob"], "model": res["model"]}
+        self.cache.put(key, verdict)
+        telemetry.record_span("scan.request", t0, id=str(item_id),
+                              cached=False)
+        return {"id": item_id, "key": key, **verdict, "cached": False,
+                "featurized": True}
+
+    @staticmethod
+    def _discard_scratch(path: Path) -> None:
+        for p in (path, Path(f"{path}.nodes.json"),
+                  Path(f"{path}.edges.json")):
+            try:
+                p.unlink(missing_ok=True)
+            except OSError:
+                pass
+
+    def _fail(self, item_id, err: contracts.ContractError, raw,
+              t0: float) -> Dict:
+        self._count("scan_errors_total")
+        self.quarantine.put(err, raw=raw)
+        logger.warning("scan: item %r quarantined (%s: %s)", item_id,
+                       err.reason, err)
+        telemetry.record_span("scan.request", t0, id=str(item_id),
+                              cached=False, error=err.reason)
+        return {"id": item_id, "error": err.reason, "detail": str(err)}
+
+    # -- offline sweep helpers (cli scan) ------------------------------------
+
+    def scan_files(self, paths: Sequence["str | Path"], *,
+                   wait: str = "drain") -> List[Dict]:
+        """One verdict per file — each file is one function's source (the
+        ETL ``prepare`` layout: functions/<id>.c). Unreadable files come
+        back as inline errors without aborting the sweep."""
+        slots: List[Optional[Dict]] = []
+        items: List[Dict] = []
+        for p in paths:
+            p = Path(p)
+            try:
+                items.append({"id": str(p),
+                              "source": p.read_text(encoding="utf-8",
+                                                    errors="replace")})
+                slots.append(None)
+            except OSError as e:
+                self._count("scan_errors_total")
+                slots.append({"id": str(p), "error": "bad_source",
+                              "detail": f"unreadable: {e}"})
+        verdicts = iter(self.scan_sources(items, wait=wait))
+        return [next(verdicts) if pre is None else pre for pre in slots]
+
+    def close(self) -> None:
+        self.pool.close()
+
+    def __enter__(self) -> "ScanService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

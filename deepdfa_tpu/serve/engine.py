@@ -163,6 +163,14 @@ class ServeEngine:
     def now(self) -> float:
         return self._clock()
 
+    @property
+    def required_subkeys(self) -> List[str]:
+        """Union of every lane's feature subkeys — the feats a request
+        graph must carry (shared by serve admission and the scan
+        featurizer, so the two surfaces cannot drift)."""
+        return sorted({k for lane in self._lanes.values()
+                       for k in lane.subkeys})
+
     # -- bucket shapes -----------------------------------------------------
 
     @property
@@ -248,8 +256,7 @@ class ServeEngine:
         rejections); the validator reproduces the historic 400
         message classes byte-for-byte, asserted by the regression test in
         tests/test_serve.py."""
-        union = sorted({k for lane in self._lanes.values()
-                        for k in lane.subkeys})
+        union = self.required_subkeys
         try:
             return contracts.validate_example(graph, union,
                                               with_label=False,

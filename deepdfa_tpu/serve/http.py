@@ -14,9 +14,20 @@ Contract (documented in README "Serving"):
       -> 500 {"results": [{"error": "internal", ...}, ...]} when every
          function in the POST died in a failed micro-batch (engine flush
          isolation: only that flush fails; the queue keeps draining)
+  POST /scan   (when a scan service is attached — `cli serve --scan-*`)
+      {"functions": [{"id"?, "source": "<raw C function text>"}, ...]}
+      -> 200 {"results": [{"id", "key", "prob", "model", "cached",
+              "featurized"} | {"id", "error", "detail"}, ...]}
+      -> 400 {"error": "bad_request", "detail"} on a malformed envelope
+      -> 501 {"error": "scan_unavailable"} with no scan service attached
+      Raw source is the attacker-controlled edge: each item passes
+      contracts.validate_scan_source before touching the Joern pool, and
+      per-item failures (bad source, Joern give-up, inadmissible graph)
+      come back inline — one poisoned function never fails the POST.
   GET /metrics   -> ServingStats snapshot (queue depth, occupancy,
                     p50/p99 latency, cache hit rate, compile count)
-  GET /healthz   -> {"status": "ok", "warm_buckets": N}
+  GET /healthz   -> {"status": "ok", "warm_buckets": N} (+ scan pool
+                    health when a scan service is attached)
 
 Transport threads (ThreadingHTTPServer, one per connection) submit into
 the engine and block on each request's event; a single pump thread owns
@@ -188,6 +199,17 @@ class ServeHandler(BaseHTTPRequestHandler):
                     # An SLO burning degrades health: orchestrators see a
                     # failing check while the process keeps serving.
                     doc["status"] = "degraded"
+            scan = self.server.scan_service
+            if scan is not None:
+                health = scan.pool.health()
+                doc["scan_pool"] = {"alive": scan.pool.alive_workers,
+                                    "size": scan.pool.size,
+                                    "healthy": sum(health),
+                                    "restarts": scan.pool.restarts}
+                if not any(health):
+                    # A scan service with zero live Joern workers cannot
+                    # do its job: degraded, while /score keeps serving.
+                    doc["status"] = "degraded"
             if SAMPLER.supported:
                 doc["device_bytes_in_use"] = telemetry.REGISTRY.gauge(
                     "device_bytes_in_use").value
@@ -213,6 +235,9 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": "not_found"})
 
     def do_POST(self) -> None:
+        if self.path == "/scan":
+            self._do_scan()
+            return
         if self.path != "/score":
             self._send_json(404, {"error": "not_found"})
             return
@@ -289,15 +314,48 @@ class ServeHandler(BaseHTTPRequestHandler):
                    rids=[req.rid for req, _ in submitted[:64]])
             self._send_json(status, {"results": results})
 
+    def _do_scan(self) -> None:
+        """POST /scan: raw source in, verdicts out — the streaming scan
+        surface. The transport thread runs validation + pooled Joern +
+        featurize and blocks on scoring events; the pump thread flushes
+        the micro-batches (wait="event")."""
+        scan = self.server.scan_service
+        if scan is None:
+            self._send_json(501, {
+                "error": "scan_unavailable",
+                "detail": "no scan service attached (start serve with "
+                          "--scan-transport)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            functions = doc["functions"]
+            if not isinstance(functions, list) or not functions:
+                raise ValueError("'functions' must be a non-empty list")
+            for fn in functions:
+                if not isinstance(fn, dict) or "source" not in fn:
+                    raise ValueError(
+                        "each function must be an object with 'source'")
+        except Exception as e:
+            self._send_json(400, {"error": "bad_request", "detail": str(e)})
+            return
+        with telemetry.span("http.scan", n_functions=len(functions)) as hs:
+            results = scan.scan_sources(functions, wait="event")
+            hs.set(errors=sum(1 for r in results if "error" in r),
+                   cached=sum(1 for r in results if r.get("cached")))
+            self._send_json(200, {"results": results})
+
 
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, address: Tuple[str, int], engine: ServeEngine,
-                 slo_monitor: Optional[SLOMonitor] = None):
+                 slo_monitor: Optional[SLOMonitor] = None,
+                 scan_service=None):
         super().__init__(address, ServeHandler)
         self.engine = engine
         self.slo_monitor = slo_monitor
+        self.scan_service = scan_service
         _predeclare_metrics()
         self.pump_thread = _PumpThread(engine, slo_monitor=slo_monitor)
 
@@ -312,9 +370,11 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
 def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
                   port: int = 8080,
-                  slo_monitor: Optional[SLOMonitor] = None) -> None:
+                  slo_monitor: Optional[SLOMonitor] = None,
+                  scan_service=None) -> None:
     """Blocking entry: warm the buckets, start the pump, serve."""
-    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor)
+    server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor,
+                             scan_service=scan_service)
     server.start_pump()
     logger.info("serving on %s:%d (%d warm buckets)", host,
                 server.server_address[1], engine.n_warm)

@@ -1,12 +1,14 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering seven fault classes — simulated preemption (kill-and-resume must
+# covering eight fault classes — simulated preemption (kill-and-resume must
 # be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
 # requeue), serving flush failure (one flush fails alone), corrupt-corpus
-# quarantine, and a mid-epoch kill under ASYNC checkpointing resumed on a
-# different device count (elastic reshape). Exits nonzero on any missed
-# recovery contract — the scripts/test.sh gate.
+# quarantine, a mid-epoch kill under ASYNC checkpointing resumed on a
+# different device count (elastic reshape), and pooled Joern workers
+# killed/hung mid-scan (fake transport; retry on a fresh worker +
+# quarantine on attempt-cap, the sweep completes with an exact manifest).
+# Exits nonzero on any missed recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak
 #   bash scripts/chaos.sh --epochs 4           # deeper training scenarios

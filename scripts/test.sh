@@ -42,12 +42,19 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli validate --smoke
 # round-trip step timings, the host/device split, compile capture
 # (post-warmup compiles 0), and a valid Perfetto-loadable trace.json.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
-# Chaos soak: seven injected fault classes against a tiny run — resume
+# Scan smoke (deepdfa_tpu/scan): hermetic fake-Joern end-to-end — sweep a
+# seeded mini-corpus through the pooled-session → featurize → warmed-engine
+# path, edit ONE function, re-scan, and assert exactly the changed function
+# re-featurized (one cache miss), untouched verdicts byte-identical, and
+# zero serve-engine compiles after warmup. No JVM, single device, seconds.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli scan --smoke
+# Chaos soak: eight injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
 # serving flush isolation, corrupt-corpus quarantine+bitwise-clean
-# training, and a mid-epoch kill under async checkpointing resumed on a
-# different device count. Fails in minutes if a recovery contract
-# regressed; the eval below would never notice.
+# training, a mid-epoch kill under async checkpointing resumed on a
+# different device count, and pooled Joern workers killed/hung mid-scan
+# (retry + quarantine, the sweep still completes). Fails in minutes if a
+# recovery contract regressed; the eval below would never notice.
 bash scripts/chaos.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

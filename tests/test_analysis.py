@@ -825,6 +825,143 @@ def predeclare():
 
 
 # ---------------------------------------------------------------------------
+# GL015 subprocess-without-timeout
+# ---------------------------------------------------------------------------
+
+
+def test_gl015_communicate_without_timeout():
+    src = """
+import subprocess
+
+def run_worker(cmd):
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+    out, err = proc.communicate()
+    return out
+"""
+    found = findings_for(src, "GL015")
+    assert len(found) == 1
+    assert ".communicate()" in found[0].message
+    assert "timeout" in found[0].message
+
+
+def test_gl015_wait_without_timeout_and_attribute_receiver():
+    # The long-lived-worker shape: the child held on self, waited on with
+    # no deadline — exactly what must not reach the Joern pool.
+    src = """
+import subprocess
+
+class Worker:
+    def start(self, cmd):
+        self._proc = subprocess.Popen(cmd)
+        self._proc.wait()
+"""
+    found = findings_for(src, "GL015")
+    assert len(found) == 1
+    assert ".wait()" in found[0].message
+
+
+def test_gl015_negative_timeout_and_kill_first():
+    # timeout= bounds the wait; so does reaping an already-killed child
+    # (the joern_session.close fallback order).
+    src = """
+import subprocess
+
+def stop(cmd):
+    proc = subprocess.Popen(cmd)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+"""
+    assert "GL015" not in rules_of(src)
+
+
+def test_gl015_oneshot_run_without_timeout():
+    src = """
+import subprocess
+
+def compile_once(cmd):
+    return subprocess.run(cmd, capture_output=True)
+"""
+    found = findings_for(src, "GL015")
+    assert len(found) == 1
+    assert "subprocess.run" in found[0].message
+
+
+def test_gl015_negative_oneshot_with_timeout():
+    src = """
+import subprocess
+
+def compile_once(cmd):
+    return subprocess.run(cmd, capture_output=True, timeout=300)
+"""
+    assert "GL015" not in rules_of(src)
+
+
+def test_gl015_blocking_pipe_read_without_select():
+    src = """
+import subprocess
+
+def pump(cmd):
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            return
+"""
+    found = findings_for(src, "GL015")
+    assert len(found) == 1
+    assert "select" in found[0].message
+
+
+def test_gl015_os_read_needs_select_deadline_guard():
+    # The pty driver idiom: os.read with a select deadline loop is the
+    # documented-honest shape; the same read bare is the hazard.
+    bare = """
+import os
+import pty
+import subprocess
+
+def read_reply(cmd):
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(cmd, stdout=slave)
+    return os.read(master, 65536)
+"""
+    guarded = """
+import os
+import pty
+import select
+import subprocess
+
+def read_reply(cmd, deadline):
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(cmd, stdout=slave)
+    ready, _, _ = select.select([master], [], [], deadline)
+    if ready:
+        return os.read(master, 65536)
+    return b""
+"""
+    assert len(findings_for(bare, "GL015")) == 1
+    assert "GL015" not in rules_of(guarded)
+
+
+def test_gl015_negative_parameter_receiver_unknown_provenance():
+    # A receiver the function did not construct stays unflagged — the
+    # caller owns its lifecycle (precision over recall, the
+    # empty-baseline contract). Event/Condition .wait() never flags.
+    src = """
+import threading
+
+def join_worker(proc, gate: threading.Event):
+    gate.wait()
+    proc.wait()
+    proc.communicate()
+"""
+    assert "GL015" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -1083,8 +1220,8 @@ def test_self_check_covers_every_rule_implementation():
     from deepdfa_tpu.analysis.rules import RULES
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
-                          | {"GL010", "GL011", "GL013", "GL014"})
-    assert len(RULES) == 14
+                          | {"GL010", "GL011", "GL013", "GL014", "GL015"})
+    assert len(RULES) == 15
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

@@ -1,0 +1,406 @@
+"""Streaming scan service (deepdfa_tpu/scan): pool death/hang/exhaustion
+behavior under injected faults, the incremental content-hash cache, and
+the headline acceptance property — scan, edit one function, re-scan:
+exactly one cache miss, byte-identical verdicts for untouched functions,
+zero serve-engine compiles after warmup.
+
+Everything here runs on the hermetic fake-Joern transport (a scripted
+subprocess speaking the real session protocol — no JVM), single-device.
+The warmed engine is module-scoped (warmup compiles are the cost
+center); tests assert counter DELTAS, never absolutes, because
+telemetry.REGISTRY is process-wide.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.contracts import read_manifest
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+from deepdfa_tpu.core.retry import GiveUp
+from deepdfa_tpu.etl.joern_session import JoernSession
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.resilience import inject
+from deepdfa_tpu.scan import (
+    JoernPool,
+    PoolExhaustedError,
+    ScanCache,
+    ScanConfig,
+    ScanService,
+    changed_paths_from_diff,
+    fake_joern_command,
+    normalize_source,
+    seeded_sources,
+    source_key,
+)
+from deepdfa_tpu.scan.fake_joern import POISON_TOKEN, edit_source
+from deepdfa_tpu.serve import ServeConfig, ServeEngine
+from deepdfa_tpu.serve.engine import random_gnn_params
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+@pytest.fixture(scope="module")
+def warm_engine():
+    config = ServeConfig(batch_slots=4, deadline_ms=100.0)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config)
+    eng.warmup()
+    return eng
+
+
+def make_pool(tmp_path, size=2, timeout_s=30.0, attempts=3, **kw):
+    return JoernPool(size=size, command=fake_joern_command(),
+                     workspace_root=tmp_path / "ws", timeout_s=timeout_s,
+                     attempts=attempts, **kw)
+
+
+def write_funcs(tmp_path, sources):
+    paths = []
+    for i, src in enumerate(sources):
+        p = tmp_path / f"fn_{i}.c"
+        p.write_text(src, encoding="utf-8")
+        paths.append(p)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# cache keys: THE normalization rule
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_source_rule():
+    # CRLF -> LF, per-line trailing whitespace stripped, leading/trailing
+    # blank lines dropped, exactly one trailing newline.
+    messy = "\r\n\nint f(void) {  \r\n  return 1;\t\n}\n\n\n"
+    clean = "int f(void) {\n  return 1;\n}\n"
+    assert normalize_source(messy) == clean
+    assert source_key(messy) == source_key(clean)
+
+
+def test_source_key_sensitivity():
+    src = "int f(int a) {\n  int x = a + 1;\n  return x;\n}\n"
+    assert source_key(src) == source_key(src + "\n\n")  # formatting churn
+    assert source_key(src) != source_key(src.replace("+ 1", "+ 2"))
+
+
+def test_cache_persistence_skips_corrupt_rows(tmp_path):
+    path = tmp_path / "verdicts.jsonl"
+    cache = ScanCache(path)
+    cache.put("k1", {"prob": 0.5, "model": "gnn"})
+    cache.put("k2", {"prob": 0.7, "model": "gnn"})
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"key": "k3", "verdict": {"prob": 0.9}')  # torn row
+    reloaded = ScanCache(path)
+    assert reloaded.get("k1") == {"prob": 0.5, "model": "gnn"}
+    assert reloaded.get("k2") == {"prob": 0.7, "model": "gnn"}
+    assert len(reloaded) == 2
+    assert reloaded.corrupt_rows == 1
+    # The torn row is quarantined, not silently dropped.
+    assert read_manifest(tmp_path / "quarantine")
+
+
+def test_changed_paths_from_diff():
+    diff = """\
+--- a/src/old.c
++++ b/src/old.c
+@@ -1 +1 @@
+--- a/gone.c
++++ /dev/null
+--- /dev/null
++++ b/src/new.c
+"""
+    assert changed_paths_from_diff(diff) == ["src/old.c", "src/new.c"]
+
+
+# ---------------------------------------------------------------------------
+# pool under injected deaths (the satellite's three scenarios)
+# ---------------------------------------------------------------------------
+
+
+def test_pool_worker_killed_mid_item_reruns_on_fresh_session(tmp_path):
+    # A killed child costs one session restart and a re-run of the item,
+    # never the batch: every item still resolves to its export.
+    paths = write_funcs(tmp_path, seeded_sources(4, seed=1))
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "joern.send", "kind": "kill", "at": 3},
+    ]})
+    with make_pool(tmp_path) as pool:
+        with inject.armed(plan):
+            out = pool.extract(paths)
+        assert [r for r in out if isinstance(r, BaseException)] == []
+        assert pool.restarts == 1
+        assert pool.alive_workers == pool.size
+    for p in paths:
+        assert p.with_suffix(".c.nodes.json").exists()
+    assert plan.report()[0]["fired"] == 1
+
+
+def test_pool_worker_hung_deadline_fires_and_pool_replaces(tmp_path):
+    # A hung REPL surfaces as the read deadline's TimeoutError; the pool
+    # restarts that worker's session between attempts and the item
+    # completes on the fresh one.
+    paths = write_funcs(tmp_path, seeded_sources(3, seed=2))
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "joern.send", "kind": "hang", "at": 2},
+    ]})
+    with make_pool(tmp_path) as pool:
+        with inject.armed(plan):
+            out = pool.extract(paths)
+        assert [r for r in out if isinstance(r, BaseException)] == []
+        assert pool.restarts == 1
+        assert all(pool.health())
+
+
+def test_pool_item_gives_up_typed_after_attempt_cap(tmp_path):
+    # Every attempt hangs: the item resolves to a typed GiveUp whose last
+    # error is the deadline's TimeoutError — and the pool survives to run
+    # the next item (the post-give-up restart).
+    paths = write_funcs(tmp_path, seeded_sources(2, seed=3))
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "joern.send", "kind": "hang", "every": 1},
+    ]})
+    with make_pool(tmp_path, size=1, attempts=2) as pool:
+        with inject.armed(plan):
+            out = pool.extract([paths[0]])
+        assert isinstance(out[0], GiveUp)
+        assert isinstance(out[0].last, TimeoutError)
+        out2 = pool.extract([paths[1]])
+        assert isinstance(out2[0], Path)
+        assert pool.alive_workers == 1
+
+
+def test_pool_all_workers_dead_typed_giveup_no_hang(tmp_path, monkeypatch):
+    # Sessions die after their second export and the factory then fails
+    # (binary "vanished"): the first items succeed, the worker dies on
+    # the restart path, and everything still queued resolves to
+    # PoolExhaustedError — partial results plus typed failures, never a
+    # hang, and new submissions fail fast.
+    monkeypatch.setenv("FAKE_JOERN_DIE_AFTER", "2")
+    built = []
+
+    def factory(wid, root):
+        if built:
+            raise RuntimeError("joern binary vanished")
+        built.append(wid)
+        return JoernSession(wid, root, timeout_s=30.0,
+                            binary=fake_joern_command())
+
+    paths = write_funcs(tmp_path, seeded_sources(5, seed=4))
+    with make_pool(tmp_path, size=1, session_factory=factory) as pool:
+        out = pool.extract(paths)
+        assert isinstance(out[0], Path)  # partial results survive
+        failed = [r for r in out if isinstance(r, BaseException)]
+        assert failed and all(isinstance(r, PoolExhaustedError)
+                              for r in failed)
+        assert pool.alive_workers == 0
+        late = pool.submit(paths[0])
+        with pytest.raises(PoolExhaustedError):
+            late.result(timeout=5.0)
+
+
+def test_scan_service_all_dead_partial_results_and_manifest(
+        tmp_path, warm_engine, monkeypatch):
+    # The same exhaustion through the service: scored prefix, inline
+    # joern_failure verdicts for the rest, every failure in the
+    # quarantine manifest, compiles flat — and the sweep returns.
+    monkeypatch.setenv("FAKE_JOERN_DIE_AFTER", "2")
+    built = []
+
+    def factory(wid, root):
+        if built:
+            raise RuntimeError("joern binary vanished")
+        built.append(wid)
+        return JoernSession(wid, root, timeout_s=30.0,
+                            binary=fake_joern_command())
+
+    compiles0 = warm_engine.stats.compiles
+    sources = seeded_sources(4, seed=6)
+    with ScanService(
+        warm_engine, TINY.feature, workdir=tmp_path,
+        config=ScanConfig(pool_size=1, timeout_s=30.0),
+        session_factory=factory,
+    ) as svc:
+        verdicts = svc.scan_sources(
+            [{"id": i, "source": s} for i, s in enumerate(sources)])
+        manifest = read_manifest(svc.quarantine.root)
+    assert "prob" in verdicts[0]
+    failures = [v for v in verdicts if "error" in v]
+    assert failures and all(v["error"] == "joern_failure" for v in failures)
+    assert len(manifest) == len(failures)
+    assert warm_engine.stats.compiles == compiles0
+
+
+# ---------------------------------------------------------------------------
+# the incremental-scan headline (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_rescan_exactly_one_miss_bitwise_stable(
+        tmp_path, warm_engine):
+    reg = telemetry.REGISTRY
+    sources = seeded_sources(6, seed=7)
+    compiles0 = warm_engine.stats.compiles
+    with ScanService(
+        warm_engine, TINY.feature, workdir=tmp_path,
+        config=ScanConfig(pool_size=2, timeout_s=30.0),
+        command=fake_joern_command(),
+    ) as svc:
+        def sweep(srcs):
+            h0 = reg.counter("scan_cache_hits_total").value
+            m0 = reg.counter("scan_cache_misses_total").value
+            f0 = reg.counter("scan_featurized_total").value
+            verdicts = svc.scan_sources(
+                [{"id": i, "source": s} for i, s in enumerate(srcs)])
+            return verdicts, (
+                reg.counter("scan_cache_hits_total").value - h0,
+                reg.counter("scan_cache_misses_total").value - m0,
+                reg.counter("scan_featurized_total").value - f0,
+            )
+
+        first, (_, miss1, feat1) = sweep(sources)
+        assert miss1 == len(sources) and feat1 == len(sources)
+        assert all("prob" in v for v in first)
+
+        edited = list(sources)
+        edited[2] = edit_source(edited[2])
+        second, (hits2, miss2, feat2) = sweep(edited)
+
+        # Exactly one cache miss — the edited function — and exactly one
+        # featurize (one Joern invocation's worth of work).
+        assert (hits2, miss2, feat2) == (len(sources) - 1, 1, 1)
+        assert [v["id"] for v in second if v["featurized"]] == [2]
+        # Byte-identical verdicts for every untouched function.
+        for i in (0, 1, 3, 4, 5):
+            assert second[i]["prob"] == first[i]["prob"]
+            assert second[i]["key"] == first[i]["key"]
+            assert second[i]["cached"]
+        # The edit changed the key (and is a fresh, real verdict).
+        assert second[2]["key"] != first[2]["key"]
+        assert not second[2]["cached"]
+        # Zero serve-engine compiles after warmup, across both sweeps.
+        assert warm_engine.stats.compiles == compiles0
+
+
+def test_rescan_warm_across_service_restart(tmp_path, warm_engine):
+    # The persisted JSONL makes a RESTARTED service resume warm: the
+    # second ScanService instance answers entirely from disk.
+    sources = seeded_sources(3, seed=8)
+    items = [{"id": i, "source": s} for i, s in enumerate(sources)]
+    with ScanService(warm_engine, TINY.feature, workdir=tmp_path,
+                     config=ScanConfig(pool_size=1, timeout_s=30.0),
+                     command=fake_joern_command()) as svc:
+        first = svc.scan_sources(items)
+    with ScanService(warm_engine, TINY.feature, workdir=tmp_path,
+                     config=ScanConfig(pool_size=1, timeout_s=30.0),
+                     command=fake_joern_command()) as svc2:
+        assert len(svc2.cache) == len(sources)
+        second = svc2.scan_sources(items)
+    assert all(v["cached"] for v in second)
+    assert [v["prob"] for v in second] == [v["prob"] for v in first]
+
+
+def test_poison_source_quarantined_inline(tmp_path, warm_engine):
+    # A METHOD-less export (the deterministic poison) costs itself — an
+    # inline reason-coded verdict plus one manifest entry — never the
+    # sweep.
+    sources = seeded_sources(2, seed=9)
+    items = [{"id": 0, "source": sources[0]},
+             {"id": "bad", "source": f"int b(void) {{ {POISON_TOKEN}; }}\n"},
+             {"id": 1, "source": sources[1]}]
+    with ScanService(warm_engine, TINY.feature, workdir=tmp_path,
+                     config=ScanConfig(pool_size=1, timeout_s=30.0),
+                     command=fake_joern_command()) as svc:
+        verdicts = svc.scan_sources(items)
+        manifest = read_manifest(svc.quarantine.root)
+    by_id = {v["id"]: v for v in verdicts}
+    assert "prob" in by_id[0] and "prob" in by_id[1]
+    assert by_id["bad"]["error"] == "no_method_node"
+    assert len(manifest) == 1
+    assert manifest[0]["reason"] == "no_method_node"
+
+
+def test_scan_source_contract_rejects_at_the_edge(tmp_path, warm_engine):
+    # The API edge where attacker-controlled text enters: non-string and
+    # oversized sources come back reason-coded without touching the pool.
+    with ScanService(warm_engine, TINY.feature, workdir=tmp_path,
+                     config=ScanConfig(pool_size=1, timeout_s=30.0,
+                                       max_source_bytes=256),
+                     command=fake_joern_command()) as svc:
+        verdicts = svc.scan_sources([
+            {"id": "nonstr", "source": 7},
+            {"id": "big", "source": "int f() {}\n" + "x" * 1024},
+            {"id": "ok", "source": "int f(int a) { return a; }\n"},
+        ])
+        restarts = svc.pool.restarts
+    by_id = {v["id"]: v for v in verdicts}
+    assert by_id["nonstr"]["error"] == "bad_source"
+    assert by_id["big"]["error"] == "bad_source"
+    assert "cap" in by_id["big"]["detail"]
+    assert "prob" in by_id["ok"]
+    assert restarts == 0
+
+
+def test_scan_scratch_files_discarded_after_sweep(tmp_path, warm_engine):
+    # The .c files and Joern exports under workdir/functions are one-shot
+    # featurize inputs: a long-lived serve fed attacker-controlled
+    # sources must not grow them without bound. Duplicate sources in one
+    # batch share a path — both must still score.
+    sources = seeded_sources(3, seed=11)
+    items = [{"id": i, "source": s} for i, s in enumerate(sources)]
+    items.append({"id": "dup", "source": sources[0]})
+    items.append({"id": "bad",
+                  "source": f"int b(void) {{ {POISON_TOKEN}; }}\n"})
+    with ScanService(warm_engine, TINY.feature, workdir=tmp_path,
+                     config=ScanConfig(pool_size=1, timeout_s=30.0),
+                     command=fake_joern_command()) as svc:
+        verdicts = svc.scan_sources(items)
+    by_id = {v["id"]: v for v in verdicts}
+    assert all("prob" in by_id[i] for i in (0, 1, 2, "dup"))
+    assert by_id["bad"]["error"] == "no_method_node"
+    assert list((tmp_path / "functions").iterdir()) == []
+
+
+def test_quarantine_concurrent_puts_keep_ordinal_join_exact(tmp_path):
+    # The serve HTTP server quarantines from one thread per POST /scan:
+    # ordinal assignment + the manifest/items appends must stay one atom
+    # or the two files' ordinal join breaks and counts undercount.
+    import threading
+
+    from deepdfa_tpu import contracts
+
+    q = contracts.Quarantine(tmp_path / "quarantine")
+    n_threads, per_thread = 8, 25
+
+    def hammer(t):
+        for i in range(per_thread):
+            err = contracts.ContractError(
+                "bad_source", f"t{t} item {i}", boundary="scan",
+                item_id=f"{t}:{i}")
+            q.put(err, raw=f"src {t}:{i}")
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    total = n_threads * per_thread
+    assert q.total == total
+    manifest = read_manifest(q.root)
+    assert len(manifest) == total
+    assert sorted(e["ordinal"] for e in manifest) == list(range(total))
+    with open(q.items_path, encoding="utf-8") as f:
+        import json as _json
+        items = [_json.loads(line) for line in f if line.strip()]
+    # Same ordinal -> same item in both files (the post-mortem join).
+    by_ordinal = {e["ordinal"]: e["item_id"] for e in manifest}
+    assert all(by_ordinal[it["ordinal"]] == it["item_id"] for it in items)
