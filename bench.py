@@ -201,13 +201,18 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
 
     ``impl``: message-passing implementation; default "band" (the block-
     banded batched-matmul path, the measured winner — module docstring) on
-    TPU and "segment" elsewhere. "tile" rides the extras as the A/B.
+    TPU and "segment" elsewhere. "tile" rides the extras as the A/B;
+    "fused" is the single-pass Pallas megakernel (ops/fused_gnn.py) over
+    dense-slot-packed batches — the ISSUE-9 headline candidate.
 
     ``diagnostics``: also return {flops_per_step, mfu, ms_per_step} — the
-    cost-model FLOPs and achieved MFU against the chip's peak. The
-    dispatch/device split is a one-off ablation finding (module docstring:
-    dispatch ~0.13 ms/step amortized at K=10), not re-measured per run —
-    a two-unroll fit at this granularity is noisier than the quantity.
+    cost-model FLOPs and achieved MFU against the chip's peak. The fused
+    program's Pallas calls are invisible to XLA's cost analysis, so their
+    hand-counted FLOPs (fused_gnn.fused_step_cost) join the accounting
+    and the capture, labelled analytic. The dispatch/device split is a
+    one-off ablation finding (module docstring: dispatch ~0.13 ms/step
+    amortized at K=10), not re-measured per run — a two-unroll fit at
+    this granularity is noisier than the quantity.
     """
     from deepdfa_tpu.core.config import DataConfig, FlowGNNConfig, TrainConfig
     from deepdfa_tpu.models.flowgnn import FlowGNN
@@ -222,7 +227,7 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     data_cfg = DataConfig(batch_size=256)
     train_cfg = TrainConfig()
 
-    batch = _example_batch(data_cfg, model_cfg)
+    batch = _example_batch(data_cfg, model_cfg, slot_pack=impl == "fused")
     model = FlowGNN(model_cfg)
     state, tx = make_train_state(model, batch, train_cfg)
     inner = make_train_step(model, tx, train_cfg)
@@ -257,12 +262,31 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     from deepdfa_tpu.eval.profiling import _costs_of_compiled
     from deepdfa_tpu.telemetry import costmodel
 
+    # Pallas custom calls count as ZERO in XLA's cost model; the fused
+    # program's kernel FLOPs enter the one shared accounting analytically
+    # (forward + hand-derived backward, per model step, times K unrolls).
+    extra_flops = extra_bytes = 0.0
+    if impl == "fused":
+        from deepdfa_tpu.ops.fused_gnn import fused_step_cost, resolve_impl
+
+        # Same guard as train/loop.py and serve/engine.py: when "fused"
+        # resolves to the XLA band composition (CPU, DEEPDFA_FUSED_IMPL=
+        # xla), the executed program's FLOPs are already in cost_analysis
+        # — adding the analytic count would double them.
+        if resolve_impl() != "xla":
+            cost = fused_step_cost(batch.band_adj, model_cfg.ggnn_hidden,
+                                   dtype)
+            extra_flops = K * model_cfg.n_steps * (cost["flops"]
+                                                   + cost["bwd_flops"])
+            extra_bytes = K * model_cfg.n_steps * (
+                cost["bytes_accessed"] + cost["bwd_bytes_accessed"])
     # Register the K-unrolled program in the cost-model registry (the
     # observatory's compiled-callable catalogue) — same executable that
     # was timed, so the roofline numbers describe the measured program.
     costmodel.capture_compiled(f"bench.ddfa_step.{dtype}.{impl}", step,
-                               steps_per_call=K)
-    flops = _costs_of_compiled(step)["flops"] / K
+                               steps_per_call=K, extra_flops=extra_flops,
+                               extra_bytes=extra_bytes)
+    flops = (_costs_of_compiled(step)["flops"] + extra_flops) / K
     sec_per_step = dt / (calls * K)
     peak = _peak_flops()
     return gps, {
@@ -274,15 +298,19 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
 
 
 
-def bench_deepdfa_infer(batch_size: int = 256, dtype: str = "bfloat16") -> float:
+def bench_deepdfa_infer(batch_size: int = 256, dtype: str = "bfloat16",
+                        impl: "str | None" = None) -> float:
     """DeepDFA-standalone inference latency (ms/example) at the published
     architecture — the comparison point for the paper's 4.6 ms/example
     (Table 5's DeepDFA row; the gap VERDICT.md round 5 called out).
 
     Forward-only FlowGNN over the 256-graph parity batch; ms/example =
-    batch latency / batch size. The data-dependent chaining + final
-    device_get mirror bench_combined_infer — the only completion barrier
-    the tunneled backend honors (module docstring).
+    batch latency / batch size. ``impl`` selects the message path like
+    bench_deepdfa (the flag-audit fix, ISSUE 9: this bench used to pin the
+    band path no matter what the config said); default keeps band on TPU /
+    segment elsewhere. The data-dependent chaining + final device_get
+    mirror bench_combined_infer — the only completion barrier the
+    tunneled backend honors (module docstring).
     """
     import jax.numpy as jnp
 
@@ -290,9 +318,11 @@ def bench_deepdfa_infer(batch_size: int = 256, dtype: str = "bfloat16") -> float
     from deepdfa_tpu.models.flowgnn import FlowGNN
     from __graft_entry__ import _example_batch
 
-    impl = "band" if jax.default_backend() == "tpu" else "segment"
+    if impl is None:
+        impl = "band" if jax.default_backend() == "tpu" else "segment"
     model_cfg = FlowGNNConfig(message_impl=impl, dtype=dtype)
-    batch = _example_batch(DataConfig(batch_size=batch_size), model_cfg)
+    batch = _example_batch(DataConfig(batch_size=batch_size), model_cfg,
+                           slot_pack=impl == "fused")
     model = FlowGNN(model_cfg)
     params = model.init(jax.random.PRNGKey(0), batch)
 
@@ -978,6 +1008,19 @@ def main() -> None:
         bench_deepdfa("bfloat16", impl="tile")
         if jax.default_backend() == "tpu" else None
     )
+    # The fused megakernel (ISSUE 9): one Pallas pass per gated step over
+    # dense-slot-packed batches. bf16 challenges the band flagship; the
+    # f32 variant is the successor the 15%-of-band acceptance gate names
+    # (f32 ran at ~55% of the bf16 band path unfused). TPU-only — on CPU
+    # "fused" resolves to the band composition and the A/B is a no-op.
+    graphs_per_sec_fused = (
+        bench_deepdfa("bfloat16", impl="fused", diagnostics=True)
+        if jax.default_backend() == "tpu" else None
+    )
+    graphs_per_sec_fused_f32 = (
+        bench_deepdfa("float32", impl="fused")
+        if jax.default_backend() == "tpu" else None
+    )
     # DeepDFA-standalone inference: the paper's 4.6 ms/example finally gets
     # a comparison point (the round-5 VERDICT gap).
     deepdfa_infer_ms = bench_deepdfa_infer()
@@ -1053,6 +1096,35 @@ def main() -> None:
                             ),
                             "message_impl": "tile",
                         }] if graphs_per_sec_tile is not None else []
+                    ),
+                    *(
+                        [{
+                            "metric": "deepdfa_train_graphs_per_sec_fused",
+                            "value": round(graphs_per_sec_fused[0], 1),
+                            "unit": "graphs/s",
+                            "vs_baseline": round(
+                                graphs_per_sec_fused[0] / baseline_gnn, 3
+                            ),
+                            "message_impl": "fused",
+                            "mfu": rnd(graphs_per_sec_fused[1]["mfu"]),
+                            "flops_per_step":
+                                graphs_per_sec_fused[1]["flops_per_step"],
+                            "ms_per_step": rnd(
+                                graphs_per_sec_fused[1]["ms_per_step"]),
+                        }] if graphs_per_sec_fused is not None else []
+                    ),
+                    *(
+                        [{
+                            "metric":
+                                "deepdfa_train_graphs_per_sec_fused_f32",
+                            "value": round(graphs_per_sec_fused_f32, 1),
+                            "unit": "graphs/s",
+                            "vs_baseline": round(
+                                graphs_per_sec_fused_f32 / baseline_gnn, 3
+                            ),
+                            "message_impl": "fused",
+                            "dtype": "float32",
+                        }] if graphs_per_sec_fused_f32 is not None else []
                     ),
                     {
                         "metric": "deepdfa_infer_ms_per_example",

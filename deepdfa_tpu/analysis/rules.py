@@ -90,6 +90,24 @@ Rule catalogue (each backed by a positive+negative fixture in
                              Names formatted from parameters or iterated
                              from static collections stay unflagged: the
                              caller bounds those.
+  GL016 pallas-interpret-in-prod  a ``pl.pallas_call`` (or a module-local
+                             kernel wrapper with an ``interpret``
+                             parameter that forwards to one) whose
+                             ``interpret`` argument is pinned to literal
+                             ``True`` — directly, through a reaching
+                             assignment, or through a module-level
+                             constant — on an unconditional path in a
+                             file importable outside ``tests/``. The
+                             interpreter is the debugging surface; a
+                             pinned ``interpret=True`` that ships runs
+                             the kernel on the Pallas interpreter at a
+                             silent ~100× slowdown. Dispatch guarded by
+                             a caller-controlled conditional (the
+                             ``impl == "interpret"`` switch idiom) and
+                             ``interpret=`` values of unknown provenance
+                             (parameters, computed expressions) stay
+                             unflagged — precision over recall, the
+                             empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -150,6 +168,7 @@ RULES: Dict[str, str] = {
     "GL013": "blocking-checkpoint-in-step",
     "GL014": "unbounded-metric-cardinality",
     "GL015": "subprocess-without-timeout",
+    "GL016": "pallas-interpret-in-prod",
 }
 
 _JIT_NAMES = frozenset({
@@ -239,6 +258,9 @@ _SELECT_GUARDS = frozenset({
     "selectors.DefaultSelector",
 })
 _PTY_OPEN = "pty.openpty"
+# GL016: the pallas_call leaf (every import spelling resolves through the
+# alias table to something ending in it).
+_PALLAS_CALL_LEAF = "pallas_call"
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -313,6 +335,39 @@ class _Module:
             n.name for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
+        # GL016 facts: module-level ``NAME = True`` constants (a pinned
+        # interpret flag one module-constant hop away), and "kernel
+        # wrappers" — module defs with an ``interpret`` parameter whose
+        # body calls pallas_call directly, mapped to that parameter's
+        # positional index (-1: keyword-only).
+        self.true_constants: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and stmt.value.value is True:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.true_constants.add(t.id)
+        self.kernel_wrappers: Dict[str, int] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            a = node.args
+            positional = [x.arg for x in a.posonlyargs + a.args]
+            if "interpret" in positional:
+                idx = positional.index("interpret")
+            elif "interpret" in [x.arg for x in a.kwonlyargs]:
+                idx = -1
+            else:
+                continue
+            calls_pallas = any(
+                isinstance(sub, ast.Call)
+                and (dotted := self.resolve(sub.func)) is not None
+                and dotted.rsplit(".", 1)[-1] == _PALLAS_CALL_LEAF
+                for sub in ast.walk(node)
+            )
+            if calls_pallas:
+                self.kernel_wrappers[node.name] = idx
         # Local defs wrapped by jax.jit(...) / jit_dp_step(...) anywhere in
         # the module: their bodies run under trace.
         self.jit_wrapped: Set[str] = set()
@@ -455,6 +510,7 @@ class _FunctionChecker:
         self._check_unchecked_ingest()
         self._check_metric_cardinality()
         self._check_subprocess_timeout()
+        self._check_pallas_interpret()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -868,6 +924,96 @@ class _FunctionChecker:
                 "a wedged child blocks the worker forever; pass "
                 "timeout= (handling subprocess.TimeoutExpired) or kill "
                 "the child first")
+
+    # -- pallas interpret pinned in prod (GL016) -----------------------------
+
+    def _pinned_true(self, expr: ast.expr, node: Node,
+                     defs) -> "Tuple[bool, str]":
+        """Is this ``interpret`` argument pinned to literal True?
+        Covers the direct literal, a reaching in-function assignment of
+        True, and a module-level ``NAME = True`` constant. Parameters and
+        computed expressions are unknown provenance — the caller owns
+        them — and stay unpinned."""
+        if isinstance(expr, ast.Constant):
+            return expr.value is True, "literal True"
+        if not isinstance(expr, ast.Name):
+            return False, ""
+        if expr.id in _params_of(self.fi.node):
+            return False, ""
+        sites = defs.get(node.idx, {}).get(expr.id, frozenset())
+        real = [d for d in sites if self.cfg.nodes[d].stmt is not None]
+        if real:
+            pinned = all(
+                isinstance(self.cfg.nodes[d].stmt, ast.Assign)
+                and isinstance(self.cfg.nodes[d].stmt.value, ast.Constant)
+                and self.cfg.nodes[d].stmt.value.value is True
+                for d in real
+            )
+            return pinned, (
+                f"`{expr.id}` pinned True at line "
+                f"{min(self.cfg.nodes[d].line for d in real)}")
+        if expr.id in self.mod.true_constants:
+            return True, f"module constant `{expr.id}` = True"
+        return False, ""
+
+    @staticmethod
+    def _caller_gated(node: Node) -> bool:
+        """An enclosing ``if`` whose test reads any name is treated as a
+        caller-controlled dispatch (the ``impl == "interpret"`` switch
+        idiom) — the pin is then an explicit mode choice, not a shipped
+        debug flag."""
+        return any(
+            any(isinstance(n, ast.Name) for n in ast.walk(t))
+            for t in node.guard_tests
+        )
+
+    def _check_pallas_interpret(self) -> None:
+        """pallas_call/kernel-wrapper dispatch with ``interpret`` pinned
+        True on an unconditional, importable-outside-tests path — the
+        shipped-debug-flag class: the kernel silently runs on the Pallas
+        interpreter at ~100× the compiled latency, and nothing crashes to
+        say so."""
+        parts = re.split(r"[\\/]", self.mod.path)
+        if "tests" in parts:
+            return
+        defs = reaching_definitions(self.cfg)
+        for node in self.cfg.nodes:
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = self.mod.resolve(sub.func)
+                    target = next(
+                        (kw.value for kw in sub.keywords
+                         if kw.arg == "interpret"), None)
+                    is_pallas = (
+                        dotted is not None
+                        and dotted.rsplit(".", 1)[-1] == _PALLAS_CALL_LEAF)
+                    wrapper = (
+                        sub.func.id if isinstance(sub.func, ast.Name)
+                        and sub.func.id in self.mod.kernel_wrappers
+                        else None)
+                    if wrapper is not None and target is None:
+                        idx = self.mod.kernel_wrappers[wrapper]
+                        if 0 <= idx < len(sub.args) and not any(
+                                isinstance(a, ast.Starred)
+                                for a in sub.args[:idx + 1]):
+                            target = sub.args[idx]
+                    if target is None or not (is_pallas or wrapper):
+                        continue
+                    pinned, how = self._pinned_true(target, node, defs)
+                    if not pinned or self._caller_gated(node):
+                        continue
+                    what = (f"{dotted}(…)" if is_pallas
+                            else f"kernel wrapper {wrapper}(…)")
+                    self._report(
+                        "GL016", sub,
+                        f"{what} with interpret pinned True ({how}) on an "
+                        "unconditional path importable outside tests/ — "
+                        "the Pallas interpreter is a ~100x slowdown that "
+                        "ships silently; gate interpreted dispatch behind "
+                        "a caller-chosen impl switch (the tile_spmm "
+                        "_dispatch idiom) or drop the pin")
 
     # -- recompilation (GL006) -----------------------------------------------
 

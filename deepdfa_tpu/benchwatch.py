@@ -327,6 +327,37 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     dt = _best_of(call, n_steps, reps)
     gps = n_steps * data_cfg.batch_size / dt
 
+    # The fused-step lane (ISSUE 9): slot-packed band batch through
+    # message_impl="fused". On the CPU gate this resolves to the XLA band
+    # composition — still the mechanism guard the smoke exists for (slot
+    # packing, band build, fused dispatch, and any host sync creeping in),
+    # while the TPU trajectory carries the kernel's real numbers.
+    from deepdfa_tpu.graphs.batch import batch_graphs, slot_nodes_for
+    from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, align_to_tile
+
+    fused_cfg = FlowGNNConfig(feature=feat, hidden_dim=16, n_steps=2,
+                              message_impl="fused")
+    slot = slot_nodes_for(examples, tile=DEFAULT_TILE)
+    fused_batch = batch_graphs(
+        examples, data_cfg.batch_size,
+        align_to_tile(data_cfg.batch_size * slot), data_cfg.max_edges,
+        subkeys_for(feat), build_band_adj=True, slot_nodes=slot,
+    )
+    fused_model = FlowGNN(fused_cfg)
+    fused_state, fused_tx = make_train_state(fused_model, fused_batch,
+                                             TrainConfig())
+    fused_step = jax.jit(
+        make_train_step(fused_model, fused_tx, TrainConfig()),
+        donate_argnums=(0,)).lower(fused_state, fused_batch).compile()
+
+    def fused_call():
+        nonlocal fused_state
+        fused_state, loss, _ = fused_step(fused_state, fused_batch)
+        return loss
+
+    fused_dt = _best_of(fused_call, n_steps, reps)
+    fused_gps = n_steps * data_cfg.batch_size / fused_dt
+
     corpus = synthetic_bigvul(n_rows, FeatureSpec(), positive_fraction=0.5,
                               seed=0)
     tmp = tempfile.mkdtemp(prefix="bench_smoke_")
@@ -352,6 +383,8 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     return {
         "smoke_gnn_train_graphs_per_sec": {
             "value": round(gps, 1), "unit": "graphs/s"},
+        "smoke_gnn_train_graphs_per_sec_fused": {
+            "value": round(fused_gps, 1), "unit": "graphs/s"},
         "smoke_ingest_rows_per_sec": {
             "value": round(n_rows / ingest_dt, 1), "unit": "rows/s"},
     }

@@ -367,8 +367,8 @@ def cmd_test(args) -> Dict[str, Any]:
                                     split_mode=args.split_mode)
     model = FlowGNN(model_cfg)
     subkeys = subkeys_for(model_cfg.feature)
-    use_tile = model_cfg.message_impl == "tile"
-    use_band = model_cfg.message_impl == "band"
+    use_tile = model_cfg.uses_tile_adj
+    use_band = model_cfg.uses_band_adj
     use_df = model_cfg.label_style.startswith("dataflow_solution")
     example_batch = next(
         _batches(examples, splits["test"][: data_cfg.eval_batch_size], data_cfg,
@@ -1062,9 +1062,22 @@ def _build_scan_service(engine, model_cfg, args):
     config = ScanConfig(pool_size=args.scan_pool_size,
                         timeout_s=args.scan_timeout_s,
                         attempts=args.scan_attempts)
+    vocabs = None
+    vocabs_path = getattr(args, "scan_vocabs", None)
+    if vocabs_path:
+        # Checkpoint-faithful scan vocabularies (the ROADMAP gap): load
+        # the ETL export's persisted vocabs so live sweeps index features
+        # exactly as the checkpoint trained — replacing the deterministic
+        # hashing fallback.
+        from deepdfa_tpu.etl.export import load_vocabs
+
+        vocabs = load_vocabs(vocabs_path)
+        logger.info("scan: loaded export vocabs from %s (%s)", vocabs_path,
+                    ", ".join(sorted(vocabs)))
     return ScanService(engine, model_cfg.feature,
                        workdir=args.scan_workdir, config=config,
-                       command=command, cache_path=args.scan_cache)
+                       command=command, cache_path=args.scan_cache,
+                       vocabs=vocabs)
 
 
 def _apply_slo_gate(report: Dict[str, Any], trace_rep: Dict[str, Any],
@@ -1844,6 +1857,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="persistent verdict cache JSONL (default "
                             "<scan-workdir>/verdicts.jsonl); re-scans "
                             "hit it across restarts")
+        p.add_argument("--scan-vocabs",
+                       default=os.environ.get("DEEPDFA_SCAN_VOCABS"),
+                       metavar="FILE",
+                       help="vocabs.json persisted by the ETL export "
+                            "(checkpoint-faithful feature indices; env "
+                            "DEEPDFA_SCAN_VOCABS). Omitted: the "
+                            "deterministic hashing vocabulary")
 
     p_srv = sub.add_parser(
         "serve", help="HTTP scoring endpoint: deadline-aware bucketed "

@@ -94,8 +94,11 @@ class FlowGNNConfig:
     dtype: str = "float32"
     # "segment": XLA gather/scatter-add; "tile": Pallas block-sparse tile
     # SpMM (requires batches built with build_tile_adj=True); "band":
-    # block-banded batched matmul (build_band_adj=True) — the fastest TPU
-    # path (fully parallel MXU work, bench.py).
+    # block-banded batched matmul (build_band_adj=True) — fully parallel
+    # MXU work (bench.py); "fused": the single-pass Pallas megakernel
+    # (ops/fused_gnn.py — edge message + band SpMM + GRU gate in one
+    # pallas_call, band adjacency required; degrades to the bitwise band
+    # composition off-TPU and on sharded batches).
     message_impl: str = "segment"
     # Rematerialize the gated steps in the backward pass. The step is
     # HBM-bound, so recomputing activations beats storing them: ~7% higher
@@ -117,6 +120,20 @@ class FlowGNNConfig:
     @property
     def input_dim(self) -> int:
         return self.feature.input_dim
+
+    @property
+    def uses_band_adj(self) -> bool:
+        """Batches for this model must carry the band adjacency — the ONE
+        predicate every lane (train loops, bench, serve engine, CLI eval)
+        keys batch construction on. "fused" consumes the band adjacency
+        too; before this property existed, lanes testing
+        ``message_impl == "band"`` literally would silently mis-build
+        batches for new band-family impls."""
+        return self.message_impl in ("band", "fused")
+
+    @property
+    def uses_tile_adj(self) -> bool:
+        return self.message_impl == "tile"
 
     @property
     def embedding_dim(self) -> int:

@@ -91,6 +91,81 @@ def cpg_to_example(
     }
 
 
+VOCABS_FILENAME = "vocabs.json"
+_VOCABS_VERSION = 1
+
+
+def save_vocabs(vocabs: Mapping[str, AbstractDataflowVocab],
+                path: str) -> str:
+    """Persist the train-split abstract-dataflow vocabularies next to the
+    export (``<workdir>/vocabs.json``).
+
+    This is the checkpoint-faithful-scan gap the ROADMAP recorded: a model
+    trained on these vocab indices must be *scanned* with the same
+    index_for mapping, but the export stage never wrote the vocabs, so the
+    scan path degraded to a deterministic hashing vocabulary. Index maps
+    serialize as ordered ``[key, index]`` pairs because the reserved
+    not-a-definition/UNKNOWN entry is keyed by ``None`` — not a legal JSON
+    object key — and the frequency-rank order is the contract."""
+    import json
+    import os
+
+    doc = {
+        "version": _VOCABS_VERSION,
+        "vocabs": {
+            subkey: {
+                "subkey": v.subkey,
+                "limit_all": v.limit_all,
+                "limit_subkeys": v.limit_subkeys,
+                "subkey_index": [[k, i] for k, i in v.subkey_index.items()],
+                "all_index": [[k, i] for k, i in v.all_index.items()],
+            }
+            for subkey, v in vocabs.items()
+        },
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_vocabs(path: str) -> Dict[str, AbstractDataflowVocab]:
+    """Load :func:`save_vocabs` output back into the vocab objects the
+    featurizers consume (``index_for`` contract unchanged). Raises
+    ``ValueError`` on a wrong version or shape — a scan must fail loudly
+    rather than silently score with half a vocabulary."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != _VOCABS_VERSION:
+        raise ValueError(
+            f"{path}: not a vocabs.json (version "
+            f"{doc.get('version') if isinstance(doc, dict) else '?'}, "
+            f"expected {_VOCABS_VERSION})")
+    if not isinstance(doc.get("vocabs"), dict):
+        raise ValueError(f"{path}: vocabs.json has no 'vocabs' mapping")
+    out: Dict[str, AbstractDataflowVocab] = {}
+    for subkey, v in doc["vocabs"].items():
+        try:
+            out[subkey] = AbstractDataflowVocab(
+                subkey=v["subkey"],
+                limit_all=int(v["limit_all"]),
+                limit_subkeys=int(v["limit_subkeys"]),
+                subkey_index={k: int(i) for k, i in v["subkey_index"]},
+                all_index={k: int(i) for k, i in v["all_index"]},
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"{path}: malformed vocab {subkey!r}: {e}")
+        if None not in out[subkey].all_index:
+            raise ValueError(
+                f"{path}: vocab {subkey!r} lacks the reserved UNKNOWN "
+                "entry (None key)")
+    return out
+
+
 def export_codet5_defect_jsonl(
     rows: Sequence[Mapping],
     path: str,

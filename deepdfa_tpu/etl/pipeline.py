@@ -196,6 +196,13 @@ def export(
     splits = make_splits(ordered, mode=split_mode, seed=split_seed)
     train_ids = [ordered[i]["id"] for i in splits["train"]]
     vocabs = build_all_vocabs(features_by_graph, train_ids, feature)
+    # Persist the vocabs WITH the export (checkpoint-faithful scanning):
+    # the scan service loads them so a live sweep indexes features with
+    # the exact mapping the model trained on, instead of the hashing
+    # fallback (etl/export.save_vocabs / scan `--scan-vocabs`).
+    from deepdfa_tpu.etl.export import VOCABS_FILENAME, save_vocabs
+
+    save_vocabs(vocabs, str(root / VOCABS_FILENAME))
 
     n_written = 0
     with open(root / "examples.jsonl", "w") as f:

@@ -140,6 +140,27 @@ def select_bucket(n: int, maximum: Optional[int] = None,
     return b if maximum is None else min(b, maximum)
 
 
+def slot_nodes_for(
+    graphs: Sequence[Mapping], minimum: int = 16, tile: Optional[int] = None
+) -> int:
+    """The dense-slot size for ``slot_nodes`` packing: the padding-bucket
+    ladder (:func:`select_bucket`) rounded up from the largest graph.
+
+    The pow2 ladder is what makes the slots *(nodes × tile)-aligned* for
+    free: with ``tile`` itself a power of two, either the slot divides the
+    tile (several whole graphs per MXU row tile) or the tile divides the
+    slot (one graph spanning whole tiles) — a graph can straddle at most
+    ``ceil(slot/tile)`` adjacent tiles, which is exactly the band
+    bandwidth the fused kernel's rolling window pays for. ``tile`` only
+    enforces the power-of-two compatibility contract; it never widens the
+    slot."""
+    biggest = max((int(g["num_nodes"]) for g in graphs), default=1)
+    slot = select_bucket(max(biggest, 1), minimum=minimum)
+    if tile is not None and tile & (tile - 1):
+        raise ValueError(f"tile {tile} is not a power of two")
+    return slot
+
+
 def pad_budget_for(
     graphs: Sequence[Mapping], n_graphs: int, add_self_loops: bool = True
 ) -> Dict[str, int]:
@@ -176,6 +197,7 @@ def batch_graphs(
     band_bandwidth: Optional[int] = None,
     impl: str = "auto",
     with_dataflow: bool = False,
+    slot_nodes: Optional[int] = None,
 ) -> "GraphBatch":
     """Pack up to ``n_graphs`` graphs into one padded batch (host-side).
 
@@ -185,11 +207,35 @@ def batch_graphs(
     callers size budgets with :func:`pad_budget_for` or spill to the next
     batch upstream.
 
+    ``slot_nodes``: dense-slot packing mode — graph ``gi`` occupies the
+    fixed node range ``[gi*slot_nodes, (gi+1)*slot_nodes)`` instead of
+    packing contiguously. Ragged per-graph shapes disappear behind one
+    slot size from the :func:`select_bucket` ladder (:func:`slot_nodes_for`),
+    which pins the band adjacency's bandwidth to ``ceil(slot/tile)`` tiles
+    regardless of the batch mix — what the fused megakernel's rolling
+    window is sized by. Slot packing trades node-slot occupancy for shape
+    regularity; masked padding was already the batching model, so padded
+    in-slot tails are inert exactly like padded batch tails.
+
     ``impl``: "native" (C++ batcher, deepdfa_tpu/native — the production
     input-pipeline path), "python" (numpy loop — the oracle), or "auto".
+    Slot packing always takes the python path (a slot layout is an offset
+    table, not a hot copy loop).
     """
     if len(graphs) > n_graphs:
         raise ValueError(f"{len(graphs)} graphs > {n_graphs} slots")
+    if slot_nodes is not None:
+        if slot_nodes < 1:
+            raise ValueError(f"slot_nodes {slot_nodes} < 1")
+        if n_graphs * slot_nodes > max_nodes:
+            raise ValueError(
+                f"{n_graphs} slots of {slot_nodes} nodes exceed the "
+                f"{max_nodes}-node budget")
+        for gi, g in enumerate(graphs):
+            if int(g["num_nodes"]) > slot_nodes:
+                raise ValueError(
+                    f"graph {gi} (id {g.get('id', '?')}): "
+                    f"{int(g['num_nodes'])} nodes > slot_nodes {slot_nodes}")
 
     # Endpoint contract, enforced BEFORE node-offsetting (and before the
     # native batcher copies anything): a dangling endpoint used to clamp
@@ -225,7 +271,10 @@ def batch_graphs(
     if impl not in ("auto", "native", "python"):
         raise ValueError(f"unknown impl {impl!r}")
     use_native = False
-    if impl in ("auto", "native"):
+    if slot_nodes is not None:
+        if impl == "native":
+            raise ValueError("slot_nodes packing has no native batcher path")
+    elif impl in ("auto", "native"):
         from deepdfa_tpu import native as _native
 
         use_native = _native.available()
@@ -257,6 +306,8 @@ def batch_graphs(
         node_off = 0
         edge_off = 0
         for gi, g in enumerate(graphs):
+            if slot_nodes is not None:
+                node_off = gi * slot_nodes
             n = int(g["num_nodes"])
             s = np.asarray(g["senders"], np.int32)
             r = np.asarray(g["receivers"], np.int32)
@@ -309,7 +360,13 @@ def batch_graphs(
         df_in = np.zeros(max_nodes, np.int32)
         df_out = np.zeros(max_nodes, np.int32)
         off = 0
-        for g in graphs:
+        for gi, g in enumerate(graphs):
+            if slot_nodes is not None:
+                # Slot packing moves every graph's node range; the
+                # dataflow bits must land at the same slot offsets as the
+                # node features or the labels silently shear off by the
+                # accumulated in-slot padding.
+                off = gi * slot_nodes
             n = int(g["num_nodes"])
             if "df_in" not in g or "df_out" not in g:
                 raise ValueError(
@@ -352,25 +409,30 @@ def batch_iterator(
     build_band_adj: bool = False,
     band_bandwidth: Optional[int] = None,
     with_dataflow: bool = False,
+    slot_nodes: Optional[int] = None,
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would
     overflow the budget into the next batch (static-shape replacement for
     DGL's GraphDataLoader). With ``build_tile_adj`` every batch carries the
     Pallas block-sparse adjacency (pin ``tile_pad_nz`` so all batches share
     one compiled kernel); ``build_band_adj`` likewise attaches the banded
-    adjacency (pin ``band_bandwidth``)."""
+    adjacency (pin ``band_bandwidth``). ``slot_nodes`` switches to
+    dense-slot packing: each graph costs one fixed slot of the node budget
+    (pin it — e.g. :func:`slot_nodes_for` over the whole corpus — so every
+    batch shares one slot layout and one compiled fused-kernel shape)."""
     pending: List[Mapping] = []
     nodes = edges = 0
     kw = dict(
         add_self_loops=add_self_loops, build_tile_adj=build_tile_adj,
         tile=tile, tile_pad_nz=tile_pad_nz, build_band_adj=build_band_adj,
         band_bandwidth=band_bandwidth, with_dataflow=with_dataflow,
+        slot_nodes=slot_nodes,
     )
 
     def _cost(g):
         n = int(g["num_nodes"])
         e = len(g["senders"]) + (n if add_self_loops else 0)
-        return n, e
+        return (n if slot_nodes is None else slot_nodes), e
 
     for g in graphs:
         n, e = _cost(g)
@@ -379,6 +441,10 @@ def batch_iterator(
         ):
             yield batch_graphs(pending, n_graphs, max_nodes, max_edges, subkeys, **kw)
             pending, nodes, edges = [], 0, 0
+        if slot_nodes is not None and int(g["num_nodes"]) > slot_nodes:
+            raise ValueError(
+                f"single graph exceeds slot: {int(g['num_nodes'])} nodes > "
+                f"slot_nodes {slot_nodes}")
         if n > max_nodes or e > max_edges:
             raise ValueError(f"single graph exceeds budget: {n} nodes / {e} edges")
         pending.append(g)

@@ -80,11 +80,16 @@ class GatedGraphStep(nn.Module):
     a single edge-typed linear applied to sender states, summed into
     receivers, fed to a GRU cell as the input with the node state as carry.
 
-    Three aggregation paths: XLA segment ops (gather + scatter-add), the
+    Four aggregation paths: XLA segment ops (gather + scatter-add), the
     Pallas block-sparse tile SpMM (``deepdfa_tpu.ops.tile_spmm``) when the
-    batch carries a precomputed ``TileAdjacency``, or the block-banded
+    batch carries a precomputed ``TileAdjacency``, the block-banded
     batched matmul (``deepdfa_tpu.ops.band_spmm``) — dense MXU work instead
-    of irregular memory traffic, fully parallel in the banded case.
+    of irregular memory traffic, fully parallel in the banded case — and
+    ``"fused"`` (``deepdfa_tpu.ops.fused_gnn``): the whole step (edge
+    message + band SpMM + GRU gate) as ONE Pallas kernel whose
+    intermediates never leave VMEM. Off-TPU (and on sharded batches) the
+    fused flag dispatches the band composition through the same flax
+    modules, so it degrades to the bitwise band path.
     """
 
     hidden: int
@@ -94,8 +99,35 @@ class GatedGraphStep(nn.Module):
 
     @nn.compact
     def __call__(self, h, batch: GraphBatch):
+        impl = self.message_impl
+        if impl == "fused":
+            if batch.band_adj is None:
+                raise ValueError(
+                    "message_impl='fused' needs batch_graphs(build_band_adj"
+                    "=True) — the fused kernel consumes the band adjacency"
+                )
+            from deepdfa_tpu.ops import fused_gnn
+
+            fimpl = fused_gnn.resolve_impl()
+            sharded = batch.band_adj.vals.ndim == 5
+            if fimpl != "xla" and not sharded:
+                # The megakernel: gather + band SpMM + GRU gate in one
+                # pallas_call (ops/fused_gnn.py). Params are declared
+                # through holder modules at the SAME scope paths as the
+                # flax Dense/GRUCell below, so the tree (and every
+                # checkpoint) is identical across impls — pinned by
+                # tests/test_fused_gnn.py.
+                params = fused_gnn.declare_step_params(
+                    self.hidden, int(h.shape[-1]))
+                return fused_gnn.fused_gate_step(
+                    params, h, batch.band_adj, impl=fimpl)
+            # Numerically-identical XLA fallback (CPU tier-1, sharded
+            # meshes): fall through to the band composition — literally
+            # the same flax modules, so fused-on-CPU IS the band path
+            # bitwise (the gradient-parity acceptance gate).
+            impl = "band"
         msg = nn.Dense(self.hidden, dtype=self.dtype, name="edge_linear")(h)
-        if self.message_impl == "tile":
+        if impl == "tile":
             if batch.tile_adj is None:
                 raise ValueError(
                     "message_impl='tile' needs batch_graphs(build_tile_adj=True)"
@@ -112,7 +144,7 @@ class GatedGraphStep(nn.Module):
                 agg = tile_spmm_sharded(batch.tile_adj, msg, self.mesh)
             else:
                 agg = tile_spmm(batch.tile_adj, msg)
-        elif self.message_impl == "band":
+        elif impl == "band":
             if batch.band_adj is None:
                 raise ValueError(
                     "message_impl='band' needs batch_graphs(build_band_adj=True)"

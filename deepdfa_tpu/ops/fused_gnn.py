@@ -1,0 +1,639 @@
+"""Fused FlowGNN megakernel: gather + band SpMM + GRU gate in ONE Pallas pass.
+
+The unfused GatedGraphStep is a chain of separate dispatches — edge-message
+dense (``h @ W_e``), the band/tile SpMM aggregate, then six GRU gate matmuls
+— and every link round-trips its [max_nodes, H] intermediate through HBM.
+At the published shape the step is HBM-bound (roofline verdict, PR 7
+observatory): the MXU waits on loads, and the f32/tile paths sit at ~55% of
+the bf16 band flagship. This module fuses the whole per-graph-step compute
+into one ``pallas_call`` per direction:
+
+- **Forward** (:func:`_fwd_kernel`): a sequential grid over node row tiles.
+  Step ``i`` computes the edge-message tile ``msg[i] = h[i] @ W_e + b_e``
+  into a rolling VMEM window of ``2B+1`` tiles (B = band bandwidth), then —
+  once the window covers row ``r = i - B`` — aggregates
+  ``agg[r] = Σ_d A[d, r] @ msg[r+d-B]`` and applies the full GRU gate update
+  in-register, writing ``h'[r]`` straight out. HBM sees each ``h`` tile
+  twice (message + carry reads) and each ``h'`` tile once; ``msg``/``agg``
+  and every gate pre-activation never leave VMEM. The grid runs ``T + B``
+  steps so the window warm-up costs B extra tiles, not a prologue branch;
+  Pallas's block pipeline double-buffers the next tile's HBM→VMEM DMAs
+  under the current tile's MXU work.
+- **Backward** (:func:`_bwd_kernel`): the same rolling-window structure with
+  two extra phase offsets — step ``i`` recomputes ``msg[i]``, runs the gate
+  backward at row ``r = i - B`` (holding ``d agg`` and the local carry
+  cotangent in windows), and completes ``d msg[c] = Σ Aᵀ[c] d agg`` plus
+  ``d h[c]`` at ``c = i - 2B``. Weight gradients accumulate in f32 output
+  blocks that stay VMEM-resident across the whole grid (constant index
+  maps) and flush once. Gradients therefore need no [nodes, H]
+  intermediates in HBM either — the unfused backward materializes five.
+
+**Dense-slot packing** (``graphs/batch.py slot_pack=True``) feeds the
+kernel: binning each CPG into a fixed node slot from the ``select_bucket``
+ladder keeps every graph inside (at most) adjacent row tiles, collapsing
+the band bandwidth — and with it the window size, the warm-up, and the
+zero-padded off-diagonal FLOPs — before the kernel ever sees the batch.
+
+**Fallback contract**: ``impl="xla"`` (the CPU/tier-1 path, and what
+``auto`` resolves to off-TPU) is :func:`fused_reference` — math-for-math
+the flax ``Dense`` + ``band_spmm`` + ``GRUCell`` composition, so the fused
+flag degrades to the *bitwise* band path where Pallas is unavailable;
+``models/flowgnn.py`` routes its fallback through the very same flax
+modules, which is what the gradient-parity acceptance test pins.
+``impl="interpret"`` runs the real kernels on the Pallas interpreter (the
+tier-1 numerics tests). Never pin ``interpret=True`` on an importable
+path — graftlint GL016 exists because that ships a silent ~100× slowdown.
+
+XLA's ``cost_analysis`` cannot see inside a Pallas custom call, so
+:func:`fused_step_cost` provides the analytic FLOPs/bytes accounting that
+``telemetry/costmodel.capture_compiled(extra_flops=..., extra_bytes=...)``
+folds into the roofline report.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, Mapping, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepdfa_tpu.ops.band_spmm import BandAdjacency, band_spmm
+
+
+def resolve_impl(impl: str = "auto") -> str:
+    """Resolve the fused dispatch: "pallas" | "interpret" | "xla".
+
+    ``auto`` honours ``DEEPDFA_FUSED_IMPL`` (the test/debug override),
+    then picks the compiled kernel on TPU and the XLA reference
+    elsewhere — the same backend gate as pool_impl/embed_impl.
+    """
+    if impl == "auto":
+        impl = os.environ.get("DEEPDFA_FUSED_IMPL", "auto")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("pallas", "interpret", "xla"):
+        raise ValueError(f"unknown fused impl {impl!r}")
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# Parameter declaration (the flax tree the unfused modules own)
+# ---------------------------------------------------------------------------
+#
+# The fused kernel consumes raw weight arrays, but the param TREE must stay
+# byte-identical to nn.Dense(name="edge_linear") + nn.GRUCell(name="gru") —
+# checkpoints restore across message_impl flips, and the serving layer
+# restores params target-free. Flax derives each param's init RNG from its
+# scope path, so declaring the same names/shapes/inits at the same paths
+# yields the identical tree (pinned by tests/test_fused_gnn.py).
+
+
+class _DenseParams(nn.Module):
+    """Declares ``{kernel[, bias]}`` exactly as ``nn.Dense`` would."""
+
+    features: int
+    in_features: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self) -> Dict[str, jnp.ndarray]:
+        out = {"kernel": self.param(
+            "kernel", self.kernel_init, (self.in_features, self.features))}
+        if self.use_bias:
+            out["bias"] = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,))
+        return out
+
+
+class _GRUParams(nn.Module):
+    """Declares the six gate kernels exactly as ``nn.GRUCell`` would
+    (input gates: lecun_normal + bias; recurrent gates: orthogonal,
+    bias only on ``hn``)."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self) -> Dict[str, Dict[str, jnp.ndarray]]:
+        lecun = nn.initializers.lecun_normal()
+        orth = nn.initializers.orthogonal()
+        spec = (
+            ("ir", True, lecun), ("iz", True, lecun), ("in", True, lecun),
+            ("hr", False, orth), ("hz", False, orth), ("hn", True, orth),
+        )
+        return {
+            name: _DenseParams(self.hidden, self.hidden, use_bias=bias,
+                               kernel_init=init, name=name)()
+            for name, bias, init in spec
+        }
+
+
+def declare_step_params(hidden: int, in_features: int
+                        ) -> Dict[str, Any]:
+    """Instantiate inside a compact module: declares (and returns) the
+    GatedGraphStep param tree under the canonical ``edge_linear``/``gru``
+    child scopes."""
+    return {
+        "edge_linear": _DenseParams(hidden, in_features,
+                                    name="edge_linear")(),
+        "gru": _GRUParams(hidden, name="gru")(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (the CPU fallback and numerics oracle)
+# ---------------------------------------------------------------------------
+
+
+def _dense_apply(p: Mapping[str, jnp.ndarray], x: jnp.ndarray,
+                 dt) -> jnp.ndarray:
+    """``nn.Dense.__call__`` math, op for op (promote to ``dt``, dot,
+    reshape-broadcast bias add)."""
+    y = jax.lax.dot_general(
+        x, p["kernel"].astype(dt), (((x.ndim - 1,), (0,)), ((), ())))
+    if "bias" in p:
+        y = y + jnp.reshape(p["bias"].astype(dt),
+                            (1,) * (y.ndim - 1) + (-1,))
+    return y
+
+
+def fused_reference(params: Mapping, h: jnp.ndarray,
+                    adj: BandAdjacency) -> jnp.ndarray:
+    """The unfused composition with the fused op's signature: flax-Dense
+    edge message → ``band_spmm`` aggregate → flax-GRUCell gate, in the
+    model's compute dtype. This IS the ``impl="xla"`` path, and the
+    program the interpret/pallas kernels are tested against."""
+    dt = h.dtype
+    msg = _dense_apply(params["edge_linear"], h.astype(dt), dt)
+    agg = band_spmm(adj, msg)
+    g = params["gru"]
+    x, hc = agg.astype(dt), h.astype(dt)
+    r = nn.sigmoid(_dense_apply(g["ir"], x, dt) + _dense_apply(g["hr"], hc, dt))
+    z = nn.sigmoid(_dense_apply(g["iz"], x, dt) + _dense_apply(g["hz"], hc, dt))
+    n = nn.tanh(_dense_apply(g["in"], x, dt)
+                + r * _dense_apply(g["hn"], hc, dt))
+    return (1.0 - z) * n + z * hc
+
+
+# ---------------------------------------------------------------------------
+# Packed weights (one [H, 3H] matmul per gate family inside the kernel)
+# ---------------------------------------------------------------------------
+
+
+def _precision(dt) -> jax.lax.Precision:
+    # The band_spmm/tile_spmm rule: f32 keeps HIGHEST so the kernel stays
+    # comparable with the unfused oracle; bf16 rides the native MXU path.
+    return (jax.lax.Precision.HIGHEST if jnp.dtype(dt) == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _packed_weights(params: Mapping, dt):
+    """(ek, eb, wi, bi, wh, bh): edge weights plus the r|z|n gate kernels
+    concatenated on the output axis — three dots become one MXU pass; the
+    recurrent bias vector packs ``[0, 0, b_hn]`` so the hn-only bias rides
+    the same add."""
+    g = params["gru"]
+    h = g["ir"]["kernel"].shape[0]
+    ek = params["edge_linear"]["kernel"].astype(dt)
+    eb = params["edge_linear"]["bias"].astype(dt).reshape(1, -1)
+    wi = jnp.concatenate(
+        [g["ir"]["kernel"], g["iz"]["kernel"], g["in"]["kernel"]],
+        axis=1).astype(dt)
+    bi = jnp.concatenate(
+        [g["ir"]["bias"], g["iz"]["bias"], g["in"]["bias"]]
+    ).astype(dt).reshape(1, -1)
+    wh = jnp.concatenate(
+        [g["hr"]["kernel"], g["hz"]["kernel"], g["hn"]["kernel"]],
+        axis=1).astype(dt)
+    bh = jnp.concatenate(
+        [jnp.zeros((2 * h,), dt), g["hn"]["bias"].astype(dt)]
+    ).reshape(1, -1)
+    return ek, eb, wi, bi, wh, bh
+
+
+def _vals_compute(adj: BandAdjacency, dt):
+    """(vals, message dtype) under the upcast-only rule: f32 adjacency
+    values (a multiplicity not bf16-exact) force f32 messages; otherwise
+    the adjacency rides the model dtype."""
+    vals = adj.vals
+    if vals.dtype == jnp.float32 and jnp.dtype(dt) != jnp.float32:
+        return vals, jnp.float32
+    return vals.astype(dt), jnp.dtype(dt)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(vals_ref, hmsg_ref, hc_ref, ek_ref, eb_ref, wi_ref, bi_ref,
+                wh_ref, bh_ref, out_ref, msg_win, *, n_tiles, bandwidth,
+                hidden, dt, mdt):
+    i = pl.program_id(0)
+    b, w = bandwidth, 2 * bandwidth + 1
+    prec = _precision(mdt)
+
+    # Phase 1: edge-message tile i into the rolling window. The dense-slot
+    # packed batch keeps b tiny, so the window (and its warm-up) is small.
+    @pl.when(i < n_tiles)
+    def _msg():
+        m = jnp.dot(hmsg_ref[:].astype(mdt), ek_ref[:].astype(mdt),
+                    preferred_element_type=jnp.float32, precision=prec)
+        msg_win[i % w] = (m.astype(mdt)
+                          + eb_ref[:].astype(mdt))
+
+    # Phase 2: aggregate + GRU gate for row r = i - b, entirely in VMEM.
+    @pl.when(i >= b)
+    def _gate():
+        r = i - b
+        agg = jnp.zeros((hmsg_ref.shape[0], hidden), jnp.float32)
+        for d in range(w):
+            j = r + d - b
+            contrib = jnp.dot(
+                vals_ref[d, 0].astype(mdt), msg_win[j % w],
+                preferred_element_type=jnp.float32, precision=prec)
+            # Off-range sender tiles hold zero adjacency blocks, but the
+            # window slot may hold uninitialized VMEM (NaN × 0 = NaN) —
+            # the mask, not the zero blocks, is what makes padding inert.
+            agg = agg + jnp.where((j >= 0) & (j < n_tiles), contrib, 0.0)
+        x = agg.astype(dt)
+        hc = hc_ref[:]
+        gi = jnp.dot(x, wi_ref[:], preferred_element_type=jnp.float32,
+                     precision=_precision(dt)).astype(dt) + bi_ref[:]
+        gh = jnp.dot(hc, wh_ref[:], preferred_element_type=jnp.float32,
+                     precision=_precision(dt)).astype(dt) + bh_ref[:]
+        rg = jax.nn.sigmoid(gi[:, :hidden] + gh[:, :hidden])
+        zg = jax.nn.sigmoid(gi[:, hidden:2 * hidden]
+                            + gh[:, hidden:2 * hidden])
+        ng = jnp.tanh(gi[:, 2 * hidden:] + rg * gh[:, 2 * hidden:])
+        out_ref[:] = ((1.0 - zg) * ng + zg * hc).astype(out_ref.dtype)
+
+
+def _run_fwd(params, h, adj: BandAdjacency, interpret: bool) -> jnp.ndarray:
+    dt = h.dtype
+    t, nt, b = adj.tile, adj.n_tiles, adj.bandwidth
+    w = 2 * b + 1
+    hidden = h.shape[1]
+    vals, mdt = _vals_compute(adj, dt)
+    ek, eb, wi, bi, wh, bh = _packed_weights(params, dt)
+
+    kernel = functools.partial(
+        _fwd_kernel, n_tiles=nt, bandwidth=b, hidden=hidden, dt=dt, mdt=mdt)
+    const = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    out = pl.pallas_call(
+        kernel,
+        grid=(nt + b,),
+        in_specs=[
+            pl.BlockSpec((w, 1, t, t),
+                         lambda i: (0, jnp.maximum(i - b, 0), 0, 0)),
+            pl.BlockSpec((t, hidden), lambda i: (jnp.minimum(i, nt - 1), 0)),
+            pl.BlockSpec((t, hidden), lambda i: (jnp.maximum(i - b, 0), 0)),
+            const((hidden, hidden)), const((1, hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+        ],
+        out_specs=pl.BlockSpec((t, hidden),
+                               lambda i: (jnp.maximum(i - b, 0), 0)),
+        out_shape=jax.ShapeDtypeStruct((nt * t, hidden), dt),
+        scratch_shapes=[pltpu.VMEM((w, t, hidden), mdt)],
+        interpret=interpret,
+    )(vals, h, h, ek, eb, wi, bi, wh, bh)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel
+# ---------------------------------------------------------------------------
+
+
+def band_transpose_vals(vals: jnp.ndarray, bandwidth: int,
+                        n_tiles: int) -> jnp.ndarray:
+    """The block-band form of Aᵀ from the block-band form of A:
+    ``t_vals[e, c] = vals[2B-e, c+e-B]ᵀ`` (zero where the source row tile
+    falls outside the batch) — the same pad/slice idiom as band_spmm's
+    shifted message tiles, plus a per-block transpose."""
+    w = 2 * bandwidth + 1
+    outs = []
+    for e in range(w):
+        src = vals[w - 1 - e]
+        shift = e - bandwidth
+        padded = jnp.pad(src, ((bandwidth, bandwidth), (0, 0), (0, 0)))
+        sl = jax.lax.slice_in_dim(
+            padded, shift + bandwidth, shift + bandwidth + n_tiles, axis=0)
+        outs.append(jnp.swapaxes(sl, 1, 2))
+    return jnp.stack(outs)
+
+
+def _bwd_kernel(vals_ref, tvals_ref, hmsg_ref, hc_ref, hdwe_ref, g_ref,
+                ek_ref, eb_ref, wi_ref, bi_ref, wh_ref, bh_ref,
+                dh_ref, dek_ref, deb_ref, dwi_ref, dbi_ref, dwh_ref, dbh_ref,
+                msg_win, dx_win, dhl_win, *, n_tiles, bandwidth, hidden,
+                dt, mdt):
+    i = pl.program_id(0)
+    b, w = bandwidth, 2 * bandwidth + 1
+    prec = _precision(mdt)
+    pdt = _precision(dt)
+
+    # Weight-grad accumulators live in the (VMEM-resident, constant-index)
+    # output blocks; zero them exactly once, before any accumulation.
+    @pl.when(i == 0)
+    def _zero():
+        for ref in (dek_ref, deb_ref, dwi_ref, dbi_ref, dwh_ref, dbh_ref):
+            ref[:] = jnp.zeros_like(ref)
+
+    # Phase 1: recompute edge-message tile i (the remat of the fused op —
+    # nothing but h is saved as residual).
+    @pl.when(i < n_tiles)
+    def _msg():
+        m = jnp.dot(hmsg_ref[:].astype(mdt), ek_ref[:].astype(mdt),
+                    preferred_element_type=jnp.float32, precision=prec)
+        msg_win[i % w] = m.astype(mdt) + eb_ref[:].astype(mdt)
+
+    # Phase 2: gate backward at row r = i - b — recompute the forward
+    # gates, then push the output cotangent through them. d agg and the
+    # local carry cotangent land in rolling windows for phase 3.
+    @pl.when((i >= b) & (i < n_tiles + b))
+    def _gate_bwd():
+        r = i - b
+        agg = jnp.zeros((hmsg_ref.shape[0], hidden), jnp.float32)
+        for d in range(w):
+            j = r + d - b
+            contrib = jnp.dot(
+                vals_ref[d, 0].astype(mdt), msg_win[j % w],
+                preferred_element_type=jnp.float32, precision=prec)
+            agg = agg + jnp.where((j >= 0) & (j < n_tiles), contrib, 0.0)
+        x = agg.astype(dt)
+        hc = hc_ref[:]
+        gi = jnp.dot(x, wi_ref[:], preferred_element_type=jnp.float32,
+                     precision=pdt).astype(dt) + bi_ref[:]
+        gh = jnp.dot(hc, wh_ref[:], preferred_element_type=jnp.float32,
+                     precision=pdt).astype(dt) + bh_ref[:]
+        rg = jax.nn.sigmoid(gi[:, :hidden] + gh[:, :hidden])
+        zg = jax.nn.sigmoid(gi[:, hidden:2 * hidden]
+                            + gh[:, hidden:2 * hidden])
+        pre_hn = gh[:, 2 * hidden:]
+        ng = jnp.tanh(gi[:, 2 * hidden:] + rg * pre_hn)
+
+        g32 = g_ref[:].astype(jnp.float32)
+        hc32 = hc.astype(jnp.float32)
+        rg32, zg32, ng32 = (rg.astype(jnp.float32), zg.astype(jnp.float32),
+                            ng.astype(jnp.float32))
+        dz = g32 * (hc32 - ng32)
+        dn = g32 * (1.0 - zg32)
+        dhc = g32 * zg32
+        dpre_n = dn * (1.0 - ng32 * ng32)
+        drg = dpre_n * pre_hn.astype(jnp.float32)
+        dpre_hn = dpre_n * rg32
+        dpre_r = drg * rg32 * (1.0 - rg32)
+        dpre_z = dz * zg32 * (1.0 - zg32)
+        dpre_i = jnp.concatenate([dpre_r, dpre_z, dpre_n], axis=1)
+        dpre_h = jnp.concatenate([dpre_r, dpre_z, dpre_hn], axis=1)
+
+        dpre_i_c = dpre_i.astype(dt)
+        dpre_h_c = dpre_h.astype(dt)
+        # d agg = dpre_i @ Wiᵀ — contract the gate axis against Wi's.
+        dx = jax.lax.dot_general(
+            dpre_i_c, wi_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=pdt)
+        dx_win[r % w] = dx.astype(mdt)
+        dhl = dhc + jax.lax.dot_general(
+            dpre_h_c, wh_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=pdt)
+        dhl_win[r % w] = dhl
+
+        # Gate weight grads: contract the node-tile axis, accumulate f32.
+        dwi_ref[:] += jax.lax.dot_general(
+            x, dpre_i_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=pdt)
+        dbi_ref[:] += jnp.sum(dpre_i, axis=0, keepdims=True)
+        dwh_ref[:] += jax.lax.dot_general(
+            hc, dpre_h_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=pdt)
+        dbh_ref[:] += jnp.sum(dpre_h, axis=0, keepdims=True)
+
+    # Phase 3: d msg[c] = Σ Aᵀ[c] · d agg, then the edge weights' grads and
+    # the total d h[c] — the dx window now covers c ± b.
+    @pl.when(i >= 2 * b)
+    def _dmsg():
+        c = i - 2 * b
+        dmsg = jnp.zeros((hmsg_ref.shape[0], hidden), jnp.float32)
+        for e in range(w):
+            j = c + e - b
+            contrib = jnp.dot(
+                tvals_ref[e, 0].astype(mdt), dx_win[j % w],
+                preferred_element_type=jnp.float32, precision=prec)
+            dmsg = dmsg + jnp.where((j >= 0) & (j < n_tiles), contrib, 0.0)
+        dmsg_c = dmsg.astype(mdt)
+        dek_ref[:] += jax.lax.dot_general(
+            hdwe_ref[:].astype(mdt), dmsg_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        deb_ref[:] += jnp.sum(dmsg, axis=0, keepdims=True)
+        dh_from_msg = jax.lax.dot_general(
+            dmsg_c, ek_ref[:].astype(mdt), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dh_ref[:] = (dhl_win[c % w] + dh_from_msg).astype(dh_ref.dtype)
+
+
+def _run_bwd(params, h, adj: BandAdjacency, g: jnp.ndarray,
+             interpret: bool):
+    dt = h.dtype
+    t, nt, b = adj.tile, adj.n_tiles, adj.bandwidth
+    w = 2 * b + 1
+    hidden = h.shape[1]
+    vals, mdt = _vals_compute(adj, dt)
+    tvals = band_transpose_vals(vals, b, nt)
+    ek, eb, wi, bi, wh, bh = _packed_weights(params, dt)
+
+    kernel = functools.partial(
+        _bwd_kernel, n_tiles=nt, bandwidth=b, hidden=hidden, dt=dt, mdt=mdt)
+    const = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    f32 = jnp.float32
+    band_blk = pl.BlockSpec((w, 1, t, t),
+                            lambda i: (0, jnp.maximum(i - b, 0), 0, 0))
+    tband_blk = pl.BlockSpec((w, 1, t, t),
+                             lambda i: (0, jnp.maximum(i - 2 * b, 0), 0, 0))
+    row = lambda off: pl.BlockSpec(
+        (t, hidden),
+        lambda i, off=off: (jnp.clip(i - off, 0, nt - 1), 0))
+    dh, dek, deb, dwi, dbi, dwh, dbh = pl.pallas_call(
+        kernel,
+        grid=(nt + 2 * b,),
+        in_specs=[
+            band_blk, tband_blk,
+            row(0),        # h for the message recompute
+            row(b),        # h as the GRU carry
+            row(2 * b),    # h against d msg for dW_e
+            row(b),        # output cotangent at the gate row
+            const((hidden, hidden)), const((1, hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+        ],
+        out_specs=(
+            pl.BlockSpec((t, hidden),
+                         lambda i: (jnp.maximum(i - 2 * b, 0), 0)),
+            const((hidden, hidden)), const((1, hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+            const((hidden, 3 * hidden)), const((1, 3 * hidden)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nt * t, hidden), dt),
+            jax.ShapeDtypeStruct((hidden, hidden), f32),
+            jax.ShapeDtypeStruct((1, hidden), f32),
+            jax.ShapeDtypeStruct((hidden, 3 * hidden), f32),
+            jax.ShapeDtypeStruct((1, 3 * hidden), f32),
+            jax.ShapeDtypeStruct((hidden, 3 * hidden), f32),
+            jax.ShapeDtypeStruct((1, 3 * hidden), f32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((w, t, hidden), mdt),   # msg window
+            pltpu.VMEM((w, t, hidden), mdt),   # d agg window
+            pltpu.VMEM((w, t, hidden), f32),   # local d h window
+        ],
+        interpret=interpret,
+    )(vals, tvals, h, h, h, g, ek, eb, wi, bi, wh, bh)
+    return dh, dek, deb, dwi, dbi, dwh, dbh
+
+
+def _unpack_grads(params, dek, deb, dwi, dbi, dwh, dbh):
+    """Packed kernel-space gradients back to the flax param tree, in the
+    params' own (f32 storage) dtypes."""
+    h = params["gru"]["ir"]["kernel"].shape[0]
+
+    def like(ref, val):
+        return val.astype(ref.dtype)
+
+    g = params["gru"]
+    sl = lambda a, k: a[:, k * h:(k + 1) * h]
+    out = {
+        "edge_linear": {
+            "kernel": like(params["edge_linear"]["kernel"], dek),
+            "bias": like(params["edge_linear"]["bias"], deb[0]),
+        },
+        "gru": {
+            "ir": {"kernel": like(g["ir"]["kernel"], sl(dwi, 0)),
+                   "bias": like(g["ir"]["bias"], sl(dbi, 0)[0])},
+            "iz": {"kernel": like(g["iz"]["kernel"], sl(dwi, 1)),
+                   "bias": like(g["iz"]["bias"], sl(dbi, 1)[0])},
+            "in": {"kernel": like(g["in"]["kernel"], sl(dwi, 2)),
+                   "bias": like(g["in"]["bias"], sl(dbi, 2)[0])},
+            "hr": {"kernel": like(g["hr"]["kernel"], sl(dwh, 0))},
+            "hz": {"kernel": like(g["hz"]["kernel"], sl(dwh, 1))},
+            "hn": {"kernel": like(g["hn"]["kernel"], sl(dwh, 2)),
+                   "bias": like(g["hn"]["bias"], sl(dbh, 2)[0])},
+        },
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The differentiable fused op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_pallas(params, h, adj: BandAdjacency,
+                  interpret: bool) -> jnp.ndarray:
+    return _run_fwd(params, h, adj, interpret)
+
+
+def _fused_fwd(params, h, adj, interpret):
+    # Residuals: params + h + the structural adjacency — no activations.
+    # The backward kernel recomputes messages/gates tile by tile (the
+    # in-kernel remat), so the fused step saves nothing [nodes, H]-sized.
+    return _run_fwd(params, h, adj, interpret), (params, h, adj)
+
+
+def _fused_bwd(interpret, res, g):
+    params, h, adj = res
+    dh, dek, deb, dwi, dbi, dwh, dbh = _run_bwd(params, h, adj, g, interpret)
+    dparams = _unpack_grads(params, dek, deb, dwi, dbi, dwh, dbh)
+    dadj = jax.tree_util.tree_map(jnp.zeros_like, adj)  # structural
+    return dparams, dh, dadj
+
+
+_fused_pallas.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_gate_step(params: Mapping, h: jnp.ndarray, adj: BandAdjacency,
+                    impl: str = "auto") -> jnp.ndarray:
+    """One fused gated graph step: ``h' = GRU(A @ (h W_e + b_e), h)``.
+
+    ``params``: the flax GatedGraphStep subtree (``edge_linear`` +
+    ``gru/{ir,iz,in,hr,hz,hn}``). ``impl``: "pallas" (the TPU megakernel)
+    | "interpret" (same kernels on the Pallas interpreter — tests) |
+    "xla" (the unfused reference composition — the CPU/tier-1 fallback)
+    | "auto". Differentiable in ``params`` and ``h``; the adjacency is
+    structural.
+    """
+    impl = resolve_impl(impl)
+    if impl == "xla":
+        return fused_reference(params, h, adj)
+    if adj.vals.ndim != 4:
+        raise ValueError(
+            "fused kernel takes one shard's band adjacency (vals "
+            f"[2B+1, T, t, t]); got ndim={adj.vals.ndim} — sharded batches "
+            "dispatch through the band fallback (models/flowgnn.py)")
+    return _fused_pallas(params, h, adj, impl == "interpret")
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost accounting (Pallas is invisible to XLA's cost model)
+# ---------------------------------------------------------------------------
+
+
+def fused_step_cost(adj: BandAdjacency, hidden: int,
+                    dtype="float32") -> Dict[str, float]:
+    """FLOPs / HBM bytes of ONE fused forward step, counted the way the
+    roofline counts the unfused ops: dense matmul FLOPs (2mnk) over the
+    message dense, the 2B+1 band block-matmuls, and the packed gate
+    matmuls; bytes = the HBM the kernel actually touches (h in twice +
+    carry, h' out, adjacency once, weights once). The backward is ~2× the
+    matmul work plus the Aᵀ pass — callers scale by steps as needed."""
+    t, nt, b = adj.tile, adj.n_tiles, adj.bandwidth
+    w = 2 * b + 1
+    n = nt * t
+    itemsize = jnp.dtype(dtype).itemsize
+    flops = (
+        2.0 * n * hidden * hidden            # msg = h @ We
+        + 2.0 * w * nt * t * t * hidden      # agg = A @ msg (band bmms)
+        + 2.0 * n * hidden * 3 * hidden      # x @ Wi
+        + 2.0 * n * hidden * 3 * hidden      # h @ Wh
+        + 10.0 * n * hidden                  # gate elementwise
+    )
+    bytes_accessed = (
+        3.0 * n * hidden * itemsize          # h: msg read + carry read, h' out
+        + float(adj.vals.size) * adj.vals.dtype.itemsize
+        + (8.0 * hidden * hidden + 7.0 * hidden) * itemsize
+    )
+    # Backward: the in-kernel remat replays every forward matmul, then the
+    # gate/edge cotangent matmuls (dx, dh_local, dWi, dWh each one packed
+    # [n,3H] pass), the Aᵀ band pass, and dW_e / dh-from-msg.
+    bwd_flops = (
+        flops                                   # forward recompute
+        + 4.0 * 2.0 * n * hidden * 3 * hidden   # dx, dh_local, dWi, dWh
+        + 2.0 * w * nt * t * t * hidden         # d msg = Aᵀ @ d agg
+        + 2.0 * 2.0 * n * hidden * hidden       # dW_e, dh from d msg
+        + 30.0 * n * hidden                     # gate backward elementwise
+    )
+    # Backward HBM: h fetched through three row pipelines (message
+    # recompute, carry, dW_e), the cotangent in, dh out, both band forms
+    # (A and the host-built Aᵀ), weights in + packed f32 grads out.
+    bwd_bytes_accessed = (
+        5.0 * n * hidden * itemsize              # h ×3, g in, dh out
+        + 2.0 * float(adj.vals.size) * adj.vals.dtype.itemsize
+        + (8.0 * hidden * hidden + 7.0 * hidden) * itemsize
+        + (8.0 * hidden * hidden + 7.0 * hidden) * 4.0   # f32 grads out
+    )
+    return {"flops": flops, "bwd_flops": bwd_flops,
+            "bytes_accessed": bytes_accessed,
+            "bwd_bytes_accessed": bwd_bytes_accessed,
+            "flops_unfused_hbm_bytes": (
+                # What the unfused chain moves: msg, agg and the six gate
+                # pre-activations all round-trip [n, hidden] through HBM.
+                bytes_accessed + 9.0 * n * hidden * itemsize)}

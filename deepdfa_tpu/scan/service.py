@@ -97,6 +97,7 @@ class ScanService:
         pool: Optional[JoernPool] = None,
         cache: Optional[ScanCache] = None,
         cache_path: "str | Path | None" = None,
+        vocabs: Optional[Mapping] = None,
     ):
         self.engine = engine
         self.config = config or ScanConfig()
@@ -114,8 +115,37 @@ class ScanService:
         self.cache = cache or ScanCache(cache_path,
                                         capacity=self.config.cache_capacity)
         self.quarantine = contracts.Quarantine(self.workdir / "quarantine")
-        self.vocabs = hashing_vocabs(engine.required_subkeys,
-                                     feature.limit_all)
+        if vocabs is not None:
+            # Checkpoint-faithful mode: the ETL export's persisted vocabs
+            # (etl/export.load_vocabs) — scan indices then match what the
+            # model trained on exactly. A vocab set missing one of the
+            # engine's subkeys would silently zero a whole embedding
+            # table's features; fail loudly instead.
+            missing = [k for k in engine.required_subkeys if k not in vocabs]
+            if missing:
+                raise ValueError(
+                    f"scan vocabs missing subkeys {missing} (engine lanes "
+                    f"need {engine.required_subkeys})")
+            # The embedding table is sized input_dim == limit_all + 2; a
+            # vocab exported under a bigger limit would hand index_for
+            # results past the table (silent clamp/wrap on gather — wrong
+            # features, no error). Same fail-loud contract as above.
+            bad = {k: v.limit_all for k, v in vocabs.items()
+                   if k in engine.required_subkeys
+                   and v.limit_all > feature.limit_all}
+            if bad:
+                raise ValueError(
+                    f"scan vocabs exported with limit_all {bad} exceed the "
+                    f"model's feature limit_all={feature.limit_all} "
+                    f"(embedding input_dim={feature.limit_all + 2}) — "
+                    "re-export with the checkpoint's FeatureSpec")
+            self.vocabs = vocabs
+        else:
+            # Fallback: deterministic hashing vocabulary (same index_for
+            # contract, no train split needed) — reproducible across
+            # restarts but NOT the mapping the checkpoint trained on.
+            self.vocabs = hashing_vocabs(engine.required_subkeys,
+                                         feature.limit_all)
 
     # -- metrics -------------------------------------------------------------
 

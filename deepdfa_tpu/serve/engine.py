@@ -65,7 +65,8 @@ class _Lane:
     infer: Callable
     params: Any
     subkeys: Sequence[str]
-    band: bool  # message_impl == "band": banded adjacency, tile-aligned
+    band: bool  # uses_band_adj: banded adjacency, tile-aligned budgets
+    graph_cfg: Any = None  # the lane's FlowGNNConfig (fused cost capture)
 
 
 def bucket_batch(config: ServeConfig, graphs: Sequence[Mapping], slots: int,
@@ -92,7 +93,7 @@ def random_gnn_params(model, config: ServeConfig, seed: int = 0):
     and bench mode (the serving stack is real, the scores are not)."""
     empty = bucket_batch(
         config, [], 1, subkeys_for(model.config.feature),
-        band=model.config.message_impl == "band",
+        band=model.config.uses_band_adj,
     )
     return model.init(jax.random.PRNGKey(seed), empty)
 
@@ -151,14 +152,19 @@ class ServeEngine:
 
     @staticmethod
     def _make_lane(name, infer, params, graph_cfg) -> _Lane:
-        if graph_cfg.message_impl not in ("segment", "band"):
+        if graph_cfg.message_impl not in ("segment", "band", "fused"):
             raise ValueError(
-                f"serving supports message_impl 'segment' or 'band' (pinned "
-                f"bandwidth), got {graph_cfg.message_impl!r} — per-batch "
-                "adjacency budgets would mint new compiled shapes at runtime"
+                f"serving supports message_impl 'segment', 'band' or "
+                f"'fused' (pinned bandwidth), got "
+                f"{graph_cfg.message_impl!r} — per-batch adjacency budgets "
+                "would mint new compiled shapes at runtime"
             )
+        # uses_band_adj, not a literal impl compare: the fused lane rides
+        # the same pinned-bandwidth band adjacency, and an impl-string
+        # test here silently dropped new band-family lanes back onto
+        # segment-shaped batches (the flag-audit fix, ISSUE 9).
         return _Lane(name, infer, params, subkeys_for(graph_cfg.feature),
-                     band=graph_cfg.message_impl == "band")
+                     band=graph_cfg.uses_band_adj, graph_cfg=graph_cfg)
 
     def now(self) -> float:
         return self._clock()
@@ -230,11 +236,26 @@ class ServeEngine:
         # Cost-model capture for the roofline report: this executable IS
         # the AOT artifact, so the capture costs one cost_analysis read,
         # no extra compile. Joined to serve.flush spans by (lane, slots).
+        # Fused lanes add the Pallas kernel's analytic forward FLOPs —
+        # XLA's cost model counts the custom call as zero.
         from deepdfa_tpu.telemetry import costmodel
 
+        extra_flops = extra_bytes = 0.0
+        cfg = lane.graph_cfg
+        if (cfg is not None and cfg.message_impl == "fused"
+                and empty.band_adj is not None
+                and empty.band_adj.vals.ndim == 4):
+            from deepdfa_tpu.ops.fused_gnn import fused_step_cost, resolve_impl
+
+            if resolve_impl() != "xla":
+                cost = fused_step_cost(
+                    empty.band_adj, cfg.ggnn_hidden, cfg.dtype)
+                extra_flops = cfg.n_steps * cost["flops"]
+                extra_bytes = cfg.n_steps * cost["bytes_accessed"]
         costmodel.capture_compiled(
             f"serve.{lane_name}.s{slots}", exe, span="serve.flush",
-            lane=lane_name, slots=slots,
+            lane=lane_name, slots=slots, extra_flops=extra_flops,
+            extra_bytes=extra_bytes,
         )
         self.stats.bump("compiles")
         logger.info("compiled %s bucket slots=%d in %.2fs", lane_name, slots,

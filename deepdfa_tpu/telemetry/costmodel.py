@@ -106,6 +106,8 @@ def reset() -> None:
 def capture_compiled(name: str, compiled, steps_per_call: int = 1,
                      span: Optional[str] = None,
                      use_fenced_window: bool = False,
+                     extra_flops: float = 0.0,
+                     extra_bytes: float = 0.0,
                      **attrs: Any) -> Optional[Dict[str, Any]]:
     """Record one compiled executable's cost model under ``name``.
 
@@ -118,6 +120,14 @@ def capture_compiled(name: str, compiled, steps_per_call: int = 1,
     the fenced-window amortized step time over the dispatch-only span
     p50 when computing MFU (the train loops' honest device-inclusive
     per-step time).
+
+    ``extra_flops``/``extra_bytes``: analytic work XLA's cost model
+    cannot see — Pallas kernels are opaque custom calls it counts as
+    zero, so callables built on them (the fused GNN megakernel, the
+    flash attention kernels) register their hand-counted FLOPs/bytes
+    here, summed over the whole dispatch (all ``steps_per_call`` steps).
+    Added on top of the XLA-counted remainder of the program; recorded
+    separately in the event so the roofline can attribute the split.
 
     Returns the record, or None when telemetry is fully disabled or the
     backend supports neither analysis. Never raises: a cost-model gap
@@ -145,12 +155,16 @@ def capture_compiled(name: str, compiled, steps_per_call: int = 1,
         "span": span or name,
         "steps_per_call": int(steps_per_call),
         "use_fenced_window": bool(use_fenced_window),
-        "flops": costs.get("flops", 0.0),
-        "bytes_accessed": costs.get("bytes accessed", 0.0),
+        "flops": costs.get("flops", 0.0) + float(extra_flops),
+        "bytes_accessed": (costs.get("bytes accessed", 0.0)
+                           + float(extra_bytes)),
         "device_kind": kind,
         "peak_flops": peak_flops,
         "peak_hbm_bytes_per_sec": peak_bw,
     }
+    if extra_flops or extra_bytes:
+        record["analytic_flops"] = float(extra_flops)
+        record["analytic_bytes"] = float(extra_bytes)
     if mem is not None:
         record["memory"] = mem
         telemetry_memory.record_compiled(name, mem)

@@ -438,8 +438,11 @@ def fit(
         )
     subkeys = subkeys_for(model.config.feature)
     n_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
-    use_tile = model.config.message_impl == "tile"
-    use_band = model.config.message_impl == "band"
+    # The band-family predicate (band AND fused consume the band
+    # adjacency) lives on the config so no lane can drift — the flag
+    # audit in tests/test_fused_gnn.py.
+    use_tile = model.config.uses_tile_adj
+    use_band = model.config.uses_band_adj
     use_df = model.config.label_style.startswith("dataflow_solution")
     # Multi-controller: every process runs this same loop; each feeds its
     # local slice of every global batch (host_shard contract, mesh.py).
@@ -770,8 +773,31 @@ def _fit_epochs(
                     and window_steps:
                 from deepdfa_tpu.telemetry import costmodel
 
+                # The fused megakernel is a Pallas custom call — zero in
+                # XLA's cost model — so its hand-counted FLOPs join the
+                # roofline capture analytically (fwd + bwd per gated
+                # step; ops/fused_gnn.fused_step_cost).
+                extra: Dict[str, Any] = {}
+                if (model.config.message_impl == "fused"
+                        and batch.band_adj is not None
+                        and batch.band_adj.vals.ndim == 4):
+                    from deepdfa_tpu.ops.fused_gnn import (
+                        fused_step_cost,
+                        resolve_impl,
+                    )
+
+                    if resolve_impl() != "xla":
+                        c = fused_step_cost(batch.band_adj,
+                                            model.config.ggnn_hidden,
+                                            model.config.dtype)
+                        extra["extra_flops"] = model.config.n_steps * (
+                            c["flops"] + c["bwd_flops"])
+                        extra["extra_bytes"] = model.config.n_steps * (
+                            c["bytes_accessed"]
+                            + c["bwd_bytes_accessed"])
                 costmodel.capture_jitted("train.step", train_step, state,
-                                         batch, use_fenced_window=True)
+                                         batch, use_fenced_window=True,
+                                         **extra)
             # Every jitted shape this fit dispatches has now compiled
             # (train step + eval step); any jax.compile event after this
             # marker is a silent recompile the trace report must surface.

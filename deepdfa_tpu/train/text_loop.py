@@ -552,11 +552,11 @@ def fit_text(
 
     build_tile_adj = (
         model.graph_config is not None
-        and model.graph_config.message_impl == "tile"
+        and model.graph_config.uses_tile_adj
     )
     build_band_adj = (
         model.graph_config is not None
-        and model.graph_config.message_impl == "band"
+        and model.graph_config.uses_band_adj
     )
     from deepdfa_tpu.parallel.mesh import DATA_AXIS
 

@@ -962,6 +962,102 @@ def join_worker(proc, gate: threading.Event):
 
 
 # ---------------------------------------------------------------------------
+# GL016 pallas-interpret-in-prod
+# ---------------------------------------------------------------------------
+
+
+def test_gl016_literal_interpret_true():
+    src = """
+from jax.experimental import pallas as pl
+
+def double(x):
+    return pl.pallas_call(_kern, interpret=True)(x)
+"""
+    found = findings_for(src, "GL016")
+    assert len(found) == 1
+    assert "interpret pinned True" in found[0].message
+    assert "100x" in found[0].message
+
+
+def test_gl016_pinned_through_assignment_and_module_constant():
+    # One reaching-def hop and the module-constant hop both count as a
+    # pin — the two shapes a debugging session actually leaves behind.
+    assigned = """
+from jax.experimental import pallas as pl
+
+def f(x):
+    debug = True
+    return pl.pallas_call(_kern, interpret=debug)(x)
+"""
+    const = """
+from jax.experimental import pallas as pl
+INTERPRET = True
+
+def f(x):
+    return pl.pallas_call(_kern, interpret=INTERPRET)(x)
+"""
+    assert len(findings_for(assigned, "GL016")) == 1
+    assert len(findings_for(const, "GL016")) == 1
+
+
+def test_gl016_kernel_wrapper_positional_pin():
+    # The wrapper shape: a local def with an `interpret` parameter that
+    # forwards to pallas_call; pinning True at its call site (keyword OR
+    # positional) is the same shipped debug flag.
+    src = """
+from jax.experimental import pallas as pl
+
+def _spmm(vals, msg, interpret):
+    return pl.pallas_call(_kern, interpret=interpret)(vals, msg)
+
+def aggregate(vals, msg):
+    return _spmm(vals, msg, True)
+"""
+    found = findings_for(src, "GL016")
+    assert len(found) == 1
+    assert "kernel wrapper _spmm" in found[0].message
+
+
+def test_gl016_negative_guarded_dispatch_and_parameter():
+    # The sanctioned idiom (tile_spmm._dispatch): interpreted mode behind
+    # a caller-chosen impl switch; and interpret= of unknown provenance
+    # (a parameter) stays unflagged — the caller owns it.
+    guarded = """
+from jax.experimental import pallas as pl
+
+def _spmm(vals, msg, interpret):
+    return pl.pallas_call(_kern, interpret=interpret)(vals, msg)
+
+def dispatch(vals, msg, impl):
+    if impl == "interpret":
+        return _spmm(vals, msg, True)
+    return _spmm(vals, msg, False)
+"""
+    passthrough = """
+from jax.experimental import pallas as pl
+
+def run(x, interpret=False):
+    return pl.pallas_call(_kern, interpret=interpret)(x)
+"""
+    assert "GL016" not in rules_of(guarded)
+    assert "GL016" not in rules_of(passthrough)
+
+
+def test_gl016_negative_tests_path_is_exempt():
+    # interpret=True in tests/ is the interpreter's intended home (the
+    # tier-1 kernel-numerics suites run exactly this way).
+    src = """
+from jax.experimental import pallas as pl
+
+def test_kernel(x):
+    return pl.pallas_call(_kern, interpret=True)(x)
+"""
+    found = [f for f in analyze_source("tests/test_kernels.py", src)
+             if f.rule == "GL016"]
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
 # GL009 swallowed-device-exception
 # ---------------------------------------------------------------------------
 
@@ -1220,8 +1316,9 @@ def test_self_check_covers_every_rule_implementation():
     from deepdfa_tpu.analysis.rules import RULES
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
-                          | {"GL010", "GL011", "GL013", "GL014", "GL015"})
-    assert len(RULES) == 15
+                          | {"GL010", "GL011", "GL013", "GL014", "GL015",
+                             "GL016"})
+    assert len(RULES) == 16
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

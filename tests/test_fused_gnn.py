@@ -1,0 +1,461 @@
+"""Fused FlowGNN megakernel (deepdfa_tpu/ops/fused_gnn.py) + dense-slot
+packing (graphs/batch.py slot_nodes) + the message_impl="fused" flag audit.
+
+The acceptance gates from ISSUE 9:
+  * gradient parity — fused vs unfused GatedGraphStep BITWISE-equal on the
+    CPU fallback (the fused flag off-TPU IS the band composition), and the
+    real kernels (Pallas interpreter) within documented tolerance
+    (f32: 1e-5 relative — one packed-matmul accumulation-order difference);
+  * the param tree is identical across impls (checkpoints survive the flag);
+  * padded slots contribute exactly zero to segment sums and gradients;
+  * serve warms the SAME compiled-executable count per lane with the fused
+    option in play, and stays zero-recompile after warmup.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig, subkeys_for
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.graphs.batch import (
+    batch_graphs,
+    batch_iterator,
+    pad_budget_for,
+    slot_nodes_for,
+)
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.ops import fused_gnn
+from deepdfa_tpu.ops.band_spmm import BandAdjacency, build_band_adjacency
+from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, align_to_tile
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+
+
+@pytest.fixture
+def force_interpret(monkeypatch):
+    """Route message_impl='fused' through the REAL Pallas kernels on the
+    CPU tier-1 host (the interpreter executes the same kernel program)."""
+    monkeypatch.setenv("DEEPDFA_FUSED_IMPL", "interpret")
+
+
+def _random_params(key, hidden):
+    ks = iter(jax.random.split(key, 20))
+    dense = lambda bias: (
+        {"kernel": jax.random.normal(next(ks), (hidden, hidden)) * 0.2,
+         **({"bias": jax.random.normal(next(ks), (hidden,)) * 0.2}
+            if bias else {})})
+    return {
+        "edge_linear": dense(True),
+        "gru": {name: dense(bias) for name, bias in
+                (("ir", True), ("iz", True), ("in", True),
+                 ("hr", False), ("hz", False), ("hn", True))},
+    }
+
+
+def _band_fixture(rng, tile, n_tiles, spread):
+    n = tile * n_tiles
+    s = rng.integers(0, n, 6 * n)
+    r = np.clip(s + rng.integers(-spread, spread + 1, 6 * n), 0, n - 1)
+    return build_band_adjacency(s, r, np.ones(len(s), bool), n, tile=tile)
+
+
+# ---------------------------------------------------------------------------
+# Kernel vs XLA reference (the numerics oracle), forward + backward
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tile,n_tiles,spread,hidden",
+                         [(8, 4, 2, 16), (8, 6, 20, 8), (16, 3, 1, 32)])
+def test_fused_kernel_matches_reference(tile, n_tiles, spread, hidden):
+    rng = np.random.default_rng(0)
+    adj = _band_fixture(rng, tile, n_tiles, spread)
+    params = _random_params(jax.random.PRNGKey(1), hidden)
+    h = jnp.asarray(
+        rng.standard_normal((tile * n_tiles, hidden)).astype(np.float32))
+
+    ref = fused_gnn.fused_gate_step(params, h, adj, impl="xla")
+    got = fused_gnn.fused_gate_step(params, h, adj, impl="interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    cot = jnp.asarray(
+        rng.standard_normal((tile * n_tiles, hidden)).astype(np.float32))
+
+    def scalar(impl):
+        return lambda p, x: jnp.vdot(
+            fused_gnn.fused_gate_step(p, x, adj, impl=impl), cot)
+
+    gref = jax.grad(scalar("xla"), argnums=(0, 1))(params, h)
+    ggot = jax.grad(scalar("interpret"), argnums=(0, 1))(params, h)
+    for a, b in zip(jax.tree_util.tree_leaves(gref),
+                    jax.tree_util.tree_leaves(ggot)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_kernel_bf16_and_zero_bandwidth():
+    # Block-diagonal edges (every graph inside one tile — the slot-packed
+    # sweet spot) and the bf16 lane in one go. The public builder's pow2
+    # ladder floors bandwidth at 1, so the true B=0 kernel path (window
+    # of ONE, zero warm-up) is exercised by re-wrapping the diagonal
+    # plane as an explicit bandwidth-0 adjacency.
+    rng = np.random.default_rng(3)
+    tile, n_tiles, hidden = 8, 4, 16
+    n = tile * n_tiles
+    base = (rng.integers(0, n, 4 * n) // tile) * tile
+    s = base + rng.integers(0, tile, 4 * n)
+    r = base + rng.integers(0, tile, 4 * n)
+    adj = build_band_adjacency(s, r, np.ones(len(s), bool), n, tile=tile)
+    assert adj.bandwidth == 1  # the ladder's floor, off-diagonals all zero
+    off = np.asarray(adj.vals)[[0, 2]]
+    assert float(np.abs(off).max()) == 0.0
+    params = _random_params(jax.random.PRNGKey(2), hidden)
+    h = jnp.asarray(
+        rng.standard_normal((n, hidden)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    ref = fused_gnn.fused_gate_step(params, h, adj, impl="xla")
+    got = fused_gnn.fused_gate_step(params, h, adj, impl="interpret")
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
+    # The genuine window-of-one kernel: same graph, bandwidth pinned 0.
+    adj0 = BandAdjacency(vals=adj.vals[1:2], tile=tile, n_tiles=n_tiles,
+                         bandwidth=0)
+    got0 = fused_gnn.fused_gate_step(params, h, adj0, impl="interpret")
+    np.testing.assert_allclose(
+        np.asarray(got0, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
+
+
+def test_band_transpose_vals_is_adjoint():
+    rng = np.random.default_rng(4)
+    adj = _band_fixture(rng, 8, 5, 12)
+    tv = fused_gnn.band_transpose_vals(
+        adj.vals.astype(jnp.float32), adj.bandwidth, adj.n_tiles)
+    # Dense check: band(tv) == band(vals).T as full matrices.
+    def dense(vals, bw, nt, t):
+        a = np.zeros((nt * t, nt * t), np.float32)
+        v = np.asarray(vals, np.float32)
+        for d in range(2 * bw + 1):
+            for row in range(nt):
+                col = row + d - bw
+                if 0 <= col < nt:
+                    a[row * t:(row + 1) * t, col * t:(col + 1) * t] = \
+                        v[d, row]
+        return a
+    a = dense(adj.vals.astype(jnp.float32), adj.bandwidth, adj.n_tiles,
+              adj.tile)
+    at = dense(tv, adj.bandwidth, adj.n_tiles, adj.tile)
+    np.testing.assert_allclose(at, a.T, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance gates: bitwise CPU fallback, tolerance-documented kernels
+# ---------------------------------------------------------------------------
+
+
+def _slot_batch(n_graphs=12, seed=3):
+    graphs = synthetic_bigvul(n_graphs, FEAT, positive_fraction=0.5,
+                              seed=seed)
+    slot = slot_nodes_for(graphs, tile=DEFAULT_TILE)
+    return batch_graphs(
+        graphs, n_graphs, align_to_tile(n_graphs * slot), 4096,
+        subkeys_for(FEAT), build_band_adj=True, slot_nodes=slot,
+    ), graphs, slot
+
+
+def _loss(model, params, batch):
+    return jnp.sum(model.apply(params, batch) ** 2)
+
+
+def test_fused_cpu_fallback_is_bitwise_band():
+    """THE gradient-parity gate: on the CPU fallback (auto resolves to
+    xla off-TPU), fused init, forward AND gradients are bit-for-bit the
+    band path — same flax modules, same program."""
+    batch, _, _ = _slot_batch()
+    cfg_b = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="band")
+    cfg_f = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+    mb, mf = FlowGNN(cfg_b), FlowGNN(cfg_f)
+    pb = mb.init(jax.random.PRNGKey(0), batch)
+    pf = mf.init(jax.random.PRNGKey(0), batch)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), pb, pf))
+    ob, of = mb.apply(pb, batch), mf.apply(pb, batch)
+    assert (np.asarray(ob) == np.asarray(of)).all()
+    gb = jax.grad(lambda p: _loss(mb, p, batch))(pb)
+    gf = jax.grad(lambda p: _loss(mf, p, batch))(pb)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), gb, gf))
+
+
+def test_fused_param_tree_identical_under_kernel_impl(force_interpret):
+    """The holder modules declare the SAME tree (paths, shapes, values)
+    the flax Dense/GRUCell would — checkpoints survive the impl flip."""
+    batch, _, _ = _slot_batch()
+    cfg_b = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="band")
+    cfg_f = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+    pb = FlowGNN(cfg_b).init(jax.random.PRNGKey(0), batch)
+    pf = FlowGNN(cfg_f).init(jax.random.PRNGKey(0), batch)
+    assert jax.tree_util.tree_structure(pb) == jax.tree_util.tree_structure(pf)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), pb, pf))
+
+
+def test_fused_kernel_model_within_tolerance(force_interpret):
+    """The real kernels (interpreted) against the band path through the
+    whole model: documented tolerance 1e-5 relative (f32) — the packed
+    [H,3H] gate matmul accumulates in one pass where flax runs three."""
+    batch, _, _ = _slot_batch()
+    cfg_b = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="band")
+    cfg_f = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+    mb, mf = FlowGNN(cfg_b), FlowGNN(cfg_f)
+    params = mb.init(jax.random.PRNGKey(0), batch)
+    np.testing.assert_allclose(
+        np.asarray(mf.apply(params, batch)),
+        np.asarray(mb.apply(params, batch)), rtol=1e-5, atol=1e-5)
+    gb = jax.grad(lambda p: _loss(mb, p, batch))(params)
+    gf = jax.grad(lambda p: _loss(mf, p, batch))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gb),
+                    jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_without_band_adj_raises():
+    graphs = synthetic_bigvul(4, FEAT, seed=0)
+    budget = pad_budget_for(graphs, 4)
+    batch = batch_graphs(graphs, 4, budget["max_nodes"],
+                         budget["max_edges"], subkeys_for(FEAT))
+    cfg = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+    with pytest.raises(ValueError, match="build_band_adj"):
+        FlowGNN(cfg).init(jax.random.PRNGKey(0), batch)
+
+
+# ---------------------------------------------------------------------------
+# Dense-slot packing (graphs/batch.py)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_packing_round_trips_ragged_mixes():
+    """Property test over seeded ragged graph mixes: packing at slot
+    offsets preserves every graph's features, labels, and edge endpoints
+    (re-based to its slot), and unpacking by slot recovers them."""
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        graphs = synthetic_bigvul(int(rng.integers(3, 10)), FEAT,
+                                  positive_fraction=0.5, seed=seed)
+        slot = slot_nodes_for(graphs)
+        n_g = len(graphs)
+        batch = batch_graphs(
+            graphs, n_g, n_g * slot, 4096, subkeys_for(FEAT),
+            add_self_loops=False, slot_nodes=slot,
+        )
+        node_mask = np.asarray(batch.node_mask)
+        node_graph = np.asarray(batch.node_graph)
+        senders = np.asarray(batch.senders)
+        receivers = np.asarray(batch.receivers)
+        edge_mask = np.asarray(batch.edge_mask)
+        for gi, g in enumerate(graphs):
+            n = int(g["num_nodes"])
+            off = gi * slot
+            # node slots: exactly this graph's span is live
+            assert node_mask[off:off + n].all()
+            assert not node_mask[off + n:off + slot].any()
+            assert (node_graph[off:off + n] == gi).all()
+            for k in subkeys_for(FEAT):
+                np.testing.assert_array_equal(
+                    np.asarray(batch.node_feats[k])[off:off + n],
+                    np.asarray(g["feats"][k]))
+            np.testing.assert_array_equal(
+                np.asarray(batch.node_vuln)[off:off + n],
+                np.asarray(g["vuln"]))
+        # edges: each graph's endpoint set re-based to its slot offset
+        live = edge_mask.nonzero()[0]
+        got = {(int(senders[e]), int(receivers[e])) for e in live}
+        want = {
+            (int(s) + gi * slot, int(r) + gi * slot)
+            for gi, g in enumerate(graphs)
+            for s, r in zip(g["senders"], g["receivers"])
+        }
+        assert got == want
+
+
+def test_slot_packing_aligns_dataflow_bits():
+    """with_dataflow=True under slot packing: df_in/df_out land at the
+    SAME slot offsets as the node features (the dataflow copy loop used
+    to keep its own contiguous accumulator, silently shearing labels off
+    by the accumulated in-slot padding)."""
+    graphs = synthetic_bigvul(5, FEAT, positive_fraction=0.5, seed=3)
+    slot = slot_nodes_for(graphs)
+    batch = batch_graphs(graphs, 5, 5 * slot, 4096, subkeys_for(FEAT),
+                         with_dataflow=True, slot_nodes=slot)
+    df_in = np.asarray(batch.node_df_in)
+    df_out = np.asarray(batch.node_df_out)
+    for gi, g in enumerate(graphs):
+        n, off = int(g["num_nodes"]), gi * slot
+        np.testing.assert_array_equal(df_in[off:off + n],
+                                      np.asarray(g["df_in"], np.int32))
+        np.testing.assert_array_equal(df_out[off:off + n],
+                                      np.asarray(g["df_out"], np.int32))
+        assert not df_in[off + n:off + slot].any()
+        assert not df_out[off + n:off + slot].any()
+
+
+def test_slot_packing_padded_slots_inert_in_sums_and_grads():
+    """Padded in-slot tails contribute EXACTLY zero to segment sums and
+    to gradients: fused forward/gradients on the slot-packed batch match
+    the densely-packed batch graph for graph."""
+    graphs = synthetic_bigvul(6, FEAT, positive_fraction=0.5, seed=7)
+    slot = slot_nodes_for(graphs, tile=DEFAULT_TILE)
+    dense_budget = pad_budget_for(graphs, 6)
+    packed = batch_graphs(graphs, 6, align_to_tile(6 * slot), 4096,
+                          subkeys_for(FEAT), build_band_adj=True,
+                          slot_nodes=slot)
+    dense = batch_graphs(graphs, 6, align_to_tile(dense_budget["max_nodes"]),
+                         dense_budget["max_edges"], subkeys_for(FEAT),
+                         build_band_adj=True)
+    cfg = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+    model = FlowGNN(cfg)
+    params = model.init(jax.random.PRNGKey(0), packed)
+    out_p = np.asarray(model.apply(params, packed))
+    out_d = np.asarray(model.apply(params, dense))
+    # Per-graph logits identical regardless of layout.
+    np.testing.assert_allclose(out_p[:6], out_d[:6], rtol=1e-5, atol=1e-6)
+    gp = jax.grad(lambda p: _loss(model, p, packed))(params)
+    gd = jax.grad(lambda p: _loss(model, p, dense))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_slot_packing_pins_bandwidth_and_validates():
+    graphs = synthetic_bigvul(8, FEAT, positive_fraction=0.5, seed=1)
+    slot = slot_nodes_for(graphs, tile=DEFAULT_TILE)
+    batch = batch_graphs(graphs, 8, align_to_tile(8 * slot), 4096,
+                         subkeys_for(FEAT), build_band_adj=True,
+                         slot_nodes=slot)
+    # A slot never spans more than ceil(slot/tile) adjacent tiles.
+    assert batch.band_adj.bandwidth <= max(1, -(-slot // DEFAULT_TILE))
+    # Overflow and misfit raise loudly.
+    with pytest.raises(ValueError, match="exceed"):
+        batch_graphs(graphs, 8, 8 * slot - 1, 4096, subkeys_for(FEAT),
+                     slot_nodes=slot)
+    big = dict(graphs[0], num_nodes=slot + 1,
+               senders=np.zeros(0, np.int32),
+               receivers=np.zeros(0, np.int32),
+               vuln=np.zeros(slot + 1, np.int32),
+               feats={k: np.zeros(slot + 1, np.int64)
+                      for k in subkeys_for(FEAT)})
+    with pytest.raises(ValueError, match="slot_nodes"):
+        batch_graphs([big], 8, 8 * slot, 4096, subkeys_for(FEAT),
+                     slot_nodes=slot)
+    with pytest.raises(ValueError, match="native"):
+        batch_graphs(graphs, 8, 8 * slot, 4096, subkeys_for(FEAT),
+                     slot_nodes=slot, impl="native")
+
+
+def test_slot_packing_iterator_spills_on_slot_budget():
+    graphs = synthetic_bigvul(10, FEAT, positive_fraction=0.5, seed=2)
+    slot = slot_nodes_for(graphs)
+    batches = list(batch_iterator(
+        graphs, n_graphs=4, max_nodes=4 * slot, max_edges=4096,
+        subkeys=subkeys_for(FEAT), slot_nodes=slot,
+    ))
+    assert len(batches) == 3  # 4 + 4 + 2
+    counts = [int(np.asarray(b.graph_mask).sum()) for b in batches]
+    assert counts == [4, 4, 2]
+    # Every batch shares the one slot layout (one compiled shape).
+    assert all(b.max_nodes == 4 * slot for b in batches)
+
+
+# ---------------------------------------------------------------------------
+# Flag audit: the band-family predicate honored end-to-end (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+def test_uses_band_adj_predicate():
+    assert FlowGNNConfig(message_impl="band").uses_band_adj
+    assert FlowGNNConfig(message_impl="fused").uses_band_adj
+    assert not FlowGNNConfig(message_impl="segment").uses_band_adj
+    assert not FlowGNNConfig(message_impl="tile").uses_band_adj
+    assert FlowGNNConfig(message_impl="tile").uses_tile_adj
+    assert not FlowGNNConfig(message_impl="fused").uses_tile_adj
+
+
+def test_serve_fused_lane_same_executable_count_and_zero_recompile():
+    """Satellite gate: adding the fused option changes NOTHING about the
+    warmed-executable accounting — a fused-lane engine warms exactly the
+    same (lane, slot-bucket) count as a band engine, its lane rides
+    band-shaped buckets, and scoring after warmup compiles nothing."""
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+
+    tiny_band = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                              num_output_layers=1, message_impl="band")
+    tiny_fused = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                               num_output_layers=1, message_impl="fused")
+    config = ServeConfig(batch_slots=4, queue_capacity=8)
+    engines = {}
+    for name, cfg in (("band", tiny_band), ("fused", tiny_fused)):
+        model = FlowGNN(cfg)
+        eng = ServeEngine(model, random_gnn_params(model, config),
+                          config=config)
+        assert eng._lanes["gnn"].band, name
+        eng.warmup()
+        engines[name] = eng
+    assert engines["fused"].n_warm == engines["band"].n_warm
+    assert (engines["fused"].warm_buckets()
+            == engines["band"].warm_buckets())
+    # Steady state: score through the fused lane, compiles stay flat.
+    eng = engines["fused"]
+    results = eng.score_sync(synthetic_bigvul(5, FEAT, seed=9))
+    assert all("prob" in r for r in results)
+    assert eng.compiles_after_warmup == 0
+
+
+def test_segment_lane_unaffected_by_fused_option():
+    """The segment serving lane neither builds band adjacencies nor
+    changes its bucket shapes — the fused option is strictly additive."""
+    from deepdfa_tpu.serve import ServeConfig
+    from deepdfa_tpu.serve.engine import bucket_batch
+
+    config = ServeConfig(batch_slots=4)
+    b = bucket_batch(config, synthetic_bigvul(2, FEAT, seed=0), 4,
+                     subkeys_for(FEAT), band=False)
+    assert b.band_adj is None
+
+
+def test_bench_infer_honors_impl_flag():
+    """deepdfa_infer_ms_per_example used to pin the band path; the impl
+    parameter must reach the model config now (CPU: segment vs fused
+    builds different batches and still measures)."""
+    import bench
+
+    ms = bench.bench_deepdfa_infer(batch_size=4, dtype="float32",
+                                   impl="fused")
+    assert ms > 0
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost accounting
+# ---------------------------------------------------------------------------
+
+
+def test_fused_step_cost_accounting():
+    rng = np.random.default_rng(0)
+    adj = _band_fixture(rng, 8, 4, 2)
+    cost = fused_gnn.fused_step_cost(adj, hidden=16, dtype="float32")
+    n, h, w = adj.n_tiles * adj.tile, 16, 2 * adj.bandwidth + 1
+    # The three matmul families are all present and dominate.
+    assert cost["flops"] > 2 * n * h * h + 2 * w * adj.n_tiles * 8 * 8 * h
+    assert cost["bwd_flops"] > cost["flops"]
+    assert cost["bytes_accessed"] > 0
+    # The fused kernel's HBM plan strictly beats the unfused chain's.
+    assert cost["flops_unfused_hbm_bytes"] > cost["bytes_accessed"]

@@ -404,3 +404,145 @@ def test_quarantine_concurrent_puts_keep_ordinal_join_exact(tmp_path):
     # Same ordinal -> same item in both files (the post-mortem join).
     by_ordinal = {e["ordinal"]: e["item_id"] for e in manifest}
     assert all(by_ordinal[it["ordinal"]] == it["item_id"] for it in items)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-faithful scan vocabularies (ISSUE 9 satellite): the ETL
+# export persists its abstract-dataflow vocabs; the scan service loads
+# them instead of the hashing fallback.
+# ---------------------------------------------------------------------------
+
+
+def _toy_vocabs():
+    from deepdfa_tpu.etl.absdf import build_all_vocabs
+
+    # Two graphs, three definition nodes — enough for a non-trivial
+    # frequency ranking per subkey.
+    features_by_graph = {
+        1: {10: [("datatype", "int"), ("operator", "assignment"),
+                 ("api", "memcpy")],
+            11: [("datatype", "char*"), ("literal", "0")]},
+        2: {20: [("datatype", "int"), ("operator", "assignment")]},
+    }
+    return build_all_vocabs(features_by_graph, [1, 2], FEAT), \
+        features_by_graph
+
+
+def test_vocabs_save_load_round_trip(tmp_path):
+    from deepdfa_tpu.etl.export import load_vocabs, save_vocabs
+
+    vocabs, features_by_graph = _toy_vocabs()
+    path = save_vocabs(vocabs, str(tmp_path / "vocabs.json"))
+    loaded = load_vocabs(path)
+    assert set(loaded) == set(vocabs)
+    probe_fields = [None, []] + [
+        fields for g in features_by_graph.values() for fields in g.values()
+    ] + [[("datatype", "never-seen-type")], [("api", "unknown_api")]]
+    for sk, v in vocabs.items():
+        lv = loaded[sk]
+        assert (lv.limit_all, lv.limit_subkeys) == (v.limit_all,
+                                                    v.limit_subkeys)
+        # The one contract that matters: index_for agrees on seen,
+        # unseen, and non-definition nodes alike.
+        for fields in probe_fields:
+            assert lv.index_for(fields) == v.index_for(fields), (sk, fields)
+
+
+def test_load_vocabs_rejects_malformed(tmp_path):
+    import json as _json
+
+    from deepdfa_tpu.etl.export import load_vocabs
+
+    bad_version = tmp_path / "v.json"
+    bad_version.write_text(_json.dumps({"version": 99, "vocabs": {}}))
+    with pytest.raises(ValueError, match="version"):
+        load_vocabs(str(bad_version))
+    no_unknown = tmp_path / "u.json"
+    no_unknown.write_text(_json.dumps({
+        "version": 1,
+        "vocabs": {"datatype": {
+            "subkey": "datatype", "limit_all": 20, "limit_subkeys": 20,
+            "subkey_index": [[None, 0]], "all_index": [["x", 0]],
+        }},
+    }))
+    with pytest.raises(ValueError, match="UNKNOWN"):
+        load_vocabs(str(no_unknown))
+    # A right-version doc with no vocabs mapping is still malformed —
+    # the documented ValueError, not a bare KeyError.
+    no_vocabs = tmp_path / "n.json"
+    no_vocabs.write_text(_json.dumps({"version": 1}))
+    with pytest.raises(ValueError, match="vocabs"):
+        load_vocabs(str(no_vocabs))
+
+
+def test_scan_service_uses_export_vocabs(tmp_path, warm_engine):
+    """A service built with persisted vocabs indexes features with the
+    export's mapping (not the hashing fallback), and a vocab set missing
+    an engine subkey fails loudly at construction."""
+    from deepdfa_tpu.etl.export import load_vocabs, save_vocabs
+    from deepdfa_tpu.scan.featurize import hashing_vocabs
+
+    vocabs, _ = _toy_vocabs()
+    path = save_vocabs(vocabs, str(tmp_path / "vocabs.json"))
+    loaded = load_vocabs(path)
+    svc = ScanService(
+        warm_engine, TINY.feature, workdir=tmp_path / "scan",
+        command=fake_joern_command(), vocabs=loaded,
+    )
+    try:
+        assert svc.vocabs is loaded
+        fields = [("datatype", "int"), ("operator", "assignment"),
+                  ("api", "memcpy")]
+        hashed = hashing_vocabs(warm_engine.required_subkeys,
+                                TINY.feature.limit_all)
+        # The trained mapping ranks by frequency (small indices); the
+        # hashing fallback scatters across the table — they are
+        # different mappings, which is the whole point.
+        assert svc.vocabs["datatype"].index_for(fields) == \
+            vocabs["datatype"].index_for(fields)
+        assert any(
+            svc.vocabs[sk].index_for(fields) != hashed[sk].index_for(fields)
+            for sk in svc.vocabs
+        )
+    finally:
+        svc.close()
+    incomplete = {k: v for k, v in loaded.items() if k != "datatype"}
+    with pytest.raises(ValueError, match="missing subkeys"):
+        ScanService(warm_engine, TINY.feature,
+                    workdir=tmp_path / "scan2",
+                    command=fake_joern_command(), vocabs=incomplete)
+    # A vocab exported under a BIGGER limit_all than the model's feature
+    # spec would hand out indices past the embedding table (input_dim ==
+    # limit_all + 2): silent gather clamp/wrap, wrong features. Fail loud.
+    import dataclasses as _dc
+    oversized = dict(loaded)
+    oversized["datatype"] = _dc.replace(
+        loaded["datatype"], limit_all=TINY.feature.limit_all + 50)
+    with pytest.raises(ValueError, match="limit_all"):
+        ScanService(warm_engine, TINY.feature,
+                    workdir=tmp_path / "scan3",
+                    command=fake_joern_command(), vocabs=oversized)
+
+
+def test_pipeline_export_writes_vocabs(tmp_path):
+    """etl.pipeline.export persists vocabs.json beside examples.jsonl —
+    the checkpoint-faithful artifact the scan CLI loads via
+    --scan-vocabs/DEEPDFA_SCAN_VOCABS."""
+    from deepdfa_tpu.core.config import subkeys_for
+    from deepdfa_tpu.etl.export import VOCABS_FILENAME, load_vocabs
+    from deepdfa_tpu.etl.pipeline import export, prepare
+    from deepdfa_tpu.scan.fake_joern import export_file
+
+    rows = [
+        {"id": i, "vul": 0, "project": "p", "added": [], "removed": [],
+         "after": "", "before": src}
+        for i, src in enumerate(seeded_sources(3, seed=5))
+    ]
+    prepare(rows, str(tmp_path))
+    # Fake the graphs stage: write scripted Joern exports per function.
+    for i in range(3):
+        export_file(str(tmp_path / "functions" / f"{i}.c"))
+    report = export(str(tmp_path), FEAT)
+    assert report["examples"] == 3
+    vocabs = load_vocabs(str(tmp_path / VOCABS_FILENAME))
+    assert set(vocabs) == set(subkeys_for(FEAT))
