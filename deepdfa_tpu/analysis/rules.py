@@ -108,6 +108,28 @@ Rule catalogue (each backed by a positive+negative fixture in
                              (parameters, computed expressions) stay
                              unflagged — precision over recall, the
                              empty-baseline contract.
+  GL017 unsafe-signal-handler  a handler registered via ``signal.signal``
+                             whose body does blocking work — I/O
+                             (open/print/logging), lock-class calls
+                             (``.acquire()``/``.wait()``/``.join()``/
+                             ``with`` context managers), sleeps,
+                             checkpoint saves, or jit dispatch — instead
+                             of only setting a flag/event consumed on
+                             the main path. Signal handlers run between
+                             bytecodes on the main thread: a lock the
+                             interrupted code already holds deadlocks,
+                             logging re-enters its module locks, and a
+                             jit dispatch can re-enter the runtime. The
+                             preemption lifecycle's contract
+                             (resilience/lifecycle.py) is exactly the
+                             accepted shape: one attribute assignment,
+                             everything else on the monitor/main path.
+                             ``Event.set()`` and ``os.write`` (the
+                             self-pipe wakeup) are the accepted
+                             signal-safe idioms; handlers of unknown
+                             provenance (parameters, dynamic lookups)
+                             stay unflagged — precision over recall,
+                             the empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -169,6 +191,7 @@ RULES: Dict[str, str] = {
     "GL014": "unbounded-metric-cardinality",
     "GL015": "subprocess-without-timeout",
     "GL016": "pallas-interpret-in-prod",
+    "GL017": "unsafe-signal-handler",
 }
 
 _JIT_NAMES = frozenset({
@@ -261,6 +284,22 @@ _PTY_OPEN = "pty.openpty"
 # GL016: the pallas_call leaf (every import spelling resolves through the
 # alias table to something ending in it).
 _PALLAS_CALL_LEAF = "pallas_call"
+# GL017: the handler-registration entry points, the blocking-work shapes
+# a handler body must not contain, and the accepted signal-safe idioms
+# (one attribute/flag assignment; Event.set(); os.write on a self-pipe).
+_SIGNAL_REGISTER = frozenset({"signal.signal", "signal.sigaction"})
+_HANDLER_BLOCKING_CALLS = frozenset({
+    "open", "print", "input", "os.fsync", "time.sleep", "json.dump",
+    "json.dumps", "pickle.dump", "subprocess.run", "subprocess.Popen",
+    "subprocess.call", "subprocess.check_call", "subprocess.check_output",
+})
+_HANDLER_BLOCKING_ATTRS = frozenset({
+    "acquire", "wait", "join", "write", "flush", "put", "get", "send",
+    "recv", "fsync", "dump", "commit", "drain", "sleep", "observe", "inc",
+} | {"save", "save_best", "save_last", "save_preempt"} | _LOG_ATTRS)
+_HANDLER_SAFE_CALLS = frozenset({"os.write", "signal.set_wakeup_fd",
+                                 "signal.Signals"})
+_HANDLER_SAFE_ATTRS = frozenset({"set"})
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -335,6 +374,14 @@ class _Module:
             n.name for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
+        # GL017: name -> def node (first definition wins), so a handler
+        # passed to signal.signal by name — module function or method —
+        # can have its body inspected.
+        self.def_nodes: Dict[str, ast.AST] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name not in self.def_nodes:
+                self.def_nodes[n.name] = n
         # GL016 facts: module-level ``NAME = True`` constants (a pinned
         # interpret flag one module-constant hop away), and "kernel
         # wrappers" — module defs with an ``interpret`` parameter whose
@@ -511,6 +558,7 @@ class _FunctionChecker:
         self._check_metric_cardinality()
         self._check_subprocess_timeout()
         self._check_pallas_interpret()
+        self._check_signal_handlers()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -924,6 +972,97 @@ class _FunctionChecker:
                 "a wedged child blocks the worker forever; pass "
                 "timeout= (handling subprocess.TimeoutExpired) or kill "
                 "the child first")
+
+    # -- unsafe signal handler (GL017) ---------------------------------------
+
+    def _resolve_handler_body(self, handler: ast.expr) -> Optional[ast.AST]:
+        """The def node a ``signal.signal`` handler argument names:
+        inline lambda, module function, or a method referenced as
+        ``self._handler`` / ``obj.handler``. Unknown provenance
+        (parameters, dynamic lookups, restored previous handlers like
+        ``signal.SIG_DFL``) resolves to None — unflagged."""
+        if isinstance(handler, ast.Lambda):
+            return handler
+        if isinstance(handler, ast.Name):
+            return self.mod.def_nodes.get(handler.id)
+        if isinstance(handler, ast.Attribute):
+            return self.mod.def_nodes.get(handler.attr)
+        return None
+
+    def _handler_blocking_work(self, body: ast.AST
+                               ) -> Optional[Tuple[ast.AST, str]]:
+        """First piece of blocking work in a handler body, or None for
+        the accepted flag-only shape. Nested defs are skipped: work a
+        handler merely *defines* doesn't run in signal context."""
+        skip: Set[int] = set()
+        for sub in ast.walk(body):
+            if sub is not body and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+                for inner in ast.walk(sub):
+                    skip.add(id(inner))
+        for sub in ast.walk(body):
+            if id(sub) in skip:
+                continue
+            if isinstance(sub, ast.With):
+                # A `with` in signal context is (almost always) a lock or
+                # span acquire — the deadlock shape when the interrupted
+                # code already holds it.
+                return sub, "context-manager acquire (`with`)"
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = self.mod.resolve(sub.func)
+            if dotted in _HANDLER_SAFE_CALLS:
+                continue
+            if dotted in _HANDLER_BLOCKING_CALLS:
+                return sub, f"{dotted}()"
+            if dotted is not None:
+                leaf = dotted.rsplit(".", 1)[-1]
+                if leaf in self.mod.jit_wrapped \
+                        or (leaf not in _HANDLER_SAFE_CALLS
+                            and _STEP_CALL_RE.match(leaf)
+                            and leaf in self.mod.module_defs):
+                    return sub, f"jit dispatch ({dotted})"
+            if isinstance(sub.func, ast.Attribute):
+                attr = sub.func.attr
+                if attr in _HANDLER_SAFE_ATTRS:
+                    continue
+                if attr in _HANDLER_BLOCKING_ATTRS:
+                    return sub, f".{attr}()"
+        return None
+
+    def _check_signal_handlers(self) -> None:
+        """GL017: a signal handler must only set a flag — handlers run
+        between bytecodes on the main thread, so I/O, locks, and jit
+        dispatch inside one deadlock or re-enter exactly when the
+        process is being preempted (the moment the drain machinery
+        exists for)."""
+        nested: Set[int] = set()
+        for child in ast.walk(self.fi.node):
+            if child is not self.fi.node and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for inner in ast.walk(child):
+                    nested.add(id(inner))
+        for sub in ast.walk(self.fi.node):
+            if id(sub) in nested or not isinstance(sub, ast.Call):
+                continue  # nested defs get their own checker pass
+            dotted = self.mod.resolve(sub.func)
+            if dotted not in _SIGNAL_REGISTER or len(sub.args) < 2:
+                continue
+            body = self._resolve_handler_body(sub.args[1])
+            if body is None:
+                continue
+            hit = self._handler_blocking_work(body)
+            if hit is not None:
+                node, what = hit
+                name = getattr(body, "name", "<lambda>")
+                self._report(
+                    "GL017", node,
+                    f"signal handler {name!r} does blocking work ({what}) "
+                    "inside the handler body; set a flag/event in the "
+                    "handler and consume it on the main path "
+                    "(resilience/lifecycle.py is the reference shape)",
+                )
 
     # -- pallas interpret pinned in prod (GL016) -----------------------------
 

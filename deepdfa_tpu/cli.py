@@ -270,9 +270,15 @@ class _CrashLog:
 
 def cmd_fit(args) -> Dict[str, Any]:
     from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.resilience import lifecycle
     from deepdfa_tpu.train.loop import fit
     from deepdfa_tpu.train.tune import TrialReporter
 
+    # Preemption lifecycle (ISSUE 10): SIGTERM/SIGINT becomes a typed
+    # notice the step loop drains on — an immediate preempt_<epoch>_<step>
+    # snapshot, writer drained, exit EXIT_PREEMPTED (75). --resume then
+    # restarts MID-epoch from it.
+    coordinator = lifecycle.fresh()
     cfgs = build_configs(args.config, args.set, inject_service_params=True)
     model_cfg, data_cfg = cfgs["model"], cfgs["data"]
     train_cfg = cfgs["train"]
@@ -303,9 +309,33 @@ def cmd_fit(args) -> Dict[str, Any]:
             return False  # reporting only; the service decides terminations
 
         on_epoch = report_epoch if reporter.attached else None
-        state, history = fit(model, examples, splits, train_cfg, data_cfg,
-                             mesh=mesh, resume=getattr(args, "resume", False),
-                             on_epoch_end=on_epoch)
+        try:
+            state, history = fit(model, examples, splits, train_cfg, data_cfg,
+                                 mesh=mesh,
+                                 resume=getattr(args, "resume", False),
+                                 on_epoch_end=on_epoch)
+        except lifecycle.Preempted as p:
+            # The graceful-drain exit: the snapshot is durable (the loop
+            # drained the writer before raising), the partial history is
+            # recorded, and the process reports the distinct preemption
+            # exit code so orchestrators reschedule instead of alerting.
+            history = p.history or {"epochs": []}
+            with open(os.path.join(run_dir, "history.json"), "w") as f:
+                json.dump(history, f, indent=1)
+            result = {
+                "preempted": True,
+                "reason": p.notice.reason,
+                "epoch": p.epoch,
+                "step": p.step,
+                "snapshot": p.snapshot,
+                "resume_hint": f"--resume --checkpoint-dir {run_dir}",
+                "exit_code": lifecycle.EXIT_PREEMPTED,
+            }
+            coordinator.complete()
+            print(json.dumps(result))
+            return result
+        finally:
+            coordinator.uninstall()
         result = {
             "best_epoch": history["best_epoch"],
             "best_val_loss": history["best_val_loss"],
@@ -628,13 +658,34 @@ def cmd_fit_text(args) -> Dict[str, Any]:
         # snapshots ``last`` per epoch so a preempted fine-tune resumes,
         # and the final ``best`` write below rides the same writer.
         ckpt = make_checkpoint_manager(run_dir)
-        best_state, history = fit_text(
-            model, data, splits, tcfg, graphs_by_id=graphs_by_id,
-            subkeys=subkeys, graph_budget=budget, init_params=init_params,
-            mesh=mesh, pad_id=pad_id,
-            freeze_submodules=("flowgnn",) if args.freeze_graph else (),
-            checkpointer=ckpt,
-        )
+        from deepdfa_tpu.resilience import lifecycle
+
+        coordinator = lifecycle.fresh()
+        try:
+            best_state, history = fit_text(
+                model, data, splits, tcfg, graphs_by_id=graphs_by_id,
+                subkeys=subkeys, graph_budget=budget,
+                init_params=init_params,
+                mesh=mesh, pad_id=pad_id,
+                freeze_submodules=("flowgnn",) if args.freeze_graph else (),
+                checkpointer=ckpt,
+            )
+        except lifecycle.Preempted as p:
+            # SIGTERM mid-fine-tune: the loop drained a durable
+            # preempt_<epoch>_<step> snapshot; record what happened and
+            # exit with the distinct preemption code.
+            history = p.history or {"epochs": []}
+            with open(os.path.join(run_dir, "history.json"), "w") as f:
+                json.dump(history, f, indent=1)
+            result = {"preempted": True, "reason": p.notice.reason,
+                      "epoch": p.epoch, "step": p.step,
+                      "snapshot": p.snapshot,
+                      "exit_code": lifecycle.EXIT_PREEMPTED}
+            coordinator.complete()
+            print(json.dumps(result))
+            return result
+        finally:
+            coordinator.uninstall()
         # Params only: the eval-time restore must not depend on the
         # optimizer tree, whose structure changes with --freeze-graph.
         ckpt.save_best({"params": best_state.params}, history["best_epoch"],
@@ -1134,9 +1185,26 @@ def cmd_serve(args) -> Dict[str, Any]:
                                      slo_monitor=slo_monitor,
                                      scan_service=scan_service)
             else:
-                serve_forever(engine, args.host, args.port,
-                              slo_monitor=slo_monitor,
-                              scan_service=scan_service)
+                # Live serving registers with the preemption lifecycle:
+                # SIGTERM/SIGINT → lame-duck (admission 503 +
+                # Retry-After, partial buckets flush now, every admitted
+                # request answered, scan pool drained via the session
+                # protocol) → clean telemetry close → EXIT_PREEMPTED.
+                from deepdfa_tpu.resilience import lifecycle
+
+                coordinator = lifecycle.fresh()
+                try:
+                    notice = serve_forever(
+                        engine, args.host, args.port,
+                        slo_monitor=slo_monitor,
+                        scan_service=scan_service,
+                        port_file=getattr(args, "port_file", None))
+                finally:
+                    coordinator.uninstall()
+                if notice is not None:
+                    coordinator.complete()
+                    return {"preempted": True, "reason": notice.reason,
+                            "exit_code": lifecycle.EXIT_PREEMPTED}
                 return {}
         finally:
             if scan_service is not None:
@@ -1395,14 +1463,17 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
 
 
 def cmd_chaos(args) -> Dict[str, Any]:
-    """Chaos soak (deepdfa_tpu/resilience): provoke eight fault classes —
+    """Chaos soak (deepdfa_tpu/resilience): provoke ten fault classes —
     simulated preemption, NaN loss, checkpoint corruption, ETL item
     failure, serving flush failure, corrupt-corpus poisoning, a
     mid-epoch kill under async checkpointing resumed on a different
-    device count, and pooled Joern workers killed mid-scan — against a
-    tiny synthetic workload and verify every recovery contract,
-    including the bit-for-bit kill-and-resume determinism gate. Exits
-    nonzero on any miss.
+    device count, pooled Joern workers killed mid-scan, a REAL SIGTERM
+    to a mid-epoch training subprocess (step-granular preempt snapshot,
+    mid-epoch resume, hung-step watchdog), and a SIGTERM lame-duck drain
+    of a live serve subprocess under load — against a tiny synthetic
+    workload and verify every recovery contract, including the
+    bit-for-bit kill-and-resume determinism gate. Exits nonzero on any
+    miss.
 
     (Custom fault plans don't belong here — the soak's scenarios arm
     their own; arm ``DEEPDFA_FAULT_PLAN`` against a regular command
@@ -1880,6 +1951,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_srv.add_argument("--combined-which", default="best")
     p_srv.add_argument("--host", default="127.0.0.1")
     p_srv.add_argument("--port", type=int, default=8321)
+    p_srv.add_argument("--port-file", default=None, metavar="FILE",
+                       help="write the bound port here after bind (how "
+                            "drivers find an ephemeral --port 0)")
     p_srv.add_argument("--no-warmup", action="store_true",
                        help="skip AOT bucket warmup (first requests then "
                             "pay the compiles)")
@@ -2086,7 +2160,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.set_defaults(func=cmd_tune)
 
     args = parser.parse_args(argv)
-    result = args.func(args)
+    from deepdfa_tpu.resilience import lifecycle as _lifecycle
+
+    try:
+        result = args.func(args)
+    except _lifecycle.Preempted as p:
+        # Surfaces without a bespoke handler (fit-gen via the exp driver,
+        # tune, clone): the typed preemption exit must still reach the
+        # orchestrator as EXIT_PREEMPTED, never as a raw traceback — the
+        # loop already drained its durable snapshot before raising.
+        print(json.dumps({"preempted": True, "reason": p.notice.reason,
+                          "epoch": p.epoch, "step": p.step,
+                          "snapshot": p.snapshot,
+                          "exit_code": _lifecycle.EXIT_PREEMPTED}))
+        return _lifecycle.EXIT_PREEMPTED
     # analyze-code carries the CI contract in exit_code (new findings -> 1);
     # every other command reports via its JSON line and exits 0.
     if isinstance(result, dict) and result.get("exit_code"):

@@ -173,17 +173,41 @@ class JoernSession:
         thread that owns this REPL.)"""
         return self._proc.poll() is None
 
-    def close(self) -> None:
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Graceful shutdown via the session protocol: ``exit`` on the
+        REPL, bounded wait, then kill — the close→wait→kill escalation.
+        Idempotent (a pool drain and an owner's close may race)."""
+        if self._master < 0:
+            return
         try:
             os.write(self._master, b"exit\n")
         except OSError:
             pass
         try:
-            self._proc.wait(timeout=10)
+            self._proc.wait(timeout=max(timeout_s, 0.1))
         except subprocess.TimeoutExpired:
             self._proc.kill()
             self._proc.wait()
-        os.close(self._master)
+        self._close_master()
+
+    def kill(self) -> None:
+        """Force-kill the child (the escalation terminus): SIGKILL + reap.
+        A worker thread blocked in the REPL read then sees EOF and fails
+        typed instead of wedging. Leaves the pty master open when a
+        reader may still be draining it; :meth:`close` reaps it."""
+        try:
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def _close_master(self) -> None:
+        if self._master >= 0:
+            try:
+                os.close(self._master)
+            except OSError:
+                pass
+            self._master = -1
 
 
 def extract_cpg_batch(

@@ -95,6 +95,39 @@ def snapshot_layout(mesh: Optional[Mesh]) -> dict:
     }
 
 
+class ProcessCountMismatchError(RuntimeError):
+    """A resume sees a different ``process_count`` than the snapshot
+    recorded. Single-host DP reshapes move only per-shard packing, but a
+    multi-host reshape changes which process feeds which global batch
+    slice — resuming silently would shear the data order (and the
+    reshard would surface only as a shape mismatch deep in device_put).
+    Fail loud with the actionable fix instead."""
+
+
+def check_layout_compatible(prev: Optional[dict], cur: dict) -> None:
+    """Typed guard for topology-independent resume (the multi-host half
+    of the elastic-resume contract): a recorded ``process_count`` that
+    differs from the resuming one raises
+    :class:`ProcessCountMismatchError` before any reshard work starts.
+    Layouts without a recorded process count (pre-ISSUE-10 snapshots)
+    pass — there is nothing to compare against."""
+    if not prev:
+        return
+    prev_pc = prev.get("process_count")
+    cur_pc = cur.get("process_count")
+    if prev_pc is None or cur_pc is None:
+        return
+    if int(prev_pc) != int(cur_pc):
+        raise ProcessCountMismatchError(
+            f"snapshot was written by a {prev_pc}-process job "
+            f"(layout {prev}); this resume runs {cur_pc} process(es) "
+            f"(layout {cur}). Cross-process-count resume is not "
+            "supported: restart the job on the original process count, "
+            "or consolidate to one host first (restore + re-save on a "
+            f"single-process mesh), then resume on {cur_pc}."
+        )
+
+
 def reshard_state(state, mesh: Optional[Mesh]):
     """Topology-independent restore placement: put a restored (host-side)
     train state onto the *current* mesh, whatever mesh wrote it.

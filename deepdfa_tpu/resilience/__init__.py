@@ -12,7 +12,14 @@ supplies the two halves of surviving that:
   codebase fire those faults deterministically.
 * ``chaos`` — the end-to-end soak scenarios behind ``cli chaos``:
   preempt-and-resume determinism, NaN-loss rollback, corrupt-checkpoint
-  fallback, ETL retry, serving flush isolation.
+  fallback, ETL retry, serving flush isolation, and the real-SIGTERM
+  drains (``preempt_drain``, ``serve_lame_duck``).
+* ``lifecycle`` — the preemption-notice lifecycle (ISSUE 10): SIGTERM/
+  SIGINT (or a fault-injected simulation) becomes a typed
+  ``PreemptionNotice`` broadcast to registered drain participants under
+  a global grace budget, policed by the hung-step watchdog; training
+  exits ``EXIT_PREEMPTED`` behind a step-granular ``preempt_*``
+  snapshot, serving lame-ducks, the scan pool drains.
 
 Recovery itself lives where the work lives (``train/checkpoint.py``,
 ``train/loop.py``, ``core/retry.py``, ``etl/*``, ``serve/engine.py``);

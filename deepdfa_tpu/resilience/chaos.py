@@ -1,7 +1,7 @@
 """Chaos soak: the ``cli chaos`` engine.
 
 One deterministic end-to-end run that provokes every fault class the
-resilience layer claims to survive (eight distinct fault kinds — the
+resilience layer claims to survive (ten distinct fault kinds — the
 acceptance gate asks for >= 3) and verifies the recovery behavior, on a
 tiny synthetic workload sized for seconds on CPU:
 
@@ -40,6 +40,19 @@ tiny synthetic workload sized for seconds on CPU:
   scored, the poison is reason-coded in an exact manifest, restarts and
   retries are asserted from the run's trace, and the warmed serving
   executables survive untouched.
+* ``preempt_drain`` — a **real SIGTERM** to a mid-epoch ``cli fit``
+  subprocess: the child drains to a committed step-granular
+  ``preempt_<epoch>_<step>`` snapshot, exits ``EXIT_PREEMPTED``, and a
+  ``--resume`` restarts mid-epoch with history bit-continuous against
+  the uninterrupted reference — the partial epoch is not lost. A second
+  phase SIGTERMs into a wedged step (injected long delay): the hung-step
+  watchdog fires ``lifecycle.hang`` with thread stacks and the process
+  still exits behind a durable snapshot inside the grace budget.
+* ``serve_lame_duck`` — SIGTERM to a live ``cli serve`` subprocess under
+  replay load: zero dropped admitted requests (responses == admissions,
+  asserted from the trace), new admissions 503 + Retry-After with
+  ``/healthz`` reporting ``draining``, partial buckets flushed
+  immediately, drain inside the grace budget, compiles flat.
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -643,6 +656,499 @@ def scenario_scan_joern_deaths(out_dir: str) -> Dict[str, Any]:
     }
 
 
+def _fit_argv(run_dir: str, n_examples: int, epochs: int,
+              resume: bool = False) -> List[str]:
+    """The ``cli fit`` argv the preempt-drain scenario's subprocesses run:
+    the chaos TINY/DATA shapes expressed as --set overrides (the REAL
+    training CLI, not a test harness — the SIGTERM lands on exactly what
+    production runs)."""
+    import sys
+
+    argv = [sys.executable, "-m", "deepdfa_tpu.cli", "fit",
+            "--dataset", f"synthetic:{n_examples}",
+            "--checkpoint-dir", run_dir,
+            "--set", "model.hidden_dim=8", "--set", "model.n_steps=2",
+            "--set", "model.num_output_layers=2",
+            "--set", f"train.max_epochs={epochs}",
+            "--set", "train.learning_rate=0.002", "--set", "train.seed=0",
+            "--set", "data.batch_size=16", "--set", "data.eval_batch_size=16",
+            "--set", "data.max_nodes_per_graph=64",
+            "--set", "data.max_edges_per_node=4",
+            "--set", "data.undersample_factor=1.0"]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def _child_env(**extra: str) -> Dict[str, str]:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop(inject.ENV_VAR, None)  # each child arms only its own plan
+    env.update(extra)
+    return env
+
+
+def _wait_for_meta_epoch(ckpt_dir: str, epoch: int, timeout_s: float,
+                         proc=None) -> bool:
+    """Poll the run's checkpoint ``meta.json`` until ``last_epoch >=
+    epoch`` — the durable marker that the epoch's snapshots committed.
+    THE sync point the SIGTERM scenarios key on: a log line races the
+    async writer, but once meta commits, the next epoch's delayed step
+    is already holding the loop open."""
+    import json as _json
+    import time
+
+    path = os.path.join(ckpt_dir, "meta.json")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            with open(path, encoding="utf-8") as f:
+                if int(_json.load(f).get("last_epoch", -1)) >= epoch:
+                    return True
+        except (OSError, ValueError):
+            pass  # not written yet / mid-replace
+        time.sleep(0.05)
+    return False
+
+
+def _read_events(run_dir: str) -> List[Dict[str, Any]]:
+    # THE events reader (telemetry/export.py), not a private re-parse:
+    # any torn-row tolerance it grows must cover these scenarios too.
+    from deepdfa_tpu.telemetry.export import read_events
+    from deepdfa_tpu.telemetry.report import events_path_of
+
+    path = events_path_of(run_dir)
+    if not os.path.exists(path):
+        return []
+    return read_events(path)
+
+
+def _steps_in_epoch0(n_examples: int) -> int:
+    """Step count of the subprocess fit's epoch 0, computed with the SAME
+    config/packer the child runs (the fault-plan ordinal anchor)."""
+    from deepdfa_tpu import cli
+    from deepdfa_tpu.core.config import (
+        DataConfig as DC,
+        FlowGNNConfig as MC,
+        TrainConfig as TC,
+        subkeys_for,
+    )
+    from deepdfa_tpu.data.sampling import epoch_indices
+    from deepdfa_tpu.train.loop import _batches
+
+    model_cfg = MC(hidden_dim=8, n_steps=2, num_output_layers=2)
+    data_cfg = DC(batch_size=16, eval_batch_size=16, max_nodes_per_graph=64,
+                  max_edges_per_node=4, undersample_factor=1.0)
+    train_cfg = TC(seed=0)
+    examples, splits = cli.load_dataset(f"synthetic:{n_examples}",
+                                        model_cfg.feature,
+                                        seed=train_cfg.seed)
+    labels = [int(ex["label"]) for ex in examples]
+    train_idx = splits["train"]
+    idx0 = epoch_indices([labels[i] for i in train_idx], 0,
+                         seed=data_cfg.seed,
+                         undersample_factor=data_cfg.undersample_factor,
+                         oversample_factor=data_cfg.oversample_factor)
+    return sum(1 for _ in _batches(examples, train_idx[idx0], data_cfg,
+                                   subkeys_for(model_cfg.feature),
+                                   data_cfg.batch_size))
+
+
+def scenario_preempt_drain(out_dir: str, n_examples: int,
+                           epochs: int) -> Dict[str, Any]:
+    """THE preemption acceptance scenario (ISSUE 10): a **real SIGTERM**
+    to a mid-epoch ``cli fit`` subprocess. Demands:
+
+    * the child exits with the distinct ``EXIT_PREEMPTED`` code behind a
+      committed, verified, step-granular ``preempt_<epoch>_<step>``
+      snapshot (an injected ``delay`` at a known step pins where the
+      signal lands, so the preemption point is deterministic);
+    * the drain is auditable from the child's trace — ``lifecycle.notice``
+      (reason SIGTERM), ``lifecycle.preempted``, and a ``lifecycle.drain``
+      inside the grace budget;
+    * a ``--resume`` run restarts **mid-epoch** from the preempt snapshot
+      and its loss history is bit-continuous with the uninterrupted
+      reference from the preemption step — the partial epoch is not lost
+      (CPU determinism gives exact equality; the tolerance story across
+      topology changes is the elastic scenario's);
+    * **watchdog phase**: the same SIGTERM landing while a step is wedged
+      (injected long delay > the hang deadline) trips ``lifecycle.hang``
+      — thread stacks captured into the trace — and the process still
+      exits (``EXIT_HANG``) behind a durable emergency snapshot inside
+      the grace budget, never a SIGKILLed wedge.
+    """
+    import json as _json
+    import shutil
+    import signal as _signal
+    import subprocess
+    import time
+
+    from deepdfa_tpu.resilience import lifecycle
+
+    root = os.path.join(out_dir, "preempt_drain")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    steps_ep0 = _steps_in_epoch0(n_examples)
+
+    def history_of(run_dir):
+        with open(os.path.join(run_dir, "history.json")) as f:
+            return _json.load(f)
+
+    # --- uninterrupted reference ---------------------------------------
+    ref_dir = os.path.join(root, "ref")
+    ref = subprocess.run(_fit_argv(ref_dir, n_examples, epochs),
+                         env=_child_env(), capture_output=True, text=True,
+                         timeout=600)
+    ref_ok = ref.returncode == 0
+    ref_hist = history_of(ref_dir) if ref_ok else {"epochs": []}
+
+    # --- SIGTERM mid-epoch 1 -------------------------------------------
+    # The delay pins the landing zone: epoch 1's SECOND step sleeps 10 s
+    # (train.loss ordinal steps_ep0 + 1, counted across the run), the
+    # parent signals inside that window, the loop finishes the step,
+    # polls, and drains at exactly (epoch 1, step 2).
+    part_dir = os.path.join(root, "part")
+    plan = _json.dumps({"faults": [
+        {"site": "train.loss", "kind": "delay", "at": steps_ep0 + 1,
+         "seconds": 10.0}]})
+    child = subprocess.Popen(
+        _fit_argv(part_dir, n_examples, epochs),
+        env=_child_env(DEEPDFA_FAULT_PLAN=plan, DEEPDFA_DRAIN_GRACE_S="60"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # Sync on epoch 0's committed meta.json: by then the loop is already
+    # inside epoch-1 step 2's 10 s injected delay (the boundary poll and
+    # fast step 1 ran while the writer was still committing), so the
+    # signal lands mid-step deterministically — no fixed-sleep race.
+    saw_epoch0 = _wait_for_meta_epoch(part_dir, 0, 300.0, proc=child)
+    time.sleep(0.5)
+    child.send_signal(_signal.SIGTERM)
+    try:
+        child_out, child_err = child.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        child_out, child_err = child.communicate()
+    preempt_rc = child.returncode
+
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    probe = CheckpointManager(part_dir)
+    candidate = probe.resume_candidate()
+    pinfo = probe.preempt_info(candidate) if candidate else None
+    snapshot_verified = bool(candidate and probe.verify(candidate))
+    events = _read_events(part_dir)
+
+    def named_events(events, name):
+        return [e for e in events if e.get("name") == name]
+
+    notices = named_events(events, "lifecycle.notice")
+    drains = named_events(events, "lifecycle.drain")
+    grace_s = 60.0
+    drain_ms = [float((e.get("attrs") or {}).get("drain_ms", 1e12))
+                for e in drains]
+    trace_ok = (
+        any((e.get("attrs") or {}).get("reason") == "SIGTERM"
+            for e in notices)
+        and bool(named_events(events, "lifecycle.preempted"))
+        and bool(drain_ms) and max(drain_ms) < grace_s * 1e3
+    )
+
+    # --- resume: the partial epoch is NOT lost --------------------------
+    res = subprocess.run(_fit_argv(part_dir, n_examples, epochs,
+                                   resume=True),
+                         env=_child_env(), capture_output=True, text=True,
+                         timeout=600)
+    res_ok = res.returncode == 0
+    res_hist = history_of(part_dir) if res_ok else {"epochs": []}
+    preempt_epoch = int(pinfo["epoch"]) if pinfo else -1
+    tail = ref_hist["epochs"][preempt_epoch:] if preempt_epoch >= 0 else []
+    continuity = (
+        res_ok and len(res_hist["epochs"]) == len(tail) and bool(tail)
+        and all(_records_match(a, b)
+                for a, b in zip(res_hist["epochs"], tail))
+        and res_hist["best_val_loss"] == ref_hist["best_val_loss"]
+    )
+
+    # --- watchdog phase: SIGTERM into a wedged step ---------------------
+    hang_dir = os.path.join(root, "hang")
+    hang_plan = _json.dumps({"faults": [
+        {"site": "train.loss", "kind": "delay", "at": steps_ep0,
+         "seconds": 60.0}]})
+    hang_child = subprocess.Popen(
+        _fit_argv(hang_dir, n_examples, epochs),
+        env=_child_env(DEEPDFA_FAULT_PLAN=hang_plan,
+                       DEEPDFA_DRAIN_GRACE_S="8",
+                       DEEPDFA_HANG_DEADLINE_S="2"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # Same meta-commit sync: epoch-1 step 1's 60 s wedge is already
+    # holding the loop when epoch 0's meta lands.
+    _wait_for_meta_epoch(hang_dir, 0, 300.0, proc=hang_child)
+    time.sleep(0.5)
+    t_kill = time.monotonic()
+    hang_child.send_signal(_signal.SIGTERM)
+    try:
+        hang_child.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        hang_child.kill()
+        hang_child.communicate()
+    hang_rc = hang_child.returncode
+    hang_exit_s = time.monotonic() - t_kill
+    hang_events = _read_events(hang_dir)
+    hangs = named_events(hang_events, "lifecycle.hang")
+    stacks_captured = bool(hangs) and bool(
+        (hangs[0].get("attrs") or {}).get("stacks"))
+    hang_probe = CheckpointManager(hang_dir)
+    hang_candidate = hang_probe.resume_candidate()
+    hang_snapshot_ok = bool(hang_candidate
+                            and hang_probe.verify(hang_candidate))
+
+    ok = bool(
+        ref_ok and saw_epoch0
+        and preempt_rc == lifecycle.EXIT_PREEMPTED
+        and pinfo is not None and int(pinfo["epoch"]) == 1
+        and int(pinfo["step"]) >= 1
+        and snapshot_verified
+        and trace_ok
+        and continuity
+        and hang_rc == lifecycle.EXIT_HANG
+        and stacks_captured
+        and hang_snapshot_ok
+        and hang_exit_s < 12.0   # well inside grace + teardown margin
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["sigterm", "delay"],
+        "preempt_exit_code": preempt_rc,
+        "preempt_snapshot": candidate,
+        "preempt_info": pinfo,
+        "snapshot_verified": snapshot_verified,
+        "trace_ok": trace_ok,
+        "drain_ms": max(drain_ms) if drain_ms else None,
+        "resume_exit_code": res.returncode,
+        "resumed_epochs": [e["epoch"] for e in res_hist["epochs"]],
+        "bit_continuous": continuity,
+        "continuity_tolerance": 0.0,
+        "watchdog": {
+            "exit_code": hang_rc,
+            "expected": lifecycle.EXIT_HANG,
+            "hang_events": len(hangs),
+            "stacks_captured": stacks_captured,
+            "durable_snapshot": hang_candidate,
+            "snapshot_verified": hang_snapshot_ok,
+            "exit_after_sigterm_s": round(hang_exit_s, 2),
+        },
+        "child_stderr_tail": (child_err or "")[-800:],
+    }
+
+
+def scenario_serve_lame_duck(out_dir: str) -> Dict[str, Any]:
+    """The serving drain acceptance scenario (ISSUE 10): SIGTERM to a
+    live ``cli serve`` subprocess under replay load. Demands:
+
+    * **zero dropped admitted requests**, asserted from the run trace:
+      every ``serve.enqueue`` rid has a completed ``serve.request`` span,
+      and every in-flight POST returns 200 with scores;
+    * lame-duck admission: POSTs after the notice answer **503 +
+      Retry-After** while the drain runs, and ``/healthz`` reports
+      ``draining``;
+    * partially-filled buckets flush **immediately** (the load is sized
+      below ``batch_slots`` with a 10 s deadline — answers arriving in
+      well under the deadline-flush horizon prove the drain didn't wait
+      for it);
+    * drain duration under the grace budget and compiles flat after
+      warmup, both from the trace; the child exits ``EXIT_PREEMPTED``.
+
+    An injected ``serve.batch`` delay (0.4 s per flush) widens the drain
+    window so the 503/healthz probes are deterministic, not a race.
+    """
+    import json as _json
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.core.config import FlowGNNConfig
+    from deepdfa_tpu.resilience import lifecycle
+    from deepdfa_tpu.telemetry.report import summarize
+
+    root = os.path.join(out_dir, "serve_lame_duck")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    run_dir = os.path.join(root, "run")
+    port_file = os.path.join(root, "port")
+    grace_s = 30.0
+    plan = _json.dumps({"faults": [
+        {"site": "serve.batch", "kind": "delay", "every": 1, "times": 0,
+         "seconds": 0.4}]})
+    argv = [sys.executable, "-m", "deepdfa_tpu.cli", "serve",
+            "--port", "0", "--port-file", port_file, "--run-dir", run_dir,
+            "--slo", "none", "--batch-slots", "4",
+            "--deadline-ms", "10000",
+            "--set", "model.hidden_dim=8", "--set", "model.n_steps=2"]
+    child = subprocess.Popen(
+        argv, env=_child_env(DEEPDFA_FAULT_PLAN=plan,
+                             DEEPDFA_DRAIN_GRACE_S=str(grace_s)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    deadline = time.monotonic() + 300.0
+    while not os.path.exists(port_file) and time.monotonic() < deadline \
+            and child.poll() is None:
+        time.sleep(0.05)
+    if not os.path.exists(port_file):
+        # A wedged child (warmup hang) must cost this scenario, not the
+        # soak: kill it and report, never raise or orphan the subprocess.
+        child.kill()
+        try:
+            out, err = child.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            err = ""
+        return {"ok": False, "fault_kinds": ["sigterm"],
+                "error": "serve child never bound",
+                "child_stderr_tail": (err or "")[-800:]}
+    with open(port_file) as f:
+        base = f"http://127.0.0.1:{int(f.read().strip())}"
+
+    feature = FlowGNNConfig(hidden_dim=8, n_steps=2).feature
+    graphs = synthetic_bigvul(12, feature, positive_fraction=0.5, seed=11)
+    payload = [
+        {"id": int(g["id"]),
+         "graph": {"num_nodes": int(g["num_nodes"]),
+                   "senders": np.asarray(g["senders"]).tolist(),
+                   "receivers": np.asarray(g["receivers"]).tolist(),
+                   "feats": {k: np.asarray(v).tolist()
+                             for k, v in g["feats"].items()}}}
+        for g in graphs
+    ]
+
+    def post(doc, timeout=60.0):
+        req = urllib.request.Request(
+            f"{base}/score", data=_json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, dict(resp.headers), \
+                    _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), _json.loads(e.read() or b"{}")
+        except (urllib.error.URLError, OSError) as e:
+            return None, {}, {"error": str(e)}
+
+    # Warm round (also exercises the injected flush delay once); a short
+    # per-request deadline so its partial bucket doesn't sit out the
+    # load phase's long one.
+    warm_status, _, warm_body = post({"functions": payload[:2],
+                                      "deadline_ms": 500})
+
+    # Replay load: three 2-function POSTs — partial buckets that would
+    # sit until the 5 s deadline-flush without the drain's immediate
+    # flush. They block server-side; SIGTERM lands while all are
+    # admitted and unanswered.
+    results: Dict[int, Any] = {}
+    answered_at: Dict[int, float] = {}
+
+    def load_thread(i, chunk):
+        results[i] = post({"functions": chunk})
+        # The honest answer clock: when THIS admitted request's response
+        # landed — not when the parent's probe loop happened to finish.
+        answered_at[i] = time.monotonic()
+
+    threads = [threading.Thread(target=load_thread,
+                                args=(i, payload[2 + 2 * i: 4 + 2 * i]))
+               for i in range(3)]
+    t_load = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # admissions land (POST submit is ms; flush is not)
+    child.send_signal(_signal.SIGTERM)
+
+    # Deterministic lame-duck probes: the injected flush delay holds the
+    # drain open ≥ 0.8 s after the notice materializes.
+    saw_503 = saw_retry_after = saw_draining = False
+    probe_deadline = time.monotonic() + 10.0
+    while time.monotonic() < probe_deadline and not (saw_503
+                                                     and saw_draining):
+        status, headers, _body = post({"functions": payload[:1]},
+                                      timeout=5.0)
+        if status == 503:
+            saw_503 = True
+            saw_retry_after = saw_retry_after or "Retry-After" in headers
+        try:
+            with urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=5.0) as resp:
+                hdoc = _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            hdoc = _json.loads(e.read() or b"{}")
+        except (urllib.error.URLError, OSError):
+            break  # server already gone: drain finished
+        if hdoc.get("status") == "draining":
+            saw_draining = True
+        time.sleep(0.05)
+
+    for t in threads:
+        t.join(timeout=grace_s + 30.0)
+    answered_s = (max(answered_at.values()) - t_load if answered_at
+                  else float("inf"))
+    try:
+        out, err = child.communicate(timeout=grace_s + 30.0)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        out, err = child.communicate()
+
+    admitted_answered = all(
+        results.get(i) and results[i][0] == 200
+        and all("prob" in r for r in results[i][2].get("results", []))
+        for i in range(3)
+    )
+    events = _read_events(run_dir)
+    rep = summarize(events)
+    enq_rids = {(e.get("attrs") or {}).get("rid")
+                for e in events if e.get("name") == "serve.enqueue"}
+    req_rids = {(e.get("attrs") or {}).get("rid")
+                for e in events
+                if e.get("kind") == "span"
+                and e.get("name") == "serve.request"}
+    dropped = sorted(r for r in enq_rids if r not in req_rids)
+    drains = [e for e in events if e.get("name") == "lifecycle.drain"
+              and (e.get("attrs") or {}).get("participant") == "serve"]
+    drain_ms = [float((e.get("attrs") or {}).get("drain_ms", 1e12))
+                for e in drains]
+    ok = bool(
+        warm_status == 200
+        and admitted_answered
+        and not dropped and enq_rids
+        and saw_503 and saw_retry_after and saw_draining
+        and child.returncode == lifecycle.EXIT_PREEMPTED
+        and drains and all((e.get("attrs") or {}).get("ok")
+                           for e in drains)
+        and max(drain_ms) < grace_s * 1e3
+        and answered_s < 5.0   # never waited out the 10 s deadline flush
+        and rep["compiles"]["after_warmup"] == 0
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["sigterm", "delay"],
+        "admitted_answered": admitted_answered,
+        "admissions": len(enq_rids),
+        "responses": len(req_rids & enq_rids),
+        "dropped_rids": dropped[:8],
+        "rejected_503": saw_503,
+        "retry_after_header": saw_retry_after,
+        "healthz_draining": saw_draining,
+        "exit_code": child.returncode,
+        "drain_ms": max(drain_ms) if drain_ms else None,
+        "answered_under_s": round(answered_s, 2),
+        "compiles_after_warmup": rep["compiles"]["after_warmup"],
+        "child_stderr_tail": (err or "")[-800:],
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -661,6 +1167,9 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
     scenarios["elastic_resume"] = scenario_elastic_resume(
         out_dir, n_examples, epochs)
     scenarios["scan_joern_deaths"] = scenario_scan_joern_deaths(out_dir)
+    scenarios["preempt_drain"] = scenario_preempt_drain(
+        out_dir, n_examples, epochs)
+    scenarios["serve_lame_duck"] = scenario_serve_lame_duck(out_dir)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
@@ -669,7 +1178,9 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
                "serve_flush_fault": "serve-batch-raise",
                "poison_corpus": "data-corrupt",
                "elastic_resume": "elastic-reshape",
-               "scan_joern_deaths": "joern-worker-kill"}
+               "scan_joern_deaths": "joern-worker-kill",
+               "preempt_drain": "sigterm-drain",
+               "serve_lame_duck": "sigterm-lame-duck"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

@@ -45,6 +45,13 @@ Spec fields:
                               position in the input sequence
     ``serve.batch``           before each serving micro-batch executes; index
                               = flush ordinal
+    ``lifecycle.preempt``     the training loops' per-step preemption check
+                              (``resilience/lifecycle.poll``); index = poll
+                              ordinal. ANY matching non-raising kind is
+                              treated as a simulated TPU preemption notice —
+                              the hermetic stand-in for a real SIGTERM
+    ``scan.item``             before each pooled scan item dispatches; index
+                              = submission ordinal
     ========================  =================================================
 
 ``kind``

@@ -186,15 +186,51 @@ class JoernPool:
                 out.append(exc)
         return out
 
-    def close(self) -> None:
+    def close(self, deadline_s: Optional[float] = None) -> None:
+        """Drain and shut the pool down with close→wait→kill escalation
+        under one overall deadline.
+
+        Phase 1 — stop dispatch (``_closed``: no new submissions, no new
+        sessions) and let workers finish everything already queued (the
+        sentinels land BEHIND the in-flight items). Phase 2 — workers
+        that outlive the deadline are mid-item on a wedged/hung child:
+        force-kill their children so the blocked REPL read sees EOF and
+        the thread exits, instead of leaking live JVMs behind an
+        "closed" pool. Phase 3 — leftover sessions shut down via the
+        session protocol (``exit`` + bounded wait, kill only as the
+        escalation terminus).
+
+        ``deadline_s`` bounds the whole drain (default: one item budget —
+        the legacy behavior); the lame-duck path passes the lifecycle
+        notice's remaining grace."""
+        import time as _time
+
         with self._lock:
             if self._closed:
                 return
             self._closed = True
         for _ in self._threads:
             self._queue.put(None)
+        budget = (self.timeout_s + 10.0 if deadline_s is None
+                  else max(float(deadline_s), 0.1))
+        deadline = _time.monotonic() + budget
         for t in self._threads:
-            t.join(timeout=self.timeout_s + 10.0)
+            t.join(timeout=max(deadline - _time.monotonic(), 0.05))
+        stuck = [t for t in self._threads if t.is_alive()]
+        if stuck:
+            # Escalation: a worker is wedged mid-item (hung child, dead
+            # pty). Kill the children outright — EOF unblocks the reader
+            # — and give the threads one short grace to unwind.
+            logger.error("pool close: %d worker(s) still busy at the "
+                         "deadline; killing their children", len(stuck))
+            telemetry.event("scan.pool_close_escalated", stuck=len(stuck))
+            with self._lock:
+                sessions = dict(self._sessions)
+            for wid, session in sessions.items():
+                if session is not None:
+                    _kill_session_child(wid, session)
+            for t in stuck:
+                t.join(timeout=5.0)
         with self._lock:
             leftovers = list(self._sessions.values())
             self._sessions.clear()
@@ -205,6 +241,7 @@ class JoernPool:
                 except Exception:
                     logger.warning("pool: session close failed",
                                    exc_info=True)
+        self._drain_dead()  # anything still queued resolves typed, never hangs
 
     def __enter__(self) -> "JoernPool":
         return self
@@ -215,11 +252,22 @@ class JoernPool:
     # -- worker internals ----------------------------------------------------
 
     def _new_session(self, wid: int) -> JoernSession:
+        with self._lock:
+            if self._closed:
+                # A restart racing close() must not mint a session nobody
+                # will ever shut down (the leaked-child shape).
+                raise _WorkerDeath(RuntimeError("pool is closed"))
         try:
             session = self._factory(wid, self.workspace_root)
         except Exception as exc:
             raise _WorkerDeath(exc) from exc
         with self._lock:
+            if self._closed:
+                try:
+                    session.close()
+                except Exception:
+                    pass
+                raise _WorkerDeath(RuntimeError("pool is closed"))
             self._sessions[wid] = session
         return session
 
@@ -338,6 +386,25 @@ class JoernPool:
             if job is not None and not job.future.done():
                 job.future.set_exception(PoolExhaustedError(
                     "all pooled Joern workers are dead"))
+
+
+def _kill_session_child(wid: int, session) -> None:
+    """Escalation terminus for a wedged worker: SIGKILL the session's
+    child process directly (``session.kill()`` when the transport
+    provides it, the raw ``_proc`` otherwise) so the blocked read sees
+    EOF. Test doubles without a child are a no-op."""
+    try:
+        killer = getattr(session, "kill", None)
+        if callable(killer):
+            killer()
+            return
+        proc = getattr(session, "_proc", None)
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=5)
+    except Exception:
+        logger.warning("pool close: killing worker %d's child failed",
+                       wid, exc_info=True)
 
 
 def _session_alive(session) -> bool:

@@ -332,6 +332,21 @@ class ScanService:
         verdicts = iter(self.scan_sources(items, wait=wait))
         return [next(verdicts) if pre is None else pre for pre in slots]
 
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """The lame-duck drain (ISSUE 10): stop dispatch, finish
+        in-flight Joern items, shut workers down via the session protocol
+        (close→wait→kill escalation under ``deadline_s``), and flush the
+        verdict cache to its persisted live set — after this returns, a
+        restarted service resumes warm from exactly the verdicts this
+        process computed. Idempotent; audited as ``lifecycle.drain``
+        events by the caller's participant plus a ``scan.drained``
+        marker here."""
+        with telemetry.span("lifecycle.drain_scan"):
+            self.pool.close(deadline_s=deadline_s)
+            compacted = self.cache.compact()
+        telemetry.event("scan.drained", cache_rows=compacted,
+                        pool_restarts=self.pool.restarts)
+
     def close(self) -> None:
         self.pool.close()
 

@@ -95,6 +95,19 @@ class MicroBatcher:
             lane: collections.deque() for lane in lanes
         }
         self._lock = threading.Lock()
+        # Lame-duck drain (ISSUE 10): with drain mode on, any non-empty
+        # lane is due IMMEDIATELY — partially-filled buckets flush now
+        # instead of waiting for fill or the deadline fraction, so every
+        # already-admitted request is answered inside the grace budget.
+        self._drain_mode = False
+
+    def set_drain_mode(self, on: bool = True) -> None:
+        with self._lock:
+            self._drain_mode = bool(on)
+
+    @property
+    def drain_mode(self) -> bool:
+        return self._drain_mode
 
     @property
     def lanes(self) -> Tuple[str, ...]:
@@ -154,7 +167,7 @@ class MicroBatcher:
                 deadline_due = now >= min(
                     r.flush_at(self.config.flush_fraction) for r in q
                 )
-                if not (filled or deadline_due):
+                if not (filled or deadline_due or self._drain_mode):
                     continue
                 remaining = min(r.arrival + r.deadline_s for r in q) - now
                 if best is None or remaining < best[0]:
@@ -169,7 +182,8 @@ class MicroBatcher:
             for q in self._pending.values():
                 if not q:
                     continue
-                when = (now if len(q) >= self.config.batch_slots
+                when = (now if (len(q) >= self.config.batch_slots
+                                or self._drain_mode)
                         else min(r.flush_at(self.config.flush_fraction)
                                  for r in q))
                 if t is None or when < t:

@@ -135,6 +135,9 @@ class ServeEngine:
         # Compile count recorded at the end of warmup(): the live SLO
         # monitor's compiles_after_warmup baseline (None until warmed).
         self.warmup_compiles: Optional[int] = None
+        # Lame-duck drain flag (enter_lame_duck): the batcher flushes
+        # immediately and the transport sheds NEW admissions.
+        self.lame_duck = False
 
         self._lanes: Dict[str, _Lane] = {
             "gnn": self._make_lane("gnn", make_gnn_infer(gnn_model),
@@ -376,6 +379,18 @@ class ServeEngine:
 
     def pending(self) -> int:
         return self.batcher.depth()
+
+    def enter_lame_duck(self) -> None:
+        """Lame-duck mode (ISSUE 10): the batcher flushes partially-filled
+        buckets immediately (no fill/deadline wait), so the pump answers
+        every already-admitted request as fast as the device allows.
+        Admission control (503 + Retry-After for NEW requests) lives at
+        the transport — in-flight producers like the scan service must
+        still be able to score what they already accepted. Idempotent."""
+        if not self.lame_duck:
+            self.lame_duck = True
+            self.batcher.set_drain_mode(True)
+            telemetry.event("lifecycle.lame_duck", pending=self.pending())
 
     def next_flush_time(self) -> Optional[float]:
         return self.batcher.next_flush_time(self._clock())

@@ -38,11 +38,13 @@ connections.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
@@ -185,7 +187,7 @@ class ServeHandler(BaseHTTPRequestHandler):
         engine = self.server.engine
         if self.path == "/healthz":
             doc: Dict = {
-                "status": "ok",
+                "status": ("draining" if self.server.draining else "ok"),
                 "warm_buckets": engine.n_warm,
                 # Observability health: a nonzero drop count means the
                 # telemetry rings overflowed and the trace is incomplete.
@@ -195,9 +197,11 @@ class ServeHandler(BaseHTTPRequestHandler):
             if monitor is not None:
                 slo = monitor.status()
                 doc["slo"] = slo
-                if not slo["ok"]:
+                if not slo["ok"] and doc["status"] == "ok":
                     # An SLO burning degrades health: orchestrators see a
                     # failing check while the process keeps serving.
+                    # (An active drain outranks it: "draining" is the
+                    # load-balancer's take-me-out-of-rotation signal.)
                     doc["status"] = "degraded"
             scan = self.server.scan_service
             if scan is not None:
@@ -206,7 +210,7 @@ class ServeHandler(BaseHTTPRequestHandler):
                                     "size": scan.pool.size,
                                     "healthy": sum(health),
                                     "restarts": scan.pool.restarts}
-                if not any(health):
+                if not any(health) and doc["status"] == "ok":
                     # A scan service with zero live Joern workers cannot
                     # do its job: degraded, while /score keeps serving.
                     doc["status"] = "degraded"
@@ -234,7 +238,33 @@ class ServeHandler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": "not_found"})
 
+    def _reject_draining(self) -> bool:
+        """Lame-duck admission control: NEW work is shed with 503 +
+        Retry-After (the replica is leaving rotation; a retry lands on a
+        live one), while requests admitted before the notice keep being
+        answered. True when the request was rejected."""
+        if not self.server.draining:
+            return False
+        retry_s = self.server.drain_retry_after_s()
+        self._send_json(503, {"error": "draining",
+                              "retry_after_s": retry_s},
+                        headers={"Retry-After":
+                                 str(max(int(-(-retry_s // 1)), 1))})
+        return True
+
     def do_POST(self) -> None:
+        # Inflight BEFORE the draining check: the drain waiter must never
+        # observe (pending=0, inflight=0) while a handler sits between an
+        # admission decision and its increment — that window would let
+        # shutdown reset an admitted connection (the dropped-request
+        # shape the lame-duck contract rules out). A post-increment 503
+        # is an answered response, not a drop.
+        with self.server.track_inflight():
+            if self._reject_draining():
+                return
+            self._do_post()
+
+    def _do_post(self) -> None:
         if self.path == "/scan":
             self._do_scan()
             return
@@ -358,6 +388,69 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self.scan_service = scan_service
         _predeclare_metrics()
         self.pump_thread = _PumpThread(engine, slo_monitor=slo_monitor)
+        # Lame-duck drain state (ISSUE 10): `draining` flips admission to
+        # 503; `_inflight` counts transport threads still assembling a
+        # response for an already-admitted POST (the queue may be empty
+        # while a handler is still writing its body — both must reach
+        # zero before shutdown, or an answered-but-unwritten response is
+        # a dropped request).
+        self.draining = False
+        self.drain_notice = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def track_inflight(self):
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain_retry_after_s(self) -> float:
+        """The Retry-After hint while draining: the remaining grace (the
+        replacement replica is up by then), floored at one flush window."""
+        notice = self.drain_notice
+        floor = (self.engine.config.flush_fraction
+                 * self.engine.config.deadline_ms / 1000.0)
+        if notice is None:
+            return max(floor, 1.0)
+        return max(notice.remaining(), floor, 1.0)
+
+    def begin_drain(self, notice=None) -> None:
+        """Enter lame-duck: NEW admissions 503, /healthz reports
+        draining, the batcher flushes partial buckets immediately."""
+        self.drain_notice = notice
+        self.draining = True
+        self.engine.enter_lame_duck()
+
+    def await_drained(self, deadline_s: float,
+                      beat: Optional[Callable[[], None]] = None,
+                      poll_s: float = 0.01) -> bool:
+        """Block until every already-admitted request is answered AND
+        written (queue depth 0, no in-flight handlers), or the deadline
+        passes. ``beat`` feeds the lifecycle watchdog while progress is
+        being made."""
+        import time
+
+        deadline = time.monotonic() + max(deadline_s, 0.0)
+        last = (-1, -1)
+        while time.monotonic() < deadline:
+            state = (self.engine.pending(), self.inflight)
+            if state == (0, 0):
+                return True
+            if beat is not None and state != last:
+                beat()  # progress, not a wedge: keep the watchdog calm
+                last = state
+            time.sleep(poll_s)
+        return self.engine.pending() == 0 and self.inflight == 0
 
     def start_pump(self) -> None:
         self.pump_thread.start()
@@ -371,14 +464,71 @@ class ServeHTTPServer(ThreadingHTTPServer):
 def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
                   port: int = 8080,
                   slo_monitor: Optional[SLOMonitor] = None,
-                  scan_service=None) -> None:
-    """Blocking entry: warm the buckets, start the pump, serve."""
+                  scan_service=None, port_file: Optional[str] = None):
+    """Blocking entry: warm the buckets, start the pump, serve.
+
+    Registers with the process lifecycle coordinator: a preemption
+    notice (SIGTERM/SIGINT or simulated) flips the server into lame-duck
+    — admission 503s with Retry-After, partially-filled buckets flush
+    immediately, every already-admitted request is answered, the scan
+    pool drains via the session protocol, the telemetry run closes
+    cleanly — then this function returns the notice (None on a plain
+    shutdown) so the CLI can exit with the preemption code.
+
+    ``port_file``: written with the bound port after bind — how
+    subprocess drivers (the ``serve_lame_duck`` chaos scenario) find an
+    ephemeral ``--port 0``.
+    """
+    from deepdfa_tpu.resilience import lifecycle
+
     server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor,
                              scan_service=scan_service)
+    if port_file:
+        tmp = f"{port_file}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(server.server_address[1]))
+        os.replace(tmp, port_file)
     server.start_pump()
     logger.info("serving on %s:%d (%d warm buckets)", host,
                 server.server_address[1], engine.n_warm)
+
+    coordinator = lifecycle.coordinator()
+    participant_box: Dict[str, object] = {}
+
+    def on_notice(notice) -> None:
+        # Monitor-thread callback: drive the whole lame-duck drain, then
+        # stop the server (serve_forever unblocks below). Every phase
+        # beats the watchdog; a wedged flush or JVM trips it instead of
+        # eating the grace window.
+        participant = participant_box.get("p")
+        beat = participant.beat if participant else (lambda: None)
+        with telemetry.span("lifecycle.drain_serve"):
+            server.begin_drain(notice)
+            beat()
+            budget = participant.deadline_s if participant else notice.grace_s
+            drained = server.await_drained(
+                min(budget, notice.remaining()), beat=beat)
+            if not drained:
+                logger.error(
+                    "lame-duck drain overran its budget: pending=%d "
+                    "inflight=%d", server.engine.pending(), server.inflight)
+            if scan_service is not None:
+                try:
+                    scan_service.drain(deadline_s=notice.remaining())
+                except Exception:
+                    logger.exception("scan drain failed during lame-duck")
+                beat()
+        if participant:
+            participant.drained(ok=drained)
+        telemetry.flush()
+        server.shutdown()
+
+    participant_box["p"] = coordinator.register("serve", on_notice=on_notice)
     try:
         server.serve_forever()
     finally:
-        server.shutdown()
+        try:
+            server.shutdown()
+        finally:
+            coordinator.unregister(participant_box["p"])
+    return coordinator.notice

@@ -157,6 +157,28 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     # --- memory: compiled HBM footprint + live device samples -----------
     memory = _memory(instants)
 
+    # --- lifecycle: preemption notices and the drain audit --------------
+    notices = named(instants, ("lifecycle.notice",))
+    lc_drains = named(instants, ("lifecycle.drain",))
+    hangs = named(instants, ("lifecycle.hang",))
+    lifecycle = {
+        "notices": len(notices),
+        "reasons": sorted({(e.get("attrs") or {}).get("reason", "?")
+                           for e in notices}),
+        "lame_duck": len(named(instants, ("lifecycle.lame_duck",))),
+        "preempt_snapshots": len(named(instants, ("lifecycle.preempted",))),
+        "drains": [
+            {"participant": (e.get("attrs") or {}).get("participant", "?"),
+             "ok": bool((e.get("attrs") or {}).get("ok")),
+             "drain_ms": round(float((e.get("attrs") or {})
+                                     .get("drain_ms", 0.0)), 3)}
+            for e in lc_drains
+        ],
+        "hangs": len(hangs),
+        "forced_exits": len([e for e in named(instants, ("lifecycle.exit",))
+                             if (e.get("attrs") or {}).get("forced")]),
+    }
+
     # --- SLO breaches observed live during the run ----------------------
     slo_breaches = named(instants, ("slo.breach",))
     slo = {
@@ -182,6 +204,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "serve": serve,
         "roofline": roofline,
         "memory": memory,
+        "lifecycle": lifecycle,
         "slo": slo,
         "telemetry_drops": drops,
     }

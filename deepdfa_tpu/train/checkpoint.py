@@ -18,8 +18,23 @@ Robustness contract (the preemptible-TPU posture, tests/test_resilience.py):
   verify it and, on mismatch (or an unreadable snapshot), fall back to
   the newest intact snapshot. Fallback order: the requested name first,
   then every other recorded snapshot by descending epoch, ties broken
-  ``last`` > ``epoch_N`` > ``best``. ``last_restored`` reports what was
-  actually loaded so resume can restart from the surviving epoch.
+  ``last`` > ``preempt_E_S`` > ``epoch_N`` > ``best``. ``last_restored``
+  reports what was actually loaded so resume can restart from the
+  surviving epoch.
+
+Preemption snapshots (ISSUE 10):
+
+* :meth:`save_preempt` writes ``preempt_<epoch>_<step>`` — the SIGTERM
+  drain's step-granular snapshot. The state tree is byte-identical in
+  structure to ``last`` (so the verified-restore fallback works across
+  names); the step-level resume payload (step index, data-order cursor,
+  host-side accumulator values) rides the snapshot's ``meta.json``
+  record and comes back through :meth:`preempt_info`. A preempt
+  snapshot taken mid-epoch ``E`` records epoch ``E`` and therefore
+  outranks the previous epoch's ``last`` in the fallback order — the
+  partial epoch wins the resume — while a torn one never beats an
+  intact older snapshot (checksum verification is name-blind), and a
+  completed epoch's ``last`` retakes the tie.
 
 Elastic/async extensions (ISSUE 6):
 
@@ -65,6 +80,7 @@ from deepdfa_tpu import telemetry
 logger = logging.getLogger(__name__)
 
 _EPOCH_NAME_RE = re.compile(r"^epoch_(\d+)$")
+_PREEMPT_NAME_RE = re.compile(r"^preempt_(\d+)_(\d+)$")
 
 ASYNC_ENV_VAR = "DEEPDFA_ASYNC_CKPT"
 
@@ -221,6 +237,40 @@ class CheckpointManager:
             self._save(f"epoch_{epoch}", state, epoch)
             self._write_meta()
 
+    def save_preempt(self, state: Any, epoch: int, step: int,
+                     resume: Optional[Dict[str, Any]] = None) -> str:
+        """The preemption drain's snapshot: ``preempt_<epoch>_<step>``,
+        carrying the in-progress epoch plus a JSON-safe step-level resume
+        payload (data-order cursor, host-read accumulator values) in its
+        meta record. Returns the snapshot name."""
+        name = f"preempt_{int(epoch)}_{int(step)}"
+        self._save(name, state, epoch)
+        record = self._meta.setdefault("snapshots", {})[name]
+        record["step"] = int(step)
+        record["preempt"] = dict(resume or {})
+        self._write_meta()
+        return name
+
+    def preempt_info(self, name: str) -> Optional[Dict[str, Any]]:
+        """The step-level resume payload a ``preempt_*`` snapshot
+        recorded, or None for every other snapshot name."""
+        record = self._meta.get("snapshots", {}).get(name)
+        if record is None or "preempt" not in record:
+            return None
+        return {"epoch": int(record["epoch"]), "step": int(record["step"]),
+                **record["preempt"]}
+
+    def remove(self, name: str) -> None:
+        """Delete a snapshot and its meta record (the consumed ``preempt``
+        cleanup once its epoch completes and ``last`` retakes the tie)."""
+        import shutil
+
+        shutil.rmtree(os.path.join(self.directory, name),
+                      ignore_errors=True)
+        self._digest_cache.pop(name, None)
+        if self._meta.get("snapshots", {}).pop(name, None) is not None:
+            self._write_meta()
+
     # -- integrity ---------------------------------------------------------
 
     def has(self, name: str) -> bool:
@@ -282,12 +332,23 @@ class CheckpointManager:
             return None
         return record.get("layout")
 
-    def resume_candidate(self) -> Optional[str]:
-        """The snapshot a resume should start from: ``last`` when it is on
-        disk, else the newest recorded snapshot (a writer that died between
-        deleting the old ``last`` and committing the new one must cost one
-        epoch, not the whole run). None when nothing restorable exists."""
-        order = self._fallback_order("last")
+    def resume_candidate(self, include_preempt: bool = True) -> Optional[str]:
+        """The snapshot a resume should start from: the newest on-disk
+        snapshot by epoch, ties broken ``last`` > ``preempt`` >
+        ``epoch_N`` > ``best``. A mid-epoch ``preempt_E_S`` records the
+        in-progress epoch ``E`` and therefore outranks epoch ``E-1``'s
+        ``last`` — the partial epoch resumes instead of being lost —
+        while a completed epoch's ``last`` retakes the tie. A writer that
+        died between deleting the old ``last`` and committing the new one
+        costs one epoch, not the whole run. None when nothing restorable
+        exists.
+
+        ``include_preempt=False`` skips ``preempt_*`` candidates — the
+        reshape path, where a step-granular skip count written under a
+        different DP packing would shear the data order."""
+        order = self._fallback_order("")
+        if not include_preempt:
+            order = [n for n in order if not _PREEMPT_NAME_RE.match(n)]
         return order[0] if order else None
 
     def drain(self, timeout: Optional[float] = None) -> float:
@@ -303,6 +364,9 @@ class CheckpointManager:
         m = _EPOCH_NAME_RE.match(name)
         if m:
             return int(m.group(1))
+        m = _PREEMPT_NAME_RE.match(name)
+        if m:
+            return int(m.group(1))
         if name == "last":
             return int(self._meta.get("last_epoch", -1))
         if name == "best":
@@ -311,17 +375,23 @@ class CheckpointManager:
 
     def _fallback_order(self, requested: str) -> List[str]:
         """Requested name first, then every other on-disk snapshot by
-        descending epoch (ties: last > epoch_N > best) — THE documented
-        checksum-fallback order (README "Fault tolerance")."""
+        descending epoch (ties: last > preempt > epoch_N > best) — THE
+        documented checksum-fallback order (README "Fault tolerance" /
+        "Graceful shutdown & preemption")."""
         on_disk = [
             d for d in sorted(os.listdir(self.directory))
             if os.path.isdir(os.path.join(self.directory, d))
-            and (d in ("best", "last") or _EPOCH_NAME_RE.match(d))
+            and (d in ("best", "last") or _EPOCH_NAME_RE.match(d)
+                 or _PREEMPT_NAME_RE.match(d))
         ]
-        pref = {"last": 0, "best": 2}
+        pref = {"last": 0, "best": 3}
 
         def rank(name: str) -> Tuple:
-            return (-self._snapshot_epoch(name), pref.get(name, 1), name)
+            tie = pref.get(name, 1 if _PREEMPT_NAME_RE.match(name) else 2)
+            # Among same-epoch preempt snapshots, the later step wins.
+            m = _PREEMPT_NAME_RE.match(name)
+            step = -int(m.group(2)) if m else 0
+            return (-self._snapshot_epoch(name), tie, step, name)
 
         rest = sorted((d for d in on_disk if d != requested), key=rank)
         head = [requested] if requested in on_disk else []
@@ -436,16 +506,21 @@ class CheckpointManager:
 
 class _PendingWrite:
     """One queued snapshot write: the state (host copy already started),
-    plus the meta fields to commit once the bytes are durable."""
+    plus the meta fields to commit once the bytes are durable.
+    ``record_extra`` merges into the snapshot's own meta record (the
+    preempt resume payload)."""
 
-    __slots__ = ("name", "state", "epoch", "meta_update", "submitted_s")
+    __slots__ = ("name", "state", "epoch", "meta_update", "submitted_s",
+                 "record_extra")
 
     def __init__(self, name: str, state: Any, epoch: int,
-                 meta_update: Dict[str, Any]):
+                 meta_update: Dict[str, Any],
+                 record_extra: Optional[Dict[str, Any]] = None):
         self.name = name
         self.state = state
         self.epoch = epoch
         self.meta_update = meta_update
+        self.record_extra = record_extra
         self.submitted_s = time.perf_counter()
 
 
@@ -513,10 +588,12 @@ class AsyncCheckpointManager(CheckpointManager):
         return jax.tree_util.tree_map(start, state)
 
     def _submit(self, name: str, state: Any, epoch: int,
-                meta_update: Dict[str, Any]) -> None:
+                meta_update: Dict[str, Any],
+                record_extra: Optional[Dict[str, Any]] = None) -> None:
         with telemetry.span("ckpt.copy", snapshot=name, epoch=int(epoch)):
             state = self._start_host_copy(state)
-        pending = _PendingWrite(name, state, int(epoch), meta_update)
+        pending = _PendingWrite(name, state, int(epoch), meta_update,
+                                record_extra)
         with self._cv:
             for i, queued in enumerate(self._queue):
                 if queued.name == name:
@@ -554,6 +631,18 @@ class AsyncCheckpointManager(CheckpointManager):
     def maybe_save_periodic(self, state: Any, epoch: int) -> None:
         if self.periodic_every and (epoch + 1) % self.periodic_every == 0:
             self._submit(f"epoch_{epoch}", state, epoch, {})
+
+    def save_preempt(self, state: Any, epoch: int, step: int,
+                     resume: Optional[Dict[str, Any]] = None) -> str:
+        """Async preempt snapshot: submitted like any write (the drain
+        barrier the preemption path takes right after makes it durable);
+        the resume payload rides the write and lands in the snapshot's
+        meta record at commit."""
+        name = f"preempt_{int(epoch)}_{int(step)}"
+        self._submit(name, state, epoch, {},
+                     record_extra={"step": int(step),
+                                   "preempt": dict(resume or {})})
+        return name
 
     # -- the writer thread -------------------------------------------------
 
@@ -628,6 +717,8 @@ class AsyncCheckpointManager(CheckpointManager):
                         f"{item.name}")
         with telemetry.span("ckpt.commit", snapshot=item.name, epoch=item.epoch):
             self._record_snapshot(item.name, path, item.epoch)
+            if item.record_extra:
+                self._meta["snapshots"][item.name].update(item.record_extra)
             self._meta.update(item.meta_update)
             self._write_meta()
 
@@ -680,9 +771,19 @@ class AsyncCheckpointManager(CheckpointManager):
         self.drain()
         return super().restore_params(name)
 
-    def resume_candidate(self) -> Optional[str]:
+    def resume_candidate(self, include_preempt: bool = True) -> Optional[str]:
         self.drain()
-        return super().resume_candidate()
+        return super().resume_candidate(include_preempt=include_preempt)
+
+    def preempt_info(self, name: str) -> Optional[Dict[str, Any]]:
+        self.drain()
+        return super().preempt_info(name)
+
+    def remove(self, name: str) -> None:
+        # A queued same-name write racing the removal would resurrect the
+        # snapshot; the barrier first makes removal final.
+        self.drain()
+        super().remove(name)
 
     @property
     def best_meta(self) -> dict:

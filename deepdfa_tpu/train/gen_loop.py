@@ -27,7 +27,7 @@ from flax import struct
 from deepdfa_tpu.core.config import TransformerTrainConfig
 from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
 from deepdfa_tpu.models.t5_generate import generate
-from deepdfa_tpu.resilience import inject
+from deepdfa_tpu.resilience import inject, lifecycle
 from deepdfa_tpu.train.text_loop import make_schedule, make_text_optimizer
 from deepdfa_tpu import telemetry
 
@@ -466,6 +466,20 @@ def fit_gen(
                             _lift_rows(tgt, mesh, host)
                         )
                     losses.append(inject.corrupt_loss(loss))
+                    # Step-granular preemption check (ISSUE 10): drain to
+                    # a durable preempt snapshot and exit typed instead
+                    # of losing the partial epoch to SIGKILL. Process 0
+                    # owns the run dir (the save_last gating).
+                    notice = lifecycle.poll()
+                    if notice is not None:
+                        lifecycle.preempt_snapshot_exit(
+                            notice,
+                            checkpointer if (host is None or host[0] == 0)
+                            else None,
+                            state, epoch, len(losses),
+                            history={"epochs": history},
+                            resume={"seen": len(losses), "loop": "gen"},
+                            loop="gen")
                 ep.fence(losses)
                 ep.set(steps=len(losses))
             record = {"epoch": epoch,

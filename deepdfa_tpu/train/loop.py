@@ -35,7 +35,7 @@ from deepdfa_tpu.graphs.batch import (
 )
 from deepdfa_tpu.models.flowgnn import FlowGNN
 from deepdfa_tpu.parallel.mesh import DATA_AXIS, batch_sharding, make_mesh, replicated
-from deepdfa_tpu.resilience import inject
+from deepdfa_tpu.resilience import inject, lifecycle
 from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
@@ -502,12 +502,34 @@ def fit(
     history: Dict[str, Any] = {"epochs": [], "best_epoch": -1, "best_val_loss": float("inf")}
     best_state = state
     start_epoch = 0
+    resume_mid: Optional[Dict[str, Any]] = None
     candidate = checkpointer.resume_candidate() if (
         resume and checkpointer is not None) else None
     if candidate is not None:
-        from deepdfa_tpu.parallel.mesh import reshard_state, snapshot_layout
+        from deepdfa_tpu.parallel.mesh import (
+            check_layout_compatible,
+            reshard_state,
+            snapshot_layout,
+        )
         from deepdfa_tpu.train.checkpoint import CheckpointError
 
+        if checkpointer.preempt_info(candidate) is not None:
+            # A step-granular skip count is only meaningful under the DP
+            # packing that wrote it: across a reshape, fall back to the
+            # newest epoch-granular snapshot (the partial epoch is lost
+            # there — loudly — instead of silently sheared).
+            prev = checkpointer.snapshot_layout(candidate) or {}
+            if prev and prev.get("n_shards") != (
+                    int(mesh.shape[DATA_AXIS]) if mesh is not None else 1):
+                logger.warning(
+                    "resume: preempt snapshot %s was written under DP "
+                    "layout %s; step-granular mid-epoch resume does not "
+                    "survive a reshape — resuming from the newest "
+                    "epoch-granular snapshot instead", candidate, prev,
+                )
+                candidate = checkpointer.resume_candidate(
+                    include_preempt=False)
+    if candidate is not None:
         meta = checkpointer.best_meta
         try:
             state = checkpointer.restore(candidate, state)
@@ -520,10 +542,13 @@ def fit(
             )
         else:
             restored = checkpointer.last_restored or {}
-            if candidate != "last":
+            if candidate != "last" and checkpointer.preempt_info(
+                    candidate) is None:
                 # The 'last' snapshot never landed (a writer killed between
                 # deleting the old bytes and committing the new): resume
                 # from the newest intact snapshot instead of from scratch.
+                # (A preempt candidate is the NORMAL mid-epoch path and
+                # logs its own message below.)
                 logger.warning(
                     "resume: no 'last' snapshot on disk; resuming from "
                     "%s (epoch %d)", candidate, int(restored.get("epoch", -1)),
@@ -547,6 +572,27 @@ def fit(
                     "resume: restored fallback snapshot %s; restarting at "
                     "epoch %d", restored.get("name"), start_epoch,
                 )
+            resume_mid = checkpointer.preempt_info(
+                restored.get("name", candidate))
+            if resume_mid is not None:
+                # Mid-epoch restart (ISSUE 10): the preempt snapshot's
+                # epoch is IN PROGRESS — re-enter it at the recorded
+                # step, with the saved accumulators, skipping the batches
+                # the preempted process already trained on (the
+                # data-order cursor is (seed, epoch, step): the packer is
+                # deterministic, so skip-by-count is exact).
+                resume_mid["snapshot"] = restored.get("name", candidate)
+                start_epoch = int(resume_mid["epoch"])
+                logger.warning(
+                    "resume: mid-epoch restart from preempt snapshot %s "
+                    "(epoch %d, %d step(s) already trained)",
+                    resume_mid["snapshot"], start_epoch,
+                    int(resume_mid["step"]),
+                )
+                telemetry.event("lifecycle.resume",
+                                snapshot=resume_mid["snapshot"],
+                                epoch=start_epoch,
+                                step=int(resume_mid["step"]))
             # Topology-independent restore: compare the snapshot's
             # recorded DP layout with the resuming mesh and reshard. Same
             # shard count => bit-tracked metrics; a reshape moves the
@@ -555,6 +601,11 @@ def fit(
             prev_layout = checkpointer.snapshot_layout(
                 restored.get("name", candidate)) or {}
             cur_layout = snapshot_layout(mesh)
+            # Multi-host guard: a process-count change across the resume
+            # is not a reshard — fail with the typed, actionable error
+            # BEFORE any device placement (the shape mismatch it would
+            # otherwise become deep in reshard is undebuggable).
+            check_layout_compatible(prev_layout, cur_layout)
             if prev_layout and prev_layout.get("n_shards") != cur_layout["n_shards"]:
                 logger.warning(
                     "resume: resharding from DP layout %s to %s "
@@ -603,7 +654,7 @@ def fit(
             model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
             use_tile, use_band, use_df, state, train_step, eval_step, labels,
             history, best_state, checkpointer, tb_writer, log_every,
-            start_epoch, host, mesh, on_epoch_end,
+            start_epoch, host, mesh, on_epoch_end, resume_mid,
         )
     finally:
         # close on every exit path: a diverging run (detect_anomaly raise)
@@ -663,15 +714,98 @@ class _AnomalyGuard:
         return True, snapshot
 
 
+def _resume_payload(epoch, seen, n_batches, loss_sum, stats, bad_step,
+                    data_cfg, train_cfg) -> Dict[str, Any]:
+    """The step-level resume state a ``preempt_*`` snapshot records.
+
+    Host reads (``float()``) here are the one-time preemption cost; the
+    values are JSON-safe and round-trip bit-exactly (f32 -> f64 -> f32),
+    so the resumed accumulators are bitwise the preempted ones. The
+    data-order cursor is just ``(seed, epoch, step)``: ``epoch_indices``
+    and the packer are deterministic, so skip-by-count replays the exact
+    batch sequence."""
+    return {
+        "seen": int(seen),
+        "n_batches": int(n_batches),
+        "loss_sum": float(loss_sum),
+        "stats": [float(stats.tp), float(stats.fp), float(stats.tn),
+                  float(stats.fn)],
+        "bad_step": int(bad_step),
+        "data_cursor": {"seed": int(data_cfg.seed), "epoch": int(epoch)},
+        "prng_seed": int(train_cfg.seed),
+    }
+
+
+def _preempt_exit(notice, checkpointer, state, epoch, seen, n_batches,
+                  loss_sum, stats, bad_step, data_cfg, train_cfg, history,
+                  participant=None):
+    """The graph fit's preemption drain (ISSUE 10): the shared
+    snapshot-drain-exit path carrying THIS loop's step-level resume
+    payload (the one :func:`fit` knows how to restart mid-epoch from).
+    Never returns."""
+    lifecycle.preempt_snapshot_exit(
+        notice, checkpointer, state, epoch, seen, history=history,
+        resume=_resume_payload(epoch, seen, n_batches, loss_sum, stats,
+                               bad_step, data_cfg, train_cfg),
+        participant=participant,
+    )
+
+
 def _fit_epochs(
     model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
     use_tile, use_band, use_df, state, train_step, eval_step, labels, history,
     best_state, checkpointer, tb_writer, log_every, start_epoch=0, host=None,
-    mesh=None, on_epoch_end=None,
+    mesh=None, on_epoch_end=None, resume_mid=None,
 ):
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
     guard = _AnomalyGuard(train_cfg)
+    # The hung-step watchdog's emergency hook: references to the last
+    # COMPLETED step's state/accumulators (updated per step — references
+    # only, no host reads). A wedged step can then still leave a durable
+    # snapshot behind before the forced exit.
+    published: Dict[str, Any] = {}
+
+    def _on_hang(notice):
+        if checkpointer is None or not published:
+            return
+        p = dict(published)
+        payload = _resume_payload(p["epoch"], p["seen"], p["n_batches"],
+                                  p["loss_sum"], p["stats"], p["bad_step"],
+                                  data_cfg, train_cfg)
+        snapshot = checkpointer.save_preempt(p["state"], p["epoch"],
+                                             p["seen"], resume=payload)
+        try:
+            checkpointer.drain(timeout=max(notice.remaining(), 1.0))
+        except TimeoutError:
+            logger.error("lifecycle: emergency snapshot drain overran the "
+                         "grace budget")
+        telemetry.event("lifecycle.preempted", epoch=int(p["epoch"]),
+                        step=int(p["seen"]), snapshot=snapshot,
+                        reason=notice.reason, forced=True)
+
+    participant = lifecycle.coordinator().register("train",
+                                                   on_hang=_on_hang)
+    try:
+        return _fit_epochs_inner(
+            model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
+            use_tile, use_band, use_df, state, train_step, eval_step, labels,
+            history, best_state, checkpointer, tb_writer, log_every,
+            start_epoch, host, mesh, on_epoch_end, resume_mid, guard,
+            published, participant,
+        )
+    finally:
+        lifecycle.coordinator().unregister(participant)
+
+
+def _fit_epochs_inner(
+    model, examples, splits, train_cfg, data_cfg, subkeys, n_shards,
+    use_tile, use_band, use_df, state, train_step, eval_step, labels, history,
+    best_state, checkpointer, tb_writer, log_every, start_epoch, host,
+    mesh, on_epoch_end, resume_mid, guard, published, participant,
+):
+    from deepdfa_tpu.parallel.mesh import assemble_global_batch
+
     for epoch in range(start_epoch, train_cfg.max_epochs):
         # Fault hook: a `raise` fault here is a simulated preemption — the
         # kill-and-resume determinism gate (tests/test_resilience.py) and
@@ -707,6 +841,29 @@ def _fit_epochs(
         # Window-start snapshot for rollback: references to the functional
         # state/accumulator values, so holding it costs nothing.
         window = (state, loss_sum, stats, n_batches)
+        # Mid-epoch resume (ISSUE 10): re-enter the preempted epoch at
+        # the recorded step — accumulators restored bitwise from the
+        # preempt snapshot's payload, the already-trained batches skipped
+        # by count (the packer is deterministic per (seed, epoch)).
+        skip = 0
+        if resume_mid is not None and epoch == int(resume_mid["epoch"]):
+            skip = int(resume_mid["step"])
+            loss_sum = jnp.asarray(resume_mid["loss_sum"], jnp.float32)
+            stats = BinaryStats(*(jnp.asarray(v, jnp.float32)
+                                  for v in resume_mid["stats"]))
+            n_batches = int(resume_mid["n_batches"])
+            seen = skip
+            bad_step = jnp.asarray(int(resume_mid.get("bad_step", -1)),
+                                   jnp.int32)
+            window = (state, loss_sum, stats, n_batches)
+        # Preemption check at the epoch boundary too: a notice that
+        # landed during eval/checkpointing must not cost one more full
+        # step before the drain starts.
+        notice = lifecycle.poll()
+        if notice is not None:
+            _preempt_exit(notice, checkpointer, state, epoch, seen,
+                          n_batches, loss_sum, stats, bad_step, data_cfg,
+                          train_cfg, history, participant)
         # Epoch span, FENCED on the device loss accumulator: its duration
         # covers dispatch AND device execution (the honest wall time the
         # GL011 rule exists to enforce), while the per-step spans inside
@@ -715,9 +872,13 @@ def _fit_epochs(
         # counts the steps the fenced span covers.
         window_steps = 0
         with telemetry.span("train.epoch", epoch=epoch) as ep:
+            raw_batches = 0
             for batch in _batches(examples, epoch_sel, data_cfg, subkeys,
                                   data_cfg.batch_size, n_shards, use_tile,
                                   use_band, use_df, host):
+                raw_batches += 1
+                if raw_batches <= skip:
+                    continue  # already trained before the preemption
                 if host is not None:
                     batch = assemble_global_batch(batch, mesh)
                 with telemetry.span("train.step", epoch=epoch, step=seen):
@@ -732,6 +893,16 @@ def _fit_epochs(
                 n_batches += 1
                 seen += 1
                 window_steps += 1
+                published.update(state=state, epoch=epoch, seen=seen,
+                                 n_batches=n_batches, loss_sum=loss_sum,
+                                 stats=stats, bad_step=bad_step)
+                # THE step-granularity preemption check: one flag read
+                # (plus the lifecycle.preempt fault site) per step.
+                notice = lifecycle.poll()
+                if notice is not None:
+                    _preempt_exit(notice, checkpointer, state, epoch, seen,
+                                  n_batches, loss_sum, stats, bad_step,
+                                  data_cfg, train_cfg, history, participant)
                 if seen % log_every == 0:
                     rolled, (state, loss_sum, stats, n_batches) = guard.check(
                         epoch, bad_step, window,
@@ -846,6 +1017,12 @@ def _fit_epochs(
         if checkpointer is not None:
             checkpointer.save_last(state, epoch)
             checkpointer.maybe_save_periodic(state, epoch)
+            if resume_mid is not None and epoch == int(resume_mid["epoch"]):
+                # The preempted epoch completed and this 'last' covers it
+                # (and wins the fallback tie): the consumed preempt
+                # snapshot is garbage now — and stale step counts must
+                # never be resumable once the schedule moved past them.
+                checkpointer.remove(resume_mid["snapshot"])
         if (
             on_epoch_end is not None
             and on_epoch_end(epoch, record)

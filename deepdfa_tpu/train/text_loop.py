@@ -29,7 +29,7 @@ from deepdfa_tpu.core.metrics import BinaryStats, binary_stats, compute_metrics
 from deepdfa_tpu.graphs.batch import GraphBatch, batch_graphs, pad_budget_for
 from deepdfa_tpu.models.linevul import LineVul, cross_entropy_loss
 from deepdfa_tpu.parallel.mesh import batch_sharding, replicated
-from deepdfa_tpu.resilience import inject
+from deepdfa_tpu.resilience import inject, lifecycle
 from deepdfa_tpu import telemetry
 
 logger = logging.getLogger(__name__)
@@ -665,6 +665,23 @@ def _fit_text_epochs(
                 loss_sum = loss_sum + loss
                 stats = stats + bstats
                 n_batches += 1
+                # Step-granular preemption check (ISSUE 10): SIGTERM (or
+                # a simulated notice) drains to a durable
+                # preempt_<epoch>_<step> snapshot and exits typed — a
+                # 10-hour combined fine-tune loses at most one step, not
+                # the partial epoch. (Resume restarts this epoch from
+                # the preempt state; the step-granular batch skip is the
+                # graph fit's — train/loop.py.) Multi-controller: only
+                # process 0 owns the run dir, same gating as save_last.
+                notice = lifecycle.poll()
+                if notice is not None:
+                    lifecycle.preempt_snapshot_exit(
+                        notice,
+                        checkpointer if (host is None or host[0] == 0)
+                        else None,
+                        state, epoch, n_batches, history=history,
+                        resume={"seen": int(n_batches), "loop": "text"},
+                        loop="text")
             ep.fence(loss_sum)
             ep.set(steps=n_batches)
         epoch_loss = float(loss_sum)

@@ -1,13 +1,19 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering eight fault classes — simulated preemption (kill-and-resume must
+# covering ten fault classes — simulated preemption (kill-and-resume must
 # be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
 # requeue), serving flush failure (one flush fails alone), corrupt-corpus
 # quarantine, a mid-epoch kill under ASYNC checkpointing resumed on a
-# different device count (elastic reshape), and pooled Joern workers
+# different device count (elastic reshape), pooled Joern workers
 # killed/hung mid-scan (fake transport; retry on a fresh worker +
-# quarantine on attempt-cap, the sweep completes with an exact manifest).
+# quarantine on attempt-cap, the sweep completes with an exact manifest),
+# a REAL SIGTERM to a mid-epoch `cli fit` subprocess (preempt_drain:
+# step-granular preempt snapshot, bit-continuous mid-epoch resume, and the
+# hung-step watchdog forcing a durable exit out of a wedged step), and a
+# SIGTERM lame-duck drain of a live `cli serve` subprocess under load
+# (serve_lame_duck: zero dropped admitted requests, 503 + Retry-After for
+# new ones, drain inside the grace budget, compiles flat).
 # Exits nonzero on any missed recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak

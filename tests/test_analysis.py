@@ -1043,6 +1043,102 @@ def run(x, interpret=False):
     assert "GL016" not in rules_of(passthrough)
 
 
+def test_gl017_blocking_handlers_fire():
+    # Every blocking-work shape the rule names: logging (module locks),
+    # an explicit lock acquire, `with` (context-manager acquire), I/O,
+    # a checkpoint save, and jit dispatch — each inside a handler body
+    # that signal.signal registers.
+    src = """
+import logging
+import signal
+import threading
+
+import jax
+
+logger = logging.getLogger(__name__)
+LOCK = threading.Lock()
+step_fn = jax.jit(lambda x: x)
+
+def h_log(signum, frame):
+    logger.warning("preempted %s", signum)
+
+def h_acquire(signum, frame):
+    LOCK.acquire()
+
+def h_with(signum, frame):
+    with LOCK:
+        pass
+
+def h_save(signum, frame, mgr=None):
+    mgr.save_preempt(None, 0, 0)
+
+def h_sleep(signum, frame):
+    import time
+    time.sleep(1.0)
+
+def install(mgr):
+    signal.signal(signal.SIGTERM, h_log)
+    signal.signal(signal.SIGINT, h_acquire)
+    signal.signal(signal.SIGUSR1, h_with)
+    signal.signal(signal.SIGUSR2, h_save)
+    signal.signal(signal.SIGHUP, h_sleep)
+    signal.signal(signal.SIGQUIT, lambda s, f: open("/tmp/x", "w"))
+"""
+    found = findings_for(src, "GL017")
+    assert len(found) == 6
+    assert any("h_log" in f.message and ".warning()" in f.message
+               for f in found)
+    assert any("'<lambda>'" in f.message and "open()" in f.message
+               for f in found)
+
+
+def test_gl017_flag_only_handlers_unflagged():
+    # The accepted signal-safe idioms: one attribute/flag assignment,
+    # Event.set(), os.write on a self-pipe, and handlers of unknown
+    # provenance (a restored previous handler) — the lifecycle
+    # coordinator's exact shape.
+    src = """
+import os
+import signal
+import threading
+
+class Coordinator:
+    def __init__(self):
+        self._pending = None
+        self._event = threading.Event()
+        self._wake_fd = os.pipe()[1]
+
+    def _handler(self, signum, frame):
+        self._pending = signum
+
+    def _handler_event(self, signum, frame):
+        self._event.set()
+
+    def _handler_pipe(self, signum, frame):
+        self._pending = signum
+        os.write(self._wake_fd, b"x")
+
+    def install(self, prev=None):
+        signal.signal(signal.SIGTERM, self._handler)
+        signal.signal(signal.SIGINT, self._handler_event)
+        signal.signal(signal.SIGUSR1, self._handler_pipe)
+        signal.signal(signal.SIGUSR2, prev)
+"""
+    assert "GL017" not in rules_of(src)
+
+
+def test_gl017_lifecycle_module_is_the_clean_reference():
+    # The rule's docstring points at resilience/lifecycle.py as the
+    # accepted shape; the module must stay GL017-clean (and clean of
+    # everything else) or the pointer is a lie.
+    import os
+
+    import deepdfa_tpu.resilience.lifecycle as lc
+
+    path = os.path.abspath(lc.__file__)
+    assert analyze_source(path) == []
+
+
 def test_gl016_negative_tests_path_is_exempt():
     # interpret=True in tests/ is the interpreter's intended home (the
     # tier-1 kernel-numerics suites run exactly this way).
@@ -1317,8 +1413,8 @@ def test_self_check_covers_every_rule_implementation():
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
-                             "GL016"})
-    assert len(RULES) == 16
+                             "GL016", "GL017"})
+    assert len(RULES) == 17
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

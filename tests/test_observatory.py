@@ -702,6 +702,9 @@ def test_cli_bench_diff_smoke_measures_and_appends(tmp_path, capsys):
     assert out["ok"] and out["appended"]
     assert out["metrics"]["smoke_gnn_train_graphs_per_sec"] > 0
     assert out["metrics"]["smoke_ingest_rows_per_sec"] > 0
+    assert out["metrics"]["smoke_sigterm_to_durable_snapshot_ms"] > 0
     (row,) = benchwatch.read_history(hist)
     assert set(row["metrics"]) == {"smoke_gnn_train_graphs_per_sec",
-                                   "smoke_ingest_rows_per_sec"}
+                                   "smoke_gnn_train_graphs_per_sec_fused",
+                                   "smoke_ingest_rows_per_sec",
+                                   "smoke_sigterm_to_durable_snapshot_ms"}
