@@ -130,6 +130,25 @@ Rule catalogue (each backed by a positive+negative fixture in
                              provenance (parameters, dynamic lookups)
                              stay unflagged — precision over recall,
                              the empty-baseline contract.
+  GL018 device-dispatch-under-shared-lock  a jitted/step-shaped dispatch
+                             (or a ``block_until_ready`` wait) inside a
+                             ``with <lock>:`` block whose lock is
+                             module-level (``_LOCK = threading.Lock()``
+                             at module scope) or class-level (assigned
+                             in a class body, reached as
+                             ``self._lock``/``cls._lock``) — the classic
+                             way a "parallel" front-end quietly
+                             serializes: every thread that shares the
+                             lock waits out the full device execution,
+                             so N replicas run at 1-replica throughput.
+                             Hold shared locks for state mutation only
+                             and hand work to the dispatch path through
+                             a queue (the serve fleet's per-replica
+                             batcher handoff is the accepted shape).
+                             Instance locks created in ``__init__`` and
+                             locks of unknown provenance (parameters,
+                             locals) stay unflagged — precision over
+                             recall, the empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -192,6 +211,7 @@ RULES: Dict[str, str] = {
     "GL015": "subprocess-without-timeout",
     "GL016": "pallas-interpret-in-prod",
     "GL017": "unsafe-signal-handler",
+    "GL018": "device-dispatch-under-shared-lock",
 }
 
 _JIT_NAMES = frozenset({
@@ -300,6 +320,14 @@ _HANDLER_BLOCKING_ATTRS = frozenset({
 _HANDLER_SAFE_CALLS = frozenset({"os.write", "signal.set_wakeup_fd",
                                  "signal.Signals"})
 _HANDLER_SAFE_ATTRS = frozenset({"set"})
+# GL018: lock constructor spellings (every import form resolves through
+# the alias table) and the device-wait attribute that counts as dispatch
+# held under the lock.
+_LOCK_CONSTRUCTORS = frozenset({
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+})
+_DEVICE_WAIT_CALLS = frozenset({"jax.block_until_ready"})
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -415,6 +443,40 @@ class _Module:
             )
             if calls_pallas:
                 self.kernel_wrappers[node.name] = idx
+        # GL018 facts: shared-lock definitions. Module-level
+        # ``NAME = threading.Lock()`` assignments and class-body
+        # ``attr = threading.Lock()`` assignments (reached later as
+        # ``self.attr``/``cls.attr``) — the two lock scopes every thread
+        # in the process shares. Instance locks built in ``__init__``
+        # are NOT collected: per-object locks are the batcher-handoff
+        # idiom, not the fleet-wide serialization hazard.
+        def _is_lock_ctor(value: ast.expr) -> bool:
+            return (isinstance(value, ast.Call)
+                    and self.resolve(value.func) in _LOCK_CONSTRUCTORS)
+
+        self.module_locks: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+        # Per-CLASS lock attrs, not a module-wide name pool: `self._lock`
+        # only counts as the shared class-level lock inside the class
+        # that declares `_lock = Lock()` in its body — another class's
+        # instance lock of the same name must stay unflagged.
+        self.class_locks: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = {
+                t.id
+                for stmt in node.body
+                if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value)
+                for t in stmt.targets
+                if isinstance(t, ast.Name)
+            }
+            if attrs:
+                self.class_locks[node.name] = attrs
         # Local defs wrapped by jax.jit(...) / jit_dp_step(...) anywhere in
         # the module: their bodies run under trace.
         self.jit_wrapped: Set[str] = set()
@@ -559,6 +621,7 @@ class _FunctionChecker:
         self._check_subprocess_timeout()
         self._check_pallas_interpret()
         self._check_signal_handlers()
+        self._check_lock_dispatch()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -1381,6 +1444,67 @@ class _FunctionChecker:
                             "(unbounded cardinality); use a bounded "
                             "enumeration for the name and put per-item "
                             "detail in event attrs")
+
+    # -- device dispatch under a shared lock (GL018) -------------------------
+
+    def _shared_lock_desc(self, expr: ast.expr) -> Optional[str]:
+        """Human description when ``expr`` names a module- or
+        class-level lock; None for instance locks, parameters, and
+        anything of unknown provenance (unflagged — the caller bounds
+        those). ``self._lock``/``cls._lock`` matches only when a class
+        on THIS function's lexical path declares the attr in its class
+        body; ``SomeClass._lock`` only when SomeClass does."""
+        if isinstance(expr, ast.Name) and expr.id in self.mod.module_locks:
+            return f"module-level lock `{expr.id}`"
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            base, attr = expr.value.id, expr.attr
+            if base in ("self", "cls"):
+                if any(attr in self.mod.class_locks.get(seg, ())
+                       for seg in self.fi.qualname.split(".")):
+                    return f"class-level lock `{base}.{attr}`"
+            elif attr in self.mod.class_locks.get(base, ()):
+                return f"class-level lock `{base}.{attr}`"
+        return None
+
+    def _is_device_dispatch_or_wait(self, call: ast.Call) -> bool:
+        """Step-shaped/jit-wrapped dispatch (the GL004/GL011 heuristics)
+        or an explicit device wait (block_until_ready) — either one held
+        under a shared lock serializes every sharer on the device."""
+        if self._is_dispatch_call(call):
+            return True
+        dotted = self.mod.resolve(call.func)
+        if dotted in _DEVICE_WAIT_CALLS:
+            return True
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "block_until_ready")
+
+    def _check_lock_dispatch(self) -> None:
+        """``with <shared lock>: ...step(...)...`` — the classic way a
+        "parallel" front-end serializes on one replica: every transport
+        or pump thread that shares the lock waits out the full device
+        execution before its own work starts, so replicated engines run
+        at single-engine throughput. Shared locks are for state
+        mutation; dispatch belongs outside the critical section, fed by
+        a queue (the per-replica batcher handoff)."""
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                desc = self._shared_lock_desc(item.context_expr)
+                if desc is None:
+                    continue
+                for sub in _walk_skip_defs(node.body):
+                    if (isinstance(sub, ast.Call)
+                            and self._is_device_dispatch_or_wait(sub)):
+                        self._report(
+                            "GL018", sub,
+                            f"jitted/step-shaped dispatch under {desc} — "
+                            "every thread sharing this lock serializes "
+                            "on the device execution (a 'parallel' "
+                            "front-end at 1-replica throughput); hold "
+                            "the lock only for state mutation and hand "
+                            "work to the dispatch path through a queue")
 
     # -- swallowed device exceptions (GL009) ---------------------------------
 

@@ -323,6 +323,10 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
       lifecycle notice poll → async ``save_preempt`` → drained durable
       commit, on the tiny trainer state (the preemption drain's
       critical path; bench.py carries the full-state headline).
+    * ``smoke_serve_fleet_rps`` — a 2-replica serving fleet's
+      saturation throughput over a tiny open-loop trace (the ISSUE-12
+      fleet mechanism: routing, per-replica batchers, continuous
+      batching; bench.py carries the 4-replica headline).
 
     Deliberately tiny shapes: the gate protects against *mechanism*
     regressions (a host sync creeping into the step loop, a validator
@@ -434,6 +438,45 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
 
     sigterm_ms = sigterm_to_snapshot_ms(state, reps=reps)
 
+    # Serving-fleet mechanism smoke (ISSUE 12): a 2-replica fleet's
+    # saturation throughput over a tiny open-loop trace on per-replica
+    # virtual timelines — routing, per-replica batchers, continuous
+    # batching, adaptive flush all on the measured path. Best-of-reps
+    # per the _timed protocol (the trace is seeded; only measured flush
+    # compute varies run to run).
+    from deepdfa_tpu.models.flowgnn import FlowGNN as _FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeFleet
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import (
+        ReplicaTimeline,
+        VirtualClock,
+        open_loop_trace,
+        replay_fleet,
+    )
+
+    serve_cfg = ServeConfig(batch_slots=4, deadline_ms=200.0,
+                            queue_capacity=32, cache_capacity=0,
+                            adaptive_flush=True)
+    serve_model = _FlowGNN(model_cfg)
+    serve_params = random_gnn_params(serve_model, serve_cfg)
+    fleet_trace = open_loop_trace(160, feat, seed=0, rps=8000.0,
+                                  duplicate_fraction=0.0)
+    primer = synthetic_bigvul(sum(serve_cfg.slot_buckets), feat,
+                              positive_fraction=0.5, seed=7)
+    fleet_rps = 0.0
+    for _ in range(reps):
+        clock = VirtualClock()
+        timelines = [ReplicaTimeline(clock) for _ in range(2)]
+        fleet = ServeFleet.build(serve_model, serve_params,
+                                 config=serve_cfg, n_replicas=2,
+                                 clock_factory=lambda i: timelines[i])
+        fleet.warmup()
+        fleet.prime(primer)
+        rep = replay_fleet(fleet, fleet_trace, clock)
+        if rep["compiles_after_warmup"]:
+            raise AssertionError("fleet smoke recompiled after warmup")
+        fleet_rps = max(fleet_rps, rep["rps"])
+
     return {
         "smoke_gnn_train_graphs_per_sec": {
             "value": round(gps, 1), "unit": "graphs/s"},
@@ -443,4 +486,6 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(n_rows / ingest_dt, 1), "unit": "rows/s"},
         "smoke_sigterm_to_durable_snapshot_ms": {
             "value": round(sigterm_ms, 2), "unit": "ms"},
+        "smoke_serve_fleet_rps": {
+            "value": round(fleet_rps, 1), "unit": "req/s"},
     }

@@ -945,6 +945,8 @@ def _serve_config(args, block_size: Optional[int] = None):
         deadline_ms=args.deadline_ms,
         queue_capacity=args.queue_capacity,
         cache_capacity=args.cache_capacity,
+        replicas=getattr(args, "replicas", 1),
+        adaptive_flush=bool(getattr(args, "adaptive_flush", False)),
     )
     if block_size is not None:
         kw["block_size"] = block_size
@@ -1012,10 +1014,34 @@ def _build_serve_engine(args):
         )
         gnn_params = random_gnn_params(model, serve_cfg)
 
+    if serve_cfg.replicas > 1:
+        # The replicated fleet (deepdfa_tpu/serve/fleet.py): N engines,
+        # each pinned to its shard of the device mesh and AOT-warmed
+        # independently, behind the content-affine router. The fleet
+        # speaks the single-engine surface, so serve/score/scan drive
+        # either shape through the same code below.
+        import jax
+
+        from deepdfa_tpu.serve import ServeFleet
+
+        fleet = ServeFleet.build(
+            model, gnn_params, config=serve_cfg,
+            combined_model=combined_model,
+            combined_params=combined_params, tokenizer=tokenizer,
+        )
+        logger.info("serving fleet: %d replicas over %d device(s)",
+                    fleet.size, jax.device_count())
+        return fleet, model_cfg
+
+    policy = None
+    if serve_cfg.adaptive_flush:
+        from deepdfa_tpu.serve import AdaptiveFlushPolicy
+
+        policy = AdaptiveFlushPolicy(serve_cfg)
     engine = ServeEngine(
         model, gnn_params, config=serve_cfg,
         combined_model=combined_model, combined_params=combined_params,
-        tokenizer=tokenizer,
+        tokenizer=tokenizer, policy=policy,
     )
     return engine, model_cfg
 
@@ -1306,7 +1332,7 @@ def _scan_smoke(engine, model_cfg, args, compiles0: int) -> Dict[str, Any]:
         and a.get("key") == b.get("key")
         for a, b in zip(first, second) if a["id"] != edited
     )
-    compiles_after = engine.stats.compiles - compiles0
+    compiles_after = int(engine.snapshot()["compiles"]) - compiles0
     ok = bool(
         all("prob" in r for r in first)
         and len(misses) == 1
@@ -1350,7 +1376,10 @@ def cmd_scan(args) -> Dict[str, Any]:
     with scope:
         engine, model_cfg = _build_serve_engine(args)
         engine.warmup()
-        compiles0 = engine.stats.compiles
+        # snapshot()["compiles"], not engine.stats: _build_serve_engine
+        # returns a ServeFleet under --replicas, and the snapshot key is
+        # the one surface both shapes share (fleet: summed per-replica).
+        compiles0 = int(engine.snapshot()["compiles"])
         if args.smoke is not None:
             report = _scan_smoke(engine, model_cfg, args, compiles0)
         else:
@@ -1394,7 +1423,7 @@ def cmd_scan(args) -> Dict[str, Any]:
                 "cache_hits":
                     sum(1 for r in verdicts if r.get("cached")),
                 "compiles_after_warmup":
-                    engine.stats.compiles - compiles0,
+                    int(engine.snapshot()["compiles"]) - compiles0,
                 "scan": snap,
                 "results": verdicts if not args.out else None,
                 "out": args.out,
@@ -1463,14 +1492,15 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
 
 
 def cmd_chaos(args) -> Dict[str, Any]:
-    """Chaos soak (deepdfa_tpu/resilience): provoke ten fault classes —
+    """Chaos soak (deepdfa_tpu/resilience): provoke eleven fault classes —
     simulated preemption, NaN loss, checkpoint corruption, ETL item
     failure, serving flush failure, corrupt-corpus poisoning, a
     mid-epoch kill under async checkpointing resumed on a different
     device count, pooled Joern workers killed mid-scan, a REAL SIGTERM
     to a mid-epoch training subprocess (step-granular preempt snapshot,
-    mid-epoch resume, hung-step watchdog), and a SIGTERM lame-duck drain
-    of a live serve subprocess under load — against a tiny synthetic
+    mid-epoch resume, hung-step watchdog), a SIGTERM lame-duck drain
+    of a live serve subprocess under load, and a rolling replica drain
+    of a 3-replica serving fleet mid-load — against a tiny synthetic
     workload and verify every recovery contract, including the
     bit-for-bit kill-and-resume determinism gate. Exits nonzero on any
     miss.
@@ -1896,6 +1926,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="pending requests before 429-style rejection")
         p.add_argument("--cache-capacity", type=int, default=4096,
                        help="content-hash result cache entries (0 = off)")
+        # String default: argparse runs type= over string defaults at
+        # parse time, so a malformed DEEPDFA_SERVE_REPLICAS is a clean
+        # parser error on the serve-family command — never an import-time
+        # crash of unrelated subcommands.
+        p.add_argument("--replicas", type=int,
+                       default=os.environ.get(
+                           "DEEPDFA_SERVE_REPLICAS", "1"),
+                       help="engine replicas, each pinned to its shard of "
+                            "the device mesh with its own micro-batcher "
+                            "and pump thread (env DEEPDFA_SERVE_REPLICAS; "
+                            "bounded by the static replica-id set, max 8)")
+        p.add_argument("--adaptive-flush", action="store_true",
+                       default=os.environ.get(
+                           "DEEPDFA_ADAPTIVE_FLUSH", "") not in ("", "0"),
+                       help="telemetry-driven flush policy: each replica "
+                            "tunes its deadline-fraction/fill thresholds "
+                            "from its own p99/occupancy (clamped, with "
+                            "hysteresis; every decision is a "
+                            "serve.flush_policy trace event; env "
+                            "DEEPDFA_ADAPTIVE_FLUSH=1)")
 
     # Streaming scan: the raw-source edge (deepdfa_tpu/scan). Shared by
     # `serve` (attaches POST /scan) and `scan` (offline sweeps). Env

@@ -146,12 +146,22 @@ class ServingStats:
         "cache_misses", "degraded", "batches", "compiles", "failures",
     )
 
-    def __init__(self, latency_window: int = 8192):
+    def __init__(self, latency_window: int = 8192,
+                 replica: "str | None" = None):
         import threading
 
         self._lock = threading.Lock()
         for name in self.COUNTERS:
             setattr(self, name, 0)
+        # Fleet identity: when set (a member of serve/config.py's
+        # statically-enumerated REPLICA_IDS), every bump also lands on
+        # this replica's own registry series (serve_<rid>_*) alongside
+        # the fleet-wide serve_* totals. The names are formatted from a
+        # code-enumerated id, never from runtime data — the GL014
+        # bounded-cardinality discipline; serve/fleet.py predeclares the
+        # full set at init so the exposition carries every replica's
+        # counters from the first scrape.
+        self._replica = replica
         self.occupancy_used = 0   # real requests over all flushed batches
         self.occupancy_slots = 0  # padded slots over all flushed batches
         self._latency_window = latency_window
@@ -167,6 +177,9 @@ class ServingStats:
         # API stays the per-engine view; the registry aggregates across
         # engines for Prometheus and the offline report).
         REGISTRY.counter(f"serve_{counter}_total").inc(by)
+        if self._replica is not None:
+            REGISTRY.counter(
+                f"serve_{self._replica}_{counter}_total").inc(by)
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -175,6 +188,10 @@ class ServingStats:
             ] = seconds * 1000.0
             self._latency_count += 1
         REGISTRY.histogram("serve_latency_ms").observe(seconds * 1000.0)
+        if self._replica is not None:
+            REGISTRY.histogram(
+                f"serve_{self._replica}_latency_ms").observe(
+                    seconds * 1000.0)
 
     def record_batch(self, n_real: int, n_slots: int) -> None:
         with self._lock:
@@ -184,6 +201,8 @@ class ServingStats:
         REGISTRY.counter("serve_batches_total").inc()
         REGISTRY.counter("serve_slots_occupied_total").inc(n_real)
         REGISTRY.counter("serve_slots_padded_total").inc(n_slots - n_real)
+        if self._replica is not None:
+            REGISTRY.counter(f"serve_{self._replica}_batches_total").inc()
 
     @property
     def latencies_ms(self) -> np.ndarray:

@@ -73,6 +73,38 @@ def make_mesh(
     return Mesh(use, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
+def replica_device_shards(
+    n_replicas: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> "list[list[jax.Device]]":
+    """Partition the device list into one shard per serving-engine replica
+    (serve/fleet.py): replica ``i`` owns ``shards[i]`` and pins its params
+    and micro-batches to ``shards[i][0]``.
+
+    Contiguous blocks (the same locality order ``make_mesh`` uses, so a
+    replica's shard is an ICI neighborhood, not a stripe across the
+    fabric); a non-dividing device count spreads the remainder over the
+    first shards — every device belongs to exactly one replica, none
+    sit silently idle. With fewer devices than replicas the assignment
+    degrades to round-robin — on a one-device host every replica shares
+    it, which is exactly the single-process CPU test/CI topology.
+    """
+    if n_replicas < 1:
+        raise ValueError("n_replicas must be >= 1")
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("no devices to assign replicas to")
+    if len(devices) >= n_replicas:
+        per, rem = divmod(len(devices), n_replicas)
+        shards, start = [], 0
+        for i in range(n_replicas):
+            width = per + (1 if i < rem else 0)
+            shards.append(devices[start:start + width])
+            start += width
+        return shards
+    return [[devices[i % len(devices)]] for i in range(n_replicas)]
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for every GraphBatch leaf: leading axis over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))

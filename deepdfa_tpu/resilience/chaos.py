@@ -1,7 +1,7 @@
 """Chaos soak: the ``cli chaos`` engine.
 
 One deterministic end-to-end run that provokes every fault class the
-resilience layer claims to survive (ten distinct fault kinds — the
+resilience layer claims to survive (eleven distinct fault kinds — the
 acceptance gate asks for >= 3) and verifies the recovery behavior, on a
 tiny synthetic workload sized for seconds on CPU:
 
@@ -53,6 +53,12 @@ tiny synthetic workload sized for seconds on CPU:
   asserted from the trace), new admissions 503 + Retry-After with
   ``/healthz`` reporting ``draining``, partial buckets flushed
   immediately, drain inside the grace budget, compiles flat.
+* ``fleet_roll`` — one of THREE serving-fleet replicas takes a
+  per-replica preemption mid-load (the in-process SIGTERM analog): its
+  admitted requests are all answered, the router shuns it while the
+  other two keep serving every POST, fleet ``/healthz`` degrades then
+  recovers, and compiles stay flat across the roll (re-entry reuses the
+  warmed executables).
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -1149,6 +1155,221 @@ def scenario_serve_lame_duck(out_dir: str) -> Dict[str, Any]:
     }
 
 
+def scenario_fleet_roll(out_dir: str) -> Dict[str, Any]:
+    """The replicated-serving roll scenario (ISSUE 12): one of THREE
+    engine replicas takes its per-replica preemption (the in-process
+    SIGTERM analog — ``fleet.begin_replica_drain``, the same lame-duck
+    machinery a real per-replica signal would drive) in the middle of
+    live HTTP load. Demands:
+
+    * **its admitted requests are all answered** — fleet-wide zero
+      dropped rids from the trace (every ``serve.enqueue`` has a
+      completed ``serve.request`` span), which covers the draining
+      replica's bucket;
+    * **the other two keep serving** — every load POST during the drain
+      returns 200 with scores, and the router never selects the
+      draining replica;
+    * **fleet /healthz degrades then recovers** — 503 "degraded" with
+      the replica marked draining mid-roll, 200 "ok" after restore;
+    * **compiles stay flat** — re-entering rotation reuses the warmed
+      executables: zero ``jax.compile`` events after the scenario's last
+      warmup marker (and engine counters unchanged across the roll).
+    """
+    import json as _json
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeFleet
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    # Event timestamps are run-relative; the window start must be too
+    # (this scenario shares the soak's one run with its siblings).
+    active = telemetry.current_run()
+    t_window = active.now() if active is not None else 0.0
+    config = ServeConfig(batch_slots=4, deadline_ms=500.0, replicas=3,
+                         adaptive_flush=True)
+    model = FlowGNN(TINY)
+    fleet = ServeFleet.build(model, random_gnn_params(model, config),
+                             config=config, n_replicas=3)
+    fleet.warmup()
+    compiles0 = sum(r.engine.stats.compiles for r in fleet.replicas)
+    server = ServeHTTPServer(("127.0.0.1", 0), fleet)
+    server.start_pump()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+    graphs = synthetic_bigvul(32, TINY.feature, positive_fraction=0.5,
+                              seed=17)
+    payload = [
+        {"id": int(g["id"]),
+         "graph": {"num_nodes": int(g["num_nodes"]),
+                   "senders": np.asarray(g["senders"]).tolist(),
+                   "receivers": np.asarray(g["receivers"]).tolist(),
+                   "feats": {k: np.asarray(v).tolist()
+                             for k, v in g["feats"].items()}}}
+        for g in graphs
+    ]
+
+    def post(chunk, timeout=30.0):
+        req = urllib.request.Request(
+            f"{base}/score", data=_json.dumps({"functions": chunk}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=10.0) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+
+    # Sustained load: three client threads, two functions per POST —
+    # partial buckets in flight across the roll.
+    load_results: List[Any] = []
+    load_lock = threading.Lock()
+    stop_load = threading.Event()
+
+    def load_thread(tid: int):
+        i = 0
+        while not stop_load.is_set():
+            start = (8 * tid + 2 * (i % 4)) % (len(payload) - 2)
+            status, body = post(payload[start:start + 2])
+            with load_lock:
+                load_results.append((status, body))
+            i += 1
+
+    threads = [threading.Thread(target=load_thread, args=(tid,))
+               for tid in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)  # load established, buckets cycling
+
+    victim = "r1"
+    fleet.begin_replica_drain(victim, reason="sigterm")
+    # Mid-roll: health degrades, the router shuns the victim, and a
+    # fresh POST is still answered by the survivors.
+    saw_degraded = False
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not saw_degraded:
+        status, doc = healthz()
+        if status == 503 and doc.get("status") == "degraded" \
+                and doc.get("fleet", {}).get("replicas", {}) \
+                        .get(victim, {}).get("status") == "draining":
+            saw_degraded = True
+        time.sleep(0.02)
+    routed_clean = all(fleet.route(f"probe-{i}").rid != victim
+                       for i in range(16))
+    mid_status, mid_body = post(payload[-2:])
+    mid_ok = (mid_status == 200
+              and all("prob" in r for r in mid_body.get("results", [])))
+    drained = fleet.await_replica_drained(victim, deadline_s=15.0)
+    fleet.restore_replica(victim)
+    saw_recovered = False
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not saw_recovered:
+        status, doc = healthz()
+        if status == 200 and doc.get("status") == "ok" \
+                and doc.get("fleet", {}).get("live") == 3:
+            saw_recovered = True
+        time.sleep(0.02)
+    time.sleep(0.3)  # a post-recovery load slice lands on the victim too
+    stop_load.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    server.shutdown()
+
+    with load_lock:
+        results = list(load_results)
+    all_answered = bool(results) and all(
+        status == 200 and all("prob" in r for r in body.get("results", []))
+        for status, body in results
+    )
+    compiles1 = sum(r.engine.stats.compiles for r in fleet.replicas)
+
+    # Trace audit (skipped untraced — DEEPDFA_TELEMETRY=0 runs the
+    # functional checks alone): zero dropped rids in the scenario
+    # window, the drain/restore events present, and zero compiles after
+    # the window's last warmup marker.
+    trace: Dict[str, Any] = {"checked": False}
+    run = telemetry.current_run()
+    if run is not None and telemetry.enabled():
+        telemetry.flush()
+        events = [e for e in _read_events(run.run_dir)
+                  if float(e.get("ts", 0.0)) >= t_window]
+
+        # Join admissions to responses on (replica, rid), never bare rid:
+        # rids are per-engine counters, so r0's rid 5 completing must not
+        # mask r1's rid 5 being dropped.
+        def _ids(e):
+            attrs = e.get("attrs") or {}
+            return (attrs.get("replica"), attrs.get("rid"))
+
+        enq = {_ids(e) for e in events if e.get("name") == "serve.enqueue"}
+        done = {_ids(e) for e in events
+                if e.get("kind") == "span"
+                and e.get("name") == "serve.request"}
+        warmups = [float(e["ts"]) for e in events
+                   if e.get("name") == "serve.warmup_done"]
+        boundary = max(warmups) if warmups else t_window
+        late_compiles = [e for e in events
+                         if e.get("name") == "jax.compile"
+                         and float(e["ts"]) > boundary]
+        trace = {
+            "checked": True,
+            "admissions": len(enq),
+            "dropped_rids": sorted(r for r in enq if r not in done)[:8],
+            "drain_events": len([e for e in events
+                                 if e.get("name") == "fleet.replica_drain"]),
+            "restore_events": len([
+                e for e in events
+                if e.get("name") == "fleet.replica_restore"]),
+            "compiles_after_warmup": len(late_compiles),
+            "flush_policy_decisions": len([
+                e for e in events
+                if e.get("name") == "serve.flush_policy"]),
+        }
+
+    ok = bool(
+        all_answered
+        and saw_degraded and saw_recovered
+        and routed_clean and mid_ok
+        and drained
+        and compiles1 == compiles0
+        and (not trace["checked"]
+             or (not trace["dropped_rids"] and trace["admissions"]
+                 and trace["drain_events"] >= 1
+                 and trace["restore_events"] >= 1
+                 and trace["compiles_after_warmup"] == 0))
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["replica-sigterm"],
+        "replicas": 3,
+        "victim": victim,
+        "load_posts": len(results),
+        "all_answered": all_answered,
+        "healthz_degraded": saw_degraded,
+        "healthz_recovered": saw_recovered,
+        "router_shunned_victim": routed_clean,
+        "served_during_drain": mid_ok,
+        "victim_drained": drained,
+        "compiles_flat": compiles1 == compiles0,
+        "trace": trace,
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -1170,6 +1391,7 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
     scenarios["preempt_drain"] = scenario_preempt_drain(
         out_dir, n_examples, epochs)
     scenarios["serve_lame_duck"] = scenario_serve_lame_duck(out_dir)
+    scenarios["fleet_roll"] = scenario_fleet_roll(out_dir)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
@@ -1180,7 +1402,8 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
                "elastic_resume": "elastic-reshape",
                "scan_joern_deaths": "joern-worker-kill",
                "preempt_drain": "sigterm-drain",
-               "serve_lame_duck": "sigterm-lame-duck"}
+               "serve_lame_duck": "sigterm-lame-duck",
+               "fleet_roll": "replica-roll"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

@@ -12,12 +12,17 @@ degradation (combined DDFA+LineVul falls back to GNN-only when the
 tokenizer path errors).
 
 Layout:
-  config.py   ServeConfig: slots/budgets/deadlines/capacities + buckets
+  config.py   ServeConfig: slots/budgets/deadlines/capacities + buckets,
+              fleet size, adaptive-flush knobs, REPLICA_IDS
   cache.py    content_hash + ResultCache (LRU)
-  batcher.py  ServeRequest + MicroBatcher (admission, flush policy)
+  batcher.py  ServeRequest + MicroBatcher (admission, continuous-batching
+              flush policy, live-tunable thresholds)
+  policy.py   AdaptiveFlushPolicy (telemetry-driven threshold controller)
   engine.py   ServeEngine: warmup, submit, pump, drain, score_sync
+  fleet.py    ServeFleet: N device-pinned replicas, routing, rolls
   http.py     stdlib http.server JSON endpoint (cli.py serve)
-  replay.py   seeded bursty traces + virtual-clock replay (bench, tests)
+  replay.py   seeded bursty traces + virtual-clock replay + the
+              open-loop fleet load harness (bench, tests)
 
 Design anchors: Just-in-Time Dynamic-Batching (arXiv:1904.07421) for the
 deadline-aware flush policy; Fast Training of Sparse GNNs on Dense
@@ -31,16 +36,22 @@ from deepdfa_tpu.serve.batcher import (
     ServeRequest,
 )
 from deepdfa_tpu.serve.cache import ResultCache, content_hash
-from deepdfa_tpu.serve.config import ServeConfig
+from deepdfa_tpu.serve.config import MAX_REPLICAS, REPLICA_IDS, ServeConfig
 from deepdfa_tpu.serve.engine import ServeEngine
+from deepdfa_tpu.serve.fleet import ServeFleet
+from deepdfa_tpu.serve.policy import AdaptiveFlushPolicy
 
 __all__ = [
+    "AdaptiveFlushPolicy",
+    "MAX_REPLICAS",
     "MicroBatcher",
     "OversizedError",
+    "REPLICA_IDS",
     "RejectedError",
     "ResultCache",
     "ServeConfig",
     "ServeEngine",
+    "ServeFleet",
     "ServeRequest",
     "content_hash",
 ]

@@ -15,6 +15,16 @@ specialized to a two-condition trigger. When several lanes are due at
 once, the lane whose oldest request has the least remaining budget
 flushes first — the SLA, not throughput, breaks ties.
 
+**Continuous batching**: a bucket is sealed at *dispatch* (:meth:`take`),
+not when its flush condition first held — admissions that land between a
+lane becoming due and the pump taking it join the partially-filled
+bucket instead of waiting out their own flush cycle (the
+admit-into-in-flight-buckets half of JiT dynamic batching; the
+route-around-a-busy-replica half lives in serve/fleet.py). Both flush
+thresholds are live-tunable (:meth:`set_flush_policy`): the adaptive
+policy (serve/policy.py) moves the deadline fraction and the fill
+threshold online from the replica's own latency/occupancy telemetry.
+
 Backpressure is explicit: admissions beyond ``queue_capacity`` raise
 :class:`RejectedError` carrying a retry-after hint (the HTTP layer maps
 it to 429 + Retry-After), and single graphs that could never fit a slot
@@ -66,6 +76,7 @@ class ServeRequest:
     t_submit: float = 0.0         # telemetry clock (perf_counter seconds)
     input_ids: Optional[np.ndarray] = None   # combined lane only
     degraded: bool = False        # tokenizer failed -> gnn fallback
+    completed_at: Optional[float] = None     # engine-clock completion time
     result: Optional[Dict] = None
     event: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False
@@ -89,8 +100,14 @@ class MicroBatcher:
     replay/bench and monotonic in live serving.
     """
 
-    def __init__(self, config: ServeConfig, lanes: Sequence[str] = ("gnn",)):
+    def __init__(self, config: ServeConfig, lanes: Sequence[str] = ("gnn",),
+                 replica: Optional[str] = None):
         self.config = config
+        # Fleet identity: rids are per-ENGINE counters, so in a fleet the
+        # enqueue events must carry the replica tag or two replicas' rid
+        # 5 are indistinguishable in the trace (the fleet_roll audit
+        # joins admissions to responses on (replica, rid)).
+        self._replica = replica
         self._pending: Dict[str, Deque[ServeRequest]] = {
             lane: collections.deque() for lane in lanes
         }
@@ -100,6 +117,37 @@ class MicroBatcher:
         # instead of waiting for fill or the deadline fraction, so every
         # already-admitted request is answered inside the grace budget.
         self._drain_mode = False
+        # Live flush thresholds (the adaptive policy's levers). Defaults
+        # reproduce the static config exactly; set_flush_policy clamps.
+        self._flush_fraction = config.flush_fraction
+        self._fill_slots = config.batch_slots
+
+    def set_flush_policy(self, fraction: Optional[float] = None,
+                         fill_slots: Optional[int] = None) -> None:
+        """Retune the two flush thresholds online (serve/policy.py).
+
+        ``fraction`` clamps to [flush_fraction_min, flush_fraction_max];
+        ``fill_slots`` to [1, batch_slots]. The clamp lives HERE so no
+        policy — adaptive, manual, or buggy — can push the batcher into a
+        never-flushes or flush-every-request regime.
+        """
+        with self._lock:
+            if fraction is not None:
+                self._flush_fraction = min(
+                    max(float(fraction), self.config.flush_fraction_min),
+                    self.config.flush_fraction_max,
+                )
+            if fill_slots is not None:
+                self._fill_slots = min(max(int(fill_slots), 1),
+                                       self.config.batch_slots)
+
+    @property
+    def flush_fraction(self) -> float:
+        return self._flush_fraction
+
+    @property
+    def fill_slots(self) -> int:
+        return self._fill_slots
 
     def set_drain_mode(self, on: bool = True) -> None:
         with self._lock:
@@ -137,15 +185,17 @@ class MicroBatcher:
                 # Retry once the current flush window has passed: by then
                 # at least one bucket has drained.
                 raise RejectedError(
-                    self.config.flush_fraction * self.config.deadline_ms
+                    self._flush_fraction * self.config.deadline_ms
                     / 1000.0
                 )
             self._pending[req.lane].append(req)
             depth = sum(len(q) for q in self._pending.values())
         # Outside the lock: the enqueue step of the per-request trace
         # (admission -> enqueue -> flush -> respond), rid threaded through.
-        telemetry.event("serve.enqueue", rid=req.rid, lane=req.lane,
-                        depth=depth)
+        attrs = dict(rid=req.rid, lane=req.lane, depth=depth)
+        if self._replica is not None:
+            attrs["replica"] = self._replica
+        telemetry.event("serve.enqueue", **attrs)
 
     def due(self, now: float) -> Optional[str]:
         """The lane to flush at ``now``, or None.
@@ -163,9 +213,9 @@ class MicroBatcher:
             for lane, q in self._pending.items():
                 if not q:
                     continue
-                filled = len(q) >= self.config.batch_slots
+                filled = len(q) >= self._fill_slots
                 deadline_due = now >= min(
-                    r.flush_at(self.config.flush_fraction) for r in q
+                    r.flush_at(self._flush_fraction) for r in q
                 )
                 if not (filled or deadline_due or self._drain_mode):
                     continue
@@ -182,16 +232,25 @@ class MicroBatcher:
             for q in self._pending.values():
                 if not q:
                     continue
-                when = (now if (len(q) >= self.config.batch_slots
+                when = (now if (len(q) >= self._fill_slots
                                 or self._drain_mode)
-                        else min(r.flush_at(self.config.flush_fraction)
+                        else min(r.flush_at(self._flush_fraction)
                                  for r in q))
                 if t is None or when < t:
                     t = when
             return t
 
     def take(self, lane: str) -> List[ServeRequest]:
-        """Pop the lane's next micro-batch (FIFO, up to ``batch_slots``)."""
+        """Pop the lane's next micro-batch (FIFO, up to ``batch_slots``).
+
+        THE continuous-batching seal point: the bucket's membership is
+        decided here, at dispatch — requests admitted after the lane
+        became due (fill, deadline, or drain) but before the pump got to
+        it ride this bucket instead of opening a fresh flush cycle.
+        Always caps at the static ``batch_slots`` (the compiled-shape
+        ladder top), not the live fill threshold: the fill knob decides
+        *when* to flush, never a new shape.
+        """
         with self._lock:
             q = self._pending[lane]
             out = [q.popleft() for _ in range(min(len(q),

@@ -16,6 +16,16 @@ from typing import Dict, List, Optional
 
 from deepdfa_tpu.graphs.batch import select_bucket
 
+# The statically-enumerated replica-id set (the PR-7 predeclare
+# discipline): every per-replica metric name in the process is formatted
+# from a member of THIS tuple, never from runtime fleet state, so the
+# Prometheus exposition's cardinality is bounded by code (GL014) and a
+# fleet's counters can all be predeclared at server init. Growing the
+# fleet beyond this set is a code change, not a config change — that is
+# the point.
+REPLICA_IDS = ("r0", "r1", "r2", "r3", "r4", "r5", "r6", "r7")
+MAX_REPLICAS = len(REPLICA_IDS)
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
@@ -53,6 +63,25 @@ class ServeConfig:
     # (every edge stays within one 128-tile of the diagonal).
     band_bandwidth: int = 1
 
+    # Fleet geometry: engine replicas behind the front-end, each pinned
+    # to its own shard of the device mesh and AOT-warmed independently
+    # (serve/fleet.py). Bounded by the statically-enumerated REPLICA_IDS
+    # set so per-replica metric names stay code-enumerable.
+    replicas: int = 1
+
+    # Telemetry-driven adaptive flush (serve/policy.py): each replica's
+    # batcher tunes its deadline-fraction and fill thresholds online from
+    # its own p99/occupancy, clamped to [flush_fraction_min,
+    # flush_fraction_max] with `adaptive_patience` consecutive signals of
+    # hysteresis; every decision is a `serve.flush_policy` trace event.
+    adaptive_flush: bool = False
+    flush_fraction_min: float = 0.1
+    flush_fraction_max: float = 0.9
+    adaptive_interval_s: float = 0.25   # evaluation cadence (engine clock)
+    adaptive_step: float = 0.1          # deadline-fraction step per move
+    adaptive_patience: int = 2          # consecutive signals before a move
+    adaptive_target_p99_frac: float = 0.8  # p99 target, share of deadline
+
     def __post_init__(self):
         if self.batch_slots < 1:
             raise ValueError("batch_slots must be >= 1")
@@ -62,6 +91,19 @@ class ServeConfig:
             raise ValueError(
                 "queue_capacity below batch_slots could never fill a bucket"
             )
+        if not 1 <= self.replicas <= MAX_REPLICAS:
+            raise ValueError(
+                f"replicas must be in [1, {MAX_REPLICAS}] (the statically-"
+                "enumerated REPLICA_IDS set bounds per-replica metric "
+                "cardinality; grow it in serve/config.py to go wider)"
+            )
+        if not (0.0 < self.flush_fraction_min
+                <= self.flush_fraction_max <= 1.0):
+            raise ValueError(
+                "need 0 < flush_fraction_min <= flush_fraction_max <= 1"
+            )
+        if self.adaptive_patience < 1:
+            raise ValueError("adaptive_patience must be >= 1")
 
     @property
     def slot_buckets(self) -> List[int]:

@@ -121,12 +121,29 @@ class ServeEngine:
         combined_params=None,
         tokenizer=None,
         clock: Callable[[], float] = time.monotonic,
+        replica: Optional[str] = None,
+        device=None,
+        policy=None,
     ):
         self.config = config or ServeConfig()
-        self.stats = ServingStats(self.config.latency_window)
+        # Fleet identity (serve/fleet.py): `replica` must come from the
+        # statically-enumerated REPLICA_IDS set — it names this engine's
+        # metric series and trace spans. `device` pins params AND every
+        # micro-batch to one device, so N replicas dispatch to N devices
+        # instead of all landing on jax's default. `policy` is the
+        # adaptive flush controller, driven from pump().
+        self.replica = replica
+        self._device = device
+        self.policy = policy
+        self.stats = ServingStats(self.config.latency_window,
+                                  replica=replica)
         self.cache = ResultCache(self.config.cache_capacity)
         self._clock = clock
         self._rid = itertools.count()
+        # Requests currently inside _run_batch (the in-flight bucket):
+        # the fleet router reads this to route arrivals toward replicas
+        # with bucket capacity while this one executes.
+        self.in_flight = 0
         # Monotonic flush ordinal for the fault hook: counts every
         # _run_batch invocation, failed or not (stats.batches counts only
         # successes, which would pin a fault plan's index on failure).
@@ -138,6 +155,14 @@ class ServeEngine:
         # Lame-duck drain flag (enter_lame_duck): the batcher flushes
         # immediately and the transport sheds NEW admissions.
         self.lame_duck = False
+
+        if device is not None:
+            # Replica pinning: committed params make the AOT executables
+            # compile for (and run on) this device; batches follow in
+            # _graph_batch. On a one-device host this is a no-op copy.
+            gnn_params = jax.device_put(gnn_params, device)
+            if combined_params is not None:
+                combined_params = jax.device_put(combined_params, device)
 
         self._lanes: Dict[str, _Lane] = {
             "gnn": self._make_lane("gnn", make_gnn_infer(gnn_model),
@@ -151,7 +176,8 @@ class ServeEngine:
                 "combined", make_combined_infer(combined_model),
                 combined_params, combined_model.graph_config,
             )
-        self.batcher = MicroBatcher(self.config, lanes=tuple(self._lanes))
+        self.batcher = MicroBatcher(self.config, lanes=tuple(self._lanes),
+                                    replica=replica)
 
     @staticmethod
     def _make_lane(name, infer, params, graph_cfg) -> _Lane:
@@ -171,6 +197,11 @@ class ServeEngine:
 
     def now(self) -> float:
         return self._clock()
+
+    @property
+    def clock(self) -> Callable[[], float]:
+        """The injected clock (replay drivers introspect timelines)."""
+        return self._clock
 
     @property
     def required_subkeys(self) -> List[str]:
@@ -232,6 +263,8 @@ class ServeEngine:
             empty = self._graph_batch(lane, [], slots)
             if lane_name == "combined":
                 ids = jnp.zeros((slots, self.config.block_size), jnp.int32)
+                if self._device is not None:
+                    ids = jax.device_put(ids, self._device)
                 lowered = jax.jit(lane.infer).lower(lane.params, ids, empty)
             else:
                 lowered = jax.jit(lane.infer).lower(lane.params, empty)
@@ -267,8 +300,14 @@ class ServeEngine:
 
     def _graph_batch(self, lane: _Lane, graphs: Sequence[Mapping],
                      slots: int):
-        return bucket_batch(self.config, graphs, slots, lane.subkeys,
-                            band=lane.band)
+        gb = bucket_batch(self.config, graphs, slots, lane.subkeys,
+                          band=lane.band)
+        if self._device is not None:
+            # The replica's executables are compiled for its pinned
+            # device; batches must land there too or dispatch pays a
+            # cross-device transfer (or an AOT placement error).
+            gb = jax.device_put(gb, self._device)
+        return gb
 
     # -- admission ---------------------------------------------------------
 
@@ -332,10 +371,15 @@ class ServeEngine:
             self.stats.bump("cache_hits")
             self.stats.bump("completed")
             self.stats.observe_latency(0.0)
+            req.completed_at = now
             req.finish(dict(cached, rid=req.rid, cached=True,
                             degraded=req.degraded))
+            hit_attrs: Dict[str, Any] = dict(rid=req.rid, lane=lane,
+                                             cached=True)
+            if self.replica is not None:
+                hit_attrs["replica"] = self.replica
             telemetry.record_span("serve.request", req.t_submit,
-                                  rid=req.rid, lane=lane, cached=True)
+                                  **hit_attrs)
             return req
         try:
             self.batcher.admit(req)
@@ -353,17 +397,28 @@ class ServeEngine:
 
     # -- execution ---------------------------------------------------------
 
-    def pump(self) -> int:
-        """Flush every lane currently due; returns micro-batches run."""
+    def pump(self, max_batches: Optional[int] = None) -> int:
+        """Flush every lane currently due; returns micro-batches run.
+
+        ``max_batches`` bounds the flushes per call — the fleet replay's
+        discrete-event driver pumps one bucket at a time so arrivals
+        interleave with this replica's flushes exactly as they would
+        against a busy device.
+        """
         n = 0
-        while True:
+        while max_batches is None or n < max_batches:
             lane = self.batcher.due(self._clock())
             if lane is None:
-                return n
+                break
             reqs = self.batcher.take(lane)
             if reqs:
                 self._run_batch(lane, reqs)
                 n += 1
+        if self.policy is not None:
+            # Adaptive flush (serve/policy.py): rate-limited inside, on
+            # the engine clock, so replayed policy runs are deterministic.
+            self.policy.maybe_update(self)
+        return n
 
     def drain(self) -> int:
         """Flush everything pending regardless of deadlines (offline
@@ -379,6 +434,10 @@ class ServeEngine:
 
     def pending(self) -> int:
         return self.batcher.depth()
+
+    def load(self) -> int:
+        """Queued + in-flight requests — the fleet router's load signal."""
+        return self.batcher.depth() + self.in_flight
 
     def enter_lame_duck(self) -> None:
         """Lame-duck mode (ISSUE 10): the batcher flushes partially-filled
@@ -401,9 +460,12 @@ class ServeEngine:
         exe = self._executable(lane_name, slots)
         ordinal = next(self._flush_ordinal)
         w0 = time.perf_counter()
-        flush_span = telemetry.span("serve.flush", lane=lane_name,
-                                    n=len(reqs), slots=slots,
-                                    ordinal=ordinal)
+        span_attrs: Dict[str, Any] = dict(lane=lane_name, n=len(reqs),
+                                          slots=slots, ordinal=ordinal)
+        if self.replica is not None:
+            span_attrs["replica"] = self.replica
+        flush_span = telemetry.span("serve.flush", **span_attrs)
+        self.in_flight = len(reqs)
         try:
             with flush_span:
                 # Fault hook (index = flush ordinal): a `raise` here
@@ -416,7 +478,9 @@ class ServeEngine:
                                   np.int32)
                     for i, r in enumerate(reqs):
                         ids[i] = r.input_ids
-                    probs = exe(lane.params, jnp.asarray(ids), gb)
+                    ids_dev = (jnp.asarray(ids) if self._device is None
+                               else jax.device_put(ids, self._device))
+                    probs = exe(lane.params, ids_dev, gb)
                 else:
                     probs = exe(lane.params, gb)
                 # One host transfer per micro-batch; everything after this
@@ -429,6 +493,7 @@ class ServeEngine:
             # class), the queue keeps draining, and later flushes run on
             # the already-compiled executables — one bad batch must not
             # wedge the pump thread or leak hung requests.
+            self.in_flight = 0
             logger.exception("micro-batch failed (%s lane, %d requests)",
                              lane_name, len(reqs))
             self.stats.bump("failures", by=len(reqs))
@@ -441,15 +506,22 @@ class ServeEngine:
                                       rid=r.rid, lane=lane_name,
                                       cached=False, error=type(e).__name__)
             return
-        # Virtual clocks (replay/bench) expose advance(): credit them with
-        # this batch's measured wall time so recorded latencies include
-        # compute, not just queueing. Live monotonic clocks tick on their
-        # own.
-        advance = getattr(self._clock, "advance", None)
-        if advance is not None:
-            advance(time.perf_counter() - w0)
-        done = self._clock()
+        # Completion-time accounting, clock-shape aware: fleet replay
+        # timelines expose flush_done(dt) (per-replica busy horizons, so
+        # N replicas' measured compute overlaps on the virtual clock);
+        # plain virtual clocks expose advance() (single serial timeline);
+        # live monotonic clocks tick on their own.
+        elapsed = time.perf_counter() - w0
+        flush_done = getattr(self._clock, "flush_done", None)
+        if flush_done is not None:
+            done = flush_done(elapsed)
+        else:
+            advance = getattr(self._clock, "advance", None)
+            if advance is not None:
+                advance(elapsed)
+            done = self._clock()
         t_done = telemetry.now()
+        self.in_flight = 0
         self.stats.record_batch(len(reqs), slots)
         for i, r in enumerate(reqs):
             # The cache line holds only content-derived values; "degraded"
@@ -457,6 +529,7 @@ class ServeEngine:
             # not the content, so it must never ride a shared cache entry.
             value = {"prob": float(p[i]), "model": lane_name}
             self.cache.put(r.key, value)
+            r.completed_at = done
             r.finish(dict(value, rid=r.rid, cached=False,
                           degraded=r.degraded))
             self.stats.bump("completed")
@@ -464,12 +537,16 @@ class ServeEngine:
             # The admission->respond span, rid threaded through; queue_ms
             # is the pre-flush share of it (both ends on the telemetry
             # clock — never the engine's virtual clock).
-            telemetry.record_span(
-                "serve.request", r.t_submit, t_done, rid=r.rid,
-                lane=lane_name, cached=False, degraded=r.degraded,
+            req_attrs: Dict[str, Any] = dict(
+                rid=r.rid, lane=lane_name, cached=False,
+                degraded=r.degraded,
                 queue_ms=max(w0 - r.t_submit, 0.0) * 1e3,
                 flush_ordinal=ordinal,
             )
+            if self.replica is not None:
+                req_attrs["replica"] = self.replica
+            telemetry.record_span("serve.request", r.t_submit, t_done,
+                                  **req_attrs)
 
     # -- offline client ----------------------------------------------------
 

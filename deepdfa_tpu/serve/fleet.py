@@ -1,0 +1,499 @@
+"""Replicated, device-parallel serving fleet.
+
+One :class:`~deepdfa_tpu.serve.engine.ServeEngine` drives one device.
+The fleet is N of them behind one front-end: each replica owns a shard
+of the device mesh (``parallel.mesh.replica_device_shards``), AOT-warms
+its bucket executables independently, and runs its own micro-batcher and
+pump thread — so the only state the transport threads share with the
+dispatch path is each replica's admission queue, never a lock that a
+device dispatch is held under (graftlint GL018 polices exactly that
+shape).
+
+**Routing** is content-affine, load-shedding, and drain-aware:
+
+* rendezvous hashing on the request's content key picks a *preferred*
+  replica, so re-submissions of the same function land on the replica
+  whose LRU already holds the verdict (the fleet analog of the
+  single-engine content cache);
+* the preferred replica is overridden the moment it is mid-flush or its
+  queue is saturated while a sibling has bucket capacity — the
+  continuous-batching admission property: an arrival NEVER waits out a
+  busy replica's flush cycle when another bucket could take it;
+* lame-duck replicas (a roll, a resize, a per-replica preemption) leave
+  the routing set immediately while their admitted requests drain.
+
+**Rolling** (:meth:`roll_replica`) is drain → out-of-rotation → back:
+the replica's batcher flushes partial buckets immediately (PR-10's drain
+mode), the router stops selecting it, every admitted request is
+answered, and re-entry reuses the replica's warmed executables — a roll
+never costs a compile, which is why the chaos gate can assert compiles
+stay flat across one.
+
+Per-replica observability: every replica's counters live in the process
+registry under its id from the statically-enumerated
+``serve/config.py:REPLICA_IDS`` set, predeclared at fleet construction
+(:func:`predeclare_fleet_metrics`) so the Prometheus exposition carries
+all of them from the first scrape — the PR-7 predeclare discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.serve.batcher import RejectedError, ServeRequest
+from deepdfa_tpu.serve.config import MAX_REPLICAS, REPLICA_IDS, ServeConfig
+from deepdfa_tpu.serve.engine import ServeEngine
+from deepdfa_tpu.serve.policy import AdaptiveFlushPolicy
+
+__all__ = ["Replica", "ServeFleet", "predeclare_fleet_metrics"]
+
+
+def predeclare_fleet_metrics(active: Sequence[str]) -> None:
+    """Create every active replica's counter/histogram series up front.
+
+    Both loops iterate *literal* constant tuples — the GL014-documented
+    bounded shape — and ``active`` only gates which ids materialize;
+    drift between these literals and ``REPLICA_IDS`` /
+    ``ServingStats.COUNTERS`` is pinned by a test in tests/test_fleet.py.
+    """
+    wanted = set(active)
+    for rid in ("r0", "r1", "r2", "r3", "r4", "r5", "r6", "r7"):
+        if rid not in wanted:
+            continue
+        for counter in ("submitted", "completed", "rejected", "oversized",
+                        "cache_hits", "cache_misses", "degraded", "batches",
+                        "compiles", "failures"):
+            telemetry.REGISTRY.counter(f"serve_{rid}_{counter}_total")
+        telemetry.REGISTRY.histogram(f"serve_{rid}_latency_ms")
+
+
+def _stable_hash(text: str) -> int:
+    """Process-stable hash for rendezvous routing (builtin ``hash`` is
+    salted per process — two fleet members would disagree)."""
+    return int.from_bytes(hashlib.blake2b(text.encode(),
+                                          digest_size=8).digest(), "big")
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine plus its fleet bookkeeping."""
+
+    rid: str
+    engine: ServeEngine
+    devices: Sequence[Any] = ()
+
+    @property
+    def lame_duck(self) -> bool:
+        return self.engine.lame_duck
+
+    def load(self) -> int:
+        return self.engine.load()
+
+
+class ServeFleet:
+    """N engine replicas behind one admission front-end.
+
+    The fleet intentionally speaks the single-engine surface —
+    ``submit`` / ``pump`` / ``drain`` / ``pending`` / ``score_sync`` /
+    ``snapshot`` / ``warmup`` / ``config`` / ``required_subkeys`` — so
+    the HTTP server, the scan service, and ``cli score`` drive a fleet
+    and a lone engine through identical code.
+    """
+
+    def __init__(self, replicas: Sequence[Replica]):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        if len(replicas) > MAX_REPLICAS:
+            raise ValueError(
+                f"fleet size {len(replicas)} exceeds the statically-"
+                f"enumerated replica-id set ({MAX_REPLICAS})")
+        self.replicas: List[Replica] = list(replicas)
+        # Predeclare only TAGGED replicas' series: a from_engine wrapper
+        # around an untagged engine keeps the pre-fleet exposition
+        # byte-identical (its ServingStats never writes serve_r0_*, so
+        # declaring them would advertise a phantom zero-traffic replica).
+        predeclare_fleet_metrics([r.rid for r in self.replicas
+                                  if r.engine.replica is not None])
+        # Round-robin cursor for load ties: without it, a burst landing
+        # on an idle fleet would pile onto r0 until its queue visibly
+        # deepens.
+        self._rr = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        gnn_model,
+        gnn_params,
+        config: Optional[ServeConfig] = None,
+        n_replicas: Optional[int] = None,
+        combined_model=None,
+        combined_params=None,
+        tokenizer=None,
+        clock: Callable[[], float] = time.monotonic,
+        clock_factory: Optional[Callable[[int], Callable[[], float]]] = None,
+        devices: Optional[Sequence[Any]] = None,
+    ) -> "ServeFleet":
+        """N engines over the device mesh. ``clock_factory(i)`` overrides
+        the shared ``clock`` per replica — the replay harness hands each
+        replica its own busy-timeline view of one virtual clock."""
+        from deepdfa_tpu.parallel.mesh import replica_device_shards
+
+        config = config or ServeConfig()
+        n = n_replicas if n_replicas is not None else config.replicas
+        if not 1 <= n <= MAX_REPLICAS:
+            raise ValueError(f"n_replicas must be in [1, {MAX_REPLICAS}]")
+        shards = replica_device_shards(n, devices=devices)
+        replicas: List[Replica] = []
+        for i in range(n):
+            rid = REPLICA_IDS[i]
+            eng_clock = clock_factory(i) if clock_factory else clock
+            policy = (AdaptiveFlushPolicy(config, replica=rid)
+                      if config.adaptive_flush else None)
+            engine = ServeEngine(
+                gnn_model, gnn_params, config=config,
+                combined_model=combined_model,
+                combined_params=combined_params, tokenizer=tokenizer,
+                clock=eng_clock, replica=rid,
+                device=shards[i][0] if shards[i] else None,
+                policy=policy,
+            )
+            replicas.append(Replica(rid=rid, engine=engine,
+                                    devices=tuple(shards[i])))
+        return cls(replicas)
+
+    @classmethod
+    def from_engine(cls, engine: ServeEngine) -> "ServeFleet":
+        """Wrap one pre-built engine as a single-replica fleet (the
+        back-compat shape every existing ServeHTTPServer caller uses).
+        The engine keeps whatever replica tag it was built with — an
+        untagged engine stays untagged so its metric series and span
+        shapes are byte-identical to the pre-fleet stack."""
+        return cls([Replica(rid=engine.replica or REPLICA_IDS[0],
+                            engine=engine)])
+
+    # -- single-engine-compatible surface ----------------------------------
+
+    @property
+    def primary(self) -> Replica:
+        return self.replicas[0]
+
+    @property
+    def config(self) -> ServeConfig:
+        return self.primary.engine.config
+
+    @property
+    def required_subkeys(self) -> List[str]:
+        return self.primary.engine.required_subkeys
+
+    @property
+    def size(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def live(self) -> List[Replica]:
+        return [r for r in self.replicas if not r.lame_duck]
+
+    def now(self) -> float:
+        return self.primary.engine.now()
+
+    def warmup(self) -> int:
+        """AOT-warm every replica independently; total new compiles."""
+        return sum(r.engine.warmup() for r in self.replicas)
+
+    @property
+    def n_warm(self) -> int:
+        return sum(r.engine.n_warm for r in self.replicas)
+
+    @property
+    def compiles_after_warmup(self) -> Optional[int]:
+        """Fleet-wide silent recompiles since warmup (None until every
+        replica is warmed) — the must-stay-0 invariant, summed."""
+        per = [r.engine.compiles_after_warmup for r in self.replicas]
+        if any(c is None for c in per):
+            return None
+        return sum(per)  # type: ignore[arg-type]
+
+    def prime(self, graphs: Sequence[Mapping]) -> int:
+        """Execute every warmed bucket once on every replica.
+
+        ``warmup()`` compiles but never runs; the FIRST execution of each
+        AOT executable pays one-time initialization that would otherwise
+        skew small measured replays toward fleets with fewer executables
+        (N replicas hold N× the bucket ladder). Measurement harnesses
+        call this between warmup and the measured trace with graphs
+        **disjoint from the trace** (or the cache disabled) — each
+        replica consumes ``sum(slot_buckets)`` distinct graphs so no
+        prime submission cache-hits an earlier one. Virtual-clock
+        timelines (and their shared clock) are rewound to zero
+        afterwards: priming is setup, not load. Returns the number of
+        primed submissions.
+        """
+        need = sum(self.config.slot_buckets)
+        if len(graphs) < need:
+            raise ValueError(
+                f"prime needs >= {need} distinct graphs "
+                f"(sum of slot_buckets), got {len(graphs)}")
+        n = 0
+        for r in self.replicas:
+            it = iter(graphs)
+            for slots in self.config.slot_buckets:
+                for _ in range(slots):
+                    r.engine.submit(next(it))
+                    n += 1
+                r.engine.drain()
+        for r in self.replicas:
+            tl = r.engine.clock
+            if hasattr(tl, "busy_until"):
+                tl.busy_until = 0.0
+            shared = getattr(tl, "shared", None)
+            if shared is not None and hasattr(shared, "t"):
+                shared.t = 0.0
+        return n
+
+    def pending(self) -> int:
+        return sum(r.engine.pending() for r in self.replicas)
+
+    def in_flight(self) -> int:
+        return sum(r.engine.in_flight for r in self.replicas)
+
+    def pump(self) -> int:
+        """Flush every due lane on every replica (single-threaded
+        drivers; threaded serving runs one pump per replica instead)."""
+        return sum(r.engine.pump() for r in self.replicas)
+
+    def drain(self) -> int:
+        return sum(r.engine.drain() for r in self.replicas)
+
+    def next_flush_time(self) -> Optional[float]:
+        horizons = [r.engine.next_flush_time() for r in self.replicas]
+        horizons = [h for h in horizons if h is not None]
+        return min(horizons) if horizons else None
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, key: Optional[str]) -> Replica:
+        """Pick the replica for a content key.
+
+        Rendezvous hashing gives each key a stable preferred replica
+        (cache affinity that survives fleet resizes better than modulo);
+        the preference yields to load the moment the preferred replica
+        is mid-flush or its queue is past one full bucket while a
+        sibling sits below that band — the continuous-batching admission
+        property lives here.
+        """
+        live = self.live
+        if not live:
+            # Whole fleet draining: shed with the standard retry hint;
+            # admitted work is still being answered behind this.
+            raise RejectedError(self.config.deadline_ms / 1000.0)
+        if len(live) == 1:
+            return live[0]
+        if key is not None:
+            pref = max(live,
+                       key=lambda r: _stable_hash(f"{key}|{r.rid}"))
+            band = self.config.batch_slots
+            if pref.engine.in_flight == 0 and pref.load() < band:
+                return pref
+        # Preferred is busy or saturated: least-loaded sibling, idle
+        # (not mid-flush) replicas first, round-robin on ties.
+        order = live[self._rr % len(live):] + live[:self._rr % len(live)]
+        self._rr += 1
+        best = min(order,
+                   key=lambda r: (r.engine.in_flight > 0, r.load()))
+        return best
+
+    def submit(self, graph: Mapping, code: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        """Admit one request through the router.
+
+        A rejection from the routed replica (its queue filled between
+        the load read and the admit) retries once on the least-loaded
+        live sibling before surfacing backpressure to the caller.
+        """
+        from deepdfa_tpu.serve.cache import content_hash
+
+        try:
+            # Graph-only routing key (code excluded): the same function
+            # routes to the same replica whether it rides the combined
+            # lane, degrades to gnn, or arrives graph-only — so every
+            # cache line the engine may write for this graph (code-keyed
+            # combined, code-free gnn/degraded) accumulates on ONE
+            # replica's LRU.
+            key = content_hash(graph)
+        except Exception:
+            # Malformed payload: route on load alone and let the engine's
+            # admission validator raise its historic BadRequestError
+            # message class (the byte-pinned 400 contract).
+            key = None
+        replica = self.route(key)
+        try:
+            return replica.engine.submit(graph, code=code,
+                                         deadline_ms=deadline_ms)
+        except RejectedError:
+            others = [r for r in self.live if r is not replica]
+            if not others:
+                raise
+            fallback = min(others, key=lambda r: r.load())
+            return fallback.engine.submit(graph, code=code,
+                                          deadline_ms=deadline_ms)
+
+    def score_sync(self, graphs: Sequence[Mapping],
+                   codes: Optional[Sequence[Optional[str]]] = None,
+                   ) -> List[Dict]:
+        """The offline batch client over the fleet — same absorb-the-
+        backpressure semantics as ``ServeEngine.score_sync``, with
+        results in submission order and byte-identical probabilities to
+        the single-engine path (same params, same bucket executables;
+        the offline-parity gate in tests/test_fleet.py)."""
+        from deepdfa_tpu.serve.batcher import OversizedError
+        from deepdfa_tpu.serve.engine import BadRequestError
+
+        out: List[Optional[ServeRequest]] = []
+        errors: Dict[int, Dict] = {}
+        for i, graph in enumerate(graphs):
+            code = codes[i] if codes is not None else None
+            try:
+                out.append(self.submit(graph, code=code))
+            except RejectedError:
+                self.drain()
+                out.append(self.submit(graph, code=code))
+            except OversizedError as e:
+                errors[i] = {"error": "oversized", "detail": str(e)}
+                out.append(None)
+            except BadRequestError as e:
+                errors[i] = {"error": "bad_request", "detail": str(e)}
+                out.append(None)
+        self.drain()
+        return [errors[i] if r is None else r.result
+                for i, r in enumerate(out)]
+
+    # -- lame-duck / roll --------------------------------------------------
+
+    def enter_lame_duck(self) -> None:
+        """Whole-fleet drain (process preemption): every replica flushes
+        partial buckets immediately; admission control is the
+        transport's job. Idempotent, like the engine's."""
+        for r in self.replicas:
+            r.engine.enter_lame_duck()
+
+    def begin_replica_drain(self, rid: str, reason: str = "roll") -> Replica:
+        """Take ONE replica out of rotation (the per-replica SIGTERM
+        analog): its batcher flushes partial buckets now, the router
+        stops selecting it, its admitted requests keep being answered by
+        its pump. The rest of the fleet keeps serving."""
+        replica = self._replica(rid)
+        replica.engine.enter_lame_duck()
+        telemetry.event("fleet.replica_drain", replica=rid, reason=reason,
+                        pending=replica.engine.pending())
+        return replica
+
+    def await_replica_drained(self, rid: str, deadline_s: float,
+                              poll_s: float = 0.01,
+                              beat: Optional[Callable[[], None]] = None,
+                              ) -> bool:
+        """Block until the replica answered everything it admitted
+        (queue 0, nothing mid-flush) or the deadline passes."""
+        replica = self._replica(rid)
+        deadline = time.monotonic() + max(deadline_s, 0.0)
+        last = (-1, -1)
+        while time.monotonic() < deadline:
+            state = (replica.engine.pending(), replica.engine.in_flight)
+            if state == (0, 0):
+                return True
+            if beat is not None and state != last:
+                beat()
+                last = state
+            time.sleep(poll_s)
+        return (replica.engine.pending(), replica.engine.in_flight) == (0, 0)
+
+    def restore_replica(self, rid: str) -> Replica:
+        """Bring a drained replica back into rotation. Its warmed
+        executables were never dropped, so re-entry costs zero compiles
+        (asserted by the ``fleet_roll`` chaos scenario)."""
+        replica = self._replica(rid)
+        replica.engine.lame_duck = False
+        replica.engine.batcher.set_drain_mode(False)
+        telemetry.event("fleet.replica_restore", replica=rid)
+        return replica
+
+    def roll_replica(self, rid: str, deadline_s: float = 30.0) -> bool:
+        """drain → await → restore, one call (the rolling-restart
+        primitive; README "Serving fleet" runbook)."""
+        self.begin_replica_drain(rid)
+        drained = self.await_replica_drained(rid, deadline_s)
+        self.restore_replica(rid)
+        return drained
+
+    def _replica(self, rid: str) -> Replica:
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no replica {rid!r} "
+                       f"(fleet: {[r.rid for r in self.replicas]})")
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet-aggregated ``/metrics`` body: the exact single-engine
+        key set (summed counters, pooled latency quantiles, recomputed
+        rates) so dashboards and the byte-compat JSON contract survive
+        the fleet refactor, plus ``n_replicas``/``replicas`` sections
+        with each replica's own snapshot and drain state."""
+        import numpy as np
+
+        from deepdfa_tpu.core.metrics import ServingStats, latency_quantile
+
+        per: Dict[str, Dict[str, Any]] = {}
+        out: Dict[str, Any] = {}
+        for name in ServingStats.COUNTERS:
+            out[name] = 0
+        used = slots = depth = 0
+        pools: List[Any] = []
+        for r in self.replicas:
+            snap = r.engine.snapshot()
+            snap["lame_duck"] = r.lame_duck
+            snap["in_flight"] = r.engine.in_flight
+            per[r.rid] = snap
+            for name in ServingStats.COUNTERS:
+                out[name] += snap[name]
+            used += r.engine.stats.occupancy_used
+            slots += r.engine.stats.occupancy_slots
+            depth += r.engine.pending()
+            pools.append(r.engine.stats.latencies_ms)
+        lat = np.concatenate(pools) if pools else np.zeros(0)
+        looked = out["cache_hits"] + out["cache_misses"]
+        out.update(
+            queue_depth=depth,
+            batch_occupancy=(used / slots) if slots else 0.0,
+            cache_hit_rate=(out["cache_hits"] / looked) if looked else 0.0,
+            latency_p50_ms=latency_quantile(lat, 0.50),
+            latency_p99_ms=latency_quantile(lat, 0.99),
+            latency_samples=int(lat.size),
+            n_replicas=len(self.replicas),
+            replicas=per,
+        )
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        """The per-replica half of ``/healthz``: fleet size, live count,
+        and each replica's rotation state. The HTTP layer maps
+        some-but-not-all-draining to status "degraded"."""
+        return {
+            "size": len(self.replicas),
+            "live": len(self.live),
+            "replicas": {
+                r.rid: {
+                    "status": "draining" if r.lame_duck else "ok",
+                    "pending": r.engine.pending(),
+                    "in_flight": r.engine.in_flight,
+                    "warm_buckets": r.engine.n_warm,
+                }
+                for r in self.replicas
+            },
+        }

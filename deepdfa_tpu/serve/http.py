@@ -24,16 +24,20 @@ Contract (documented in README "Serving"):
       contracts.validate_scan_source before touching the Joern pool, and
       per-item failures (bad source, Joern give-up, inadmissible graph)
       come back inline — one poisoned function never fails the POST.
-  GET /metrics   -> ServingStats snapshot (queue depth, occupancy,
-                    p50/p99 latency, cache hit rate, compile count)
+  GET /metrics   -> fleet-aggregated ServingStats snapshot (queue depth,
+                    occupancy, p50/p99 latency, cache hit rate, compile
+                    count; + n_replicas/replicas sections on a fleet)
   GET /healthz   -> {"status": "ok", "warm_buckets": N} (+ scan pool
-                    health when a scan service is attached)
+                    health when a scan service is attached; + a "fleet"
+                    section — some-but-not-all replicas draining reads
+                    "degraded"/503)
 
-Transport threads (ThreadingHTTPServer, one per connection) submit into
-the engine and block on each request's event; a single pump thread owns
-execution, waking on the batcher's next flush horizon. This split keeps
-the engine's one-pump-thread contract while the stdlib server fans out
-connections.
+Transport threads (ThreadingHTTPServer, one per connection) submit
+through the fleet router and block on each request's event; each replica
+runs exactly ONE pump thread owning its execution, waking on its own
+batcher's flush horizon. This split keeps the engine's one-pump-thread
+contract per replica while the stdlib server fans out connections — and
+no device dispatch ever runs under a lock shared across threads (GL018).
 """
 
 from __future__ import annotations
@@ -49,6 +53,7 @@ from typing import Callable, Dict, Optional, Tuple
 from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
 from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
+from deepdfa_tpu.serve.fleet import ServeFleet
 from deepdfa_tpu.telemetry.memory import SAMPLER
 from deepdfa_tpu.telemetry.slo import SLOMonitor
 
@@ -82,11 +87,27 @@ def _predeclare_metrics() -> None:
 
 
 class _PumpThread(threading.Thread):
+    """One replica's execution thread.
+
+    Each engine gets its OWN pump (the per-replica dispatch path shares
+    no lock with siblings — the fleet's lock-free handoff is the
+    batcher's per-replica deque, and nothing device-shaped ever runs
+    under a shared lock: graftlint GL018). ``observed`` is the snapshot
+    source for the SLO observation — the FLEET on the observer pump, so
+    burn rates see aggregate state, this engine elsewhere (None skips
+    observation entirely: exactly one pump per server observes).
+    """
+
     def __init__(self, engine: ServeEngine,
-                 slo_monitor: Optional[SLOMonitor] = None):
-        super().__init__(name="serve-pump", daemon=True)
+                 slo_monitor: Optional[SLOMonitor] = None,
+                 observed=None, observer: bool = True):
+        name = (f"serve-pump-{engine.replica}" if engine.replica
+                else "serve-pump")
+        super().__init__(name=name, daemon=True)
         self.engine = engine
         self.slo_monitor = slo_monitor
+        self.observed = observed if observed is not None else engine
+        self.observer = observer
         self._halt = threading.Event()
         self._last_observe = 0.0
 
@@ -96,8 +117,8 @@ class _PumpThread(threading.Thread):
     def _observe(self) -> None:
         """SLO burn-rate + live HBM observation, at most once per
         interval: registry snapshot (histograms expand, so dotted
-        ``serve_latency_ms.p99`` resolves) merged with this engine's
-        stats and the live compiles-after-warmup count."""
+        ``serve_latency_ms.p99`` resolves) merged with the observed
+        engine/fleet's stats and the live compiles-after-warmup count."""
         import time
 
         now = time.monotonic()
@@ -108,16 +129,16 @@ class _PumpThread(threading.Thread):
         if self.slo_monitor is None:
             return
         values = dict(telemetry.REGISTRY.snapshot())
-        eng_snap = self.engine.snapshot()
+        eng_snap = self.observed.snapshot()
         values.update(eng_snap)
         # Trace-report-shaped aliases (compiles.after_warmup,
         # serve.request_ms_p99): one spec — the built-in "smoke" — must
         # resolve on both surfaces, the offline report and this live
-        # snapshot. The engine's submit→complete p99 is the live face of
-        # the report's admission→respond request p99. "compiles" becomes
-        # a namespace here, so the engine's total-compiles counter stays
-        # reachable at compiles.total (and serve_compiles).
-        caw = self.engine.compiles_after_warmup
+        # snapshot. The submit→complete p99 is the live face of the
+        # report's admission→respond request p99. "compiles" becomes a
+        # namespace here, so the total-compiles counter stays reachable
+        # at compiles.total (and serve_compiles).
+        caw = self.observed.compiles_after_warmup
         if caw is not None:
             values["compiles_after_warmup"] = caw
         values["serve_compiles"] = eng_snap.get("compiles", 0)
@@ -136,11 +157,13 @@ class _PumpThread(threading.Thread):
         while not self._halt.is_set():
             try:
                 self.engine.pump()
-                self._observe()
-                # Keep events.jsonl current for live scrapes; a no-op
-                # with no active run or empty rings. Inside the guard:
-                # a full disk must cost the trace, never the serving.
-                telemetry.flush()
+                if self.observer:
+                    self._observe()
+                    # Keep events.jsonl current for live scrapes; a
+                    # no-op with no active run or empty rings. Inside
+                    # the guard: a full disk must cost the trace, never
+                    # the serving.
+                    telemetry.flush()
             except Exception:
                 logger.exception("pump failed")
             horizon = self.engine.next_flush_time()
@@ -184,15 +207,27 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self) -> None:
-        engine = self.server.engine
+        fleet = self.server.fleet
         if self.path == "/healthz":
             doc: Dict = {
                 "status": ("draining" if self.server.draining else "ok"),
-                "warm_buckets": engine.n_warm,
+                "warm_buckets": fleet.n_warm,
                 # Observability health: a nonzero drop count means the
                 # telemetry rings overflowed and the trace is incomplete.
                 "telemetry_drops": telemetry.drop_count(),
             }
+            if fleet.size > 1:
+                # Fleet rotation state: a replica mid-roll degrades the
+                # fleet (partial capacity — balancers may keep sending,
+                # autoscalers should notice) without taking it out of
+                # rotation the way a full drain does.
+                health = fleet.health()
+                doc["fleet"] = health
+                if 0 < health["live"] < health["size"] \
+                        and doc["status"] == "ok":
+                    doc["status"] = "degraded"
+                elif health["live"] == 0 and doc["status"] == "ok":
+                    doc["status"] = "draining"
             monitor = self.server.slo_monitor
             if monitor is not None:
                 slo = monitor.status()
@@ -223,18 +258,20 @@ class ServeHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             # Content negotiation: Prometheus scrapers ask for text/plain
             # (or OpenMetrics) and get the text exposition — the process
-            # registry plus this engine's snapshot as gauges. Everyone
-            # else gets the historic JSON body, byte-compatible
-            # (regression-tested).
+            # registry (which carries every replica's predeclared
+            # serve_<rid>_* series) plus the fleet-aggregated snapshot as
+            # gauges. Everyone else gets the historic JSON body,
+            # byte-compatible for single-replica servers plus the
+            # fleet's per-replica sections (regression-tested).
+            snap = fleet.snapshot()
             accept = self.headers.get("Accept", "") or ""
             if "text/plain" in accept or "openmetrics" in accept:
                 body = telemetry.REGISTRY.prometheus_text(
-                    extra={f"serve_{k}": v
-                           for k, v in engine.snapshot().items()}
+                    extra={f"serve_{k}": v for k, v in snap.items()}
                 )
                 self._send_text(200, body, "text/plain; version=0.0.4")
             else:
-                self._send_json(200, engine.snapshot())
+                self._send_json(200, snap)
         else:
             self._send_json(404, {"error": "not_found"})
 
@@ -285,14 +322,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._send_json(400, {"error": "bad_request", "detail": str(e)})
             return
-        engine = self.server.engine
+        fleet = self.server.fleet
         submitted, results = [], []
         with telemetry.span("http.post", n_functions=len(functions)) as hs:
             for fn in functions:
                 entry: Dict = {}
                 try:
-                    req = engine.submit(fn["graph"], code=fn.get("code"),
-                                        deadline_ms=deadline_ms)
+                    req = fleet.submit(fn["graph"], code=fn.get("code"),
+                                       deadline_ms=deadline_ms)
                     submitted.append((req, entry))
                 except RejectedError as e:
                     entry.update(error="rejected",
@@ -323,10 +360,10 @@ class ServeHandler(BaseHTTPRequestHandler):
                                          str(max(int(-(-retry // 1)), 1))})
                 return
 
-            # Block until the pump thread answers each admitted request;
+            # Block until a pump thread answers each admitted request;
             # the timeout is generous (deadline covers queueing + compute,
             # and a stuck pump must surface as an error, not a hang).
-            wait_s = ((deadline_ms or engine.config.deadline_ms) / 1000.0) \
+            wait_s = ((deadline_ms or fleet.config.deadline_ms) / 1000.0) \
                 * 10 + 30.0
             for req, entry in submitted:
                 if req.event.wait(timeout=wait_s) and req.result is not None:
@@ -379,15 +416,31 @@ class ServeHandler(BaseHTTPRequestHandler):
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, address: Tuple[str, int], engine: ServeEngine,
+    def __init__(self, address: Tuple[str, int], engine,
                  slo_monitor: Optional[SLOMonitor] = None,
                  scan_service=None):
         super().__init__(address, ServeHandler)
-        self.engine = engine
+        # `engine` may be a lone ServeEngine (the historic surface — every
+        # existing caller/test) or a ServeFleet; either way the server
+        # works against the fleet view, and `self.engine` stays the
+        # primary replica for back-compat introspection.
+        self.fleet = (engine if isinstance(engine, ServeFleet)
+                      else ServeFleet.from_engine(engine))
         self.slo_monitor = slo_monitor
         self.scan_service = scan_service
         _predeclare_metrics()
-        self.pump_thread = _PumpThread(engine, slo_monitor=slo_monitor)
+        # One pump thread per replica: per-replica batchers flush on
+        # their own threads (no dispatch ever holds a shared lock —
+        # GL018); exactly one pump (the first) carries the SLO/memory
+        # observer and the telemetry flusher, observing FLEET state.
+        self.pump_threads = [
+            _PumpThread(r.engine,
+                        slo_monitor=slo_monitor if i == 0 else None,
+                        observed=self.fleet if i == 0 else None,
+                        observer=(i == 0))
+            for i, r in enumerate(self.fleet.replicas)
+        ]
+        self.pump_thread = self.pump_threads[0]
         # Lame-duck drain state (ISSUE 10): `draining` flips admission to
         # 503; `_inflight` counts transport threads still assembling a
         # response for an already-admitted POST (the queue may be empty
@@ -398,6 +451,11 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self.drain_notice = None
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+
+    @property
+    def engine(self) -> ServeEngine:
+        """The primary replica's engine (single-engine back-compat)."""
+        return self.fleet.primary.engine
 
     @contextlib.contextmanager
     def track_inflight(self):
@@ -426,46 +484,52 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
     def begin_drain(self, notice=None) -> None:
         """Enter lame-duck: NEW admissions 503, /healthz reports
-        draining, the batcher flushes partial buckets immediately."""
+        draining, every replica's batcher flushes partial buckets
+        immediately."""
         self.drain_notice = notice
         self.draining = True
-        self.engine.enter_lame_duck()
+        self.fleet.enter_lame_duck()
 
     def await_drained(self, deadline_s: float,
                       beat: Optional[Callable[[], None]] = None,
                       poll_s: float = 0.01) -> bool:
         """Block until every already-admitted request is answered AND
-        written (queue depth 0, no in-flight handlers), or the deadline
-        passes. ``beat`` feeds the lifecycle watchdog while progress is
-        being made."""
+        written (fleet queue depth 0, no in-flight handlers), or the
+        deadline passes. ``beat`` feeds the lifecycle watchdog while
+        progress is being made."""
         import time
 
         deadline = time.monotonic() + max(deadline_s, 0.0)
         last = (-1, -1)
         while time.monotonic() < deadline:
-            state = (self.engine.pending(), self.inflight)
+            state = (self.fleet.pending(), self.inflight)
             if state == (0, 0):
                 return True
             if beat is not None and state != last:
                 beat()  # progress, not a wedge: keep the watchdog calm
                 last = state
             time.sleep(poll_s)
-        return self.engine.pending() == 0 and self.inflight == 0
+        return self.fleet.pending() == 0 and self.inflight == 0
 
     def start_pump(self) -> None:
-        self.pump_thread.start()
+        for t in self.pump_threads:
+            t.start()
 
     def shutdown(self) -> None:  # type: ignore[override]
-        self.pump_thread.stop()
+        for t in self.pump_threads:
+            t.stop()
         super().shutdown()
-        self.pump_thread.join(timeout=10.0)
+        for t in self.pump_threads:
+            t.join(timeout=10.0)
 
 
-def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
+def serve_forever(engine, host: str = "127.0.0.1",
                   port: int = 8080,
                   slo_monitor: Optional[SLOMonitor] = None,
                   scan_service=None, port_file: Optional[str] = None):
-    """Blocking entry: warm the buckets, start the pump, serve.
+    """Blocking entry: warm the buckets, start the pumps, serve.
+    ``engine`` is a ServeEngine or a ServeFleet (N replicas, one pump
+    thread each).
 
     Registers with the process lifecycle coordinator: a preemption
     notice (SIGTERM/SIGINT or simulated) flips the server into lame-duck
@@ -489,8 +553,9 @@ def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
             f.write(str(server.server_address[1]))
         os.replace(tmp, port_file)
     server.start_pump()
-    logger.info("serving on %s:%d (%d warm buckets)", host,
-                server.server_address[1], engine.n_warm)
+    logger.info("serving on %s:%d (%d replica(s), %d warm buckets)", host,
+                server.server_address[1], server.fleet.size,
+                server.fleet.n_warm)
 
     coordinator = lifecycle.coordinator()
     participant_box: Dict[str, object] = {}
@@ -511,7 +576,7 @@ def serve_forever(engine: ServeEngine, host: str = "127.0.0.1",
             if not drained:
                 logger.error(
                     "lame-duck drain overran its budget: pending=%d "
-                    "inflight=%d", server.engine.pending(), server.inflight)
+                    "inflight=%d", server.fleet.pending(), server.inflight)
             if scan_service is not None:
                 try:
                     scan_service.drain(deadline_s=notice.remaining())

@@ -1,0 +1,189 @@
+"""Telemetry-driven adaptive flush policy.
+
+Each replica's batcher has two flush levers (serve/batcher.py): the
+deadline *fraction* (how much of a request's budget may be spent waiting
+for companions) and the *fill* threshold (how full a bucket must be to
+flush on occupancy alone). The static defaults are one operating point;
+this policy moves them online from the replica's **own** telemetry —
+the rolling latency window behind its ``serve_<rid>_latency_ms``
+registry series (read at the ``ServingStats`` source: the process
+registry's ring is never reset, and a fresh replica's controller must
+not inherit a dead one's tail) and the engine's rolling occupancy — so
+a replica drowning in tail latency flushes sooner and an idle one waits
+longer for fuller buckets.
+
+Guard rails, because a feedback loop on the serving path must be boring:
+
+* **Clamped** — the batcher itself clamps the fraction to
+  ``[flush_fraction_min, flush_fraction_max]`` and the fill threshold to
+  ``[1, batch_slots]``; no policy state can escape the band.
+* **Hysteresis** — a move needs ``adaptive_patience`` *consecutive*
+  same-direction signals; one noisy window never swings the thresholds.
+* **Audited** — every evaluation (move, hold, or clamp) is a
+  ``serve.flush_policy`` trace event carrying the inputs (p99,
+  occupancy, target) and outputs (fraction, fill), so ``cli trace
+  report`` reconstructs the policy's whole decision history from the
+  trace alone.
+
+Time comes from the engine's clock (virtual in replay/bench, monotonic
+live), so replayed policy behaviour is deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.serve.config import ServeConfig
+
+__all__ = ["AdaptiveFlushPolicy"]
+
+
+@dataclasses.dataclass
+class _Decision:
+    action: str               # "lower" | "raise" | "hold"
+    fraction: float
+    fill_slots: int
+    p99_ms: float
+    occupancy: float
+
+
+class AdaptiveFlushPolicy:
+    """One replica's flush-threshold controller.
+
+    ``maybe_update(engine)`` is called from the pump path after flushes
+    (engine.pump) at most once per ``adaptive_interval_s`` of engine
+    clock. It reads the replica's p99 from the registry histogram when
+    the engine is replica-tagged (falling back to the engine's own
+    rolling window), compares against ``adaptive_target_p99_frac *
+    deadline_ms``, and nudges the thresholds one ``adaptive_step`` at a
+    time after ``adaptive_patience`` consecutive signals.
+    """
+
+    def __init__(self, config: ServeConfig, replica: Optional[str] = None):
+        self.config = config
+        self.replica = replica
+        self.fraction = min(
+            max(config.flush_fraction, config.flush_fraction_min),
+            config.flush_fraction_max,
+        )
+        self.fill_slots = config.batch_slots
+        self.target_p99_ms = (config.adaptive_target_p99_frac
+                              * config.deadline_ms)
+        self._pressure = 0      # consecutive over-target windows
+        self._slack = 0         # consecutive well-under-target windows
+        self._last_eval: Optional[float] = None
+        self._last_traffic = -1  # completed+failures at the last decision
+        # Occupancy baseline: stats.occupancy is a LIFETIME average, so
+        # the controller differences it per decision window — a server
+        # that spent an hour saturated must still see its buckets go
+        # empty the minute traffic does.
+        self._last_used = 0
+        self._last_slots = 0
+        self.decisions = 0
+
+    # -- inputs ------------------------------------------------------------
+
+    @staticmethod
+    def _p99_ms(engine) -> float:
+        """The replica's own p99: the rolling latency window behind its
+        ``serve_<rid>_latency_ms`` registry series — read at the source
+        (``ServingStats``, which mirrors every observation into that
+        series) rather than from the process-global histogram, because
+        the registry ring is never reset and outlives engine instances:
+        a fresh fleet's controller must not be steered by a previous
+        fleet's tail (the 1-vs-N bench runs back to back in one
+        process)."""
+        from deepdfa_tpu.core.metrics import latency_quantile
+
+        return latency_quantile(engine.stats.latencies_ms, 0.99)
+
+    def _window_occupancy(self, engine) -> float:
+        """Occupancy over the batches flushed SINCE the last decision
+        (``stats.occupancy`` is a lifetime average that an hour of
+        saturation pins near 1.0 forever). No flushes since last time —
+        cache-hit-only traffic — reads as 1.0: no evidence of empty
+        buckets, so the raise branch stays conservative."""
+        used = engine.stats.occupancy_used
+        slots = engine.stats.occupancy_slots
+        d_used, d_slots = used - self._last_used, slots - self._last_slots
+        self._last_used, self._last_slots = used, slots
+        return d_used / d_slots if d_slots > 0 else 1.0
+
+    # -- the control step --------------------------------------------------
+
+    def maybe_update(self, engine) -> Optional[Dict[str, Any]]:
+        """Evaluate once per interval; returns the decision dict (also
+        emitted as a ``serve.flush_policy`` event) or None when the
+        interval has not elapsed."""
+        now = engine.now()
+        if (self._last_eval is not None
+                and now - self._last_eval < self.config.adaptive_interval_s):
+            return None
+        # No decision without traffic: the pump loop spins every few ms
+        # even on an idle server, and an idle replica has nothing to
+        # decide — emitting interval-paced "hold" events forever would
+        # bloat the trace with zero information. Every decision made IS
+        # still emitted; idleness just isn't a decision.
+        traffic = engine.stats.completed + engine.stats.failures
+        if traffic == self._last_traffic:
+            self._last_eval = now
+            return None
+        self._last_traffic = traffic
+        self._last_eval = now
+        decision = self._decide(self._p99_ms(engine),
+                                self._window_occupancy(engine))
+        engine.batcher.set_flush_policy(fraction=decision.fraction,
+                                        fill_slots=decision.fill_slots)
+        # The batcher clamped; read back so the audit records reality.
+        self.fraction = engine.batcher.flush_fraction
+        self.fill_slots = engine.batcher.fill_slots
+        self.decisions += 1
+        doc = {
+            "replica": self.replica or "r0",
+            "action": decision.action,
+            "fraction": round(self.fraction, 4),
+            "fill_slots": self.fill_slots,
+            "p99_ms": round(decision.p99_ms, 3),
+            "occupancy": round(decision.occupancy, 4),
+            "target_p99_ms": round(self.target_p99_ms, 3),
+            "pressure": self._pressure,
+            "slack": self._slack,
+        }
+        # The audit: EVERY decision (hold included) is a trace event —
+        # `cli trace report` replays the controller from events alone.
+        telemetry.event("serve.flush_policy", **doc)
+        return doc
+
+    def _decide(self, p99_ms: float, occupancy: float) -> _Decision:
+        cfg = self.config
+        action = "hold"
+        if p99_ms > self.target_p99_ms:
+            # Tail latency over target: spend less of the budget waiting
+            # and flush at smaller fills — latency buys occupancy back
+            # once the queue drains.
+            self._pressure += 1
+            self._slack = 0
+            if self._pressure >= cfg.adaptive_patience:
+                action = "lower"
+                self.fraction -= cfg.adaptive_step
+                self.fill_slots = max(1, self.fill_slots // 2)
+                self._pressure = 0
+        elif p99_ms < 0.5 * self.target_p99_ms and occupancy < 0.5:
+            # Comfortable tail + half-empty buckets: wait longer so
+            # buckets fill (throughput), one step at a time.
+            self._slack += 1
+            self._pressure = 0
+            if self._slack >= cfg.adaptive_patience:
+                action = "raise"
+                self.fraction += cfg.adaptive_step
+                self.fill_slots = min(cfg.batch_slots, self.fill_slots * 2)
+                self._slack = 0
+        else:
+            self._pressure = 0
+            self._slack = 0
+        self.fraction = min(max(self.fraction, cfg.flush_fraction_min),
+                            cfg.flush_fraction_max)
+        return _Decision(action, self.fraction, self.fill_slots,
+                         p99_ms, occupancy)

@@ -14,6 +14,16 @@ Used by bench.py (serve_p99_ms / serve_graphs_per_sec) and by the
 tests/test_serve.py acceptance check (zero post-warmup compiles, ≥50%
 occupancy, responses match the offline eval path).
 
+The **fleet harness** (:func:`open_loop_trace` / :func:`replay_fleet`)
+scales the same idea to the replicated fleet as a discrete-event
+simulation: open-loop seeded-Poisson arrivals at thousands of RPS, each
+replica crediting its *measured* micro-batch compute to its own
+:class:`ReplicaTimeline` busy horizon over one shared clock — N
+replicas overlap like N devices, arrivals keep landing mid-flush
+(continuous batching stays observable), and backpressure sheds instead
+of retrying (open-loop semantics). bench.py's ``serve_fleet_rps`` /
+``serve_fleet_p99_ms`` 1-vs-N comparison runs on it.
+
 The **scan lane** (:func:`scan_trace` / :func:`replay_scan`) is the same
 idea one layer earlier: a seeded stream of *raw-source* requests with an
 edit/repeat mix — the PR-diff traffic shape — driven through a
@@ -50,6 +60,38 @@ class VirtualClock:
 
     def advance_to(self, t: float) -> None:
         self.t = max(self.t, t)
+
+    def flush_done(self, dt: float) -> float:
+        """Engine completion-clock protocol (engine._run_batch): credit a
+        flush's measured compute and return its completion time. On the
+        single serial timeline that is just advance-and-read."""
+        self.advance(dt)
+        return self.t
+
+
+class ReplicaTimeline:
+    """One replica's busy horizon over a shared virtual clock.
+
+    The fleet replay's concurrency model: all replicas read one global
+    clock (arrival order stays global), but each credits its measured
+    flush compute to its OWN ``busy_until`` — replica A executing a
+    5 ms bucket does not stall replica B's timeline, exactly like N
+    engines on N devices. A replica's flushes serialize against
+    themselves: a flush dispatched while the previous one is still
+    "running" starts at the busy horizon, not at the dispatch read.
+    """
+
+    def __init__(self, shared: VirtualClock):
+        self.shared = shared
+        self.busy_until = 0.0
+
+    def __call__(self) -> float:
+        return self.shared()
+
+    def flush_done(self, dt: float) -> float:
+        start = max(self.shared(), self.busy_until)
+        self.busy_until = start + dt
+        return self.busy_until
 
 
 @dataclasses.dataclass
@@ -150,6 +192,154 @@ def replay(
     report["span_s"] = span
     report["graphs_per_sec"] = (len(requests) / span) if span > 0 else 0.0
     return {"metrics": report, "requests": requests}
+
+
+# ---------------------------------------------------------------------------
+# Sustained-load fleet replay: open-loop arrivals over replica timelines
+# ---------------------------------------------------------------------------
+
+
+def open_loop_trace(
+    n_requests: int,
+    feature: FeatureSpec = FeatureSpec(),
+    seed: int = 0,
+    rps: float = 2000.0,
+    duplicate_fraction: float = 0.25,
+    code_fraction: float = 0.0,
+) -> List[TraceEvent]:
+    """Open-loop arrival schedule at ``rps`` requests/second.
+
+    *Open-loop* is the point: arrival times are fixed by the schedule
+    (seeded-Poisson interarrivals), never by completions — a slow server
+    faces a growing queue instead of a politely waiting client, which is
+    the only load shape that exposes queue-limited throughput.
+    ``code_fraction`` of requests carry source text and ride the
+    combined lane when the fleet has one (the mixed-lane traffic the
+    fairness gate measures); duplicates exercise the content caches.
+    """
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+    rng = np.random.default_rng(seed)
+    uniques = synthetic_bigvul(n_requests, feature, positive_fraction=0.5,
+                               seed=seed)
+    events: List[TraceEvent] = []
+    t = 0.0
+    next_unique = 0
+    for _ in range(n_requests):
+        if next_unique and rng.random() < duplicate_fraction:
+            g = uniques[int(rng.integers(next_unique))]
+        else:
+            g = uniques[next_unique]
+            next_unique = min(next_unique + 1, len(uniques) - 1)
+        code = None
+        if code_fraction and rng.random() < code_fraction:
+            code = f"int f_{int(g['id'])}(char *p) {{ return p[0]; }}"
+        events.append(TraceEvent(at=t, graph=g, code=code))
+        t += float(rng.exponential(1.0 / rps))
+    return events
+
+
+def replay_fleet(fleet, trace: Sequence[TraceEvent],
+                 clock: VirtualClock) -> Dict:
+    """Drive a :class:`~deepdfa_tpu.serve.fleet.ServeFleet` (whose
+    replicas must run :class:`ReplicaTimeline` views of ``clock``)
+    through an open-loop trace as a discrete-event simulation.
+
+    Event order is exact: the next event is whichever comes first of the
+    next scheduled arrival or the earliest replica able to flush (its
+    batcher horizon, floored by its busy timeline). Flush compute is
+    *measured* wall time, credited to the flushing replica's own
+    timeline — N replicas overlap like N devices, while arrivals keep
+    landing mid-flush and late-join pending buckets (continuous
+    batching, observable instead of simulated away).
+
+    Backpressure sheds (``shed`` in the report) — an open-loop client
+    has no completion to wait on, so a full queue is a shed, not a
+    retry loop. Throughput is completed/span: at overload this measures
+    service capacity, which is exactly the 1-vs-N number the fleet
+    bench compares.
+    """
+    from deepdfa_tpu.serve.batcher import RejectedError
+
+    timelines: List[ReplicaTimeline] = []
+    for r in fleet.replicas:
+        tl = r.engine.clock
+        if not isinstance(tl, ReplicaTimeline):
+            raise ValueError(
+                f"replica {r.rid} clock must be a ReplicaTimeline view of "
+                "the shared virtual clock (ServeFleet.build clock_factory)")
+        timelines.append(tl)
+
+    requests = []
+    shed = 0
+    i = 0
+    stalls = 0
+    while i < len(trace) or fleet.pending():
+        t_arr = trace[i].at if i < len(trace) else float("inf")
+        best = None
+        for r, tl in zip(fleet.replicas, timelines):
+            horizon = r.engine.next_flush_time()
+            if horizon is None:
+                continue
+            ready = max(horizon, tl.busy_until)
+            if best is None or ready < best[0]:
+                best = (ready, r)
+        t_flush = best[0] if best is not None else float("inf")
+        if t_arr == float("inf") and t_flush == float("inf"):
+            break
+        if t_arr <= t_flush:
+            clock.advance_to(t_arr)
+            ev = trace[i]
+            i += 1
+            try:
+                requests.append(fleet.submit(ev.graph, code=ev.code))
+            except RejectedError:
+                shed += 1
+            stalls = 0
+        else:
+            clock.advance_to(t_flush)
+            ran = best[1].engine.pump(max_batches=1)
+            # A horizon that produces no flush twice in a row would spin
+            # the driver forever; break loudly instead (a bug, not load).
+            stalls = 0 if ran else stalls + 1
+            if stalls > 2 * len(fleet.replicas) + 2:
+                raise RuntimeError(
+                    "fleet replay stalled: flush horizons keep firing "
+                    "without a dispatchable bucket")
+
+    end = max([clock()] + [tl.busy_until for tl in timelines])
+    span = end - (trace[0].at if trace else 0.0)
+    completed = [r for r in requests
+                 if r.result is not None and "prob" in r.result]
+    lat_ms = [(r.completed_at - r.arrival) * 1e3 for r in completed
+              if r.completed_at is not None]
+    from deepdfa_tpu.core.metrics import latency_quantile
+
+    lanes: Dict[str, Dict[str, float]] = {}
+    for lane in sorted({r.lane for r in completed}):
+        ms = [(r.completed_at - r.arrival) * 1e3 for r in completed
+              if r.lane == lane and r.completed_at is not None]
+        lanes[lane] = {
+            "requests": len(ms),
+            "latency_p50_ms": latency_quantile(ms, 0.50),
+            "latency_p99_ms": latency_quantile(ms, 0.99),
+        }
+    offered = (len(trace) / (trace[-1].at - trace[0].at)
+               if len(trace) > 1 and trace[-1].at > trace[0].at else 0.0)
+    return {
+        "metrics": fleet.snapshot(),
+        "requests": requests,
+        "n_offered": len(trace),
+        "offered_rps": offered,
+        "completed": len(completed),
+        "shed": shed,
+        "span_s": span,
+        "rps": len(completed) / span if span > 0 else 0.0,
+        "latency_p50_ms": latency_quantile(lat_ms, 0.50),
+        "latency_p99_ms": latency_quantile(lat_ms, 0.99),
+        "lanes": lanes,
+        "compiles_after_warmup": fleet.compiles_after_warmup,
+    }
 
 
 # ---------------------------------------------------------------------------

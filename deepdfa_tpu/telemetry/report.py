@@ -110,6 +110,68 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "flushes": len(flushes),
     }
 
+    def _req_stats(group: List[Dict[str, Any]]) -> Dict[str, Any]:
+        ms = [float(r.get("dur_ms", 0.0)) for r in group]
+        waits = [float((r.get("attrs") or {}).get("queue_ms", 0.0))
+                 for r in group
+                 if "queue_ms" in (r.get("attrs") or {})]
+        out = {
+            "requests": len(group),
+            "request_ms_p50": round(_quantile(ms, 0.50), 4),
+            "request_ms_p99": round(_quantile(ms, 0.99), 4),
+            "queue_ms_p50": round(_quantile(waits, 0.50), 4),
+            "queue_ms_p99": round(_quantile(waits, 0.99), 4),
+        }
+        return out
+
+    # Per-replica and per-lane breakdowns (the fleet's fairness + skew
+    # evidence): replica ids come from span attrs (engines tag their
+    # spans with their REPLICA_IDS member), lanes are the batcher's
+    # queues — per-lane queue_ms is THE fair-queueing number the
+    # sustained-load gate bounds.
+    by_replica: Dict[str, List[Dict[str, Any]]] = {}
+    by_lane: Dict[str, List[Dict[str, Any]]] = {}
+    for r in reqs:
+        attrs = r.get("attrs") or {}
+        if attrs.get("replica"):
+            by_replica.setdefault(str(attrs["replica"]), []).append(r)
+        if attrs.get("lane"):
+            by_lane.setdefault(str(attrs["lane"]), []).append(r)
+    if by_replica:
+        serve["replicas"] = {rid: _req_stats(group)
+                             for rid, group in sorted(by_replica.items())}
+    if by_lane:
+        serve["lanes"] = {lane: _req_stats(group)
+                          for lane, group in sorted(by_lane.items())}
+
+    # Adaptive flush-policy audit: every controller decision is an
+    # event; the report replays the decision history (counts by action
+    # and replica, and each replica's final thresholds) from the trace
+    # alone.
+    policy_events = named(instants, ("serve.flush_policy",))
+    if policy_events:
+        by_action: Dict[str, int] = {}
+        last_by_replica: Dict[str, Dict[str, Any]] = {}
+        moved: Dict[str, int] = {}
+        for e in policy_events:
+            attrs = e.get("attrs") or {}
+            action = str(attrs.get("action", "?"))
+            by_action[action] = by_action.get(action, 0) + 1
+            rid = str(attrs.get("replica", "?"))
+            last_by_replica[rid] = {
+                "fraction": attrs.get("fraction"),
+                "fill_slots": attrs.get("fill_slots"),
+                "p99_ms": attrs.get("p99_ms"),
+            }
+            if action in ("raise", "lower"):
+                moved[rid] = moved.get(rid, 0) + 1
+        serve["flush_policy"] = {
+            "decisions": len(policy_events),
+            "by_action": by_action,
+            "moves_by_replica": moved,
+            "final_by_replica": last_by_replica,
+        }
+
     # --- checkpointing: async overlap + supersede/drain accounting ------
     # ckpt.copy is the step-blocking portion (the submit-side host-copy
     # start); ckpt.write/ckpt.commit run on the writer thread. A write

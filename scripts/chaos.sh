@@ -1,7 +1,7 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering ten fault classes — simulated preemption (kill-and-resume must
-# be bit-for-bit deterministic), NaN loss (rollback self-healing),
+# covering eleven fault classes — simulated preemption (kill-and-resume
+# must be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
 # requeue), serving flush failure (one flush fails alone), corrupt-corpus
 # quarantine, a mid-epoch kill under ASYNC checkpointing resumed on a
@@ -10,10 +10,13 @@
 # quarantine on attempt-cap, the sweep completes with an exact manifest),
 # a REAL SIGTERM to a mid-epoch `cli fit` subprocess (preempt_drain:
 # step-granular preempt snapshot, bit-continuous mid-epoch resume, and the
-# hung-step watchdog forcing a durable exit out of a wedged step), and a
+# hung-step watchdog forcing a durable exit out of a wedged step), a
 # SIGTERM lame-duck drain of a live `cli serve` subprocess under load
 # (serve_lame_duck: zero dropped admitted requests, 503 + Retry-After for
-# new ones, drain inside the grace budget, compiles flat).
+# new ones, drain inside the grace budget, compiles flat), and a rolling
+# replica drain of a 3-replica serving fleet mid-load (fleet_roll: the
+# rolled replica's admissions all answered, the other two keep serving,
+# /healthz degrades then recovers, zero compiles across the roll).
 # Exits nonzero on any missed recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak

@@ -48,17 +48,20 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
 # re-featurized (one cache miss), untouched verdicts byte-identical, and
 # zero serve-engine compiles after warmup. No JVM, single device, seconds.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli scan --smoke
-# Chaos soak: ten injected fault classes against a tiny run — resume
+# Chaos soak: eleven injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
 # serving flush isolation, corrupt-corpus quarantine+bitwise-clean
 # training, a mid-epoch kill under async checkpointing resumed on a
 # different device count, pooled Joern workers killed/hung mid-scan
 # (retry + quarantine, the sweep still completes), a REAL SIGTERM to a
 # mid-epoch fit subprocess (preempt_drain: step-granular snapshot,
-# bit-continuous mid-epoch resume, hung-step watchdog), and a SIGTERM
+# bit-continuous mid-epoch resume, hung-step watchdog), a SIGTERM
 # lame-duck drain of a live serve subprocess (serve_lame_duck: zero
-# dropped admitted requests, 503 for new ones). Fails in minutes if a
-# recovery contract regressed; the eval below would never notice.
+# dropped admitted requests, 503 for new ones), and a rolling replica
+# drain of a 3-replica serving fleet mid-load (fleet_roll: admissions
+# all answered, survivors keep serving, /healthz degrades-then-recovers,
+# compiles flat). Fails in minutes if a recovery contract regressed; the
+# eval below would never notice.
 bash scripts/chaos.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

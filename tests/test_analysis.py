@@ -1127,6 +1127,92 @@ class Coordinator:
     assert "GL017" not in rules_of(src)
 
 
+def test_gl018_dispatch_under_module_and_class_lock_fires():
+    # The two shared-lock scopes the rule names: a module-level lock and
+    # a class-body lock reached through self — each wrapped around a
+    # step-shaped dispatch or an explicit device wait. This is the
+    # "parallel front-end at 1-replica throughput" shape.
+    src = """
+import threading
+
+import jax
+
+_LOCK = threading.Lock()
+
+def pump(step_fn, state, batch):
+    with _LOCK:
+        state, loss = step_fn(state, batch)
+    return state
+
+class Server:
+    _lock = threading.RLock()
+
+    def wait(self, out):
+        with self._lock:
+            return jax.block_until_ready(out)
+"""
+    found = findings_for(src, "GL018")
+    assert len(found) == 2
+    assert any("module-level lock `_LOCK`" in f.message for f in found)
+    assert any("class-level lock `self._lock`" in f.message for f in found)
+
+
+def test_gl018_instance_lock_and_lockless_dispatch_unflagged():
+    # The accepted shapes: an instance lock created in __init__ guarding
+    # only state mutation (the micro-batcher handoff idiom), dispatch
+    # OUTSIDE the critical section, and non-dispatch work under a module
+    # lock. Unknown-provenance locks (parameters) also stay unflagged.
+    src = """
+import threading
+
+import jax
+
+_LOCK = threading.Lock()
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def admit(self, req):
+        with self._lock:
+            self.pending.append(req)
+
+    def flush(self, step_fn, state):
+        with self._lock:
+            reqs = list(self.pending)
+            self.pending.clear()
+        state, loss = step_fn(state, reqs)
+        return jax.block_until_ready(loss)
+
+def bookkeeping(n):
+    with _LOCK:
+        return n + 1
+
+def borrowed(lock, step_fn, state, batch):
+    with lock:
+        return step_fn(state, batch)
+
+class Config:
+    _lock = threading.RLock()  # some OTHER class's class-level lock
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()  # instance lock, same attr name
+
+    def run(self, step_fn, state, batch):
+        # Must stay unflagged: Worker's _lock is instance-scoped; the
+        # name collision with Config's class-body lock is irrelevant.
+        with self._lock:
+            return step_fn(state, batch)
+
+def peer(batcher, step_fn, state, batch):
+    with batcher._lock:  # parameter receiver: unknown provenance
+        return step_fn(state, batch)
+"""
+    assert "GL018" not in rules_of(src)
+
+
 def test_gl017_lifecycle_module_is_the_clean_reference():
     # The rule's docstring points at resilience/lifecycle.py as the
     # accepted shape; the module must stay GL017-clean (and clean of
@@ -1413,8 +1499,8 @@ def test_self_check_covers_every_rule_implementation():
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
-                             "GL016", "GL017"})
-    assert len(RULES) == 17
+                             "GL016", "GL017", "GL018"})
+    assert len(RULES) == 18
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

@@ -1,0 +1,569 @@
+"""Serving fleet (deepdfa_tpu/serve/fleet.py + policy.py): replica/device
+assignment, content-affine routing, the continuous-batching admission
+property, offline parity across replicas, adaptive flush policy
+(clamps/hysteresis/audit events), the open-loop sustained-load replay,
+and the fleet-aggregated HTTP surfaces.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+from deepdfa_tpu.core.metrics import ServingStats
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.serve import (
+    REPLICA_IDS,
+    AdaptiveFlushPolicy,
+    MicroBatcher,
+    ServeConfig,
+    ServeEngine,
+    ServeFleet,
+)
+from deepdfa_tpu.serve.engine import random_gnn_params
+from deepdfa_tpu.serve.replay import (
+    ReplicaTimeline,
+    VirtualClock,
+    open_loop_trace,
+    replay_fleet,
+)
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+def graphs_n(n, seed=0):
+    return synthetic_bigvul(n, FEAT, positive_fraction=0.5, seed=seed)
+
+
+def _build_fleet(n, config=None, clock=None, **kw):
+    """A tiny gnn-only fleet; with ``clock`` (a VirtualClock) each
+    replica gets its own ReplicaTimeline view — the replay topology."""
+    config = config or ServeConfig(batch_slots=4, deadline_ms=100.0)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+    if clock is not None:
+        timelines = [ReplicaTimeline(clock) for _ in range(n)]
+        kw["clock_factory"] = lambda i: timelines[i]
+    return ServeFleet.build(model, params, config=config, n_replicas=n,
+                            **kw)
+
+
+# ---------------------------------------------------------------------------
+# Replica/device assignment (parallel/mesh.py)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_device_shards_partition():
+    from deepdfa_tpu.parallel.mesh import replica_device_shards
+
+    devices = jax.devices()
+    shards = replica_device_shards(2)
+    assert len(shards) == 2
+    if len(devices) >= 2:
+        # Contiguous, disjoint, covering blocks.
+        assert shards[0][0] is devices[0]
+        assert not set(d.id for d in shards[0]) & set(d.id
+                                                      for d in shards[1])
+    else:
+        assert shards[0][0] is devices[0] and shards[1][0] is devices[0]
+    # More replicas than devices: round-robin, never empty.
+    many = replica_device_shards(len(devices) + 3)
+    assert all(len(s) == 1 for s in many)
+    if len(devices) >= 3:
+        # Non-dividing counts spread the remainder: every device lands
+        # in exactly one shard, none idle.
+        uneven = replica_device_shards(3)
+        covered = [d.id for s in uneven for d in s]
+        assert sorted(covered) == sorted(d.id for d in devices)
+    with pytest.raises(ValueError):
+        replica_device_shards(0)
+
+
+def test_fleet_replicas_pin_distinct_devices():
+    fleet = _build_fleet(2)
+    assert [r.rid for r in fleet.replicas] == ["r0", "r1"]
+    if jax.device_count() >= 2:
+        d0 = fleet.replicas[0].devices[0]
+        d1 = fleet.replicas[1].devices[0]
+        assert d0.id != d1.id
+
+
+# ---------------------------------------------------------------------------
+# Per-replica metrics: statically-enumerated predeclare (GL014 discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_predeclare_literals_match_the_real_enumerations():
+    """The predeclare loops iterate LITERAL tuples (so GL014's
+    static-collection exemption applies); this pins them against the
+    canonical enumerations so they cannot drift silently."""
+    import ast
+    import inspect
+
+    from deepdfa_tpu.serve import fleet as fleet_mod
+
+    src = inspect.getsource(fleet_mod.predeclare_fleet_metrics)
+    tree = ast.parse(src.lstrip())
+    literal_tuples = [
+        tuple(e.value for e in node.elts)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Tuple)
+        and all(isinstance(e, ast.Constant) for e in node.elts)
+        and node.elts
+    ]
+    assert REPLICA_IDS in literal_tuples
+    assert tuple(ServingStats.COUNTERS) in literal_tuples
+
+
+def test_fleet_metrics_predeclared_and_tagged():
+    fleet = _build_fleet(2)
+    snap = telemetry.REGISTRY.snapshot()
+    for rid in ("r0", "r1"):
+        for counter in ServingStats.COUNTERS:
+            assert f"serve_{rid}_{counter}_total" in snap
+        assert f"serve_{rid}_latency_ms" in snap
+    # Tagged stats land on the replica's own series.
+    fleet.warmup()
+    before = telemetry.REGISTRY.counter("serve_r0_completed_total").value
+    r0 = fleet.replicas[0].engine
+    r0.submit(graphs_n(1, seed=3)[0])
+    r0.drain()
+    assert telemetry.REGISTRY.counter(
+        "serve_r0_completed_total").value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Routing: content affinity + the continuous-batching admission property
+# ---------------------------------------------------------------------------
+
+
+def test_route_is_content_stable_and_drain_aware():
+    fleet = _build_fleet(3)
+    picks = {fleet.route("key-A").rid for _ in range(8)}
+    assert len(picks) == 1  # rendezvous: same key, same replica
+    (rid,) = picks
+    fleet.begin_replica_drain(rid)
+    assert fleet.route("key-A").rid != rid  # drained replica leaves rotation
+    fleet.restore_replica(rid)
+    assert fleet.route("key-A").rid == rid
+
+
+def test_admission_never_waits_on_a_busy_replica():
+    """THE continuous-batching admission property: a request arriving
+    while one replica's bucket is in flight routes to a replica with
+    bucket capacity instead of queueing behind the flush."""
+    fleet = _build_fleet(2)
+    fleet.warmup()
+    # Find a key preferring r0, then make r0 busy (bucket mid-flush).
+    key = next(f"k{i}" for i in range(64)
+               if fleet.route(f"k{i}").rid == "r0")
+    fleet.replicas[0].engine.in_flight = 3
+    try:
+        assert fleet.route(key).rid == "r1"
+    finally:
+        fleet.replicas[0].engine.in_flight = 0
+    # Saturated-but-idle preferred replica also yields.
+    cfg = fleet.config
+    model_graphs = graphs_n(cfg.batch_slots, seed=5)
+    for g in model_graphs:
+        fleet.replicas[0].engine.submit(g)
+    try:
+        assert fleet.route(key).rid == "r1"
+    finally:
+        fleet.replicas[0].engine.drain()
+
+
+def test_admission_during_inflight_flush_is_answered_by_sibling():
+    """End to end over real pump threads: while replica A's flush sleeps
+    on an injected 0.6 s device delay, a request arriving mid-flight is
+    answered by the sibling in a normal flush cycle — it never waits out
+    A's in-flight bucket."""
+    from deepdfa_tpu.resilience import inject
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=4, deadline_ms=100.0)
+    fleet = _build_fleet(2, config=config)
+    fleet.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), fleet)
+    server.start_pump()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def payload(g):
+        return {"graph": {"num_nodes": int(g["num_nodes"]),
+                          "senders": np.asarray(g["senders"]).tolist(),
+                          "receivers": np.asarray(g["receivers"]).tolist(),
+                          "feats": {k: np.asarray(v).tolist()
+                                    for k, v in g["feats"].items()}}}
+
+    g1, g2 = graphs_n(2, seed=7)
+    # Only the FIRST flush in the process sleeps 0.6 s.
+    plan = inject.FaultPlan.from_doc({"faults": [
+        {"site": "serve.batch", "kind": "delay", "at": 0,
+         "seconds": 0.6}]})
+    timing = {}
+
+    def slow_post():
+        t0 = time.monotonic()
+        timing["slow"] = (post({"functions": [payload(g1)]}),
+                          time.monotonic() - t0)
+
+    try:
+        with inject.armed(plan):
+            t = threading.Thread(target=slow_post)
+            t.start()
+            time.sleep(0.25)  # its deadline-flush started, delay holding
+            t0 = time.monotonic()
+            fast = post({"functions": [payload(g2)]})
+            fast_s = time.monotonic() - t0
+            t.join(timeout=10.0)
+        assert "prob" in fast["results"][0]
+        assert fast_s < 0.45, f"arrival waited out the in-flight flush " \
+                              f"({fast_s:.3f}s)"
+        slow_result, slow_s = timing["slow"]
+        assert "prob" in slow_result["results"][0]
+        assert slow_s > 0.55  # the delayed flush really was in flight
+    finally:
+        server.shutdown()
+
+
+def test_batcher_late_join_seals_at_dispatch():
+    """A deadline-due partial bucket absorbs admissions that land before
+    take(): the bucket seals at dispatch, not when the condition first
+    held (continuous batching inside one replica)."""
+    from deepdfa_tpu.serve.batcher import ServeRequest
+
+    def req(rid, arrival):
+        g = {"num_nodes": 2, "senders": np.zeros(1, np.int32),
+             "receivers": np.ones(1, np.int32), "feats": {}}
+        return ServeRequest(rid=rid, key=f"k{rid}", graph=g, lane="gnn",
+                            arrival=arrival, deadline_s=0.1)
+
+    b = MicroBatcher(ServeConfig(batch_slots=8, queue_capacity=16))
+    b.admit(req(0, arrival=0.0))
+    assert b.due(now=0.06) == "gnn"   # deadline-due, not yet dispatched
+    b.admit(req(1, arrival=0.06))     # late arrival joins the open bucket
+    assert [r.rid for r in b.take("gnn")] == [0, 1]
+
+
+def test_set_flush_policy_clamps():
+    cfg = ServeConfig(batch_slots=8, flush_fraction_min=0.2,
+                      flush_fraction_max=0.8)
+    b = MicroBatcher(cfg)
+    b.set_flush_policy(fraction=0.01, fill_slots=0)
+    assert b.flush_fraction == pytest.approx(0.2)
+    assert b.fill_slots == 1
+    b.set_flush_policy(fraction=5.0, fill_slots=99)
+    assert b.flush_fraction == pytest.approx(0.8)
+    assert b.fill_slots == 8
+
+
+# ---------------------------------------------------------------------------
+# Offline parity: the fleet answers byte-identical to one engine
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_offline_parity_200_requests_zero_compiles():
+    """The acceptance gate, fleet edition: 200 requests through a
+    3-replica fleet score byte-identically to the single-engine offline
+    path, with zero post-warmup compiles across ALL replicas."""
+    config = ServeConfig(batch_slots=8, deadline_ms=100.0)
+    model = FlowGNN(TINY)
+    params = random_gnn_params(model, config)
+
+    single = ServeEngine(model, params, config=config,
+                         clock=VirtualClock())
+    single.warmup()
+    gs = graphs_n(200, seed=1)
+    ref = single.score_sync(gs)
+
+    fleet = ServeFleet.build(model, params, config=config, n_replicas=3,
+                             clock=time.monotonic)
+    fleet.warmup()
+    got = fleet.score_sync(gs)
+
+    assert fleet.compiles_after_warmup == 0
+    for r in fleet.replicas:
+        assert r.engine.compiles_after_warmup == 0
+    assert len(got) == len(ref) == 200
+    for a, b in zip(got, ref):
+        assert "prob" in a and "prob" in b
+        assert a["prob"] == b["prob"]  # byte-identical, not approx
+        assert a["model"] == b["model"]
+    # Every replica actually served (the router spread the work).
+    served = [r.engine.stats.completed for r in fleet.replicas]
+    assert all(s > 0 for s in served), served
+
+
+# ---------------------------------------------------------------------------
+# Adaptive flush policy: hysteresis, clamps, audit events
+# ---------------------------------------------------------------------------
+
+
+def test_policy_hysteresis_and_clamps():
+    cfg = ServeConfig(batch_slots=8, deadline_ms=100.0,
+                      adaptive_flush=True, adaptive_patience=2,
+                      adaptive_step=0.2, flush_fraction_min=0.1,
+                      flush_fraction_max=0.9)
+    pol = AdaptiveFlushPolicy(cfg)
+    target = cfg.adaptive_target_p99_frac * cfg.deadline_ms
+    # One over-target window: hold (hysteresis).
+    d1 = pol._decide(target * 2, occupancy=0.9)
+    assert d1.action == "hold" and d1.fraction == pytest.approx(0.5)
+    # Second consecutive: lower one step, fill halves.
+    d2 = pol._decide(target * 2, occupancy=0.9)
+    assert d2.action == "lower"
+    assert d2.fraction == pytest.approx(0.3)
+    assert d2.fill_slots == 4
+    # Pressure forever: clamps at the floor, never below.
+    for _ in range(20):
+        d = pol._decide(target * 2, occupancy=0.9)
+    assert d.fraction == pytest.approx(cfg.flush_fraction_min)
+    assert d.fill_slots == 1
+    # Comfortable + empty buckets: raises (after patience), clamps at max.
+    for _ in range(40):
+        d = pol._decide(1.0, occupancy=0.1)
+    assert d.fraction == pytest.approx(cfg.flush_fraction_max)
+    assert d.fill_slots == cfg.batch_slots
+    # A mid-band window resets both streaks.
+    pol._pressure = 1
+    d = pol._decide(target * 0.7, occupancy=0.9)
+    assert d.action == "hold" and pol._pressure == 0
+
+
+def test_policy_decisions_are_trace_events(tmp_path):
+    """Every evaluation — moves AND holds — lands in the trace as a
+    serve.flush_policy event with the full decision record (the audit
+    the tentpole demands), rate-limited on the engine clock."""
+    from deepdfa_tpu.telemetry.export import read_events
+    from deepdfa_tpu.telemetry.report import events_path_of, summarize
+
+    cfg = ServeConfig(batch_slots=4, deadline_ms=100.0,
+                      adaptive_flush=True, adaptive_interval_s=0.25,
+                      adaptive_patience=1)
+    clock = VirtualClock()
+    model = FlowGNN(TINY)
+    pol = AdaptiveFlushPolicy(cfg, replica="r0")
+    eng = ServeEngine(model, random_gnn_params(model, cfg), config=cfg,
+                      clock=clock, replica="r0", policy=pol)
+    run_dir = str(tmp_path / "run")
+    with telemetry.run_scope(run_dir):
+        eng.warmup()
+        for i in range(6):
+            # Slow requests: p99 over target -> pressure -> "lower".
+            eng.stats.observe_latency(0.5)
+            eng.submit(graphs_n(1, seed=20 + i)[0])
+            clock.advance(1.0)
+            eng.pump()
+        telemetry.flush()
+    events = read_events(events_path_of(run_dir))
+    decisions = [e for e in events
+                 if e.get("name") == "serve.flush_policy"]
+    assert len(decisions) >= 3
+    attrs = decisions[-1].get("attrs") or {}
+    assert attrs["replica"] == "r0"
+    assert {"action", "fraction", "fill_slots", "p99_ms", "occupancy",
+            "target_p99_ms"} <= set(attrs)
+    assert any((e.get("attrs") or {}).get("action") == "lower"
+               for e in decisions)
+    # Interval rate limit held: no more evaluations than pump rounds.
+    assert len(decisions) <= 6
+    # The trace report replays the controller history.
+    rep = summarize(events)
+    fp = rep["serve"]["flush_policy"]
+    assert fp["decisions"] == len(decisions)
+    assert fp["moves_by_replica"].get("r0", 0) >= 1
+    assert fp["final_by_replica"]["r0"]["fraction"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Open-loop sustained load: throughput scales, lanes stay fair
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_replay_sustained_load_scales_and_completes():
+    """The same open-loop trace through 1 and 3 replicas: everything is
+    answered or shed (open-loop backpressure), zero post-warmup compiles
+    fleet-wide, admitted p99 under the deadline, and the fleet's
+    saturation throughput beats the single replica's."""
+    cfg = ServeConfig(batch_slots=8, deadline_ms=200.0,
+                      queue_capacity=64, cache_capacity=0)
+    trace = open_loop_trace(240, FEAT, seed=2, rps=6000.0,
+                            duplicate_fraction=0.0)
+    primer = graphs_n(sum(cfg.slot_buckets), seed=99)
+
+    def run(n):
+        clock = VirtualClock()
+        fleet = _build_fleet(n, config=cfg, clock=clock)
+        fleet.warmup()
+        # Execute every bucket once: AOT warmup only compiles, and
+        # first-execution cost would skew the 1-vs-3 comparison toward
+        # the fleet with fewer executables.
+        fleet.prime(primer)
+        return replay_fleet(fleet, trace, clock)
+
+    solo = run(1)
+    multi = run(3)
+    for rep in (solo, multi):
+        assert rep["completed"] + rep["shed"] == 240
+        assert rep["compiles_after_warmup"] == 0
+        assert rep["latency_p99_ms"] <= cfg.deadline_ms
+    # Queue-limited -> hardware-limited: at identical offered overload,
+    # the single replica must shed what the fleet absorbs and answers.
+    # Deliberately NO rps comparison here: at this tiny-flush scale the
+    # measured per-flush wall time is dominated by per-dispatch overhead
+    # that swings with CI contention, and the two runs' different shed
+    # profiles give completed/span different meanings — the >=2x
+    # capacity ratio lives in bench_serve_fleet, where ~8 ms flushes
+    # make it stable (measured 3.7x).
+    assert solo["shed"] > 0, "trace did not saturate the single replica"
+    assert multi["shed"] < solo["shed"]
+    assert multi["completed"] > solo["completed"]
+    assert multi["rps"] > 0 and solo["rps"] > 0
+
+
+def test_fleet_replay_mixed_lanes_fair_queueing(tmp_path):
+    """Mixed gnn/combined traffic over a 2-replica combined fleet: both
+    lanes complete and neither lane's p99 starves (fair queueing across
+    lanes, asserted from the replay AND visible per-lane in the trace
+    report)."""
+    import dataclasses
+
+    from deepdfa_tpu.data.text import HashingCodeTokenizer
+    from deepdfa_tpu.models.linevul import LineVul
+    from deepdfa_tpu.models.transformer import EncoderConfig
+    from deepdfa_tpu.serve.engine import bucket_batch
+    from deepdfa_tpu.telemetry.export import read_events
+    from deepdfa_tpu.telemetry.report import events_path_of, summarize
+
+    enc = dataclasses.replace(EncoderConfig.tiny(),
+                              max_position_embeddings=70)
+    cfg = ServeConfig(batch_slots=2, block_size=32, deadline_ms=200.0,
+                      cache_capacity=0)
+    gnn = FlowGNN(TINY)
+    gnn_params = random_gnn_params(gnn, cfg)
+    comb = LineVul(enc, graph_config=dataclasses.replace(
+        TINY, encoder_mode=True))
+    empty = bucket_batch(cfg, [], 2,
+                         ("api", "datatype", "literal", "operator"))
+    comb_params = comb.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jax.numpy.zeros((2, 32), jax.numpy.int32), empty,
+        deterministic=True)
+    clock = VirtualClock()
+    timelines = [ReplicaTimeline(clock) for _ in range(2)]
+    fleet = ServeFleet.build(
+        gnn, gnn_params, config=cfg, n_replicas=2,
+        combined_model=comb, combined_params=comb_params,
+        tokenizer=HashingCodeTokenizer(enc.vocab_size),
+        clock_factory=lambda i: timelines[i])
+    run_dir = str(tmp_path / "run")
+    with telemetry.run_scope(run_dir):
+        fleet.warmup()
+        trace = open_loop_trace(60, FEAT, seed=3, rps=500.0,
+                                duplicate_fraction=0.0, code_fraction=0.4)
+        rep = replay_fleet(fleet, trace, clock)
+        telemetry.flush()
+    assert rep["shed"] == 0 and rep["completed"] == 60
+    assert rep["compiles_after_warmup"] == 0
+    assert set(rep["lanes"]) == {"gnn", "combined"}
+    for lane, stats in rep["lanes"].items():
+        assert stats["requests"] > 0
+        assert stats["latency_p99_ms"] <= cfg.deadline_ms, lane
+    # Per-lane + per-replica sections from the trace alone.
+    trace_rep = summarize(read_events(events_path_of(run_dir)))
+    assert set(trace_rep["serve"]["lanes"]) == {"gnn", "combined"}
+    assert set(trace_rep["serve"]["replicas"]) == {"r0", "r1"}
+    for lane_stats in trace_rep["serve"]["lanes"].values():
+        assert lane_stats["queue_ms_p99"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP aggregation + per-replica roll
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_http_metrics_health_and_roll():
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    fleet = _build_fleet(2, config=ServeConfig(batch_slots=2,
+                                               deadline_ms=40.0))
+    fleet.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), fleet)
+    server.start_pump()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(f"{base}{path}",
+                                        timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        gs = graphs_n(6, seed=9)
+        payload = [{"graph": {
+            "num_nodes": int(g["num_nodes"]),
+            "senders": np.asarray(g["senders"]).tolist(),
+            "receivers": np.asarray(g["receivers"]).tolist(),
+            "feats": {k: np.asarray(v).tolist()
+                      for k, v in g["feats"].items()},
+        }} for g in gs]
+        out = post({"functions": payload[:4]})
+        assert all("prob" in r for r in out["results"])
+
+        status, metrics = get("/metrics")
+        assert status == 200
+        assert metrics["n_replicas"] == 2
+        assert set(metrics["replicas"]) == {"r0", "r1"}
+        assert metrics["completed"] == sum(
+            m["completed"] for m in metrics["replicas"].values())
+
+        status, health = get("/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["fleet"]["live"] == 2
+
+        # Roll r1: fleet degrades (503 for balancers) but keeps serving,
+        # then recovers; re-entry costs zero compiles.
+        compiles0 = metrics["compiles"]
+        fleet.begin_replica_drain("r1")
+        status, health = get("/healthz")
+        assert status == 503 and health["status"] == "degraded"
+        assert health["fleet"]["replicas"]["r1"]["status"] == "draining"
+        served_mid = post({"functions": payload[4:]})
+        assert all("prob" in r for r in served_mid["results"])
+        assert fleet.await_replica_drained("r1", deadline_s=10.0)
+        fleet.restore_replica("r1")
+        status, health = get("/healthz")
+        assert status == 200 and health["status"] == "ok"
+        _, metrics2 = get("/metrics")
+        assert metrics2["compiles"] == compiles0  # a roll never compiles
+    finally:
+        server.shutdown()
