@@ -972,7 +972,9 @@ def _gen_decode_setup(batch_size: int = 48, src_len: int = 256):
 
 def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
                      src_len: int = 256, max_len: int = 128,
-                     n_calls: int = 3, setup=None):
+                     n_calls: int = 3, setup=None,
+                     beam_impl: str = "batched",
+                     gather_impl: str = "take_along"):
     """Generation decode throughput at the summarize shape: codet5-base,
     256-token sources, 128 generated tokens, batch 48 (exp.resolve's
     reference table) — the loop the reference times in its generation eval
@@ -997,24 +999,52 @@ def bench_gen_decode(beam_size: int = 1, batch_size: int = 48,
     intensity ~1 FLOP/byte at batch 48 — each step re-reads the decoder
     params and the whole KV cache to produce one token per row); the
     greedy step's ~1 GB/step traffic at the measured rate is ~0.3-0.4 of
-    the chip's HBM peak, and the beam step adds the cache gather
-    (read+write of the full self cache per step).
+    the chip's HBM peak. Beam cache movement depends on ``beam_impl``:
+
+    - "batched" (default, ISSUE 13): ONE physical [B*K] cache, ancestry
+      resolved at attention-read time — per-step cache traffic is the
+      read attention performs anyway (~2.3 GB at this shape); the
+      reorder is a [B,K,T] int32 gather in the scan body.
+    - "reference": the pre-ISSUE-13 formulation — the whole self cache
+      take_along_axis-gathered through HBM every step (read + gather +
+      write ≈ 3× the cache bytes, ~6.8 GB/step) — kept so the A/B that
+      justifies the layout stays runnable per backend.
+
+    ``gather_impl`` A/Bs how the batched read resolves ancestry
+    ("take_along" vs "onehot"); the one-hot bmm reads K× the cache and
+    measured a LOSS on both v5e and CPU, which is why take_along is the
+    default (ISSUE 13 gate). Early exit is DISABLED here so tokens/s
+    counts exactly batch * max_len steps of compute — comparable across
+    impls and to the recorded trajectory.
     """
     import jax.numpy as jnp
 
-    from deepdfa_tpu.models.t5_generate import generate
+    from deepdfa_tpu.models.t5_generate import (
+        beam_search,
+        beam_search_reference,
+        greedy_decode,
+    )
 
     model, params, src = setup or _gen_decode_setup(batch_size, src_len)
     # The setup's shapes are authoritative — a prebuilt setup at another
     # shape must not silently mislabel the per-example math.
     batch_size, src_len = src.shape
+    if beam_impl not in ("batched", "reference"):
+        raise ValueError(f"beam_impl {beam_impl!r}")
 
     def decode(params, src, prev):
         # Chain calls through a data dependency (the infer-bench barrier
         # pattern) so the timed sequence cannot overlap on the device.
         src = src.at[0, 0].add((prev * 0).astype(src.dtype))
-        seq = generate(model, params, src, max_len=max_len,
-                       beam_size=beam_size)
+        if beam_size <= 1:
+            seq = greedy_decode(model, params, src, max_len)
+        elif beam_impl == "reference":
+            seq, _ = beam_search_reference(model, params, src, max_len,
+                                           beam_size)
+        else:
+            seq, _ = beam_search(model, params, src, max_len, beam_size,
+                                 gather_impl=gather_impl,
+                                 early_exit=False)
         return seq, seq[0, 0]
 
     step = jax.jit(decode).lower(params, src, jnp.zeros((), jnp.int32)).compile()
@@ -1485,11 +1515,18 @@ def main() -> None:
     # beam-10 eval decoding at the summarize shape. No baseline number
     # exists (BASELINE.md has no decode measurement); HBM-bound — see
     # bench_gen_decode's docstring for the rationale and the layout/dedup
-    # A/Bs behind the defaults.
+    # A/Bs behind the defaults. Since ISSUE 13 the beam metric measures
+    # the batched ancestry-cache implementation; the _ref row is the same
+    # shape on the old gather-every-step formulation, so the history
+    # carries the A/B that justifies the layout (the pre-13 v5e rows of
+    # gen_decode_tokens_per_sec_beam10 ARE the reference trajectory).
     decode_setup = _gen_decode_setup()
     decode_greedy = bench_gen_decode(beam_size=1, setup=decode_setup)
     decode_beam10 = bench_gen_decode(beam_size=10, n_calls=2,
                                      setup=decode_setup)
+    decode_beam10_ref = bench_gen_decode(beam_size=10, n_calls=2,
+                                         setup=decode_setup,
+                                         beam_impl="reference")
     extras += [
         {
             "metric": "gen_decode_tokens_per_sec",
@@ -1512,6 +1549,21 @@ def main() -> None:
             "model": "codet5_base",
             "src_len": 256,
             "max_len": 128,
+            "beam_impl": "batched",
+            "vs_reference_impl": (round(decode_beam10 / decode_beam10_ref,
+                                        3) if decode_beam10_ref else None),
+        },
+        {
+            "metric": "gen_decode_tokens_per_sec_beam10_ref",
+            "value": round(decode_beam10_ref, 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "beam_size": 10,
+            "batch_size": 48,
+            "model": "codet5_base",
+            "src_len": 256,
+            "max_len": 128,
+            "beam_impl": "reference",
         },
     ]
     final = headline(extras)

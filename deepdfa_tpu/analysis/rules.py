@@ -149,6 +149,27 @@ Rule catalogue (each backed by a positive+negative fixture in
                              locks of unknown provenance (parameters,
                              locals) stay unflagged — precision over
                              recall, the empty-baseline contract.
+  GL019 per-hypothesis-decode-dispatch  a jit-wrapped/step-shaped
+                             dispatch inside a Python ``for`` loop over a
+                             beam/hypothesis/decode-length axis (the loop
+                             target or iterable names the axis:
+                             ``for t in range(max_len)``, ``for hyp in
+                             beams``) when a ``lax.scan``-able carry
+                             exists — a name both assigned and read in
+                             the loop body (``cache``, ``state``). Each
+                             iteration then pays a fresh host dispatch of
+                             a device program: the per-hypothesis decode
+                             tax that held CodeT5 beam-10 12× under
+                             greedy until ISSUE 13 folded the loop into
+                             one batched ``lax.scan`` over the carry
+                             (models/t5_generate.py is the accepted
+                             shape). Loops with no carry (vmap-shaped
+                             independent work), loops that ``break``/
+                             ``return`` early (host-controlled exit the
+                             carry can't express without while_loop
+                             surgery), and data loops over batches stay
+                             unflagged — precision over recall, the
+                             empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -212,6 +233,7 @@ RULES: Dict[str, str] = {
     "GL016": "pallas-interpret-in-prod",
     "GL017": "unsafe-signal-handler",
     "GL018": "device-dispatch-under-shared-lock",
+    "GL019": "per-hypothesis-decode-dispatch",
 }
 
 _JIT_NAMES = frozenset({
@@ -328,6 +350,14 @@ _LOCK_CONSTRUCTORS = frozenset({
     "multiprocessing.Lock", "multiprocessing.RLock",
 })
 _DEVICE_WAIT_CALLS = frozenset({"jax.block_until_ready"})
+# GL019: identifier stems that name a beam/hypothesis/decode-length axis
+# — matched against the loop target and the iterable's source text.
+# Deliberately narrow (batch/epoch/step data loops must never match):
+# the decode-loop vocabulary, not loop vocabulary in general.
+_DECODE_AXIS_RE = re.compile(
+    r"\b(beams?|num_beams|beam_size|hyps?|hypotheses|hypothesis|"
+    r"max_len|max_length|max_target_length|max_new_tokens|decode_steps|"
+    r"decode_len)\b", re.IGNORECASE)
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -622,6 +652,8 @@ class _FunctionChecker:
         self._check_pallas_interpret()
         self._check_signal_handlers()
         self._check_lock_dispatch()
+        if not self.jit_scope:
+            self._check_per_hypothesis_dispatch()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -1505,6 +1537,69 @@ class _FunctionChecker:
                             "front-end at 1-replica throughput); hold "
                             "the lock only for state mutation and hand "
                             "work to the dispatch path through a queue")
+
+    # -- per-hypothesis decode dispatch (GL019) ------------------------------
+
+    @staticmethod
+    def _loop_carry_names(loop: ast.For) -> List[str]:
+        """Names both (hard-)assigned and read inside the loop body —
+        the lax.scan carry shape (``logits, cache = step(cache, tok)``).
+        The loop target itself is the axis, never the carry."""
+        targets: Set[str] = {
+            n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+        }
+        assigned: Set[str] = set()
+        read: Set[str] = set()
+        for sub in _walk_skip_defs(loop.body):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            assigned.add(n.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name):
+                assigned.add(sub.target.id)
+                read.add(sub.target.id)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                read.add(sub.id)
+        return sorted((assigned & read) - targets)
+
+    def _check_per_hypothesis_dispatch(self) -> None:
+        """``for t in range(max_len): _, cache = step(cache, ...)`` — the
+        hand-rolled decode loop: one host dispatch per token/hypothesis
+        where a single lax.scan over the carry would keep the device
+        saturated (the 12× beam-10 cliff ISSUE 13 closed). Flags only
+        loops whose axis vocabulary is decode-shaped AND that carry
+        state; no-carry loops and early-`break` loops stay unflagged."""
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.For):
+                continue
+            axis_text = " ".join(
+                [n.id for n in ast.walk(node.target)
+                 if isinstance(n, ast.Name)]
+                + [_expr_text(node.iter)])
+            m = _DECODE_AXIS_RE.search(axis_text)
+            if not m:
+                continue
+            if any(isinstance(sub, (ast.Break, ast.Return))
+                   for sub in _walk_skip_defs(node.body)):
+                continue  # host-controlled early exit: not scan-able as-is
+            carry = self._loop_carry_names(node)
+            if not carry:
+                continue  # independent per-item work: vmap's job, not scan's
+            for sub in _walk_skip_defs(node.body):
+                if isinstance(sub, ast.Call) and self._is_dispatch_call(sub):
+                    self._report(
+                        "GL019", sub,
+                        f"jit-wrapped/step-shaped dispatch inside a "
+                        f"Python loop over a decode axis (`{m.group(0)}`) "
+                        f"with scan-able carry `{carry[0]}` — every "
+                        "iteration pays a fresh host dispatch (the "
+                        "per-hypothesis decode tax); fold the loop into "
+                        "the program as one lax.scan over the carry "
+                        "(models/t5_generate.py's batched beam is the "
+                        "accepted shape)")
+                    break  # one finding per loop: the loop is the hazard
 
     # -- swallowed device exceptions (GL009) ---------------------------------
 

@@ -327,6 +327,12 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
       saturation throughput over a tiny open-loop trace (the ISSUE-12
       fleet mechanism: routing, per-replica batchers, continuous
       batching; bench.py carries the 4-replica headline).
+    * ``smoke_gen_decode_tok_per_sec`` — an AOT-compiled batched-beam
+      decode (ISSUE 13: one physical KV cache, ancestry resolved at
+      attention-read time, fixed trip count) on a tiny T5 — the
+      mechanism gate for the generation lane's hot loop; bench.py
+      carries the codet5-base beam-10 headline and its reference-impl
+      A/B row.
 
     Deliberately tiny shapes: the gate protects against *mechanism*
     regressions (a host sync creeping into the step loop, a validator
@@ -477,6 +483,33 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             raise AssertionError("fleet smoke recompiled after warmup")
         fleet_rps = max(fleet_rps, rep["rps"])
 
+    # Batched-beam decode mechanism smoke (ISSUE 13): tiny T5, beam 4,
+    # early exit OFF so tokens/s counts exactly batch * max_len steps
+    # (the comparable-trajectory rule bench_gen_decode documents).
+    import dataclasses as _dc
+
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+    from deepdfa_tpu.models.t5_generate import beam_search
+
+    gen_cfg = _dc.replace(T5Config.tiny(vocab_size=256), dropout_rate=0.0)
+    gen_model = T5Model(gen_cfg)
+    g_rng = np.random.RandomState(0)
+    gen_b, gen_src, gen_len, gen_beam = 4, 32, 16, 4
+    gen_src_ids = jax.numpy.asarray(
+        g_rng.randint(3, gen_cfg.vocab_size,
+                      size=(gen_b, gen_src)).astype(np.int32))
+    gen_params = gen_model.init(
+        jax.random.PRNGKey(0), gen_src_ids,
+        jax.numpy.zeros((gen_b, 4), jax.numpy.int32))
+    gen_step = jax.jit(
+        lambda p, s: beam_search(gen_model, p, s, gen_len, gen_beam,
+                                 early_exit=False)[0]
+    ).lower(gen_params, gen_src_ids).compile()
+
+    gen_dt = _best_of(lambda: gen_step(gen_params, gen_src_ids),
+                      n_steps // 4, reps)
+    gen_tps = (n_steps // 4) * gen_b * gen_len / gen_dt
+
     return {
         "smoke_gnn_train_graphs_per_sec": {
             "value": round(gps, 1), "unit": "graphs/s"},
@@ -488,4 +521,6 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(sigterm_ms, 2), "unit": "ms"},
         "smoke_serve_fleet_rps": {
             "value": round(fleet_rps, 1), "unit": "req/s"},
+        "smoke_gen_decode_tok_per_sec": {
+            "value": round(gen_tps, 1), "unit": "tok/s"},
     }

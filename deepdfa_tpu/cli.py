@@ -948,9 +948,79 @@ def _serve_config(args, block_size: Optional[int] = None):
         replicas=getattr(args, "replicas", 1),
         adaptive_flush=bool(getattr(args, "adaptive_flush", False)),
     )
+    if getattr(args, "gen_src_len", None) is not None:
+        kw["gen_src_len"] = args.gen_src_len
+        kw["gen_src_min_bucket"] = min(
+            ServeConfig.gen_src_min_bucket, args.gen_src_len)
+    if getattr(args, "gen_max_len", None) is not None:
+        kw["gen_max_len"] = args.gen_max_len
+    if getattr(args, "gen_beam", None) is not None:
+        kw["gen_beam_size"] = args.gen_beam
     if block_size is not None:
         kw["block_size"] = block_size
     return ServeConfig(**kw)
+
+
+def _build_gen_lane(args, serve_cfg):
+    """(gen_model, gen_params, gen_tokenizer) for the generation lane, or
+    (None, None, None) when not requested. ``--gen-checkpoint-dir``
+    restores a fit-gen run's params for the ``--gen-model`` shape;
+    ``--gen-lane`` alone serves RANDOM-INIT weights (smoke mode — the
+    decode stack is real, the tokens are not). ``--gen-tokenizer`` loads
+    the run's trained BPE assets; without it the hashing tokenizer is
+    only correct for hashing-encoded (synthetic) runs, and serving a
+    BPE-trained checkpoint through it would return confidently-wrong
+    tokens — hence the loud warning below."""
+    import dataclasses as _dc
+
+    if not (getattr(args, "gen_lane", False)
+            or getattr(args, "gen_checkpoint_dir", None)):
+        return None, None, None
+    import jax
+
+    from deepdfa_tpu.data.text import HashingT5Tokenizer
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    name = getattr(args, "gen_model", "tiny") or "tiny"
+    if name == "tiny":
+        tcfg = T5Config.tiny(vocab_size=256)
+    elif name == "codet5-small":
+        tcfg = T5Config.codet5_small()
+    elif name == "codet5-base":
+        tcfg = T5Config.codet5_base()
+    else:
+        raise ValueError(f"--gen-model {name!r}: expected tiny, "
+                         "codet5-small or codet5-base")
+    tcfg = _dc.replace(tcfg, dropout_rate=0.0)
+    if getattr(args, "gen_tokenizer", None):
+        from deepdfa_tpu.data.text import check_tok_vocab, load_bpe_tokenizer
+
+        tokenizer = load_bpe_tokenizer(args.gen_tokenizer)
+        check_tok_vocab(tokenizer, tcfg.vocab_size,
+                        pad_id=tcfg.pad_token_id, eos_id=tcfg.eos_token_id)
+    else:
+        tokenizer = HashingT5Tokenizer(vocab_size=tcfg.vocab_size)
+        if getattr(args, "gen_checkpoint_dir", None):
+            logger.warning(
+                "gen lane: restoring %s with the HASHING tokenizer — "
+                "correct only for checkpoints trained on hashing-encoded "
+                "(synthetic) data; a BPE-trained run needs its assets via "
+                "--gen-tokenizer or the served tokens are garbage",
+                args.gen_checkpoint_dir)
+    model = T5Model(tcfg)
+    if getattr(args, "gen_checkpoint_dir", None):
+        params = CheckpointManager(args.gen_checkpoint_dir).restore_params(
+            getattr(args, "gen_which", "best") or "best")
+    else:
+        logger.warning(
+            "gen lane on RANDOM-INIT weights (smoke mode — the decode "
+            "stack is real, the tokens are not)")
+        import numpy as _np
+
+        src = _np.zeros((1, serve_cfg.gen_src_len), _np.int32)
+        params = model.init(jax.random.PRNGKey(0), src, src[:, :4])
+    return model, params, tokenizer
 
 
 def _build_serve_engine(args):
@@ -1014,6 +1084,8 @@ def _build_serve_engine(args):
         )
         gnn_params = random_gnn_params(model, serve_cfg)
 
+    gen_model, gen_params, gen_tokenizer = _build_gen_lane(args, serve_cfg)
+
     if serve_cfg.replicas > 1:
         # The replicated fleet (deepdfa_tpu/serve/fleet.py): N engines,
         # each pinned to its shard of the device mesh and AOT-warmed
@@ -1028,6 +1100,8 @@ def _build_serve_engine(args):
             model, gnn_params, config=serve_cfg,
             combined_model=combined_model,
             combined_params=combined_params, tokenizer=tokenizer,
+            gen_model=gen_model, gen_params=gen_params,
+            gen_tokenizer=gen_tokenizer,
         )
         logger.info("serving fleet: %d replicas over %d device(s)",
                     fleet.size, jax.device_count())
@@ -1042,6 +1116,8 @@ def _build_serve_engine(args):
         model, gnn_params, config=serve_cfg,
         combined_model=combined_model, combined_params=combined_params,
         tokenizer=tokenizer, policy=policy,
+        gen_model=gen_model, gen_params=gen_params,
+        gen_tokenizer=gen_tokenizer,
     )
     return engine, model_cfg
 
@@ -1094,6 +1170,21 @@ def _smoke_http(engine, host: str, port: int, n: int,
             )["results"]
         # Duplicate the first chunk: CI-scan traffic, must hit the cache.
         dup = post({"functions": payload[:chunk]})["results"]
+        gen_ok = None
+        if getattr(server.fleet, "has_gen_lane", False):
+            # Generation-lane round (ISSUE 13): lane="gen" entries over
+            # real HTTP — tokens come back, and a byte-identical replay
+            # must answer from the content cache with zero new compiles
+            # (the SLO gate on the trace asserts the compile half).
+            gdoc = {"functions": [
+                {"id": i, "lane": "gen",
+                 "code": f"int gen_{i}(char *p) {{ return p[{i}]; }}"}
+                for i in range(3)
+            ]}
+            first_gen = post(gdoc)["results"]
+            replay_gen = post(gdoc)["results"]
+            gen_ok = (all("tokens" in r for r in first_gen)
+                      and all(r.get("cached") for r in replay_gen))
         scan_ok = None
         if scan_service is not None:
             # One POST /scan round-trip over real HTTP (raw source ->
@@ -1111,9 +1202,12 @@ def _smoke_http(engine, host: str, port: int, n: int,
             metrics = json.loads(resp.read())
         ok = (all("prob" in r for r in results)
               and all(r.get("cached") for r in dup)
-              and scan_ok is not False)
+              and scan_ok is not False
+              and gen_ok is not False)
         report = {"smoke": n, "ok": ok, "cached_replay": len(dup),
                   "metrics": metrics}
+        if gen_ok is not None:
+            report["gen_ok"] = gen_ok
         if scan_ok is not None:
             report["scan_ok"] = scan_ok
             report["scan"] = scan_service.snapshot()
@@ -1946,6 +2040,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "hysteresis; every decision is a "
                             "serve.flush_policy trace event; env "
                             "DEEPDFA_ADAPTIVE_FLUSH=1)")
+        # Generation lane (ISSUE 13): batched-beam CodeT5 decode served
+        # under the same AOT-warmup/zero-recompile discipline.
+        p.add_argument("--gen-lane", action="store_true",
+                       default=os.environ.get(
+                           "DEEPDFA_GEN_LANE", "") not in ("", "0"),
+                       help="attach the generation lane (lane='gen' on "
+                            "POST /score); without --gen-checkpoint-dir "
+                            "it serves random-init weights (smoke mode; "
+                            "env DEEPDFA_GEN_LANE=1)")
+        p.add_argument("--gen-model", default="tiny",
+                       choices=("tiny", "codet5-small", "codet5-base"),
+                       help="gen-lane model shape")
+        p.add_argument("--gen-checkpoint-dir", default=None,
+                       help="fit-gen run dir to restore gen params from "
+                            "(implies --gen-lane)")
+        p.add_argument("--gen-which", default="best")
+        p.add_argument("--gen-tokenizer", default=None, metavar="ASSETS",
+                       help="trained tokenizer assets for the gen lane "
+                            "(tokenizer.json / vocab+merges dir) — "
+                            "required for BPE-trained checkpoints; "
+                            "omitted: the hashing tokenizer (synthetic/"
+                            "smoke runs only)")
+        p.add_argument("--gen-src-len", type=int, default=None,
+                       help="gen-lane source-token cap / length-bucket "
+                            "ladder top (default ServeConfig)")
+        p.add_argument("--gen-max-len", type=int, default=None,
+                       help="generated tokens per request (static decode "
+                            "shape)")
+        p.add_argument("--gen-beam", type=int, default=None,
+                       help="beam width (1 = greedy)")
 
     # Streaming scan: the raw-source edge (deepdfa_tpu/scan). Shared by
     # `serve` (attaches POST /scan) and `scan` (offline sweeps). Env

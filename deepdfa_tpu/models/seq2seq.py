@@ -78,7 +78,8 @@ class _DecoderAttention(nn.Module):
     causal: bool = False
 
     @nn.compact
-    def __call__(self, x, kv, mask, deterministic, decode=False):
+    def __call__(self, x, kv, mask, deterministic, decode=False,
+                 beam_anc=None, beam_gather_impl="take_along"):
         c = self.cfg
         h = c.encoder.num_heads
         d = c.hidden_size
@@ -115,6 +116,13 @@ class _DecoderAttention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
                 ci.value = idx + 1
                 k, v = ck.value, cv.value
+                if beam_anc is not None:
+                    # Batched-beam decode: physical cache rows, ancestry
+                    # resolved at read time (models/t5.py ancestry_gather).
+                    from deepdfa_tpu.models.t5 import ancestry_gather
+
+                    k = ancestry_gather(k, beam_anc, beam_gather_impl)
+                    v = ancestry_gather(v, beam_anc, beam_gather_impl)
                 mask = (jnp.arange(k.shape[1]) <= idx)[None, None, None, :]
 
         # Beam-deduped cross K/V (models/beam_fold.py): the beam factor
@@ -144,12 +152,13 @@ class _DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, self_mask, enc_out, enc_mask, deterministic,
-                 decode=False):
+                 decode=False, beam_anc=None, beam_gather_impl="take_along"):
         c = self.cfg
         eps = c.encoder.layer_norm_eps
         drop = c.encoder.dropout_rate
         attn = _DecoderAttention(c, causal=True, name="self_attn")(
-            x, None, self_mask, deterministic, decode=decode
+            x, None, self_mask, deterministic, decode=decode,
+            beam_anc=beam_anc, beam_gather_impl=beam_gather_impl,
         )
         attn = nn.Dropout(drop)(attn, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=eps, name="self_ln")(x + attn)
@@ -215,7 +224,8 @@ class RobertaSeq2Seq(nn.Module):
         return hidden
 
     def decode(self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
-               deterministic: bool = True, decode: bool = False):
+               deterministic: bool = True, decode: bool = False,
+               beam_anc=None, beam_gather_impl: str = "take_along"):
         c = self.cfg
         x = self.shared(decoder_input_ids)
         positions = self.pos_cache(decoder_input_ids.shape[1], decode)
@@ -225,16 +235,20 @@ class RobertaSeq2Seq(nn.Module):
         cross_mask = enc_mask[:, None, None, :]
         for layer in self.layers:
             x = layer(x, self_mask, enc_out, cross_mask, deterministic,
-                      decode=decode)
+                      decode=decode, beam_anc=beam_anc,
+                      beam_gather_impl=beam_gather_impl)
         return x
 
     def logits(self, hidden):
         return hidden @ self.shared.embedding.T
 
     def decode_logits(self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
-                      deterministic: bool = True, decode: bool = False):
+                      deterministic: bool = True, decode: bool = False,
+                      beam_anc=None, beam_gather_impl: str = "take_along"):
         hidden = self.decode(decoder_input_ids, decoder_mask, enc_out, enc_mask,
-                             deterministic=deterministic, decode=decode)
+                             deterministic=deterministic, decode=decode,
+                             beam_anc=beam_anc,
+                             beam_gather_impl=beam_gather_impl)
         return self.logits(hidden)
 
     def __call__(self, input_ids, decoder_input_ids,

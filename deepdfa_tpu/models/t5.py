@@ -30,6 +30,38 @@ import numpy as np
 from deepdfa_tpu.models.beam_fold import fold_beam_queries, unfold_beam_out
 
 
+def ancestry_gather(x, anc, impl: str = "take_along"):
+    """Resolve a beam-ancestry index into a beam-major cache read.
+
+    ``x``: a decode-cache buffer ``[B*K, T, ...]`` whose rows were written
+    in PHYSICAL order (row k always holds whatever logical beam occupied
+    slot k when each position was written — the batched-beam layout of
+    models/t5_generate.py, which never reorders the cache itself).
+    ``anc``: ``[B, K, T]`` int32 — for logical beam k of batch row b, the
+    physical row holding its position-p K/V. The gather runs at READ time,
+    fused into the attention score computation, so the per-step cost is
+    one indexed read of the bytes attention was going to read anyway —
+    never a separate gather+write round trip of the whole cache through
+    HBM between steps (the reorder that made beam-10 12x slower than
+    greedy).
+
+    ``impl``: "take_along" (default) or "onehot" — the one-hot einsum
+    reads K× the cache per step (measured a LOSS on v5e, ISSUE 13), kept
+    only so bench.py can A/B the choice per backend.
+    """
+    b, k, t = anc.shape
+    xr = x.reshape(b, k, *x.shape[1:])
+    if impl == "onehot":
+        hot = jax.nn.one_hot(anc, k, dtype=x.dtype)  # [B, K, T, K]
+        return jnp.einsum("bptj,bjt...->bpt...", hot, xr).reshape(x.shape)
+    if impl != "take_along":
+        raise ValueError(
+            f"ancestry gather impl {impl!r}: expected 'take_along' or "
+            "'onehot'")
+    idx = anc.reshape(b, k, t, *([1] * (x.ndim - 2)))
+    return jnp.take_along_axis(xr, idx, axis=1).reshape(x.shape)
+
+
 @dataclasses.dataclass(frozen=True)
 class T5Config:
     """Salesforce codet5-base shape by default (CodeT5/sh/exp_with_args.sh
@@ -175,6 +207,8 @@ class T5Attention(nn.Module):
         position_bias: Optional[jnp.ndarray],
         deterministic: bool,
         decode: bool = False,
+        beam_anc: Optional[jnp.ndarray] = None,
+        beam_gather_impl: str = "take_along",
     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         c = self.cfg
         d = jnp.dtype(c.dtype)
@@ -256,6 +290,14 @@ class T5Attention(nn.Module):
                 )
                 ci.value = idx + 1
                 k, v = unmerge(ck.value), unmerge(cv.value)
+                if beam_anc is not None:
+                    # Batched-beam decode (models/t5_generate.py): the
+                    # cache rows are physical — never reordered between
+                    # steps — and the ancestry index resolves each
+                    # logical beam's history here, fused into the read
+                    # attention performs anyway.
+                    k = ancestry_gather(k, beam_anc, beam_gather_impl)
+                    v = ancestry_gather(v, beam_anc, beam_gather_impl)
                 max_len = k.shape[1]
                 mask = (jnp.arange(max_len) <= idx)[None, None, None, :]
                 if self.has_relative_bias:
@@ -330,13 +372,16 @@ class T5Block(nn.Module):
         cross_mask=None,
         deterministic: bool = True,
         decode: bool = False,
+        beam_anc: Optional[jnp.ndarray] = None,
+        beam_gather_impl: str = "take_along",
     ):
         c = self.cfg
         h = T5LayerNorm(c.layer_norm_epsilon, name="self_attn_ln")(x)
         attn, position_bias = T5Attention(
             c, causal=self.causal, has_relative_bias=self.has_relative_bias,
             name="self_attn",
-        )(h, None, self_mask, position_bias, deterministic, decode=decode)
+        )(h, None, self_mask, position_bias, deterministic, decode=decode,
+          beam_anc=beam_anc, beam_gather_impl=beam_gather_impl)
         x = x + nn.Dropout(c.dropout_rate)(attn, deterministic=deterministic)
 
         if self.has_cross_attention:
@@ -366,6 +411,8 @@ class T5Stack(nn.Module):
         enc_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
         decode: bool = False,
+        beam_anc: Optional[jnp.ndarray] = None,
+        beam_gather_impl: str = "take_along",
     ) -> jnp.ndarray:
         c = self.cfg
         q_len = embeds.shape[1]
@@ -389,7 +436,8 @@ class T5Stack(nn.Module):
                 has_cross_attention=enc_out is not None,
                 name=f"block_{i}",
             )(x, self_mask, position_bias, enc_out, cross_mask, deterministic,
-              decode=decode)
+              decode=decode, beam_anc=beam_anc,
+              beam_gather_impl=beam_gather_impl)
         x = T5LayerNorm(c.layer_norm_epsilon, name="final_ln")(x)
         return nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
 
@@ -424,20 +472,24 @@ class T5Model(nn.Module):
     def decode(
         self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
         deterministic: bool = True, decode: bool = False,
+        beam_anc=None, beam_gather_impl: str = "take_along",
     ):
         return self.decoder(
             self.shared(decoder_input_ids), decoder_mask, enc_out, enc_mask,
-            deterministic=deterministic, decode=decode,
+            deterministic=deterministic, decode=decode, beam_anc=beam_anc,
+            beam_gather_impl=beam_gather_impl,
         )
 
     def decode_logits(
         self, decoder_input_ids, decoder_mask, enc_out, enc_mask,
         deterministic: bool = True, decode: bool = False,
+        beam_anc=None, beam_gather_impl: str = "take_along",
     ):
         """decode() + lm logits in one apply (generation step fn)."""
         hidden = self.decode(
             decoder_input_ids, decoder_mask, enc_out, enc_mask,
-            deterministic=deterministic, decode=decode,
+            deterministic=deterministic, decode=decode, beam_anc=beam_anc,
+            beam_gather_impl=beam_gather_impl,
         )
         return self.logits(hidden)
 

@@ -9,12 +9,35 @@ models/t5.py's T5Model and models/seq2seq.py's RobertaSeq2Seq both qualify.
 The reference generates with HF ``model.generate(num_beams=args.beam_size,
 early_stopping=..., max_length=...)`` (CodeT5/run_gen.py:104-112) on the
 CUDA stack, and hand-rolls a ``Beam`` class for the RoBERTa path
-(CodeT5/models.py:195-408). Here decoding is a single jitted ``lax.scan``
-over steps with a KV cache (models/t5.py decode path): static trip count,
-static shapes, no host round-trips — the XLA-native shape of a decode loop.
-Beam search follows the standard alive/finished formulation (score =
-logprob / length**length_penalty, HF semantics) with the cache gathered
-along the beam axis at every reorder.
+(CodeT5/models.py:195-408). Here decoding is a jitted scan over steps with
+a KV cache (models/t5.py decode path): static shapes, no host round-trips
+— the XLA-native shape of a decode loop. Beam search follows the standard
+alive/finished formulation (score = logprob / length**length_penalty, HF
+semantics).
+
+**Batched-beam cache layout (ISSUE 13).** All ``batch*beams`` hypotheses
+ride ONE KV cache ``[B*K, ...]`` whose rows are *physical*: row k writes
+its step-t K/V at position t and the buffer is NEVER reordered between
+steps. Beam reorders touch only a ``[B, K, T]`` int32 *ancestry* index —
+gathered at the beam-select point inside the scan body (a few hundred KB)
+— and the attention read resolves ancestry in place
+(:func:`deepdfa_tpu.models.t5.ancestry_gather`), fused into the read the
+score einsum performs anyway. The previous formulation
+(:func:`beam_search_reference`, kept as the parity oracle)
+``take_along_axis``-gathered the WHOLE cache through HBM every step —
+read + gather + write ≈ 3× the cache bytes per step, the dominant term in
+the measured 12× beam-10-vs-greedy cliff at the codet5-base bench shape.
+Cross-attention K/V stay deduped per request (primed once with
+unreplicated encoder outputs; the beam factor folds into the query axis —
+models/beam_fold.py) exactly as before.
+
+**Length-bucketed early exit.** The scan runs in fixed-length segments
+under a ``lax.while_loop``; after each segment the device checks the
+flax/t5x termination bound — the best alive hypothesis, brevity-optimally
+extended to ``max_len``, can no longer beat the worst kept finished score
+— and a batch whose every row is decided stops paying the remaining
+``max_len`` steps. The bound is exact, so early-exit outputs are bitwise
+identical to the full-length run (asserted in tests/test_t5_generate.py).
 
 All functions take ``model``/``params`` explicitly and are jit-compatible;
 wrap in ``jax.jit`` (or pjit with a sharded batch) at the call site.
@@ -77,8 +100,16 @@ def _merge_cache(cross, dyn):
     )
 
 
-def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask):
-    """One cached decode step. token: [B, 1] -> logits [B, V], new cache."""
+def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask,
+                 beam_anc=None, gather_impl: str = "take_along"):
+    """One cached decode step. token: [B, 1] -> logits [B, V], new cache.
+
+    ``beam_anc`` [B, K, T]: batched-beam ancestry — the self-attention
+    cache rows are physical and the read resolves each logical beam's
+    history through this index (models/t5.py ancestry_gather)."""
+    kwargs = {}
+    if beam_anc is not None:
+        kwargs = dict(beam_anc=beam_anc, beam_gather_impl=gather_impl)
     logits, variables = model.apply(
         {"params": params["params"], "cache": cache},
         token,
@@ -88,6 +119,7 @@ def _step_logits(model: T5Model, params, cache, token, enc_out, enc_mask):
         decode=True,
         method=type(model).decode_logits,
         mutable=["cache"],
+        **kwargs,
     )
     return logits[:, -1, :], variables["cache"]
 
@@ -151,7 +183,7 @@ def _gather_beams(tree, beam_idx, batch: int, beams: int):
     return jax.tree_util.tree_map(gather, tree)
 
 
-def beam_search(
+def beam_search_reference(
     model: T5Model,
     params,
     input_ids: jnp.ndarray,
@@ -160,9 +192,15 @@ def beam_search(
     length_penalty: float = 1.0,
     attn_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Beam search; returns (sequences [B, max_len], scores [B]) — the best
-    finished hypothesis per row (falling back to the best alive one if none
-    finished). Score = sum logprob / len**length_penalty (HF convention)."""
+    """The pre-ISSUE-13 beam search, kept verbatim as the parity oracle:
+    same alive/finished bookkeeping as :func:`beam_search`, but the whole
+    self-attention cache is physically ``take_along_axis``-gathered along
+    the beam axis every step — a read+gather+write of the full cache
+    through HBM per token, which is exactly the traffic the batched
+    ancestry layout removes. Returns (sequences [B, max_len], scores [B])
+    — the best finished hypothesis per row (falling back to the best alive
+    one if none finished). Score = sum logprob / len**length_penalty (HF
+    convention)."""
     c = model.cfg
     if attn_mask is None:
         attn_mask = input_ids != c.pad_token_id
@@ -247,6 +285,174 @@ def beam_search(
     return best_seq, best_score
 
 
+def default_segment_len(max_len: int) -> int:
+    """The early-exit check cadence: the largest divisor of ``max_len``
+    that is <= max_len // 4 (floored at 1) — four decision points along
+    the length ladder, every segment the same compiled shape."""
+    target = max(max_len // 4, 1)
+    for s in range(target, 0, -1):
+        if max_len % s == 0:
+            return s
+    return 1
+
+
+def beam_search(
+    model: T5Model,
+    params,
+    input_ids: jnp.ndarray,
+    max_len: int,
+    beam_size: int = 10,
+    length_penalty: float = 1.0,
+    attn_mask: Optional[jnp.ndarray] = None,
+    gather_impl: str = "take_along",
+    early_exit: bool = True,
+    segment_len: Optional[int] = None,
+    with_aux: bool = False,
+):
+    """Batched beam search on one physical KV cache (module docstring).
+
+    Returns (sequences [B, max_len], scores [B]) — the best finished
+    hypothesis per row, falling back to the best alive one if none
+    finished; score = sum logprob / len**length_penalty (HF convention).
+    Bit-for-bit the same outputs as :func:`beam_search_reference` — the
+    per-step math is identical, only the cache movement changed.
+
+    ``gather_impl``: how the attention read resolves ancestry —
+    "take_along" (default) or "onehot" (the bmm variant; measured a LOSS
+    on v5e, kept A/B-able per backend via bench.py).
+    ``early_exit``: stop at the next segment boundary once no future
+    hypothesis can alter the result (the exact flax/t5x bound: best alive
+    logprob, brevity-optimally normalized, vs the worst kept finished
+    score). Exact, so outputs are bitwise identical either way.
+    ``segment_len``: steps per early-exit check (must divide ``max_len``;
+    default :func:`default_segment_len`).
+    ``with_aux``: also return ``{"steps": <int32 scalar>}`` — decode steps
+    actually executed (a segment multiple; ``max_len`` when never exited).
+    """
+    c = model.cfg
+    if attn_mask is None:
+        attn_mask = input_ids != c.pad_token_id
+    b = input_ids.shape[0]
+    k = beam_size
+    if segment_len is None:
+        segment_len = default_segment_len(max_len)
+    if max_len % segment_len:
+        raise ValueError(
+            f"segment_len {segment_len} must divide max_len {max_len}")
+
+    enc_out = model.apply(
+        {"params": params["params"]}, input_ids, attn_mask, method=type(model).encode
+    )
+    # Cross K/V deduped exactly as the reference: primed once per request
+    # row, beam factor folded into the query axis (models/beam_fold.py).
+    cross, dyn = _partition_cache(
+        _init_cache(model, params, b * k, max_len, enc_out, attn_mask)
+    )
+
+    alive_logp = jnp.tile(jnp.array([0.0] + [NEG_INF] * (k - 1)), (b, 1))
+    alive_seq = jnp.full((b, k, max_len), c.pad_token_id, jnp.int32)
+    fin_seq = jnp.full((b, k, max_len), c.pad_token_id, jnp.int32)
+    fin_score = jnp.full((b, k), NEG_INF)
+    token = jnp.full((b * k, 1), c.decoder_start_token_id, jnp.int32)
+    # Ancestry: anc[b, j, p] = physical cache row of logical beam j's
+    # position-p K/V. Row j writes position t in place, so at every step
+    # column t is pinned to identity before the model call; the
+    # beam-select point then gathers this [B, K, T] int32 index — a few
+    # hundred KB — instead of the multi-GB cache.
+    own_row = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[None, :, None], (b, k, 1))
+    anc = jnp.broadcast_to(own_row, (b, k, max_len)).astype(jnp.int32)
+
+    def step(carry, t):
+        dyn, anc, token, alive_logp, alive_seq, fin_seq, fin_score = carry
+        anc = jax.lax.dynamic_update_slice_in_dim(anc, own_row, t, axis=2)
+        logits, cache = _step_logits(
+            model, params, _merge_cache(cross, dyn), token, enc_out,
+            attn_mask, beam_anc=anc, gather_impl=gather_impl,
+        )
+        dyn = _partition_cache(cache)[1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # [B*K, V]
+        v = logp.shape[-1]
+        total = alive_logp[:, :, None] + logp.reshape(b, k, v)  # [B, K, V]
+
+        # Top 2K candidates over (beam, token): enough survive even if K
+        # of them are eos.
+        flat = total.reshape(b, k * v)
+        cand_logp, cand_idx = jax.lax.top_k(flat, 2 * k)
+        cand_beam = cand_idx // v  # [B, 2K]
+        cand_tok = (cand_idx % v).astype(jnp.int32)
+
+        cand_seq = jnp.take_along_axis(alive_seq, cand_beam[:, :, None], axis=1)
+        cand_seq = jax.lax.dynamic_update_slice_in_dim(
+            cand_seq, cand_tok[:, :, None], t, axis=2
+        )
+        is_eos = cand_tok == c.eos_token_id
+
+        # Finished pool: merge newly-eos candidates (length-normalized).
+        cand_score = cand_logp / ((t + 1).astype(jnp.float32) ** length_penalty)
+        new_fin_score = jnp.where(is_eos, cand_score, NEG_INF)
+        all_fin_score = jnp.concatenate([fin_score, new_fin_score], axis=1)
+        all_fin_seq = jnp.concatenate([fin_seq, cand_seq], axis=1)
+        fin_score, fin_top = jax.lax.top_k(all_fin_score, k)
+        fin_seq = jnp.take_along_axis(all_fin_seq, fin_top[:, :, None], axis=1)
+
+        # Alive pool: best K non-eos candidates.
+        alive_cand = jnp.where(is_eos, NEG_INF, cand_logp)
+        alive_logp, alive_top = jax.lax.top_k(alive_cand, k)
+        alive_seq = jnp.take_along_axis(cand_seq, alive_top[:, :, None], axis=1)
+        chosen_beam = jnp.take_along_axis(cand_beam, alive_top, axis=1)  # [B, K]
+        chosen_tok = jnp.take_along_axis(cand_tok, alive_top, axis=1)
+
+        # THE beam-select reorder: compose the ancestry, not the cache.
+        anc = jnp.take_along_axis(anc, chosen_beam[:, :, None], axis=1)
+        token = chosen_tok.reshape(b * k, 1)
+        return (dyn, anc, token, alive_logp, alive_seq, fin_seq, fin_score), None
+
+    def decided(alive_logp, fin_score, t_next):
+        # Exact termination: the best alive hypothesis's best achievable
+        # future score vs the worst kept finished score. Log-probs are
+        # <= 0, so with length_penalty >= 0 the most favorable future
+        # normalization is the longest (max_len); with a negative penalty
+        # it is the earliest possible finish (t_next + 1).
+        if length_penalty >= 0:
+            denom = float(max_len) ** length_penalty
+        else:
+            denom = (t_next + 1.0).astype(jnp.float32) ** length_penalty
+        bound = alive_logp[:, 0] / denom
+        return jnp.all(fin_score[:, -1] >= bound)
+
+    def seg_cond(state):
+        t0, done = state[0], state[1]
+        in_range = t0 < max_len
+        if not early_exit:
+            return in_range
+        return in_range & jnp.logical_not(done)
+
+    def seg_body(state):
+        t0 = state[0]
+        carry, _ = jax.lax.scan(step, state[2:],
+                                t0 + jnp.arange(segment_len))
+        done = decided(carry[3], carry[6], t0 + segment_len)
+        return (t0 + segment_len, done) + carry
+
+    state = jax.lax.while_loop(
+        seg_cond, seg_body,
+        (jnp.zeros((), jnp.int32), jnp.zeros((), bool),
+         dyn, anc, token, alive_logp, alive_seq, fin_seq, fin_score))
+    steps, alive_logp, alive_seq = state[0], state[5], state[6]
+    fin_seq, fin_score = state[7], state[8]
+
+    # Prefer finished hypotheses; fall back to the best alive
+    # (unterminated) beam when nothing finished within max_len.
+    alive_score = alive_logp / (float(max_len) ** length_penalty)
+    none_fin = fin_score[:, 0] <= NEG_INF / 2
+    best_seq = jnp.where(none_fin[:, None], alive_seq[:, 0], fin_seq[:, 0])
+    best_score = jnp.where(none_fin, alive_score[:, 0], fin_score[:, 0])
+    if with_aux:
+        return best_seq, best_score, {"steps": steps}
+    return best_seq, best_score
+
+
 def generate(
     model: T5Model,
     params,
@@ -254,11 +460,14 @@ def generate(
     max_len: int = 128,
     beam_size: int = 1,
     length_penalty: float = 1.0,
+    gather_impl: str = "take_along",
+    early_exit: bool = True,
 ) -> jnp.ndarray:
     """HF-generate-shaped convenience: beam_size 1 → greedy."""
     if beam_size <= 1:
         return greedy_decode(model, params, input_ids, max_len)
     seq, _ = beam_search(
-        model, params, input_ids, max_len, beam_size, length_penalty
+        model, params, input_ids, max_len, beam_size, length_penalty,
+        gather_impl=gather_impl, early_exit=early_exit,
     )
     return seq

@@ -14,11 +14,13 @@ tokenizer path errors).
 Layout:
   config.py   ServeConfig: slots/budgets/deadlines/capacities + buckets,
               fleet size, adaptive-flush knobs, REPLICA_IDS
-  cache.py    content_hash + ResultCache (LRU)
+  cache.py    content_hash/text_hash + ResultCache (LRU)
   batcher.py  ServeRequest + MicroBatcher (admission, continuous-batching
               flush policy, live-tunable thresholds)
   policy.py   AdaptiveFlushPolicy (telemetry-driven threshold controller)
-  engine.py   ServeEngine: warmup, submit, pump, drain, score_sync
+  engine.py   ServeEngine: warmup, submit, pump, drain, score_sync;
+              lanes gnn/combined/gen (gen: batched-beam CodeT5 decode,
+              warmed per (slot, src-length-bucket) — ISSUE 13)
   fleet.py    ServeFleet: N device-pinned replicas, routing, rolls
   http.py     stdlib http.server JSON endpoint (cli.py serve)
   replay.py   seeded bursty traces + virtual-clock replay + the
@@ -35,7 +37,7 @@ from deepdfa_tpu.serve.batcher import (
     RejectedError,
     ServeRequest,
 )
-from deepdfa_tpu.serve.cache import ResultCache, content_hash
+from deepdfa_tpu.serve.cache import ResultCache, content_hash, text_hash
 from deepdfa_tpu.serve.config import MAX_REPLICAS, REPLICA_IDS, ServeConfig
 from deepdfa_tpu.serve.engine import ServeEngine
 from deepdfa_tpu.serve.fleet import ServeFleet
@@ -54,4 +56,5 @@ __all__ = [
     "ServeFleet",
     "ServeRequest",
     "content_hash",
+    "text_hash",
 ]

@@ -69,12 +69,13 @@ class ServeRequest:
 
     rid: int
     key: str                      # content hash (cache line)
-    graph: Mapping
-    lane: str                     # "gnn" | "combined"
+    graph: Optional[Mapping]      # None on the gen lane (text-only input)
+    lane: str                     # "gnn" | "combined" | "gen"
     arrival: float                # engine-clock seconds
     deadline_s: float
     t_submit: float = 0.0         # telemetry clock (perf_counter seconds)
-    input_ids: Optional[np.ndarray] = None   # combined lane only
+    input_ids: Optional[np.ndarray] = None   # combined + gen lanes
+    src_bucket: Optional[int] = None         # gen lane: padded source len
     degraded: bool = False        # tokenizer failed -> gnn fallback
     completed_at: Optional[float] = None     # engine-clock completion time
     result: Optional[Dict] = None
@@ -172,11 +173,14 @@ class MicroBatcher:
         ``batch_slots`` admitted graphs fit the top bucket), so this is
         the only size check in the serving path.
         """
-        n = int(req.graph["num_nodes"])
-        e = len(req.graph["senders"]) + n  # + self loops, as batching adds
-        reason = self.config.admission_caps(n, e)
-        if reason is not None:
-            raise OversizedError(reason)
+        if req.graph is not None:
+            n = int(req.graph["num_nodes"])
+            e = len(req.graph["senders"]) + n  # + self loops, as batching
+            reason = self.config.admission_caps(n, e)
+            if reason is not None:
+                raise OversizedError(reason)
+        # Gen-lane requests carry no graph; their size cap (token count
+        # vs gen_src_len) is enforced at encode time in engine.submit.
         with self._lock:
             if req.lane not in self._pending:
                 raise ValueError(f"unknown lane {req.lane!r}")

@@ -43,6 +43,16 @@ def content_hash(graph: Mapping, code: Optional[str] = None) -> str:
     return h.hexdigest()
 
 
+def text_hash(code: str) -> str:
+    """Content key for the generation lane: the raw source text is the
+    whole model input, namespaced apart from graph keys so a gen request
+    and a scoring request can never collide on one cache line."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(b"gen\x00")
+    h.update(str(code).encode("utf-8", "replace"))
+    return h.hexdigest()
+
+
 class ResultCache:
     """Thread-safe LRU of ``content_hash -> result dict``.
 

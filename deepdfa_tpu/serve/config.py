@@ -69,6 +69,19 @@ class ServeConfig:
     # set so per-replica metric names stay code-enumerable.
     replicas: int = 1
 
+    # Generation lane (ISSUE 13): CodeT5 batched-beam decode as a served
+    # lane. Source token counts round up the ``gen_src_buckets`` pow2
+    # ladder (select_bucket from ``gen_src_min_bucket`` to
+    # ``gen_src_len``); every (slot-bucket, src-bucket) decode program is
+    # AOT-warmed at startup like the scoring lanes, so steady-state gen
+    # traffic never compiles. ``gen_max_len`` / ``gen_beam_size`` are
+    # static decode-program shape — a per-request max_len would mint new
+    # executables at runtime.
+    gen_src_len: int = 64            # oversize cap AND ladder top
+    gen_src_min_bucket: int = 64     # ladder base (== top: one bucket)
+    gen_max_len: int = 32            # generated tokens per request
+    gen_beam_size: int = 4           # 1 = greedy decode
+
     # Telemetry-driven adaptive flush (serve/policy.py): each replica's
     # batcher tunes its deadline-fraction and fill thresholds online from
     # its own p99/occupancy, clamped to [flush_fraction_min,
@@ -104,6 +117,11 @@ class ServeConfig:
             )
         if self.adaptive_patience < 1:
             raise ValueError("adaptive_patience must be >= 1")
+        if not 1 <= self.gen_src_min_bucket <= self.gen_src_len:
+            raise ValueError(
+                "need 1 <= gen_src_min_bucket <= gen_src_len")
+        if self.gen_max_len < 1 or self.gen_beam_size < 1:
+            raise ValueError("gen_max_len and gen_beam_size must be >= 1")
 
     @property
     def slot_buckets(self) -> List[int]:
@@ -118,6 +136,25 @@ class ServeConfig:
 
     def bucket_for(self, n_requests: int) -> int:
         return select_bucket(n_requests, maximum=self.batch_slots, minimum=1)
+
+    @property
+    def gen_src_buckets(self) -> List[int]:
+        """Every source-length bucket the gen lane may pad to (ascending
+        pow2 ladder from ``gen_src_min_bucket`` to ``gen_src_len`` — the
+        select_bucket rounding rule applied to token counts)."""
+        out: List[int] = []
+        s = self.gen_src_min_bucket
+        while s < self.gen_src_len:
+            out.append(s)
+            s *= 2
+        out.append(self.gen_src_len)
+        return out
+
+    def gen_src_bucket_for(self, n_tokens: int) -> int:
+        """The padded source length for an ``n_tokens``-token request
+        (callers reject > gen_src_len before asking)."""
+        return select_bucket(n_tokens, maximum=self.gen_src_len,
+                             minimum=self.gen_src_min_bucket)
 
     def budget_for(self, slots: int,
                    tile: Optional[int] = None) -> Dict[str, int]:

@@ -49,7 +49,7 @@ from deepdfa_tpu.serve.batcher import (
     RejectedError,
     ServeRequest,
 )
-from deepdfa_tpu.serve.cache import ResultCache, content_hash
+from deepdfa_tpu.serve.cache import ResultCache, content_hash, text_hash
 from deepdfa_tpu.serve.config import ServeConfig
 
 logger = logging.getLogger(__name__)
@@ -67,6 +67,37 @@ class _Lane:
     subkeys: Sequence[str]
     band: bool  # uses_band_adj: banded adjacency, tile-aligned budgets
     graph_cfg: Any = None  # the lane's FlowGNNConfig (fused cost capture)
+
+
+@dataclasses.dataclass
+class _GenLane:
+    """The generation lane (ISSUE 13): a CodeT5-shaped encoder-decoder
+    served through the batched-beam decode of models/t5_generate.py.
+    ``infer(params, ids) -> (sequences, scores)`` is the AOT-compiled
+    unit, one executable per (slot-bucket, src-bucket) shape."""
+
+    model: Any
+    params: Any
+    tokenizer: Any
+    infer: Callable
+
+
+def _make_gen_infer(model, config: ServeConfig) -> Callable:
+    """(params, ids [slots, src_bucket]) -> (seqs [slots, gen_max_len],
+    scores [slots]). Beam > 1 rides the batched ancestry cache with
+    length-bucketed early exit (an all-decided micro-batch stops paying
+    the remaining max_len steps); beam 1 is the greedy scan."""
+    from deepdfa_tpu.models.t5_generate import beam_search, greedy_decode
+
+    if config.gen_beam_size > 1:
+        def infer(params, ids):
+            return beam_search(model, params, ids, config.gen_max_len,
+                               beam_size=config.gen_beam_size)
+    else:
+        def infer(params, ids):
+            seq = greedy_decode(model, params, ids, config.gen_max_len)
+            return seq, jnp.zeros((ids.shape[0],), jnp.float32)
+    return infer
 
 
 def bucket_batch(config: ServeConfig, graphs: Sequence[Mapping], slots: int,
@@ -105,7 +136,10 @@ class ServeEngine:
     (label_style "graph") — always present; it is both the graph-only
     scoring path and the degradation target. ``combined_model``/
     ``combined_params`` (+ ``tokenizer``): the DeepDFA+LineVul lane for
-    requests that carry source code.
+    requests that carry source code. ``gen_model``/``gen_params`` (+
+    ``gen_tokenizer``): the CodeT5 generation lane (ISSUE 13) — source
+    text in, batched-beam decoded tokens out, warmed per (slot-bucket,
+    src-length-bucket) shape under the same zero-recompile discipline.
 
     Threading: ``submit`` may run on many transport threads;
     ``pump``/``drain`` must run on exactly one (the pump thread or the
@@ -124,6 +158,9 @@ class ServeEngine:
         replica: Optional[str] = None,
         device=None,
         policy=None,
+        gen_model=None,
+        gen_params=None,
+        gen_tokenizer=None,
     ):
         self.config = config or ServeConfig()
         # Fleet identity (serve/fleet.py): `replica` must come from the
@@ -163,6 +200,8 @@ class ServeEngine:
             gnn_params = jax.device_put(gnn_params, device)
             if combined_params is not None:
                 combined_params = jax.device_put(combined_params, device)
+            if gen_params is not None:
+                gen_params = jax.device_put(gen_params, device)
 
         self._lanes: Dict[str, _Lane] = {
             "gnn": self._make_lane("gnn", make_gnn_infer(gnn_model),
@@ -176,7 +215,16 @@ class ServeEngine:
                 "combined", make_combined_infer(combined_model),
                 combined_params, combined_model.graph_config,
             )
-        self.batcher = MicroBatcher(self.config, lanes=tuple(self._lanes),
+        self._gen: Optional[_GenLane] = None
+        if gen_model is not None:
+            if gen_tokenizer is None:
+                raise ValueError("the gen lane needs a gen_tokenizer")
+            self._gen = _GenLane(
+                model=gen_model, params=gen_params, tokenizer=gen_tokenizer,
+                infer=_make_gen_infer(gen_model, self.config),
+            )
+        lanes = tuple(self._lanes) + (("gen",) if self._gen else ())
+        self.batcher = MicroBatcher(self.config, lanes=lanes,
                                     replica=replica)
 
     @staticmethod
@@ -222,15 +270,33 @@ class ServeEngine:
         return [(lane, slots) for lane in self._lanes
                 for slots in self.config.slot_buckets]
 
+    def gen_warm_buckets(self) -> List[Tuple[str, int, int]]:
+        """Every (lane, slot-bucket, src-bucket) decode-program shape the
+        gen lane may dispatch — the length-bucket ladder crossed with the
+        slot ladder; empty without a gen lane."""
+        if self._gen is None:
+            return []
+        return [("gen", slots, src_b)
+                for slots in self.config.slot_buckets
+                for src_b in self.config.gen_src_buckets]
+
+    @property
+    def has_gen_lane(self) -> bool:
+        return self._gen is not None
+
     def warmup(self) -> int:
-        """AOT-compile every (lane, slot-bucket) shape; returns the count.
+        """AOT-compile every (lane, slot-bucket) shape — including the
+        gen lane's (slot, src-length) decode ladder; returns the count.
 
         After this returns, a trace whose every micro-batch fits
-        ``batch_slots`` runs with zero new compiles.
+        ``batch_slots`` (and whose gen sources fit ``gen_src_len``) runs
+        with zero new compiles.
         """
         before = self.stats.compiles
         for lane, slots in self.warm_buckets():
             self._executable(lane, slots)
+        for _, slots, src_b in self.gen_warm_buckets():
+            self._executable("gen", slots, src_b)
         # The trace's warmup marker: any jax.compile event after this is
         # a silent recompile, and `cli trace report` must say so (the
         # compiles-after-warmup-must-be-0 gate for serve traces).
@@ -248,12 +314,42 @@ class ServeEngine:
             return None
         return self.stats.compiles - self.warmup_compiles
 
-    def _executable(self, lane: str, slots: int):
-        key = (lane, slots)
+    def _executable(self, lane: str, slots: int,
+                    src_bucket: Optional[int] = None):
+        key: Tuple = ((lane, slots) if src_bucket is None
+                      else (lane, slots, src_bucket))
         exe = self._compiled.get(key)
         if exe is None:
-            exe = self._compile(lane, slots)
+            exe = (self._compile_gen(slots, src_bucket)
+                   if src_bucket is not None
+                   else self._compile(lane, slots))
             self._compiled[key] = exe
+        return exe
+
+    def _compile_gen(self, slots: int, src_bucket: int):
+        """AOT-compile one gen decode program: batched beam (or greedy)
+        over a [slots, src_bucket] source block, static gen_max_len/
+        gen_beam_size — the zero-steady-state-recompile discipline
+        applied to generation."""
+        gen = self._gen
+        assert gen is not None
+        t0 = time.perf_counter()
+        with telemetry.span("serve.compile", lane="gen", slots=slots,
+                            src_bucket=src_bucket):
+            ids = jnp.zeros((slots, src_bucket), jnp.int32)
+            if self._device is not None:
+                ids = jax.device_put(ids, self._device)
+            exe = jax.jit(gen.infer).lower(gen.params, ids).compile()
+        from deepdfa_tpu.telemetry import costmodel
+
+        costmodel.capture_compiled(
+            f"serve.gen.s{slots}.t{src_bucket}", exe, span="serve.flush",
+            lane="gen", slots=slots,
+            steps_per_call=self.config.gen_max_len,
+        )
+        self.stats.bump("compiles")
+        logger.info("compiled gen bucket slots=%d src=%d in %.2fs", slots,
+                    src_bucket, time.perf_counter() - t0)
         return exe
 
     def _compile(self, lane_name: str, slots: int):
@@ -328,19 +424,58 @@ class ServeEngine:
         except contracts.ContractError as e:
             raise BadRequestError(str(e))
 
-    def submit(self, graph: Mapping, code: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> ServeRequest:
-        """Admit one scoring request; returns its ServeRequest handle.
+    def _encode_gen(self, code: str):
+        """(padded ids, src bucket) for one gen request — the gen lane's
+        only size check (the token-count analog of admission_caps)."""
+        from deepdfa_tpu.data.text import encode_function_t5
 
-        Cache hits complete immediately (result set, event signalled);
-        misses enqueue for the next micro-batch. Raises BadRequestError /
-        OversizedError / RejectedError — the transport maps them to
-        400 / 413 / 429.
+        tok = self._gen.tokenizer
+        n = len(tok.tokenize(str(code))) + 2  # + bos/eos
+        if n > self.config.gen_src_len:
+            raise OversizedError(
+                f"source has {n} tokens > gen-lane cap "
+                f"{self.config.gen_src_len}")
+        src_b = self.config.gen_src_bucket_for(n)
+        return encode_function_t5(code, tok, block_size=src_b), src_b
+
+    def submit(self, graph: Optional[Mapping], code: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               lane: Optional[str] = None) -> ServeRequest:
+        """Admit one scoring or generation request; returns its
+        ServeRequest handle.
+
+        ``lane``: None routes by content as before (code + combined lane
+        -> "combined", else "gnn"); ``lane="gen"`` submits ``code`` to
+        the generation lane (no graph needed). Cache hits complete
+        immediately (result set, event signalled); misses enqueue for
+        the next micro-batch. Raises BadRequestError / OversizedError /
+        RejectedError — the transport maps them to 400 / 413 / 429.
         """
         now = self._clock()
         self.stats.bump("submitted")
-        norm = self._normalize_graph(graph)
+        deadline_s = (deadline_ms if deadline_ms is not None
+                      else self.config.deadline_ms) / 1000.0
 
+        if lane == "gen":
+            if self._gen is None:
+                raise BadRequestError(
+                    "lane 'gen': no generation lane attached (start serve "
+                    "with a gen model)")
+            if code is None:
+                raise BadRequestError("lane 'gen' requires 'code'")
+            input_ids, src_b = self._encode_gen(code)
+            req = ServeRequest(
+                rid=next(self._rid), key=text_hash(code), graph=None,
+                lane="gen", arrival=now, deadline_s=deadline_s,
+                input_ids=input_ids, src_bucket=src_b,
+                t_submit=telemetry.now(),
+            )
+            return self._finish_submit(req, now)
+        if lane is not None:
+            raise BadRequestError(
+                f"unknown lane {lane!r} (expected 'gen' or omitted)")
+
+        norm = self._normalize_graph(graph)
         lane, input_ids, degraded = "gnn", None, False
         if code is not None and "combined" in self._lanes:
             try:
@@ -360,13 +495,15 @@ class ServeEngine:
         key = content_hash(norm, code if lane == "combined" else None)
         req = ServeRequest(
             rid=next(self._rid), key=key, graph=norm, lane=lane,
-            arrival=now,
-            deadline_s=(deadline_ms if deadline_ms is not None
-                        else self.config.deadline_ms) / 1000.0,
+            arrival=now, deadline_s=deadline_s,
             input_ids=input_ids, degraded=degraded,
             t_submit=telemetry.now(),
         )
-        cached = self.cache.get(key)
+        return self._finish_submit(req, now)
+
+    def _finish_submit(self, req: ServeRequest, now: float) -> ServeRequest:
+        """The shared admission tail: cache lookup, enqueue, accounting."""
+        cached = self.cache.get(req.key)
         if cached is not None:
             self.stats.bump("cache_hits")
             self.stats.bump("completed")
@@ -374,7 +511,7 @@ class ServeEngine:
             req.completed_at = now
             req.finish(dict(cached, rid=req.rid, cached=True,
                             degraded=req.degraded))
-            hit_attrs: Dict[str, Any] = dict(rid=req.rid, lane=lane,
+            hit_attrs: Dict[str, Any] = dict(rid=req.rid, lane=req.lane,
                                              cached=True)
             if self.replica is not None:
                 hit_attrs["replica"] = self.replica
@@ -454,10 +591,33 @@ class ServeEngine:
     def next_flush_time(self) -> Optional[float]:
         return self.batcher.next_flush_time(self._clock())
 
+    def _gen_values(self, reqs: List[ServeRequest], slots: int) -> List[Dict]:
+        """Execute one gen micro-batch; per-request result values.
+
+        Sources pad to the batch's largest length bucket (every request
+        bucket is on the warmed ladder, so the max is too); empty slots
+        stay all-pad rows whose decode output is discarded."""
+        gen = self._gen
+        src_b = max(r.src_bucket for r in reqs)
+        exe = self._executable("gen", slots, src_b)
+        pad_id = int(gen.model.cfg.pad_token_id)
+        eos_id = int(gen.model.cfg.eos_token_id)
+        ids = np.full((slots, src_b), pad_id, np.int32)
+        for i, r in enumerate(reqs):
+            ids[i, : len(r.input_ids)] = r.input_ids
+        ids_dev = (jnp.asarray(ids) if self._device is None
+                   else jax.device_put(ids, self._device))
+        seqs, scores = exe(gen.params, ids_dev)
+        # One host transfer per micro-batch (GL004 discipline below).
+        s, sc = np.asarray(seqs), np.asarray(scores)
+        from deepdfa_tpu.train.gen_loop import strip_ids
+
+        return [{"tokens": strip_ids(s[i], pad_id, eos_id),
+                 "score": float(sc[i]), "model": "gen"}
+                for i in range(len(reqs))]
+
     def _run_batch(self, lane_name: str, reqs: List[ServeRequest]) -> None:
-        lane = self._lanes[lane_name]
         slots = self.config.bucket_for(len(reqs))
-        exe = self._executable(lane_name, slots)
         ordinal = next(self._flush_ordinal)
         w0 = time.perf_counter()
         span_attrs: Dict[str, Any] = dict(lane=lane_name, n=len(reqs),
@@ -471,23 +631,32 @@ class ServeEngine:
                 # Fault hook (index = flush ordinal): a `raise` here
                 # simulates an executable/device failure mid-flush.
                 inject.fire("serve.batch", index=ordinal)
-                gb = self._graph_batch(lane, [r.graph for r in reqs], slots)
-                if lane_name == "combined":
-                    pad_id = int(self.tokenizer.pad_token_id)
-                    ids = np.full((slots, self.config.block_size), pad_id,
-                                  np.int32)
-                    for i, r in enumerate(reqs):
-                        ids[i] = r.input_ids
-                    ids_dev = (jnp.asarray(ids) if self._device is None
-                               else jax.device_put(ids, self._device))
-                    probs = exe(lane.params, ids_dev, gb)
+                if lane_name == "gen":
+                    values = self._gen_values(reqs, slots)
                 else:
-                    probs = exe(lane.params, gb)
-                # One host transfer per micro-batch; everything after this
-                # indexes numpy (GL004: per-request reads must not ride on
-                # device buffers). It is also the span's honest device
-                # barrier — the flush duration includes execution.
-                p = np.asarray(probs)
+                    lane = self._lanes[lane_name]
+                    exe = self._executable(lane_name, slots)
+                    gb = self._graph_batch(lane, [r.graph for r in reqs],
+                                           slots)
+                    if lane_name == "combined":
+                        pad_id = int(self.tokenizer.pad_token_id)
+                        ids = np.full((slots, self.config.block_size),
+                                      pad_id, np.int32)
+                        for i, r in enumerate(reqs):
+                            ids[i] = r.input_ids
+                        ids_dev = (jnp.asarray(ids) if self._device is None
+                                   else jax.device_put(ids, self._device))
+                        probs = exe(lane.params, ids_dev, gb)
+                    else:
+                        probs = exe(lane.params, gb)
+                    # One host transfer per micro-batch; everything after
+                    # this indexes numpy (GL004: per-request reads must
+                    # not ride on device buffers). It is also the span's
+                    # honest device barrier — the flush duration includes
+                    # execution.
+                    p = np.asarray(probs)
+                    values = [{"prob": float(p[i]), "model": lane_name}
+                              for i in range(len(reqs))]
         except Exception as e:
             # Flush isolation: THIS micro-batch's requests fail (HTTP 500
             # class), the queue keeps draining, and later flushes run on
@@ -527,7 +696,7 @@ class ServeEngine:
             # The cache line holds only content-derived values; "degraded"
             # describes THIS request's handling (its tokenizer failure),
             # not the content, so it must never ride a shared cache entry.
-            value = {"prob": float(p[i]), "model": lane_name}
+            value = values[i]
             self.cache.put(r.key, value)
             r.completed_at = done
             r.finish(dict(value, rid=r.rid, cached=False,

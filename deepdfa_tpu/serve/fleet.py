@@ -138,6 +138,9 @@ class ServeFleet:
         clock: Callable[[], float] = time.monotonic,
         clock_factory: Optional[Callable[[int], Callable[[], float]]] = None,
         devices: Optional[Sequence[Any]] = None,
+        gen_model=None,
+        gen_params=None,
+        gen_tokenizer=None,
     ) -> "ServeFleet":
         """N engines over the device mesh. ``clock_factory(i)`` overrides
         the shared ``clock`` per replica — the replay harness hands each
@@ -162,6 +165,8 @@ class ServeFleet:
                 clock=eng_clock, replica=rid,
                 device=shards[i][0] if shards[i] else None,
                 policy=policy,
+                gen_model=gen_model, gen_params=gen_params,
+                gen_tokenizer=gen_tokenizer,
             )
             replicas.append(Replica(rid=rid, engine=engine,
                                     devices=tuple(shards[i])))
@@ -190,6 +195,10 @@ class ServeFleet:
     @property
     def required_subkeys(self) -> List[str]:
         return self.primary.engine.required_subkeys
+
+    @property
+    def has_gen_lane(self) -> bool:
+        return self.primary.engine.has_gen_lane
 
     @property
     def size(self) -> int:
@@ -246,6 +255,19 @@ class ServeFleet:
                 for _ in range(slots):
                     r.engine.submit(next(it))
                     n += 1
+                r.engine.drain()
+            # The gen ladder too — "every warmed bucket once" includes
+            # the (slot, src-bucket) decode programs, or a measured gen
+            # replay pays their one-time init inside its window. Prime
+            # sources are synthetic declarations padded with exactly
+            # enough distinct word tokens to land in each src bucket,
+            # disjoint from the seeded replay corpus by construction.
+            for lane, slots, src_b in r.engine.gen_warm_buckets():
+                for j in range(slots):
+                    words = " ".join(
+                        f"prime{n + j}w{i}" for i in range(src_b - 3))
+                    r.engine.submit(None, code=f"{words};", lane=lane)
+                n += slots
                 r.engine.drain()
         for r in self.replicas:
             tl = r.engine.clock
@@ -308,40 +330,50 @@ class ServeFleet:
                    key=lambda r: (r.engine.in_flight > 0, r.load()))
         return best
 
-    def submit(self, graph: Mapping, code: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> ServeRequest:
-        """Admit one request through the router.
+    def submit(self, graph: Optional[Mapping], code: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               lane: Optional[str] = None) -> ServeRequest:
+        """Admit one request through the router (``lane="gen"`` routes a
+        generation request — no graph needed).
 
         A rejection from the routed replica (its queue filled between
         the load read and the admit) retries once on the least-loaded
         live sibling before surfacing backpressure to the caller.
         """
-        from deepdfa_tpu.serve.cache import content_hash
+        from deepdfa_tpu.serve.cache import content_hash, text_hash
 
-        try:
-            # Graph-only routing key (code excluded): the same function
-            # routes to the same replica whether it rides the combined
-            # lane, degrades to gnn, or arrives graph-only — so every
-            # cache line the engine may write for this graph (code-keyed
-            # combined, code-free gnn/degraded) accumulates on ONE
-            # replica's LRU.
-            key = content_hash(graph)
-        except Exception:
-            # Malformed payload: route on load alone and let the engine's
-            # admission validator raise its historic BadRequestError
-            # message class (the byte-pinned 400 contract).
-            key = None
+        if lane == "gen":
+            # Gen routing key: the source text IS the model input, so a
+            # re-generation of the same function lands on the replica
+            # whose LRU already holds its tokens.
+            key = text_hash(code) if code is not None else None
+        else:
+            try:
+                # Graph-only routing key (code excluded): the same
+                # function routes to the same replica whether it rides
+                # the combined lane, degrades to gnn, or arrives
+                # graph-only — so every cache line the engine may write
+                # for this graph (code-keyed combined, code-free
+                # gnn/degraded) accumulates on ONE replica's LRU.
+                key = content_hash(graph)
+            except Exception:
+                # Malformed payload: route on load alone and let the
+                # engine's admission validator raise its historic
+                # BadRequestError message class (the byte-pinned 400
+                # contract).
+                key = None
         replica = self.route(key)
         try:
             return replica.engine.submit(graph, code=code,
-                                         deadline_ms=deadline_ms)
+                                         deadline_ms=deadline_ms, lane=lane)
         except RejectedError:
             others = [r for r in self.live if r is not replica]
             if not others:
                 raise
             fallback = min(others, key=lambda r: r.load())
             return fallback.engine.submit(graph, code=code,
-                                          deadline_ms=deadline_ms)
+                                          deadline_ms=deadline_ms,
+                                          lane=lane)
 
     def score_sync(self, graphs: Sequence[Mapping],
                    codes: Optional[Sequence[Optional[str]]] = None,

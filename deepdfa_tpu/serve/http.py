@@ -4,10 +4,13 @@ Contract (documented in README "Serving"):
 
   POST /score
       {"functions": [{"id"?, "graph": {"num_nodes", "senders",
-       "receivers", "feats": {subkey: [...]}}, "code"?}, ...],
+       "receivers", "feats": {subkey: [...]}}, "code"?, "lane"?}, ...],
        "deadline_ms"?}
       -> 200 {"results": [{"rid", "prob", "model", "degraded", "cached"}
               | {"error", ...}, ...]}   (per-function errors inline)
+      lane="gen" entries need only "code": they ride the generation lane
+      (batched-beam CodeT5 decode) and answer {"rid", "tokens", "score",
+      "model": "gen", "cached"}; 400 when no gen lane is attached.
       -> 429 {"error": "rejected", "retry_after_s"} + Retry-After header
          when EVERY function was shed by backpressure
       -> 400 {"error": "bad_request", "detail"} on malformed payloads
@@ -328,8 +331,11 @@ class ServeHandler(BaseHTTPRequestHandler):
             for fn in functions:
                 entry: Dict = {}
                 try:
-                    req = fleet.submit(fn["graph"], code=fn.get("code"),
-                                       deadline_ms=deadline_ms)
+                    lane = fn.get("lane")
+                    req = fleet.submit(
+                        fn["graph"] if lane != "gen" else fn.get("graph"),
+                        code=fn.get("code"), deadline_ms=deadline_ms,
+                        lane=lane)
                     submitted.append((req, entry))
                 except RejectedError as e:
                     entry.update(error="rejected",

@@ -24,6 +24,12 @@ replicas overlap like N devices, arrivals keep landing mid-flush
 of retrying (open-loop semantics). bench.py's ``serve_fleet_rps`` /
 ``serve_fleet_p99_ms`` 1-vs-N comparison runs on it.
 
+The **gen lane** rides the same fleet harness: ``open_loop_trace``'s
+``gen_fraction`` mixes ``lane="gen"`` arrivals (raw source, no graph —
+batched-beam CodeT5 decode, ISSUE 13) into the open-loop schedule, so
+generation throughput/latency under load is measured by the exact same
+discrete-event machinery as scoring.
+
 The **scan lane** (:func:`scan_trace` / :func:`replay_scan`) is the same
 idea one layer earlier: a seeded stream of *raw-source* requests with an
 edit/repeat mix — the PR-diff traffic shape — driven through a
@@ -97,8 +103,9 @@ class ReplicaTimeline:
 @dataclasses.dataclass
 class TraceEvent:
     at: float                 # virtual arrival time (seconds)
-    graph: Mapping
+    graph: Optional[Mapping]
     code: Optional[str] = None
+    lane: Optional[str] = None   # "gen" rides the generation lane
 
 
 def bursty_trace(
@@ -206,6 +213,7 @@ def open_loop_trace(
     rps: float = 2000.0,
     duplicate_fraction: float = 0.25,
     code_fraction: float = 0.0,
+    gen_fraction: float = 0.0,
 ) -> List[TraceEvent]:
     """Open-loop arrival schedule at ``rps`` requests/second.
 
@@ -215,7 +223,10 @@ def open_loop_trace(
     the only load shape that exposes queue-limited throughput.
     ``code_fraction`` of requests carry source text and ride the
     combined lane when the fleet has one (the mixed-lane traffic the
-    fairness gate measures); duplicates exercise the content caches.
+    fairness gate measures); ``gen_fraction`` of requests are
+    *generation* traffic (``lane="gen"``: raw source, no graph — the
+    ISSUE-13 load shape); duplicates exercise the content caches on
+    every lane.
     """
     from deepdfa_tpu.data.synthetic import synthetic_bigvul
 
@@ -232,6 +243,16 @@ def open_loop_trace(
             g = uniques[next_unique]
             next_unique = min(next_unique + 1, len(uniques) - 1)
         code = None
+        lane = None
+        if gen_fraction and rng.random() < gen_fraction:
+            lane = "gen"
+            # Short declarations: every seeded gen source fits the
+            # smallest sensible gen_src_len ladder (<= 12 tokens).
+            code = f"int gen_{int(g['id'])}(char *p);"
+            events.append(TraceEvent(at=t, graph=None, code=code,
+                                     lane=lane))
+            t += float(rng.exponential(1.0 / rps))
+            continue
         if code_fraction and rng.random() < code_fraction:
             code = f"int f_{int(g['id'])}(char *p) {{ return p[0]; }}"
         events.append(TraceEvent(at=t, graph=g, code=code))
@@ -292,7 +313,8 @@ def replay_fleet(fleet, trace: Sequence[TraceEvent],
             ev = trace[i]
             i += 1
             try:
-                requests.append(fleet.submit(ev.graph, code=ev.code))
+                requests.append(fleet.submit(ev.graph, code=ev.code,
+                                             lane=ev.lane))
             except RejectedError:
                 shed += 1
             stalls = 0
@@ -309,8 +331,8 @@ def replay_fleet(fleet, trace: Sequence[TraceEvent],
 
     end = max([clock()] + [tl.busy_until for tl in timelines])
     span = end - (trace[0].at if trace else 0.0)
-    completed = [r for r in requests
-                 if r.result is not None and "prob" in r.result]
+    completed = [r for r in requests if r.result is not None
+                 and ("prob" in r.result or "tokens" in r.result)]
     lat_ms = [(r.completed_at - r.arrival) * 1e3 for r in completed
               if r.completed_at is not None]
     from deepdfa_tpu.core.metrics import latency_quantile

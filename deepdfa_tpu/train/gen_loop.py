@@ -280,25 +280,29 @@ def evaluate_gen(
     eval_loss_fn, gen = fns or _make_eval_fns(
         model, max_target_length, beam_size, mesh
     )
-    losses, preds = [], []
+    losses, preds, valids = [], [], []
     for s, t, n_valid in _batches(
         eval_data, cfg.eval_batch_size, pad_tail=True, pad_id=pad_id
     ):
         s_dev = _lift_rows(s, mesh, host)
         t_dev = _lift_rows(t, mesh, host)
-        # Losses stay on device until the single device_get below — the
-        # old float() here blocked the host BEFORE the gen dispatch each
-        # batch (graftlint GL004). The np.asarray on preds still transfers
-        # per batch; predictions are host outputs.
+        # BOTH accumulators stay on device until the single device_get
+        # below. The old float() on losses blocked the host BEFORE the
+        # gen dispatch each batch (graftlint GL004, fixed in PR 1); the
+        # np.asarray on preds left behind by that pass did the same on
+        # the gen side — every eval batch's loss dispatch waited out the
+        # previous decode instead of queueing behind it (ISSUE 13).
         losses.append(eval_loss_fn(state.params, s_dev, t_dev))
-        preds.append(np.asarray(gen(state.params, s_dev))[:n_valid])
+        preds.append(gen(state.params, s_dev))
+        valids.append(n_valid)
+    losses, preds = jax.device_get((losses, preds))
     pred = (
-        np.concatenate(preds)
+        np.concatenate([p[:n] for p, n in zip(preds, valids)])
         if preds
         else np.zeros((0, max_target_length), np.int32)
     )
     out: Dict[str, Any] = {
-        "eval_loss": (float(np.mean(jax.device_get(losses)))
+        "eval_loss": (float(np.mean(losses))
                       if losses else float("nan")),
         "exact_match": exact_match(
             pred, eval_data["target_ids"][: len(pred)],

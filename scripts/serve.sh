@@ -5,6 +5,7 @@
 #
 #   CHECKPOINT_DIR=runs/deepdfa bash scripts/serve.sh        # serve a run
 #   COMBINED_DIR=runs/combined bash scripts/serve.sh          # + text lane
+#   GEN_DIR=runs/summarize bash scripts/serve.sh              # + gen lane
 #   bash scripts/serve.sh --smoke 8                           # self-test
 #
 # Extra flags pass through to `cli serve` (--port, --batch-slots,
@@ -17,6 +18,9 @@ if [ -n "${CHECKPOINT_DIR:-}" ]; then
 fi
 if [ -n "${COMBINED_DIR:-}" ]; then
   ARGS+=(--combined-checkpoint-dir "$COMBINED_DIR")
+fi
+if [ -n "${GEN_DIR:-}" ]; then
+  ARGS+=(--gen-checkpoint-dir "$GEN_DIR")
 fi
 python -m deepdfa_tpu.cli serve --config configs/default.yaml \
   "${ARGS[@]}" "$@"

@@ -11,9 +11,13 @@ bash scripts/lint.sh
 # SLO-checked (ISSUE 7): its trace is gated on the built-in "smoke" spec,
 # so a post-warmup recompile or a p99 blowout exits nonzero here, not as
 # a log line. Checkpoint env vars are cleared: the smoke's tiny --set
-# shapes must not try to load the eval checkpoint below.
-CHECKPOINT_DIR= COMBINED_DIR= bash scripts/serve.sh --smoke 8 \
+# shapes must not try to load the eval checkpoint below. --gen-lane
+# (ISSUE 13) warms the generation lane's (slot, src-length) decode
+# ladder too, serves lane="gen" rounds over real HTTP, and the same SLO
+# gate asserts compiles_after_warmup=0 ACROSS it.
+CHECKPOINT_DIR= COMBINED_DIR= GEN_DIR= bash scripts/serve.sh --smoke 8 \
   --batch-slots 4 --port 0 \
+  --gen-lane --gen-src-len 32 --gen-max-len 8 --gen-beam 2 \
   --set model.hidden_dim=8 --set model.n_steps=2
 # The same smoke with the observatory fully disabled: DEEPDFA_TELEMETRY=0
 # must keep serving functional with no trace, no SLO gate, and no

@@ -1213,6 +1213,65 @@ def peer(batcher, step_fn, state, batch):
     assert "GL018" not in rules_of(src)
 
 
+def test_gl019_decode_loop_dispatch_fires():
+    # The per-hypothesis decode tax (ISSUE 13): a Python loop over a
+    # decode axis (range(max_len), beams) dispatching a step-shaped
+    # call while carrying state — the exact shape a lax.scan over the
+    # carry replaces. One finding per loop: the loop is the hazard.
+    src = """
+import jax
+
+def decode_all(step_fn, cache, tokens, max_len):
+    for t in range(max_len):
+        logits, cache = step_fn(cache, tokens)
+        tokens = logits
+    return tokens
+
+def per_beam(step_fn, state, beams):
+    for hyp in beams:
+        state, out = step_fn(state, hyp)
+    return state
+"""
+    found = findings_for(src, "GL019")
+    assert len(found) == 2
+    assert {f.function for f in found} == {"decode_all", "per_beam"}
+    assert all("lax.scan" in f.message for f in found)
+
+
+def test_gl019_negatives_unflagged():
+    # The accepted shapes: a data loop over batches (the training-loop
+    # idiom — axis vocabulary decides, not loop shape), carry-free
+    # per-item dispatch (vmap's job), a host-controlled early `break`
+    # (not scan-able as-is), and a layer-stack unroll.
+    src = """
+import jax
+
+def data_loop(step_fn, state, batches):
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+    return state
+
+def independent(step_fn, beams):
+    outs = []
+    for hyp in beams:
+        outs.append(step_fn(hyp))
+    return outs
+
+def early_exit(step_fn, cache, max_len):
+    for t in range(max_len):
+        logits, cache = step_fn(cache)
+        if logits is None:
+            break
+    return cache
+
+def layer_stack(x, layers):
+    for layer in layers:
+        x = layer(x)
+    return x
+"""
+    assert "GL019" not in rules_of(src)
+
+
 def test_gl017_lifecycle_module_is_the_clean_reference():
     # The rule's docstring points at resilience/lifecycle.py as the
     # accepted shape; the module must stay GL017-clean (and clean of
@@ -1499,8 +1558,8 @@ def test_self_check_covers_every_rule_implementation():
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
-                             "GL016", "GL017", "GL018"})
-    assert len(RULES) == 18
+                             "GL016", "GL017", "GL018", "GL019"})
+    assert len(RULES) == 19
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

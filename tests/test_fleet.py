@@ -567,3 +567,62 @@ def test_fleet_http_metrics_health_and_roll():
         assert metrics2["compiles"] == compiles0  # a roll never compiles
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Generation lane over the fleet (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_replay_gen_lane_under_load():
+    """Mixed gnn/gen open-loop traffic over a 2-replica gen fleet: the
+    gen lane completes under the same DES replay as scoring, appears in
+    the per-lane report, routes content-affine on the source text, and
+    the whole run stays zero-recompile after warmup."""
+    from deepdfa_tpu.data.text import HashingT5Tokenizer
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+    from deepdfa_tpu.serve.cache import text_hash
+
+    cfg = ServeConfig(batch_slots=2, deadline_ms=300.0, cache_capacity=0,
+                      gen_src_len=16, gen_src_min_bucket=16,
+                      gen_max_len=8, gen_beam_size=2)
+    gnn = FlowGNN(TINY)
+    gnn_params = random_gnn_params(gnn, cfg)
+    tok = HashingT5Tokenizer(vocab_size=256)
+    gen_model = T5Model(T5Config.tiny(vocab_size=256))
+    src = np.zeros((1, 16), np.int32)
+    gen_params = gen_model.init(jax.random.PRNGKey(0), src, src[:, :4])
+    clock = VirtualClock()
+    timelines = [ReplicaTimeline(clock) for _ in range(2)]
+    fleet = ServeFleet.build(
+        gnn, gnn_params, config=cfg, n_replicas=2,
+        gen_model=gen_model, gen_params=gen_params, gen_tokenizer=tok,
+        clock_factory=lambda i: timelines[i])
+    fleet.warmup()
+    assert fleet.has_gen_lane
+    # prime() covers the gen (slot, src-bucket) ladder too: every primed
+    # bucket must already be warmed (zero compiles) or measured replays
+    # would pay first-execution init inside their window.
+    fleet.prime(graphs_n(sum(cfg.slot_buckets), seed=17))
+    assert fleet.compiles_after_warmup == 0
+    trace = open_loop_trace(40, FEAT, seed=5, rps=400.0,
+                            duplicate_fraction=0.0, gen_fraction=0.4)
+    assert any(ev.lane == "gen" for ev in trace)
+    rep = replay_fleet(fleet, trace, clock)
+    assert rep["shed"] == 0 and rep["completed"] == 40
+    assert rep["compiles_after_warmup"] == 0
+    assert set(rep["lanes"]) == {"gnn", "gen"}
+    assert rep["lanes"]["gen"]["requests"] > 0
+    gen_reqs = [r for r in rep["requests"] if r.lane == "gen"]
+    assert all("tokens" in r.result for r in gen_reqs)
+    # Content-affine gen routing: on an idle fleet the router must pick
+    # the rendezvous-preferred replica for the source's text_hash —
+    # recomputed here independently, so a router that ignored the key
+    # (pure load-based) fails this.
+    from deepdfa_tpu.serve.fleet import _stable_hash
+
+    for code in ("int affinity(void);", "void other_affinity(int);"):
+        key = text_hash(code)
+        want = max(fleet.replicas,
+                   key=lambda r: _stable_hash(f"{key}|{r.rid}")).rid
+        assert fleet.route(key).rid == want

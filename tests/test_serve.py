@@ -701,3 +701,150 @@ def test_http_500_for_failed_flush_then_recovers():
         assert metrics["compiles"] == compiles
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Generation lane (ISSUE 13): batched-beam decode as a served lane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_eng():
+    """Shared warmed engine with a gen lane: tiny T5, beam 2, two source
+    length buckets (8, 16) — 4 slot buckets x 2 src buckets + 2 gnn
+    buckets of warmed executables."""
+    from deepdfa_tpu.data.text import HashingT5Tokenizer
+    from deepdfa_tpu.models.t5 import T5Config, T5Model
+
+    clock = VirtualClock()
+    config = ServeConfig(batch_slots=2, deadline_ms=100.0,
+                         gen_src_len=16, gen_src_min_bucket=8,
+                         gen_max_len=8, gen_beam_size=2)
+    model = FlowGNN(TINY)
+    tok = HashingT5Tokenizer(vocab_size=256)
+    gen_model = T5Model(T5Config.tiny(vocab_size=256))
+    src = np.zeros((1, 16), np.int32)
+    gen_params = gen_model.init(jax.random.PRNGKey(0), src, src[:, :4])
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config, clock=clock,
+                      gen_model=gen_model, gen_params=gen_params,
+                      gen_tokenizer=tok)
+    eng.warmup()
+    return eng, clock, gen_model, gen_params
+
+
+def test_gen_warmup_covers_slot_and_length_ladder(gen_eng):
+    eng = gen_eng[0]
+    assert eng.has_gen_lane
+    # gnn: slots {1, 2}; gen: slots {1, 2} x src {8, 16}.
+    assert eng.gen_warm_buckets() == [("gen", 1, 8), ("gen", 1, 16),
+                                      ("gen", 2, 8), ("gen", 2, 16)]
+    assert eng.n_warm == 6
+    assert eng.compiles_after_warmup == 0
+
+
+def test_gen_lane_serves_tokens_with_zero_recompiles(gen_eng):
+    """Mixed gen + gnn traffic over the warmed engine: tokens come back,
+    the second identical source answers from the content cache, and
+    nothing compiles after warmup — the scoring lanes' acceptance gate
+    applied to generation."""
+    eng, clock, _, _ = gen_eng
+    r1 = eng.submit(None, code="int a(void);", lane="gen")
+    r2 = eng.submit(None, code="int b(int x) { return x + 1; }",
+                    lane="gen")
+    r3 = eng.submit(graphs_n(1, seed=11)[0])
+    eng.drain()
+    for r in (r1, r2):
+        assert r.result["model"] == "gen"
+        assert isinstance(r.result["tokens"], list)
+        assert len(r.result["tokens"]) <= eng.config.gen_max_len
+        assert isinstance(r.result["score"], float)
+    assert r1.src_bucket == 8 and r2.src_bucket == 16  # length buckets
+    assert "prob" in r3.result
+    hit = eng.submit(None, code="int a(void);", lane="gen")
+    assert hit.result["cached"] and hit.result["tokens"] == \
+        r1.result["tokens"]
+    assert eng.compiles_after_warmup == 0
+
+
+def test_gen_lane_matches_direct_beam_search(gen_eng):
+    """Served tokens == a direct beam_search on the same padded ids (the
+    offline-parity gate for the gen lane)."""
+    from deepdfa_tpu.models.t5_generate import beam_search
+    from deepdfa_tpu.train.gen_loop import strip_ids
+
+    eng, _, gen_model, gen_params = gen_eng
+    code = "long parity_check(void);"
+    req = eng.submit(None, code=code, lane="gen")
+    eng.drain()
+    ids, src_b = eng._encode_gen(code)
+    batch = np.full((1, src_b), gen_model.cfg.pad_token_id, np.int32)
+    batch[0, : len(ids)] = ids
+    seq, score = beam_search(gen_model, gen_params, jax.numpy.asarray(batch),
+                             eng.config.gen_max_len,
+                             beam_size=eng.config.gen_beam_size)
+    want = strip_ids(np.asarray(seq)[0], gen_model.cfg.pad_token_id,
+                     gen_model.cfg.eos_token_id)
+    assert req.result["tokens"] == want
+    assert req.result["score"] == pytest.approx(float(np.asarray(score)[0]))
+    assert eng.compiles_after_warmup == 0
+
+
+def test_gen_lane_admission_errors(gen_eng):
+    eng = gen_eng[0]
+    # Over the token cap -> 413 class.
+    with pytest.raises(OversizedError, match="gen-lane cap"):
+        eng.submit(None, code=" ".join(f"tok{i}" for i in range(40)),
+                   lane="gen")
+    # lane="gen" without code -> 400 class.
+    with pytest.raises(BadRequestError, match="requires 'code'"):
+        eng.submit(None, lane="gen")
+    # Unknown lane -> 400 class.
+    with pytest.raises(BadRequestError, match="unknown lane"):
+        eng.submit(graphs_n(1)[0], lane="combined")
+    assert eng.pending() == 0
+
+
+def test_gen_lane_absent_is_a_bad_request(eng4):
+    eng, _ = eng4
+    with pytest.raises(BadRequestError, match="no generation lane"):
+        eng.submit(None, code="int f(void);", lane="gen")
+
+
+def test_http_score_gen_lane(gen_eng):
+    """lane="gen" over real HTTP: tokens in the 200 body, byte-identical
+    replay served from the cache, no graph required."""
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    eng = gen_eng[0]
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(doc):
+        req = urllib.request.Request(
+            f"{base}/score", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    try:
+        doc = {"functions": [
+            {"id": 0, "lane": "gen", "code": "int http_gen(void);"},
+            {"id": 1, "lane": "gen", "code": "void other(int);"},
+        ]}
+        out = post(doc)["results"]
+        assert all(r["model"] == "gen" and isinstance(r["tokens"], list)
+                   for r in out)
+        again = post(doc)["results"]
+        assert all(r["cached"] and r["tokens"] == out[i]["tokens"]
+                   for i, r in enumerate(again))
+        # A gen entry with no code stays an inline 400-class error.
+        bad = post({"functions": [{"lane": "gen"}]})["results"]
+        assert bad[0]["error"] == "bad_request"
+        assert eng.compiles_after_warmup == 0
+    finally:
+        server.shutdown()

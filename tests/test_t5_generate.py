@@ -1,5 +1,13 @@
 """T5 incremental decoding: KV-cache parity with full recompute, greedy and
-beam search."""
+beam search — including the ISSUE-13 batched-beam layout (one physical
+cache + ancestry-resolved reads) against the pre-13 gather-every-step
+implementation as oracle, and the length-bucketed early exit's
+bitwise-equality contract."""
+
+import json
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -9,11 +17,15 @@ import pytest
 from deepdfa_tpu.models.t5 import T5Config, T5Model, shift_right
 from deepdfa_tpu.models.t5_generate import (
     beam_search,
+    beam_search_reference,
+    default_segment_len,
     generate,
     greedy_decode,
 )
 
 CFG = T5Config.tiny(vocab_size=64)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _setup(b=2, src_len=10, seed=0):
@@ -157,3 +169,169 @@ def test_generate_dispatch():
     g1 = generate(model, params, src, max_len=6, beam_size=1)
     g2 = generate(model, params, src, max_len=6, beam_size=2)
     assert g1.shape == g2.shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: batched-beam parity vs the pre-13 implementation as oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_batched_beam_matches_reference_oracle(seed):
+    """The ancestry-cache beam must reproduce the gather-every-step
+    oracle exactly: the per-step math is identical (same values read in
+    the same order through the ancestry index), only the cache movement
+    changed. Clear-margin fixture (seed set avoids near-tied logits);
+    sequences AND scores compared."""
+    model, params, src = _setup(seed=seed)
+    ref_seq, ref_score = beam_search_reference(
+        model, params, src, max_len=8, beam_size=4)
+    new_seq, new_score = beam_search(model, params, src, max_len=8,
+                                     beam_size=4)
+    np.testing.assert_array_equal(np.asarray(ref_seq), np.asarray(new_seq))
+    np.testing.assert_allclose(np.asarray(ref_score),
+                               np.asarray(new_score), atol=1e-6)
+
+
+def test_batched_beam_onehot_gather_parity():
+    """The A/B pair (ISSUE 13 gates the read on a bench A/B — the
+    one-hot bmm measured a LOSS but must stay numerically right or the
+    A/B is meaningless)."""
+    model, params, src = _setup(seed=3)
+    ta_seq, ta_score = beam_search(model, params, src, max_len=8,
+                                   beam_size=4, gather_impl="take_along")
+    oh_seq, oh_score = beam_search(model, params, src, max_len=8,
+                                   beam_size=4, gather_impl="onehot")
+    np.testing.assert_array_equal(np.asarray(ta_seq), np.asarray(oh_seq))
+    np.testing.assert_allclose(np.asarray(ta_score), np.asarray(oh_score),
+                               atol=1e-5)
+
+
+def test_batched_beam_jit_and_segments_match():
+    """Jitted whole-program decode (the serve-lane AOT unit) and an
+    unusual segment length produce the same result as the default."""
+    model, params, src = _setup(seed=4)
+    base_seq, base_score = beam_search(model, params, src, max_len=8,
+                                       beam_size=4)
+    jit_seq, jit_score = jax.jit(
+        lambda p, s: beam_search(model, p, s, max_len=8, beam_size=4)
+    )(params, src)
+    seg_seq, seg_score = beam_search(model, params, src, max_len=8,
+                                     beam_size=4, segment_len=1)
+    np.testing.assert_array_equal(np.asarray(base_seq), np.asarray(jit_seq))
+    np.testing.assert_array_equal(np.asarray(base_seq), np.asarray(seg_seq))
+    np.testing.assert_allclose(np.asarray(base_score), np.asarray(seg_score),
+                               atol=1e-6)
+
+
+def test_default_segment_len_divides():
+    for max_len in (1, 7, 8, 16, 100, 128):
+        s = default_segment_len(max_len)
+        assert max_len % s == 0 and 1 <= s <= max(max_len // 4, 1)
+
+
+def test_segment_len_must_divide_max_len():
+    model, params, src = _setup()
+    with pytest.raises(ValueError, match="divide"):
+        beam_search(model, params, src, max_len=8, beam_size=2,
+                    segment_len=3)
+
+
+def _eos_biased_setup(seed=1, scale=30.0):
+    """A fixture whose every row actually finishes: the eos embedding row
+    is a constant positive vector, so eos wins the logit race early and
+    all beams terminate well before max_len."""
+    rng = np.random.RandomState(seed)
+    src = jnp.asarray(rng.randint(3, CFG.vocab_size, size=(2, 10)))
+    model = T5Model(CFG)
+    params = model.init(jax.random.PRNGKey(seed), src,
+                        jnp.zeros((2, 4), jnp.int32))
+    emb = np.asarray(params["params"]["shared"]["embedding"]).copy()
+    emb[CFG.eos_token_id] = np.abs(emb).mean() * scale
+    params["params"]["shared"]["embedding"] = jnp.asarray(emb)
+    return model, params, src
+
+
+def test_early_exit_stops_early_and_is_bitwise_equal():
+    """The length-bucketed early exit contract (ISSUE 13): an all-EOS'd
+    batch stops at a segment boundary before max_len, and the outputs
+    are BITWISE equal to the full-length run (the termination bound is
+    exact, not heuristic)."""
+    model, params, src = _eos_biased_setup()
+    e_seq, e_score, e_aux = beam_search(model, params, src, max_len=16,
+                                        beam_size=4, segment_len=4,
+                                        with_aux=True)
+    f_seq, f_score, f_aux = beam_search(model, params, src, max_len=16,
+                                        beam_size=4, segment_len=4,
+                                        early_exit=False, with_aux=True)
+    assert int(f_aux["steps"]) == 16
+    assert int(e_aux["steps"]) < 16  # stopped at a segment boundary
+    assert int(e_aux["steps"]) % 4 == 0
+    # Every row decided: the winning hypotheses are finished (contain eos).
+    assert (np.asarray(e_seq) == CFG.eos_token_id).any(axis=1).all()
+    np.testing.assert_array_equal(np.asarray(e_seq), np.asarray(f_seq))
+    assert np.asarray(e_score).tobytes() == np.asarray(f_score).tobytes()
+
+
+def test_early_exit_conservative_on_undecided_batch():
+    """A random-param model rarely EOS's every beam: the bound must hold
+    the loop to max_len (never exit early on an undecided batch)."""
+    model, params, src = _setup(seed=0)
+    _, _, aux = beam_search(model, params, src, max_len=8, beam_size=4,
+                            segment_len=2, with_aux=True)
+    assert int(aux["steps"]) == 8
+
+
+def test_batched_beam_parity_on_8_virtual_devices(tmp_path):
+    """The oracle parity on a forced-8-device CPU mesh: batch rows shard
+    over the data axis (the gen_loop eval sharding), reference and
+    batched beams jitted with the same shardings must agree."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_EIGHT_DEVICE_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, str(worker)], env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    result = json.loads(line[0][len("RESULT "):])
+    assert result["n_devices"] == 8
+    assert result["seq_equal"] and result["score_maxdiff"] <= 1e-6
+
+
+_EIGHT_DEVICE_WORKER = """
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.t5 import T5Config, T5Model
+from deepdfa_tpu.models.t5_generate import beam_search, beam_search_reference
+from deepdfa_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+
+CFG = T5Config.tiny(vocab_size=64)
+rng = np.random.RandomState(0)
+src = jnp.asarray(rng.randint(3, CFG.vocab_size, size=(8, 10)))
+model = T5Model(CFG)
+params = model.init(jax.random.PRNGKey(0), src, jnp.zeros((8, 4), jnp.int32))
+
+mesh = make_mesh(n_data=8)
+rep, dsh = replicated(mesh), batch_sharding(mesh)
+src = jax.device_put(src, dsh)
+ref = jax.jit(
+    lambda p, s: beam_search_reference(model, p, s, max_len=8, beam_size=4),
+    in_shardings=(rep, dsh), out_shardings=rep)(params, src)
+new = jax.jit(
+    lambda p, s: beam_search(model, p, s, max_len=8, beam_size=4),
+    in_shardings=(rep, dsh), out_shardings=rep)(params, src)
+print("RESULT " + json.dumps({
+    "n_devices": jax.device_count(),
+    "seq_equal": bool(np.array_equal(np.asarray(ref[0]), np.asarray(new[0]))),
+    "score_maxdiff": float(np.max(np.abs(np.asarray(ref[1])
+                                         - np.asarray(new[1])))),
+}))
+"""
