@@ -608,6 +608,88 @@ def bench_telemetry_overhead(n_steps: int = 200, reps: int = 3,
     }
 
 
+def bench_trace_propagation(n_requests: int = 256, batch_slots: int = 8,
+                            reps: int = 3, gate_pct: float = 2.0) -> dict:
+    """The distributed-trace tax (ISSUE 14 gate: < 2%, the PR-5
+    observability discipline).
+
+    A/B over the SAME warmed serve replay: the instrumented side submits
+    every request with a trace id (the traceparent-continuation path —
+    two extra attrs on every ``serve.request`` span) under an active
+    telemetry run with the sharding/rotation machinery on the write path
+    (an explicit flush per rep makes the events durable inside the timed
+    region); the other side is ``DEEPDFA_TELEMETRY=0``, where every hook
+    is a no-op. Alternated back-to-back per rep, BEST-of-reps per the
+    ``_timed`` variance protocol. The cache is disabled so both sides do
+    identical compute every rep; compiles after warmup must stay 0.
+    """
+    import shutil
+    import tempfile
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.core.config import FlowGNNConfig
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock
+    from deepdfa_tpu.telemetry import context as trace_context
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_cfg = FlowGNNConfig(
+        message_impl="band" if on_tpu else "segment",
+        dtype="bfloat16" if on_tpu else "float32",
+    )
+    config = ServeConfig(batch_slots=batch_slots, cache_capacity=0)
+    model = FlowGNN(model_cfg)
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config, clock=VirtualClock())
+    graphs = synthetic_bigvul(n_requests, model_cfg.feature,
+                              positive_fraction=0.5, seed=0)
+    trace_ids = [trace_context.new_trace_id() for _ in range(n_requests)]
+
+    def run_replay(with_trace: bool) -> float:
+        t0 = time.perf_counter()
+        for i, g in enumerate(graphs):
+            engine.submit(
+                g, trace_id=trace_ids[i] if with_trace else None,
+                trace_continued=with_trace)
+        engine.drain()
+        telemetry.flush()  # sharding on the measured path (no-op when off)
+        return time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="bench_trace_prop_")
+    t_on, t_off = [], []
+    try:
+        with telemetry.run_scope(tmp):
+            engine.warmup()
+            compiles0 = engine.stats.compiles
+            run_replay(True)  # warm both code paths + the event machinery
+            for _ in range(reps):
+                t_on.append(run_replay(True))
+                telemetry.set_enabled(False)
+                try:
+                    t_off.append(run_replay(False))
+                finally:
+                    telemetry.set_enabled(None)
+            recompiled = engine.stats.compiles != compiles0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if recompiled:
+        raise AssertionError(
+            "trace-propagation bench recompiled after warmup")
+    on_s, off_s = float(np.min(t_on)), float(np.min(t_off))
+    pct = (on_s - off_s) / off_s * 100.0
+    return {
+        "overhead_pct": pct,
+        "gate_pct": gate_pct,
+        "gate_ok": pct < gate_pct,
+        "instrumented_rps": n_requests / on_s,
+        "disabled_rps": n_requests / off_s,
+        "n_requests": n_requests,
+    }
+
+
 def bench_serve(n_requests: int = 512, batch_slots: int = 16,
                 seed: int = 0) -> dict:
     """Serving-path latency/throughput on THE seeded bursty trace.
@@ -1180,6 +1262,10 @@ def main() -> None:
     # train loop over the same AOT step — the ISSUE-5 gate holds this
     # under 2%.
     telemetry_report = bench_telemetry_overhead()
+    # Distributed-trace tax (ISSUE 14): propagation + sharding on vs
+    # DEEPDFA_TELEMETRY=0 over the same warmed serve replay, same <2%
+    # discipline.
+    trace_prop_report = bench_trace_propagation()
     combined_eps, comb_diag = bench_combined_train(attention_impl="flash",
                                                    diagnostics=True)
     # The A/B at the parity shape, re-checked every run (flash wins since
@@ -1426,6 +1512,25 @@ def main() -> None:
                         "disabled_steps_per_sec": round(
                             telemetry_report["disabled_steps_per_sec"], 1),
                         "n_steps": telemetry_report["n_steps"],
+                    },
+                    {
+                        # Distributed-trace tax (ISSUE 14): traceparent
+                        # continuation + shard-writing on vs
+                        # DEEPDFA_TELEMETRY=0, same warmed serve replay.
+                        "metric": "trace_propagation_overhead_pct",
+                        "value": round(
+                            trace_prop_report["overhead_pct"], 2),
+                        "unit": "%",
+                        # new capability: the reference has no trace plane
+                        "vs_baseline": None,
+                        # MUST stay true: the <2% observability-tax gate.
+                        "gate_ok": trace_prop_report["gate_ok"],
+                        "gate_pct": trace_prop_report["gate_pct"],
+                        "instrumented_rps": round(
+                            trace_prop_report["instrumented_rps"], 1),
+                        "disabled_rps": round(
+                            trace_prop_report["disabled_rps"], 1),
+                        "n_requests": trace_prop_report["n_requests"],
                     },
                     {
                         "metric": "combined_train_examples_per_sec",

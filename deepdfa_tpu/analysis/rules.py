@@ -170,6 +170,31 @@ Rule catalogue (each backed by a positive+negative fixture in
                              surgery), and data loops over batches stay
                              unflagged — precision over recall, the
                              empty-baseline contract.
+  GL020 subprocess-without-trace-context  spawning a deepdfa entrypoint
+                             (a ``Popen``/``run``-family call whose argv
+                             names a ``deepdfa_tpu`` module — literally,
+                             through a name assigned such a list, or via
+                             a module-local argv-builder function)
+                             without propagating the distributed trace
+                             context into the child env: the child's
+                             telemetry then lands in an orphan run
+                             instead of a shard of the parent's, and a
+                             cross-process drain becomes unauditable
+                             (ISSUE 14). The accepted shapes: ``env=``
+                             built by a ``*child_env``/``*trace_env``
+                             helper (``telemetry.context.child_env`` or
+                             a module-local wrapper whose body calls
+                             one / references the
+                             ``DEEPDFA_TRACE_CONTEXT`` literal), or any
+                             env expression carrying that literal. A
+                             ``ProcessPoolExecutor`` construction is the
+                             fork-side of the same hazard: it must
+                             install a trace-context ``initializer=``
+                             (``context.init_forked_worker``) so forked
+                             workers rebind to their own shard. Non-
+                             deepdfa argvs and receivers of unknown
+                             provenance stay unflagged — precision over
+                             recall, the empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -234,6 +259,7 @@ RULES: Dict[str, str] = {
     "GL017": "unsafe-signal-handler",
     "GL018": "device-dispatch-under-shared-lock",
     "GL019": "per-hypothesis-decode-dispatch",
+    "GL020": "subprocess-without-trace-context",
 }
 
 _JIT_NAMES = frozenset({
@@ -358,6 +384,14 @@ _DECODE_AXIS_RE = re.compile(
     r"\b(beams?|num_beams|beam_size|hyps?|hypotheses|hypothesis|"
     r"max_len|max_length|max_target_length|max_new_tokens|decode_steps|"
     r"decode_len)\b", re.IGNORECASE)
+# GL020: the deepdfa-entrypoint argv marker, the env-helper naming
+# convention that counts as propagation, the env literal that proves it,
+# and the initializer-name shapes accepted on a ProcessPoolExecutor.
+_ENTRYPOINT_SUBSTR = "deepdfa_tpu"
+_TRACE_ENV_KEY = "DEEPDFA_TRACE_CONTEXT"
+_TRACE_ENV_HELPER_RE = re.compile(r"(child_env|trace_env)$")
+_TRACE_INIT_RE = re.compile(r"(trace|context|init_forked)")
+_PPE_LEAF = "ProcessPoolExecutor"
 _INGEST_CLEANERS = frozenset(
     form
     for name in _VALIDATOR_FNS
@@ -507,6 +541,32 @@ class _Module:
             }
             if attrs:
                 self.class_locks[node.name] = attrs
+        # GL020 facts: module defs that BUILD a deepdfa-entrypoint argv
+        # (a list/tuple literal holding a "deepdfa_tpu…" string somewhere
+        # in their body — the chaos `_fit_argv` shape; docstrings that
+        # merely mention the package never sit in a list literal), and
+        # module defs that count as trace-env helpers (their body calls
+        # a *child_env/*trace_env function or carries the
+        # DEEPDFA_TRACE_CONTEXT literal — the chaos `_child_env` shape).
+        self.entrypoint_builders: Set[str] = set()
+        self.trace_env_helpers: Set[str] = set()
+        for name, dn in self.def_nodes.items():
+            for sub in ast.walk(dn):
+                if isinstance(sub, (ast.List, ast.Tuple)) and any(
+                    isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)
+                    and _ENTRYPOINT_SUBSTR in el.value
+                    for el in sub.elts
+                ):
+                    self.entrypoint_builders.add(name)
+                if isinstance(sub, ast.Constant) \
+                        and sub.value == _TRACE_ENV_KEY:
+                    self.trace_env_helpers.add(name)
+                if isinstance(sub, ast.Call):
+                    dotted = self.resolve(sub.func)
+                    if dotted is not None and _TRACE_ENV_HELPER_RE.search(
+                            dotted.rsplit(".", 1)[-1]):
+                        self.trace_env_helpers.add(name)
         # Local defs wrapped by jax.jit(...) / jit_dp_step(...) anywhere in
         # the module: their bodies run under trace.
         self.jit_wrapped: Set[str] = set()
@@ -649,6 +709,7 @@ class _FunctionChecker:
         self._check_unchecked_ingest()
         self._check_metric_cardinality()
         self._check_subprocess_timeout()
+        self._check_trace_context()
         self._check_pallas_interpret()
         self._check_signal_handlers()
         self._check_lock_dispatch()
@@ -1067,6 +1128,110 @@ class _FunctionChecker:
                 "a wedged child blocks the worker forever; pass "
                 "timeout= (handling subprocess.TimeoutExpired) or kill "
                 "the child first")
+
+    # -- subprocess without trace context (GL020) ----------------------------
+
+    def _gl020_env_ok(self, env_expr: ast.expr,
+                      env_names: Set[str]) -> bool:
+        """Does this ``env=`` expression propagate the trace context?
+        Accepted: any expression whose source carries the
+        DEEPDFA_TRACE_CONTEXT literal, a call to a ``*child_env``/
+        ``*trace_env`` helper (the blessed propagation point — including
+        module-local wrappers whose body does either), or a name
+        assigned one of those function-wide."""
+        if _TRACE_ENV_KEY in _expr_text(env_expr):
+            return True
+        if isinstance(env_expr, ast.Call):
+            dotted = self.mod.resolve(env_expr.func) \
+                or _expr_text(env_expr.func)
+            leaf = dotted.rsplit(".", 1)[-1]
+            if _TRACE_ENV_HELPER_RE.search(leaf) \
+                    or leaf in self.mod.trace_env_helpers:
+                return True
+        if isinstance(env_expr, ast.Name) and env_expr.id in env_names:
+            return True
+        return False
+
+    def _gl020_is_entrypoint_argv(self, expr: ast.expr,
+                                  argv_names: Set[str]) -> bool:
+        """Is this argv a deepdfa entrypoint: a literal list/tuple naming
+        a deepdfa_tpu module, a name assigned one function-wide, or a
+        call to a module-local argv builder?"""
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            return any(isinstance(el, ast.Constant)
+                       and isinstance(el.value, str)
+                       and _ENTRYPOINT_SUBSTR in el.value
+                       for el in expr.elts)
+        if isinstance(expr, ast.Name):
+            return expr.id in argv_names
+        if isinstance(expr, ast.Call):
+            dotted = self.mod.resolve(expr.func) or _expr_text(expr.func)
+            return dotted.rsplit(".", 1)[-1] in self.mod.entrypoint_builders
+        return False
+
+    def _check_trace_context(self) -> None:
+        """Deepdfa entrypoint spawns must carry the distributed trace
+        context (ISSUE 14): a child started without
+        ``DEEPDFA_TRACE_CONTEXT`` writes its telemetry into an orphan
+        run, and the cross-process timeline the chaos/drain audits read
+        silently loses a participant. ProcessPoolExecutor is the fork
+        flavor: without a trace-context initializer the forked workers'
+        events die in copied rings."""
+        argv_names: Set[str] = set()
+        env_names: Set[str] = set()
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            argv_hit = (isinstance(v, (ast.List, ast.Tuple)) and any(
+                isinstance(el, ast.Constant) and isinstance(el.value, str)
+                and _ENTRYPOINT_SUBSTR in el.value for el in v.elts))
+            env_hit = self._gl020_env_ok(v, env_names)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if argv_hit:
+                        argv_names.add(t.id)
+                    if env_hit:
+                        env_names.add(t.id)
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self.mod.resolve(node.func)
+            if dotted is None:
+                continue
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf == _PPE_LEAF:
+                init_kw = next((kw.value for kw in node.keywords
+                                if kw.arg == "initializer"), None)
+                init_name = (self.mod.resolve(init_kw)
+                             or _expr_text(init_kw)) if init_kw is not None \
+                    else ""
+                if init_kw is None or not _TRACE_INIT_RE.search(init_name):
+                    self._report(
+                        "GL020", node,
+                        "ProcessPoolExecutor without a trace-context "
+                        "initializer — forked workers' telemetry dies in "
+                        "copied rings; pass initializer=telemetry.context"
+                        ".init_forked_worker so each worker rebinds to "
+                        "its own shard of the active run")
+                continue
+            if leaf != _POPEN_LEAF and dotted not in _SUBPROCESS_ONESHOTS:
+                continue
+            argv = node.args[0] if node.args else None
+            if argv is None \
+                    or not self._gl020_is_entrypoint_argv(argv, argv_names):
+                continue
+            env_kw = next((kw.value for kw in node.keywords
+                           if kw.arg == "env"), None)
+            if env_kw is not None and self._gl020_env_ok(env_kw, env_names):
+                continue
+            self._report(
+                "GL020", node,
+                "deepdfa entrypoint spawned without propagating "
+                "DEEPDFA_TRACE_CONTEXT into the child env — its telemetry "
+                "lands in an orphan run instead of a shard of this one; "
+                "build the env with telemetry.context.child_env(process) "
+                "(or a module-local *child_env wrapper)")
 
     # -- unsafe signal handler (GL017) ---------------------------------------
 

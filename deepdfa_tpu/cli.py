@@ -1128,12 +1128,19 @@ def _smoke_http(engine, host: str, port: int, n: int,
     """Self-drive the full HTTP stack with ``n`` synthetic functions
     (chunks exercise batching; a duplicated chunk exercises the cache).
     With a scan service attached, one ``POST /scan`` round proves the
-    raw-source edge end-to-end over real HTTP."""
+    raw-source edge end-to-end over real HTTP.
+
+    Every POST carries a traceparent header and records a
+    ``client.request`` span under the same trace id (ISSUE 14), so the
+    smoke trace demonstrates the client↔server join the report's
+    ``propagation`` section audits — coverage on the smoke must be
+    complete, and cmd_serve gates on it."""
     import threading
     import urllib.request
 
     from deepdfa_tpu.data.synthetic import synthetic_bigvul
     from deepdfa_tpu.serve.http import ServeHTTPServer
+    from deepdfa_tpu.telemetry import context as trace_context
 
     server = ServeHTTPServer((host, port), engine, slo_monitor=slo_monitor,
                              scan_service=scan_service)
@@ -1144,12 +1151,21 @@ def _smoke_http(engine, host: str, port: int, n: int,
     base = f"http://{host}:{bound_port}"
 
     def post(doc, path="/score"):
+        trace_id = trace_context.new_trace_id()
         req = urllib.request.Request(
             f"{base}{path}", data=json.dumps(doc).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json",
+                     trace_context.TRACEPARENT_HEADER:
+                         trace_context.make_traceparent(trace_id)},
         )
-        with urllib.request.urlopen(req, timeout=120) as resp:
-            return json.loads(resp.read())
+        t0 = telemetry.now()
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())
+        finally:
+            telemetry.record_span("client.request", t0,
+                                  trace_id=trace_id, path=path,
+                                  n=len(doc.get("functions", [])))
 
     try:
         graphs = synthetic_bigvul(n, feature, positive_fraction=0.5, seed=0)
@@ -1335,10 +1351,31 @@ def cmd_serve(args) -> Dict[str, Any]:
     # reports only its own functional checks.
     if run_dir:
         report["telemetry"] = os.path.join(run_dir, "telemetry")
-        if telemetry.enabled() and args.slo != "none":
+        if telemetry.enabled():
             from deepdfa_tpu.telemetry.report import trace_report
 
-            _apply_slo_gate(report, trace_report(run_dir), args.slo)
+            trace_rep = trace_report(run_dir)
+            if args.slo != "none":
+                _apply_slo_gate(report, trace_rep, args.slo)
+            # Trace-plane gate (ISSUE 14): the smoke's merged-shard
+            # report must round-trip and show the client↔server join —
+            # every _smoke_http POST sent a traceparent, so propagation
+            # coverage on this trace must be complete and at least one
+            # trace id must join a client span to its serve.request.
+            prop = trace_rep.get("propagation") or {}
+            report["propagation"] = {
+                k: prop.get(k)
+                for k in ("coverage", "continued_requests",
+                          "joined_traces", "client_ms_p50",
+                          "server_ms_p50", "client_minus_server_ms_p50")
+            }
+            report["trace_processes"] = sorted(
+                trace_rep.get("processes") or {})
+            if not (prop.get("continued_requests")
+                    and prop.get("joined_traces")):
+                logger.error("serve smoke: no propagated traces in the "
+                             "report (propagation=%s)", prop)
+                report["ok"] = False
     if not report["ok"]:
         report["exit_code"] = 1
     print(json.dumps(report))
@@ -1690,6 +1727,23 @@ def cmd_trace(args) -> Dict[str, Any]:
             ex["id"] = i
         splits = make_splits(examples, seed=args.seed)
         with telemetry.run_scope(run_dir):
+            # Cross-process leg (ISSUE 14): a real forked pmap pool whose
+            # workers emit events from their own processes — each lands
+            # in its own shard of THIS run, and the merged report must
+            # see them under a distinct process name. Forked BEFORE the
+            # fit dispatches anything: os.fork() from a process whose
+            # JAX thread pools are already hot risks the classic
+            # fork-while-a-thread-holds-a-lock wedge — forking first
+            # keeps the smoke's fork window as single-threaded as this
+            # process gets.
+            from deepdfa_tpu.etl.parallel import pmap
+
+            def _probe(i):
+                telemetry.event("smoke.child_work", item=int(i))
+                return int(i)
+
+            child_ok = pmap(_probe, list(range(4)), workers=2,
+                            desc="trace-smoke") == [0, 1, 2, 3]
             fit(FlowGNN(model_cfg), examples, splits,
                 TrainConfig(max_epochs=2, seed=args.seed),
                 DataConfig(batch_size=8, eval_batch_size=8), log_every=2)
@@ -1697,6 +1751,11 @@ def cmd_trace(args) -> Dict[str, Any]:
         trace_json = os.path.join(run_dir, "telemetry", "trace.json")
         with open(trace_json) as f:
             trace_doc = json.load(f)
+        procs = report.get("processes") or {}
+        child_procs = [p for p in procs if p != "main"]
+        proc_meta = [e for e in trace_doc.get("traceEvents", [])
+                     if e.get("ph") == "M"
+                     and e.get("name") == "process_name"]
         checks = {
             "steps_recorded": report["train"]["steps"] > 0,
             "fenced_windows": report["train"]["fenced_windows"] > 0,
@@ -1706,6 +1765,16 @@ def cmd_trace(args) -> Dict[str, Any]:
             "no_faults": report["faults"]["total"] == 0,
             "no_drops": report["telemetry_drops"] == 0,
             "trace_json_valid": bool(trace_doc.get("traceEvents")),
+            # Merged-shard round-trip: child processes' events survived
+            # into the one report/trace under their own identity.
+            "child_items_ok": child_ok,
+            "cross_process_shards": len(child_procs) >= 1,
+            "child_events_merged": any(procs[p]["events"] > 0
+                                       for p in child_procs),
+            "merged_trace_processes":
+                len({m.get("pid") for m in proc_meta}) >= 2,
+            "no_torn_rows": all(p.get("torn_rows", 0) == 0
+                                for p in procs.values()),
         }
         out = {"smoke": True, "ok": all(checks.values()), "checks": checks,
                "report": report}

@@ -88,13 +88,21 @@ class JoernSession:
 
         self._master, slave = pty.openpty()
         try:
+            # Trace-context propagation (ISSUE 14): the child env carries
+            # DEEPDFA_TRACE_CONTEXT for this worker, so a deepdfa-python
+            # transport (the hermetic fake Joern is one) could shard into
+            # the active run; a real JVM simply ignores it. A stale
+            # inherited payload is scrubbed either way.
+            from deepdfa_tpu.telemetry import context as trace_context
+
             self._proc = subprocess.Popen(
                 argv,
                 stdin=slave,
                 stdout=slave,
                 stderr=slave,
                 cwd=self.workspace,
-                env={**os.environ, "TERM": "dumb"},
+                env=trace_context.child_env(f"joern-{worker_id}",
+                                            TERM="dumb"),
                 close_fds=True,
             )
         except BaseException:

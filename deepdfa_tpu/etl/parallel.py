@@ -33,6 +33,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from deepdfa_tpu import telemetry
 from deepdfa_tpu.resilience import inject
+from deepdfa_tpu.telemetry import context as trace_context
 
 logger = logging.getLogger(__name__)
 
@@ -57,12 +58,30 @@ def _call(indexed: Tuple[int, Any]):
     idx, item = indexed
     try:
         inject.fire("etl.item", index=idx)
-        return _ACTIVE_FN(item)
+        result = _ACTIVE_FN(item)
     except Exception as e:  # per-item fault tolerance: record, don't abort
-        return (_SENTINEL_ERROR, repr(item)[:200], f"{type(e).__name__}: {e}")
+        result = (_SENTINEL_ERROR, repr(item)[:200],
+                  f"{type(e).__name__}: {e}")
+    if telemetry.in_child_shard():
+        # A child process writing a shard of the parent's run (ISSUE 14)
+        # makes each item's events durable before the next — a killed
+        # worker costs at most its in-flight item's tail, and the merged
+        # report still sees every completed item. Never fatal: a shard
+        # write failure (disk full, run dir gone) costs the trace, not
+        # the sweep — the per-item fault-tolerance contract holds on the
+        # serial path too, where this runs outside the try above.
+        try:
+            telemetry.flush()
+        except Exception:
+            logger.warning("per-item telemetry flush failed",
+                           exc_info=True)
+    return result
 
 
 def _isolated_entry(indexed: Tuple[int, Any], queue) -> None:
+    # The isolated child is a fork: rebind the inherited run to this
+    # process's own shard so its events merge instead of dying with it.
+    trace_context.init_forked_worker("etl-iso")
     queue.put(_call(indexed))
 
 
@@ -128,9 +147,11 @@ def pmap(
     """
     attempts = max(attempts, 1)
     indexed = list(enumerate(items))
-    # Telemetry: the map itself is one span; per-item events are emitted
-    # from the PARENT as results land (forked workers' in-memory rings die
-    # with them — the parent is the only durable writer).
+    # Telemetry: the map itself is one span; per-item bookkeeping events
+    # are emitted from the PARENT as results land. Worker-side events
+    # (anything `fn` itself emits) land in each forked worker's own shard
+    # of the active run (trace_context.init_forked_worker) and merge into
+    # the same timeline offline — they no longer die in copied rings.
     with telemetry.span("etl.pmap", n_items=len(items), workers=workers,
                         desc=desc or "pmap") as pmap_span:
         return _pmap_locked(fn, indexed, items, workers, desc, failed_log,
@@ -158,8 +179,14 @@ def _pmap_locked(fn, indexed, items, workers, desc, failed_log, attempts,
                 from concurrent.futures import ProcessPoolExecutor
 
                 results = []
+                # initializer: each forked worker rebinds the inherited
+                # telemetry run to its own events-<process>-<pid>.jsonl
+                # shard (GL020's blessed shape for module workers) —
+                # worker-side spans/events used to die in copied rings.
                 with ProcessPoolExecutor(
-                    max_workers=workers, mp_context=mp.get_context("fork")
+                    max_workers=workers, mp_context=mp.get_context("fork"),
+                    initializer=trace_context.init_forked_worker,
+                    initargs=("etl-pool",),
                 ) as pool:
                     futures = [pool.submit(_call, x) for x in indexed]
                     for x, fut in zip(indexed, futures):

@@ -686,9 +686,25 @@ def _fit_argv(run_dir: str, n_examples: int, epochs: int,
     return argv
 
 
-def _child_env(**extra: str) -> Dict[str, str]:
+def _child_env(process: "str | None" = None, **extra: str) -> Dict[str, str]:
+    """Subprocess env for the SIGTERM scenarios' children.
+
+    ``process`` opts the child into the parent's trace plane (ISSUE 14):
+    ``DEEPDFA_TRACE_CONTEXT`` rides the env (via the blessed
+    ``context.child_env`` helper GL020 polices) so the child's telemetry
+    lands as an ``events-<process>-<pid>.jsonl`` shard of the soak's own
+    run — its drain spans appear in the parent's merged trace. Children
+    whose scenarios audit their OWN run dir (serve_lame_duck) pass no
+    process and keep the historic isolated-run behavior; a stale
+    inherited payload is scrubbed either way.
+    """
+    from deepdfa_tpu.telemetry import context as trace_context
+
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop(inject.ENV_VAR, None)  # each child arms only its own plan
+    env.pop(trace_context.ENV_VAR, None)
+    if process is not None:
+        env = trace_context.child_env(process, base=env)
     env.update(extra)
     return env
 
@@ -720,14 +736,12 @@ def _wait_for_meta_epoch(ckpt_dir: str, epoch: int, timeout_s: float,
 
 def _read_events(run_dir: str) -> List[Dict[str, Any]]:
     # THE events reader (telemetry/export.py), not a private re-parse:
-    # any torn-row tolerance it grows must cover these scenarios too.
-    from deepdfa_tpu.telemetry.export import read_events
-    from deepdfa_tpu.telemetry.report import events_path_of
+    # merged over every shard and rotation segment, so a run that
+    # rotated (or grew child shards) still audits whole.
+    from deepdfa_tpu.telemetry.export import read_run_dir
 
-    path = events_path_of(run_dir)
-    if not os.path.exists(path):
-        return []
-    return read_events(path)
+    events, _shards = read_run_dir(run_dir)
+    return events
 
 
 def _steps_in_epoch0(n_examples: int) -> int:
@@ -790,12 +804,27 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
     import subprocess
     import time
 
+    from deepdfa_tpu import telemetry
     from deepdfa_tpu.resilience import lifecycle
 
     root = os.path.join(out_dir, "preempt_drain")
     shutil.rmtree(root, ignore_errors=True)
     os.makedirs(root, exist_ok=True)
     steps_ep0 = _steps_in_epoch0(n_examples)
+
+    # Trace plane (ISSUE 14): with the soak's run active, the fit
+    # children join it via DEEPDFA_TRACE_CONTEXT — each writes its own
+    # shard of THIS run dir, and the drain audit reads the child's spans
+    # from the parent's merged trace. Untraced (DEEPDFA_TELEMETRY=0)
+    # runs keep the historic child-owned-run-dir behavior.
+    active = telemetry.current_run() if telemetry.enabled() else None
+
+    def _child_trace(proc_name: str, own_dir: str) -> List[Dict[str, Any]]:
+        if active is not None:
+            telemetry.flush()
+            return [e for e in _read_events(active.run_dir)
+                    if e.get("_process") == proc_name]
+        return _read_events(own_dir)
 
     def history_of(run_dir):
         with open(os.path.join(run_dir, "history.json")) as f:
@@ -820,7 +849,8 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
          "seconds": 10.0}]})
     child = subprocess.Popen(
         _fit_argv(part_dir, n_examples, epochs),
-        env=_child_env(DEEPDFA_FAULT_PLAN=plan, DEEPDFA_DRAIN_GRACE_S="60"),
+        env=_child_env(process="fit-part", DEEPDFA_FAULT_PLAN=plan,
+                       DEEPDFA_DRAIN_GRACE_S="60"),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     # Sync on epoch 0's committed meta.json: by then the loop is already
     # inside epoch-1 step 2's 10 s injected delay (the boundary poll and
@@ -842,7 +872,7 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
     candidate = probe.resume_candidate()
     pinfo = probe.preempt_info(candidate) if candidate else None
     snapshot_verified = bool(candidate and probe.verify(candidate))
-    events = _read_events(part_dir)
+    events = _child_trace("fit-part", part_dir)
 
     def named_events(events, name):
         return [e for e in events if e.get("name") == name]
@@ -882,7 +912,7 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
          "seconds": 60.0}]})
     hang_child = subprocess.Popen(
         _fit_argv(hang_dir, n_examples, epochs),
-        env=_child_env(DEEPDFA_FAULT_PLAN=hang_plan,
+        env=_child_env(process="fit-hang", DEEPDFA_FAULT_PLAN=hang_plan,
                        DEEPDFA_DRAIN_GRACE_S="8",
                        DEEPDFA_HANG_DEADLINE_S="2"),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
@@ -899,7 +929,7 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
         hang_child.communicate()
     hang_rc = hang_child.returncode
     hang_exit_s = time.monotonic() - t_kill
-    hang_events = _read_events(hang_dir)
+    hang_events = _child_trace("fit-hang", hang_dir)
     hangs = named_events(hang_events, "lifecycle.hang")
     stacks_captured = bool(hangs) and bool(
         (hangs[0].get("attrs") or {}).get("stacks"))
@@ -907,6 +937,30 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
     hang_candidate = hang_probe.resume_candidate()
     hang_snapshot_ok = bool(hang_candidate
                             and hang_probe.verify(hang_candidate))
+
+    # ONE merged trace.json (the ISSUE 14 acceptance): regenerate the
+    # parent run's Perfetto view now that both children's shards are on
+    # disk, and assert parent and children render under distinct named
+    # processes (M-phase process_name metadata, per-emitter pids).
+    merged: Dict[str, Any] = {"checked": False}
+    if active is not None:
+        from deepdfa_tpu.telemetry.export import write_merged_trace
+
+        telemetry.flush()
+        write_merged_trace(active.run_dir)
+        with open(os.path.join(active.run_dir, "telemetry",
+                               "trace.json")) as f:
+            doc = _json.load(f)
+        metas = [e for e in doc.get("traceEvents", [])
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        named = {(m.get("args") or {}).get("name") for m in metas}
+        merged = {
+            "checked": True,
+            "processes": sorted(n for n in named if n),
+            "distinct_pids": len({m.get("pid") for m in metas}),
+            "parent_and_children":
+                {"main", "fit-part", "fit-hang"} <= named,
+        }
 
     ok = bool(
         ref_ok and saw_epoch0
@@ -920,10 +974,12 @@ def scenario_preempt_drain(out_dir: str, n_examples: int,
         and stacks_captured
         and hang_snapshot_ok
         and hang_exit_s < 12.0   # well inside grace + teardown margin
+        and (not merged["checked"] or merged["parent_and_children"])
     )
     return {
         "ok": ok,
         "fault_kinds": ["sigterm", "delay"],
+        "merged_trace": merged,
         "preempt_exit_code": preempt_rc,
         "preempt_snapshot": candidate,
         "preempt_info": pinfo,

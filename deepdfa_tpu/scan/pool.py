@@ -152,6 +152,14 @@ class JoernPool:
         read deadline, plus restart/backoff slack."""
         return self.attempts * (self.timeout_s + 5.0) + 15.0
 
+    def _session_pid(self, wid: int) -> Optional[int]:
+        """The child pid behind a worker's current session (None for
+        test doubles without a process) — trace-plane bookkeeping."""
+        with self._lock:
+            session = self._sessions.get(wid)
+        proc = getattr(session, "_proc", None)
+        return getattr(proc, "pid", None)
+
     # -- submission ----------------------------------------------------------
 
     def submit(self, path: "str | Path") -> Future:
@@ -321,11 +329,18 @@ class JoernPool:
                 # ordinal (position-derived, so plans replay across pool
                 # sizes). A `hang` here surfaces as the item's failure.
                 inject.fire("scan.item", index=job.index)
+                # Worker bookkeeping for the cross-process trace plane
+                # (ISSUE 14): the span records the session child's pid
+                # AFTER the item ran, so the merged timeline attributes
+                # each item to the exact Joern process that served it —
+                # across restarts, one worker slot's items join to
+                # different pids.
                 with telemetry.span("scan.joern", worker=wid,
-                                    item=job.path.name):
+                                    item=job.path.name) as jsp:
                     result = retry_call(
                         self._run_item, (wid, job), policy=policy,
                         on_retry=lambda a, e, d: self._restart(wid, e))
+                    jsp.set(child_pid=self._session_pid(wid))
                 job.future.set_result(result)
             except _WorkerDeath as death:
                 self._die(wid, job, death)

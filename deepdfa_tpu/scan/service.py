@@ -170,7 +170,9 @@ class ScanService:
     # -- the scan ------------------------------------------------------------
 
     def scan_sources(self, items: Sequence[Mapping], *,
-                     wait: str = "drain") -> List[Dict]:
+                     wait: str = "drain",
+                     trace_id: Optional[str] = None,
+                     trace_continued: bool = False) -> List[Dict]:
         """Score a batch of raw-source items, returning one verdict per
         item in order.
 
@@ -181,7 +183,16 @@ class ScanService:
         bounded timeout). Verdicts are ``{"id", "key", "prob", "model",
         "cached", "featurized"}`` or inline ``{"id", "error", "detail"}``
         — a bad item costs itself, never the sweep.
+
+        ``trace_id``/``trace_continued`` (ISSUE 14): the distributed
+        trace this sweep rides — ``POST /scan`` passes its traceparent
+        continuation, and every ``scan.request`` span plus the engine
+        submissions carry it for the offline client↔server join.
+        Threaded as locals: concurrent transport threads sweep with
+        their own trace ids, so none of this lives on ``self``.
         """
+        tattrs = ({"trace_id": trace_id, "trace_continued": trace_continued}
+                  if trace_id is not None else {})
         results: List[Optional[Dict]] = [None] * len(items)
         pending: List[Tuple[int, Any, str, Path, float]] = []
         for i, item in enumerate(items):
@@ -194,7 +205,7 @@ class ScanService:
                     max_bytes=self.config.max_source_bytes,
                     stats=contracts.STATS)
             except contracts.ContractError as e:
-                results[i] = self._fail(item_id, e, raw, t0)
+                results[i] = self._fail(item_id, e, raw, t0, tattrs)
                 continue
             key = source_key(source)
             cached = self.cache.get(key)
@@ -203,7 +214,7 @@ class ScanService:
                 results[i] = {"id": item_id, "key": key, **cached,
                               "cached": True, "featurized": False}
                 telemetry.record_span("scan.request", t0, id=str(item_id),
-                                      cached=True)
+                                      cached=True, **tattrs)
                 continue
             self._count("scan_cache_misses_total")
             path = self.workdir / "functions" / f"{key}.c"
@@ -221,16 +232,16 @@ class ScanService:
                     f"CPG extraction failed: {type(outcome).__name__}: "
                     f"{outcome}",
                     boundary="scan", item_id=item_id)
-                results[i] = self._fail(item_id, err, key, t0)
+                results[i] = self._fail(item_id, err, key, t0, tattrs)
                 continue
             try:
                 with telemetry.span("scan.featurize", item=key):
                     graph = featurize_export(path, self.vocabs,
                                              gtype=self.config.gtype)
                 self._count("scan_featurized_total")
-                req = self._submit(graph, wait)
+                req = self._submit(graph, wait, tattrs)
             except contracts.ContractError as e:
-                results[i] = self._fail(item_id, e, key, t0)
+                results[i] = self._fail(item_id, e, key, t0, tattrs)
                 continue
             except (BadRequestError, OversizedError, RejectedError,
                     ValueError) as e:
@@ -239,7 +250,7 @@ class ScanService:
                     f"featurized graph not admissible: "
                     f"{type(e).__name__}: {e}",
                     boundary="scan", item_id=item_id)
-                results[i] = self._fail(item_id, err, key, t0)
+                results[i] = self._fail(item_id, err, key, t0, tattrs)
                 continue
             scored.append((i, item_id, key, t0, req))
 
@@ -255,12 +266,16 @@ class ScanService:
             if scored and wait == "drain":
                 self.engine.drain()
             for i, item_id, key, t0, req in scored:
-                results[i] = self._collect(item_id, key, t0, req, wait)
+                results[i] = self._collect(item_id, key, t0, req, wait,
+                                           tattrs)
         return [r for r in results if r is not None]
 
-    def _submit(self, graph: Dict, wait: str):
+    def _submit(self, graph: Dict, wait: str,
+                tattrs: Optional[Dict] = None):
+        kw = {"trace_id": tattrs["trace_id"],
+              "trace_continued": tattrs["trace_continued"]} if tattrs else {}
         try:
-            return self.engine.submit(graph)
+            return self.engine.submit(graph, **kw)
         except RejectedError as e:
             # Offline: drain and retry (nowhere to shed load to).
             # Transport mode: the pump thread is flushing — wait out one
@@ -269,9 +284,11 @@ class ScanService:
                 self.engine.drain()
             else:
                 time.sleep(max(e.retry_after_s, 0.01))
-            return self.engine.submit(graph)
+            return self.engine.submit(graph, **kw)
 
-    def _collect(self, item_id, key: str, t0: float, req, wait: str) -> Dict:
+    def _collect(self, item_id, key: str, t0: float, req, wait: str,
+                 tattrs: Optional[Dict] = None) -> Dict:
+        tattrs = tattrs or {}
         if wait != "drain":
             wait_s = self.engine.config.deadline_ms / 1000.0 * 10 + 30.0
             req.event.wait(timeout=wait_s)
@@ -280,13 +297,13 @@ class ScanService:
             self._count("scan_errors_total")
             detail = (res or {}).get("detail", "scoring timed out")
             telemetry.record_span("scan.request", t0, id=str(item_id),
-                                  cached=False, error="internal")
+                                  cached=False, error="internal", **tattrs)
             return {"id": item_id, "key": key, "error": "internal",
                     "detail": detail}
         verdict = {"prob": res["prob"], "model": res["model"]}
         self.cache.put(key, verdict)
         telemetry.record_span("scan.request", t0, id=str(item_id),
-                              cached=False)
+                              cached=False, **tattrs)
         return {"id": item_id, "key": key, **verdict, "cached": False,
                 "featurized": True}
 
@@ -300,13 +317,14 @@ class ScanService:
                 pass
 
     def _fail(self, item_id, err: contracts.ContractError, raw,
-              t0: float) -> Dict:
+              t0: float, tattrs: Optional[Dict] = None) -> Dict:
         self._count("scan_errors_total")
         self.quarantine.put(err, raw=raw)
         logger.warning("scan: item %r quarantined (%s: %s)", item_id,
                        err.reason, err)
         telemetry.record_span("scan.request", t0, id=str(item_id),
-                              cached=False, error=err.reason)
+                              cached=False, error=err.reason,
+                              **(tattrs or {}))
         return {"id": item_id, "error": err.reason, "detail": str(err)}
 
     # -- offline sweep helpers (cli scan) ------------------------------------

@@ -76,6 +76,12 @@ class ServeRequest:
     t_submit: float = 0.0         # telemetry clock (perf_counter seconds)
     input_ids: Optional[np.ndarray] = None   # combined + gen lanes
     src_bucket: Optional[int] = None         # gen lane: padded source len
+    # Distributed trace context (ISSUE 14): the trace id this request
+    # rides (continued from a client's traceparent header, or minted
+    # fresh at admission); the serve.request span carries both so the
+    # offline report joins client-observed to server-observed latency.
+    trace_id: Optional[str] = None
+    trace_continued: bool = False
     degraded: bool = False        # tokenizer failed -> gnn fallback
     completed_at: Optional[float] = None     # engine-clock completion time
     result: Optional[Dict] = None

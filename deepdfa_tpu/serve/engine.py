@@ -440,7 +440,9 @@ class ServeEngine:
 
     def submit(self, graph: Optional[Mapping], code: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               lane: Optional[str] = None) -> ServeRequest:
+               lane: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               trace_continued: bool = False) -> ServeRequest:
         """Admit one scoring or generation request; returns its
         ServeRequest handle.
 
@@ -450,6 +452,11 @@ class ServeEngine:
         immediately (result set, event signalled); misses enqueue for
         the next micro-batch. Raises BadRequestError / OversizedError /
         RejectedError — the transport maps them to 400 / 413 / 429.
+
+        ``trace_id``/``trace_continued`` (ISSUE 14): the distributed
+        trace this request belongs to — continued from a client's
+        traceparent header by the HTTP layer, threaded onto the
+        ``serve.request`` span for offline client↔server joins.
         """
         now = self._clock()
         self.stats.bump("submitted")
@@ -469,6 +476,7 @@ class ServeEngine:
                 lane="gen", arrival=now, deadline_s=deadline_s,
                 input_ids=input_ids, src_bucket=src_b,
                 t_submit=telemetry.now(),
+                trace_id=trace_id, trace_continued=trace_continued,
             )
             return self._finish_submit(req, now)
         if lane is not None:
@@ -498,8 +506,19 @@ class ServeEngine:
             arrival=now, deadline_s=deadline_s,
             input_ids=input_ids, degraded=degraded,
             t_submit=telemetry.now(),
+            trace_id=trace_id, trace_continued=trace_continued,
         )
         return self._finish_submit(req, now)
+
+    @staticmethod
+    def _trace_attrs(req: ServeRequest) -> Dict[str, Any]:
+        """Span attrs for the request's distributed-trace identity —
+        empty for untraced submissions (replay/bench keep zero extra
+        keys on the hot path)."""
+        if req.trace_id is None:
+            return {}
+        return {"trace_id": req.trace_id,
+                "trace_continued": req.trace_continued}
 
     def _finish_submit(self, req: ServeRequest, now: float) -> ServeRequest:
         """The shared admission tail: cache lookup, enqueue, accounting."""
@@ -512,7 +531,8 @@ class ServeEngine:
             req.finish(dict(cached, rid=req.rid, cached=True,
                             degraded=req.degraded))
             hit_attrs: Dict[str, Any] = dict(rid=req.rid, lane=req.lane,
-                                             cached=True)
+                                             cached=True,
+                                             **self._trace_attrs(req))
             if self.replica is not None:
                 hit_attrs["replica"] = self.replica
             telemetry.record_span("serve.request", req.t_submit,
@@ -673,7 +693,8 @@ class ServeEngine:
                           "degraded": r.degraded})
                 telemetry.record_span("serve.request", r.t_submit,
                                       rid=r.rid, lane=lane_name,
-                                      cached=False, error=type(e).__name__)
+                                      cached=False, error=type(e).__name__,
+                                      **self._trace_attrs(r))
             return
         # Completion-time accounting, clock-shape aware: fleet replay
         # timelines expose flush_done(dt) (per-replica busy horizons, so
@@ -711,6 +732,7 @@ class ServeEngine:
                 degraded=r.degraded,
                 queue_ms=max(w0 - r.t_submit, 0.0) * 1e3,
                 flush_ordinal=ordinal,
+                **self._trace_attrs(r),
             )
             if self.replica is not None:
                 req_attrs["replica"] = self.replica

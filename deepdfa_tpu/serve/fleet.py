@@ -332,13 +332,17 @@ class ServeFleet:
 
     def submit(self, graph: Optional[Mapping], code: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               lane: Optional[str] = None) -> ServeRequest:
+               lane: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               trace_continued: bool = False) -> ServeRequest:
         """Admit one request through the router (``lane="gen"`` routes a
         generation request — no graph needed).
 
         A rejection from the routed replica (its queue filled between
         the load read and the admit) retries once on the least-loaded
         live sibling before surfacing backpressure to the caller.
+        ``trace_id``/``trace_continued`` thread the distributed trace
+        context through to whichever replica serves the request.
         """
         from deepdfa_tpu.serve.cache import content_hash, text_hash
 
@@ -365,7 +369,9 @@ class ServeFleet:
         replica = self.route(key)
         try:
             return replica.engine.submit(graph, code=code,
-                                         deadline_ms=deadline_ms, lane=lane)
+                                         deadline_ms=deadline_ms, lane=lane,
+                                         trace_id=trace_id,
+                                         trace_continued=trace_continued)
         except RejectedError:
             others = [r for r in self.live if r is not replica]
             if not others:
@@ -373,7 +379,8 @@ class ServeFleet:
             fallback = min(others, key=lambda r: r.load())
             return fallback.engine.submit(graph, code=code,
                                           deadline_ms=deadline_ms,
-                                          lane=lane)
+                                          lane=lane, trace_id=trace_id,
+                                          trace_continued=trace_continued)
 
     def score_sync(self, graphs: Sequence[Mapping],
                    codes: Optional[Sequence[Optional[str]]] = None,

@@ -57,6 +57,7 @@ from deepdfa_tpu import telemetry
 from deepdfa_tpu.serve.batcher import OversizedError, RejectedError
 from deepdfa_tpu.serve.engine import BadRequestError, ServeEngine
 from deepdfa_tpu.serve.fleet import ServeFleet
+from deepdfa_tpu.telemetry import context as trace_context
 from deepdfa_tpu.telemetry.memory import SAMPLER
 from deepdfa_tpu.telemetry.slo import SLOMonitor
 
@@ -292,6 +293,22 @@ class ServeHandler(BaseHTTPRequestHandler):
                                  str(max(int(-(-retry_s // 1)), 1))})
         return True
 
+    def _request_trace(self) -> Tuple[str, bool]:
+        """Continue (or start) the distributed trace for this request
+        (ISSUE 14): a valid ``traceparent`` header joins the client's
+        trace — the ``serve.request`` span then carries the client's
+        trace id so the offline report joins the two sides; an absent
+        header starts a fresh trace; a malformed one is ignored with a
+        ``trace_ctx_malformed_total`` bump (a broken client header must
+        never cost the request)."""
+        raw = self.headers.get(trace_context.TRACEPARENT_HEADER)
+        if raw is not None:
+            parsed = trace_context.parse_traceparent(raw)
+            if parsed is not None:
+                return parsed[0], True
+            telemetry.REGISTRY.counter("trace_ctx_malformed_total").inc()
+        return trace_context.new_trace_id(), False
+
     def do_POST(self) -> None:
         # Inflight BEFORE the draining check: the drain waiter must never
         # observe (pending=0, inflight=0) while a handler sits between an
@@ -326,8 +343,11 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "bad_request", "detail": str(e)})
             return
         fleet = self.server.fleet
+        trace_id, trace_continued = self._request_trace()
         submitted, results = [], []
-        with telemetry.span("http.post", n_functions=len(functions)) as hs:
+        with telemetry.span("http.post", n_functions=len(functions),
+                            trace_id=trace_id,
+                            trace_continued=trace_continued) as hs:
             for fn in functions:
                 entry: Dict = {}
                 try:
@@ -335,7 +355,8 @@ class ServeHandler(BaseHTTPRequestHandler):
                     req = fleet.submit(
                         fn["graph"] if lane != "gen" else fn.get("graph"),
                         code=fn.get("code"), deadline_ms=deadline_ms,
-                        lane=lane)
+                        lane=lane, trace_id=trace_id,
+                        trace_continued=trace_continued)
                     submitted.append((req, entry))
                 except RejectedError as e:
                     entry.update(error="rejected",
@@ -412,8 +433,13 @@ class ServeHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._send_json(400, {"error": "bad_request", "detail": str(e)})
             return
-        with telemetry.span("http.scan", n_functions=len(functions)) as hs:
-            results = scan.scan_sources(functions, wait="event")
+        trace_id, trace_continued = self._request_trace()
+        with telemetry.span("http.scan", n_functions=len(functions),
+                            trace_id=trace_id,
+                            trace_continued=trace_continued) as hs:
+            results = scan.scan_sources(functions, wait="event",
+                                        trace_id=trace_id,
+                                        trace_continued=trace_continued)
             hs.set(errors=sum(1 for r in results if "error" in r),
                    cached=sum(1 for r in results if r.get("cached")))
             self._send_json(200, {"results": results})

@@ -30,10 +30,25 @@ The performance observatory (ISSUE 7) extends the layer with:
   as burn rates over registry snapshots (live, degrading ``/healthz``)
   or against a trace report (``cli trace report --slo``).
 
+The distributed trace plane (ISSUE 14) extends the layer with:
+
+* :mod:`~deepdfa_tpu.telemetry.context` — cross-process trace context:
+  ``DEEPDFA_TRACE_CONTEXT`` env propagation to subprocesses (a child
+  writes its own ``events-<process>-<pid>.jsonl`` shard of the SAME run,
+  on the same clock), a fork-worker rebind hook, and traceparent-style
+  HTTP header helpers so a client span joins its server
+  ``serve.request`` span offline by trace id.
+* Shard rotation/retention: the active events file seals into segments
+  at ``DEEPDFA_TRACE_ROTATE_BYTES``, sealed segments are dropped
+  oldest-first past ``DEEPDFA_TRACE_RETAIN_BYTES`` — all counted in the
+  registry; the report and the merged Chrome view read segments
+  transparently.
+
 ``DEEPDFA_TELEMETRY=0`` disables everything; with no run active every
 hook is a cheap no-op, so instrumentation lives in production code paths.
 """
 
+from deepdfa_tpu.telemetry import context
 from deepdfa_tpu.telemetry.registry import REGISTRY, Registry, sanitize
 from deepdfa_tpu.telemetry.spans import (
     ENV_VAR,
@@ -45,7 +60,9 @@ from deepdfa_tpu.telemetry.spans import (
     end_run,
     event,
     flush,
+    in_child_shard,
     now,
+    rebind_forked,
     record_span,
     run_scope,
     set_enabled,
@@ -59,13 +76,16 @@ __all__ = [
     "Registry",
     "Span",
     "TelemetryRun",
+    "context",
     "current_run",
     "drop_count",
     "enabled",
     "end_run",
     "event",
     "flush",
+    "in_child_shard",
     "now",
+    "rebind_forked",
     "record_span",
     "run_scope",
     "sanitize",

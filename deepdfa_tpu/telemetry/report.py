@@ -10,10 +10,10 @@ quarantines fired.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from deepdfa_tpu.core.metrics import latency_quantile as _quantile
-from deepdfa_tpu.telemetry.export import read_events
+from deepdfa_tpu.telemetry.export import read_run_dir
 
 # Span names whose durations are per-step work (host-dispatch side).
 STEP_SPANS = ("train.step", "eval.step")
@@ -22,9 +22,15 @@ WINDOW_SPANS = ("train.window", "train.epoch")
 WARMUP_MARKERS = ("serve.warmup_done", "train.warmup_done")
 
 
-def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+def summarize(events: List[Dict[str, Any]],
+              shards: Optional[List[Dict[str, Any]]] = None,
+              ) -> Dict[str, Any]:
     """The report body. Pure function of the event list — everything the
-    acceptance gate asks for comes from here."""
+    acceptance gate asks for comes from here. ``shards`` (per-shard
+    stats from :func:`~deepdfa_tpu.telemetry.export.read_run_dir`) feeds
+    the ``processes`` section's rotation/torn-row accounting when the
+    caller read a whole run dir."""
+    events = [e for e in events if e.get("kind") != "meta"]
     spans = [e for e in events if e.get("kind") == "span"]
     instants = [e for e in events if e.get("kind") == "event"]
 
@@ -249,6 +255,12 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                     for e in slo_breaches}),
     }
 
+    # --- processes: the cross-process shard map (ISSUE 14) --------------
+    processes = _processes(events, instants, shards)
+
+    # --- propagation: client↔server request joins by trace id -----------
+    propagation = _propagation(spans)
+
     # --- bookkeeping ----------------------------------------------------
     flush_events = named(instants, ("telemetry.flush",))
     drops = max((int((e.get("attrs") or {}).get("drops", 0))
@@ -268,7 +280,108 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "memory": memory,
         "lifecycle": lifecycle,
         "slo": slo,
+        "processes": processes,
+        "propagation": propagation,
         "telemetry_drops": drops,
+    }
+
+
+def _processes(events: List[Dict[str, Any]],
+               instants: List[Dict[str, Any]],
+               shards: Optional[List[Dict[str, Any]]],
+               ) -> Dict[str, Dict[str, Any]]:
+    """Per-process span/event stats plus drop/rotation accounting — the
+    debugging surface for a run whose work crossed process boundaries
+    (who emitted what, who dropped, who rotated). Emitter identity comes
+    from the shard meta annotations (``_process``); legacy single-file
+    runs collapse into ``"main"``."""
+    by_proc: Dict[str, Dict[str, Any]] = {}
+
+    def entry(name: str) -> Dict[str, Any]:
+        return by_proc.setdefault(name, {
+            "pid": None, "spans": 0, "events": 0, "span_ms": [],
+            "drops": 0, "rotations": 0, "segments_dropped": 0,
+            "torn_rows": 0, "segments": 0, "bytes": 0,
+        })
+
+    for e in events:
+        d = entry(str(e.get("_process") or "main"))
+        if d["pid"] is None and e.get("_pid") is not None:
+            d["pid"] = int(e["_pid"])
+        if e.get("kind") == "span":
+            d["spans"] += 1
+            d["span_ms"].append(float(e.get("dur_ms", 0.0)))
+        else:
+            d["events"] += 1
+    # Each process's final flush summary carries its ring-drop and
+    # rotation totals; fold them onto that process's entry.
+    for e in instants:
+        if e.get("name") not in ("telemetry.flush",):
+            continue
+        attrs = e.get("attrs") or {}
+        d = entry(str(attrs.get("process")
+                      or e.get("_process") or "main"))
+        d["drops"] = max(d["drops"], int(attrs.get("drops", 0) or 0))
+        d["rotations"] = max(d["rotations"],
+                             int(attrs.get("rotations", 0) or 0))
+        d["segments_dropped"] = max(
+            d["segments_dropped"],
+            int(attrs.get("segments_dropped", 0) or 0))
+    for s in shards or ():
+        d = entry(str(s.get("process") or "main"))
+        if d["pid"] is None and s.get("pid") is not None:
+            d["pid"] = int(s["pid"])
+        d["torn_rows"] += int(s.get("torn_rows", 0))
+        d["segments"] += int(s.get("segments", 0))
+        d["bytes"] += int(s.get("bytes", 0))
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, d in sorted(by_proc.items()):
+        ms = d.pop("span_ms")
+        d["span_ms_p50"] = round(_quantile(ms, 0.50), 4)
+        d["span_ms_p99"] = round(_quantile(ms, 0.99), 4)
+        out[name] = d
+    return out
+
+
+def _propagation(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The HTTP trace-context audit: how many server requests continued
+    a client's trace (coverage), and per-trace client-observed vs
+    server-observed latency for every joinable trace id — the
+    network+framing overhead a server-side p99 alone cannot show."""
+    client = [s for s in spans if s.get("name") == "client.request"
+              and (s.get("attrs") or {}).get("trace_id")]
+    server = [s for s in spans if s.get("name") == "serve.request"]
+    continued = [s for s in server
+                 if (s.get("attrs") or {}).get("trace_continued")]
+
+    def _by_tid(group):
+        out: Dict[str, float] = {}
+        for s in group:
+            tid = (s.get("attrs") or {}).get("trace_id")
+            if tid:
+                out[str(tid)] = max(out.get(str(tid), 0.0),
+                                    float(s.get("dur_ms", 0.0)))
+        return out
+
+    client_ms = _by_tid(client)
+    server_ms = _by_tid(continued)
+    joined = sorted(set(client_ms) & set(server_ms))
+    c = [client_ms[t] for t in joined]
+    v = [server_ms[t] for t in joined]
+    deltas = [a - b for a, b in zip(c, v)]
+    return {
+        "client_spans": len(client),
+        "server_requests": len(server),
+        "continued_requests": len(continued),
+        "coverage": (round(len(continued) / len(server), 4)
+                     if server else None),
+        "joined_traces": len(joined),
+        "client_ms_p50": round(_quantile(c, 0.50), 4),
+        "client_ms_p99": round(_quantile(c, 0.99), 4),
+        "server_ms_p50": round(_quantile(v, 0.50), 4),
+        "server_ms_p99": round(_quantile(v, 0.99), 4),
+        "client_minus_server_ms_p50": round(_quantile(deltas, 0.50), 4),
+        "client_minus_server_ms_p99": round(_quantile(deltas, 0.99), 4),
     }
 
 
@@ -396,13 +509,16 @@ def events_path_of(run_dir: str) -> str:
 
 
 def trace_report(run_dir: str) -> Dict[str, Any]:
-    """``cli trace report <run>``: summarize one run directory."""
-    path = events_path_of(run_dir)
-    if not os.path.exists(path):
+    """``cli trace report <run>``: summarize one run directory — every
+    shard (child processes included) and sealed rotation segment, merged
+    onto the one timeline."""
+    events, shards = read_run_dir(run_dir)
+    if not shards:
+        path = events_path_of(run_dir)
         raise FileNotFoundError(
             f"no telemetry under {run_dir!r} (expected {path}); run the "
             "command with telemetry enabled (DEEPDFA_TELEMETRY unset/1)"
         )
-    report = summarize(read_events(path))
+    report = summarize(events, shards=shards)
     report["run"] = run_dir
     return report

@@ -43,6 +43,14 @@ from typing import Any, Dict, List, Optional
 ENV_VAR = "DEEPDFA_TELEMETRY"
 RING_ENV_VAR = "DEEPDFA_TELEMETRY_RING"
 DEFAULT_RING_CAPACITY = 65536
+# Trace retention (ISSUE 14): the active shard seals into a segment at
+# the rotate threshold, and sealed segments are dropped oldest-first
+# past the retention budget — a long-lived serve appends bounded bytes,
+# with every rotation/drop counted in the shared registry.
+ROTATE_ENV_VAR = "DEEPDFA_TRACE_ROTATE_BYTES"
+RETAIN_ENV_VAR = "DEEPDFA_TRACE_RETAIN_BYTES"
+DEFAULT_ROTATE_BYTES = 64 * 1024 * 1024
+DEFAULT_RETAIN_BYTES = 512 * 1024 * 1024
 
 _ENABLED: Optional[bool] = None  # tri-state: None = read the env lazily
 
@@ -150,40 +158,102 @@ def drop_count() -> int:
 
 
 class TelemetryRun:
-    """One run's sink: ``<run_dir>/telemetry/{events.jsonl,trace.json}``.
+    """One process's sink into a run: ``<run_dir>/telemetry/``.
 
-    All timestamps are seconds on ONE clock — ``time.perf_counter()``
-    relative to ``t0`` (run start). ``flush()`` drains every thread's
-    ring and appends to ``events.jsonl`` (a single writer under one
-    lock); ``close()`` flushes, writes the Chrome-trace view, and emits
-    a final summary event carrying the drop count.
+    The PRIMARY process (the one that opened the run) writes
+    ``events.jsonl`` and owns the merged ``trace.json`` view; a process
+    with an *inherited* context (``DEEPDFA_TRACE_CONTEXT`` from its
+    parent, or a post-``fork`` rebind) writes its own
+    ``events-<process>-<pid>.jsonl`` shard into the SAME run dir, on the
+    SAME clock (``t0`` is inherited; ``perf_counter`` is system-wide
+    CLOCK_MONOTONIC on Linux, so timestamps merge into one timeline).
+
+    Every shard file opens with a ``kind: "meta"`` record carrying the
+    emitter's pid/process name — the Chrome view stamps the *emitter's*
+    pid on every event, never the reader's. ``flush()`` drains every
+    thread's ring and appends (a single writer per shard under one
+    lock); at the rotate threshold the active file seals into a
+    ``.seg-NNNNNN.jsonl`` segment and sealed segments beyond the
+    retention budget are dropped oldest-first, all counted. ``close()``
+    flushes, emits the final summary event, and (primary only)
+    regenerates the merged Chrome-trace view from every shard present.
     """
 
-    def __init__(self, run_dir: str):
+    def __init__(self, run_dir: str, process: str = "main", inherit=None):
         self.run_dir = run_dir
+        self.process = process
+        self.pid = os.getpid()
+        self.inherited = inherit is not None
         self.dir = os.path.join(run_dir, "telemetry")
         os.makedirs(self.dir, exist_ok=True)
-        self.events_path = os.path.join(self.dir, "events.jsonl")
         self.trace_path = os.path.join(self.dir, "trace.json")
-        self.t0 = time.perf_counter()
-        self.wall_start = time.time()
+        if inherit is None:
+            self.run_id = (f"{os.path.basename(os.path.abspath(run_dir)) or 'run'}"
+                           f"-{os.urandom(4).hex()}")
+            self.t0 = time.perf_counter()
+            self.wall_start = time.time()
+            shard = "events.jsonl"
+        else:
+            # One timeline: the child stamps ts relative to the PARENT's
+            # t0 (shared monotonic clock), under the parent's run id.
+            self.run_id = inherit.run_id
+            self.t0 = float(inherit.t0)
+            self.wall_start = float(inherit.wall_start)
+            from deepdfa_tpu.telemetry.context import sanitize_process
+
+            shard = f"events-{sanitize_process(process)}-{self.pid}.jsonl"
+        self.events_path = os.path.join(self.dir, shard)
+        self.rotate_bytes = int(os.environ.get(ROTATE_ENV_VAR,
+                                               DEFAULT_ROTATE_BYTES))
+        self.retain_bytes = int(os.environ.get(RETAIN_ENV_VAR,
+                                               DEFAULT_RETAIN_BYTES))
+        self.rotations = 0
+        self.segments_dropped = 0
+        self.segment_bytes_dropped = 0
+        self._seg_seq = 0
         self.drops0 = drop_count()  # ring drops are process-lifetime;
         # the run reports its own delta
         self.n_written = 0
         self._write_lock = threading.Lock()
-        # Fresh files per run: a resumed run dir must not interleave two
-        # runs' clocks in events.jsonl, and a stale trace.json from the
-        # previous run must not pose as a view of the new one (it is
-        # regenerated at close()).
-        open(self.events_path, "w").close()
-        if os.path.exists(self.trace_path):
-            os.remove(self.trace_path)
+        if inherit is None:
+            # Fresh files per run: a resumed run dir must not interleave
+            # two runs' clocks, a previous run's shards/segments must not
+            # pose as this run's processes, and a stale trace.json must
+            # not pose as a view of the new run (regenerated at close()).
+            for name in os.listdir(self.dir):
+                if name.startswith("events") and name.endswith(".jsonl"):
+                    try:
+                        os.remove(os.path.join(self.dir, name))
+                    except OSError:
+                        pass
+            if os.path.exists(self.trace_path):
+                os.remove(self.trace_path)
+            with open(self.events_path, "w") as f:
+                self._write_meta(f)
+        else:
+            # A shard never truncates: the parent's files are live, and a
+            # pid-reusing sibling's history is worth more than a clean
+            # slate. Each (re)open appends a fresh meta record.
+            with open(self.events_path, "a") as f:
+                self._write_meta(f)
 
     def now(self) -> float:
         return time.perf_counter() - self.t0
 
+    def _write_meta(self, f) -> None:
+        """The shard header: who is writing this file. Readers annotate
+        every subsequent record with this pid/process, so the Chrome
+        view carries real emitter identity (ISSUE 14 satellite: the old
+        exporter stamped the *converting* process's pid on everything)."""
+        f.write(json.dumps({
+            "kind": "meta", "name": "telemetry.shard", "ts": self.now(),
+            "pid": self.pid, "process": self.process,
+            "run_id": self.run_id, "wall_start": self.wall_start,
+        }) + "\n")
+
     def flush(self) -> int:
-        """Drain all rings into events.jsonl; returns events written."""
+        """Drain all rings into this process's shard; returns events
+        written. Rotation happens here, under the same write lock."""
         with _RINGS_LOCK:
             rings = list(_RINGS)
         batch: List[Dict[str, Any]] = []
@@ -197,17 +267,74 @@ class TelemetryRun:
             with open(self.events_path, "a") as f:
                 for rec in batch:
                     f.write(json.dumps(rec) + "\n")
+                size = f.tell()
             self.n_written += len(batch)
+            if self.rotate_bytes > 0 and size >= self.rotate_bytes:
+                self._rotate_locked(size)
         return len(batch)
+
+    def _rotate_locked(self, size: int) -> None:
+        """Seal the active file into a segment and enforce the retention
+        budget over this shard's sealed segments (oldest-first drops,
+        all accounted — a long-run trace loses its oldest history, never
+        its accounting)."""
+        from deepdfa_tpu.telemetry.registry import REGISTRY
+
+        self._seg_seq += 1
+        stem = self.events_path[:-len(".jsonl")]
+        seg = f"{stem}.seg-{self._seg_seq:06d}.jsonl"
+        os.replace(self.events_path, seg)
+        self.rotations += 1
+        REGISTRY.counter("telemetry_rotations_total").inc()
+        with open(self.events_path, "w") as f:
+            self._write_meta(f)
+        prefix = os.path.basename(stem) + ".seg-"
+        segments = sorted(
+            name for name in os.listdir(self.dir)
+            if name.startswith(prefix) and name.endswith(".jsonl")
+        )
+        sizes = {}
+        for name in segments:
+            try:
+                sizes[name] = os.path.getsize(os.path.join(self.dir, name))
+            except OSError:
+                sizes[name] = 0
+        total = sum(sizes.values())
+        dropped = 0
+        while segments and total > self.retain_bytes and len(segments) > 1:
+            victim = segments.pop(0)
+            try:
+                os.remove(os.path.join(self.dir, victim))
+            except OSError:
+                break
+            total -= sizes[victim]
+            dropped += 1
+            self.segments_dropped += 1
+            self.segment_bytes_dropped += sizes[victim]
+            REGISTRY.counter(
+                "telemetry_retention_dropped_segments_total").inc()
+            REGISTRY.counter(
+                "telemetry_retention_dropped_bytes_total").inc(
+                    sizes[victim])
+        # Queued into the ring: lands in the fresh active file on the
+        # next flush — the rotation is auditable from the trace itself.
+        event("telemetry.rotate", segment=os.path.basename(seg),
+              bytes=size, process=self.process,
+              dropped_segments=dropped)
 
     def close(self) -> None:
         event("telemetry.flush", drops=drop_count() - self.drops0,
-              events=self.n_written)
+              events=self.n_written, process=self.process,
+              rotations=self.rotations,
+              segments_dropped=self.segments_dropped)
         self.flush()
-        from deepdfa_tpu.telemetry.export import write_chrome_trace
+        if not self.inherited:
+            # The merged Perfetto view over every shard present at close
+            # (children that already exited included). A shard-writing
+            # child never writes trace.json — the primary owns the view.
+            from deepdfa_tpu.telemetry.export import write_merged_trace
 
-        write_chrome_trace(self.events_path, self.trace_path,
-                           wall_start=self.wall_start)
+            write_merged_trace(self.run_dir, wall_start=self.wall_start)
 
 
 _RUN: Optional[TelemetryRun] = None
@@ -245,7 +372,15 @@ def current_run() -> Optional[TelemetryRun]:
 def start_run(run_dir: str) -> Optional[TelemetryRun]:
     """Bind the process to one run sink. No-op (returns None) when
     telemetry is disabled; nested runs are an error — end the previous
-    one first (``run_scope`` does)."""
+    one first (``run_scope`` does).
+
+    When the process was spawned with a ``DEEPDFA_TRACE_CONTEXT`` env
+    payload (ISSUE 14), the inherited context WINS over ``run_dir``: the
+    child binds to the parent's run directory and writes its own
+    ``events-<process>-<pid>.jsonl`` shard on the parent's clock, so one
+    merged timeline covers both processes. Without the env var, behavior
+    is unchanged — the caller's run_dir, the primary ``events.jsonl``.
+    """
     global _RUN
     if not enabled():
         return None
@@ -253,10 +388,50 @@ def start_run(run_dir: str) -> Optional[TelemetryRun]:
         raise RuntimeError(
             f"telemetry run already active ({_RUN.run_dir}); end it first"
         )
+    from deepdfa_tpu.telemetry import context as _context
+
     _install_jax_listener()
-    _RUN = TelemetryRun(run_dir)
-    event("telemetry.start", run_dir=run_dir)
+    ctx = _context.inherited()
+    if ctx is not None:
+        _RUN = TelemetryRun(ctx.run_dir, process=ctx.process, inherit=ctx)
+    else:
+        _RUN = TelemetryRun(run_dir)
+    event("telemetry.start", run_dir=_RUN.run_dir,
+          process=_RUN.process,
+          **({"requested_run_dir": run_dir} if ctx is not None else {}))
     return _RUN
+
+
+def rebind_forked(process: str) -> Optional[TelemetryRun]:
+    """Post-``fork`` shard rebind: the forked child inherited the
+    parent's run object and ring *copies* by memory; writing either from
+    the child would duplicate the parent's events or tear its file. This
+    discards the copied rings and binds the child to its own shard of
+    the same run (same run id, same clock). No-op when no run is active,
+    telemetry is disabled, or the caller is not actually a fork (same
+    pid)."""
+    global _RUN, _REAPED_DROPS
+    run = _RUN
+    if run is None or not enabled():
+        return None
+    if run.pid == os.getpid():
+        return run
+    with _RINGS_LOCK:
+        _RINGS.clear()
+    _REAPED_DROPS = 0
+    _TLS.ring = None
+    _RUN = TelemetryRun(run.run_dir, process=process, inherit=run)
+    event("telemetry.start", run_dir=run.run_dir, process=process,
+          forked=True)
+    return _RUN
+
+
+def in_child_shard() -> bool:
+    """True when this process writes a shard of an inherited run — the
+    hook per-item flush policies key on (a forked ETL worker must make
+    its events durable before it can be killed)."""
+    run = _RUN
+    return run is not None and run.inherited
 
 
 def end_run() -> None:

@@ -14,7 +14,11 @@ bash scripts/lint.sh
 # shapes must not try to load the eval checkpoint below. --gen-lane
 # (ISSUE 13) warms the generation lane's (slot, src-length) decode
 # ladder too, serves lane="gen" rounds over real HTTP, and the same SLO
-# gate asserts compiles_after_warmup=0 ACROSS it.
+# gate asserts compiles_after_warmup=0 ACROSS it. Every smoke POST
+# carries a traceparent header (ISSUE 14): the smoke exits nonzero
+# unless the merged-shard trace report shows propagation coverage > 0
+# AND at least one client.request span joined to its serve.request span
+# by trace id.
 CHECKPOINT_DIR= COMBINED_DIR= GEN_DIR= bash scripts/serve.sh --smoke 8 \
   --batch-slots 4 --port 0 \
   --gen-lane --gen-src-len 32 --gen-max-len 8 --gen-beam 2 \
@@ -45,6 +49,11 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli validate --smoke
 # runs/<run>/telemetry/{events.jsonl,trace.json} and `trace report` must
 # round-trip step timings, the host/device split, compile capture
 # (post-warmup compiles 0), and a valid Perfetto-loadable trace.json.
+# ISSUE 14: the smoke also forks a real pmap worker pool inside the run
+# — the merged-shard report must show >= 2 named processes (the workers'
+# events land in their own events-<proc>-<pid>.jsonl shards), the
+# Chrome view must carry >= 2 emitter pids with M-phase process
+# metadata, and zero torn rows.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
 # Scan smoke (deepdfa_tpu/scan): hermetic fake-Joern end-to-end — sweep a
 # seeded mini-corpus through the pooled-session → featurize → warmed-engine
@@ -59,7 +68,11 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli scan --smoke
 # different device count, pooled Joern workers killed/hung mid-scan
 # (retry + quarantine, the sweep still completes), a REAL SIGTERM to a
 # mid-epoch fit subprocess (preempt_drain: step-granular snapshot,
-# bit-continuous mid-epoch resume, hung-step watchdog), a SIGTERM
+# bit-continuous mid-epoch resume, hung-step watchdog; ISSUE 14: the
+# fit children join the soak's trace plane via DEEPDFA_TRACE_CONTEXT —
+# their drain/hang spans are asserted from the PARENT run's merged
+# trace, which must render main + both children as distinct named
+# processes in ONE trace.json), a SIGTERM
 # lame-duck drain of a live serve subprocess (serve_lame_duck: zero
 # dropped admitted requests, 503 for new ones), and a rolling replica
 # drain of a 3-replica serving fleet mid-load (fleet_roll: admissions

@@ -1272,6 +1272,90 @@ def layer_stack(x, layers):
     assert "GL019" not in rules_of(src)
 
 
+def test_gl020_entrypoint_spawn_without_context_fires():
+    # The trace-plane propagation hazard (ISSUE 14): spawning a deepdfa
+    # entrypoint — literal argv, name-assigned argv, or a module-local
+    # argv builder — without DEEPDFA_TRACE_CONTEXT in the child env; and
+    # the fork flavor, a ProcessPoolExecutor with no trace-context
+    # initializer.
+    src = """
+import os
+import subprocess
+import sys
+from concurrent.futures import ProcessPoolExecutor
+
+def _fit_argv(run_dir):
+    return [sys.executable, "-m", "deepdfa_tpu.cli", "fit",
+            "--checkpoint-dir", run_dir]
+
+def spawn_literal():
+    return subprocess.Popen([sys.executable, "-m", "deepdfa_tpu.cli",
+                             "serve"], stdout=subprocess.PIPE)
+
+def spawn_assigned():
+    argv = [sys.executable, "-m", "deepdfa_tpu.cli", "fit"]
+    return subprocess.run(argv, env={**os.environ, "X": "1"}, timeout=5)
+
+def spawn_builder(run_dir):
+    return subprocess.run(_fit_argv(run_dir), timeout=5)
+
+def fork_pool(items, fn):
+    with ProcessPoolExecutor(max_workers=4) as pool:
+        return list(pool.map(fn, items))
+"""
+    found = findings_for(src, "GL020")
+    assert {f.function for f in found} == {"spawn_literal", "spawn_assigned",
+                                           "spawn_builder", "fork_pool"}
+    assert any("child_env" in f.message for f in found)
+    assert any("init_forked_worker" in f.message for f in found)
+
+
+def test_gl020_negatives_unflagged():
+    # The accepted shapes: env built by telemetry.context.child_env, a
+    # module-local *child_env wrapper (body references the literal or
+    # calls the blessed helper), an env expression carrying the literal
+    # key itself, a non-deepdfa argv (the caller spawns someone else's
+    # binary — not our trace plane), and a ProcessPoolExecutor with the
+    # trace-context initializer installed.
+    src = """
+import os
+import subprocess
+import sys
+from concurrent.futures import ProcessPoolExecutor
+from deepdfa_tpu.telemetry import context as trace_context
+
+def _child_env(**extra):
+    env = trace_context.child_env("fit-child")
+    env.update(extra)
+    return env
+
+def spawn_helper():
+    argv = [sys.executable, "-m", "deepdfa_tpu.cli", "fit"]
+    return subprocess.Popen(argv, env=trace_context.child_env("fit"))
+
+def spawn_wrapper():
+    return subprocess.run([sys.executable, "-m", "deepdfa_tpu.cli",
+                           "serve"], env=_child_env(), timeout=5)
+
+def spawn_literal_key():
+    env = {**os.environ, "DEEPDFA_TRACE_CONTEXT": "payload"}
+    return subprocess.run([sys.executable, "-m", "deepdfa_tpu.cli",
+                           "fit"], env=env, timeout=5)
+
+def spawn_foreign():
+    return subprocess.Popen(["joern", "--script", "export.sc"],
+                            stdout=subprocess.PIPE)
+
+def fork_pool(items, fn):
+    with ProcessPoolExecutor(
+        max_workers=4, initializer=trace_context.init_forked_worker,
+        initargs=("etl-pool",),
+    ) as pool:
+        return list(pool.map(fn, items))
+"""
+    assert "GL020" not in rules_of(src)
+
+
 def test_gl017_lifecycle_module_is_the_clean_reference():
     # The rule's docstring points at resilience/lifecycle.py as the
     # accepted shape; the module must stay GL017-clean (and clean of
@@ -1558,8 +1642,8 @@ def test_self_check_covers_every_rule_implementation():
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
-                             "GL016", "GL017", "GL018", "GL019"})
-    assert len(RULES) == 19
+                             "GL016", "GL017", "GL018", "GL019", "GL020"})
+    assert len(RULES) == 20
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

@@ -238,14 +238,21 @@ def test_trace_json_is_valid_chrome_trace(tmp_path):
     events = doc["traceEvents"]
     assert events, "trace must carry events"
     for ev in events:
-        assert ev["ph"] in ("X", "i")
+        assert ev["ph"] in ("X", "i", "M")
         assert isinstance(ev["ts"], (int, float))
         assert isinstance(ev["name"], str) and ev["name"]
         assert "pid" in ev and "tid" in ev
         if ev["ph"] == "X":
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
-        else:
+        elif ev["ph"] == "i":
             assert ev["s"] == "t"
+    # Emitter identity (ISSUE 14): every event wears the WRITER's pid
+    # (recorded in the shard's meta header at write time), and the view
+    # names the process via M-phase metadata.
+    metas = [e for e in events if e["ph"] == "M"]
+    assert [(m["name"], m["args"]["name"]) for m in metas] == \
+        [("process_name", "main")]
+    assert all(e["pid"] == metas[0]["pid"] for e in events)
     # span duration round-trips in microseconds
     (a,) = [e for e in events if e["name"] == "a"]
     assert a["args"]["depth"] == 0

@@ -1,0 +1,399 @@
+"""Distributed trace plane (ISSUE 14): cross-process shards via
+DEEPDFA_TRACE_CONTEXT, traceparent propagation over HTTP, shard rotation
+under a retention budget, torn-row tolerance, and the merged report's
+processes/propagation sections."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.serve import ServeConfig, ServeEngine
+from deepdfa_tpu.serve.engine import random_gnn_params
+from deepdfa_tpu.serve.http import ServeHTTPServer
+from deepdfa_tpu.telemetry import context as tctx
+from deepdfa_tpu.telemetry.export import read_run_dir, write_merged_trace
+from deepdfa_tpu.telemetry.report import summarize, trace_report
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+TINY = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=1,
+                     num_output_layers=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_run_state():
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+    yield
+    telemetry.end_run()
+    telemetry.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# TraceContext: encode/decode, traceparent parsing
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_round_trips_through_env_payload():
+    ctx = tctx.TraceContext(run_dir="/runs/x", run_id="x-abc",
+                            process="fit-child", t0=12.5, wall_start=99.0,
+                            parent_process="main")
+    back = tctx.TraceContext.decode(ctx.encode())
+    assert back == ctx
+
+
+@pytest.mark.parametrize("payload", [
+    "not json", "[1, 2]", "{}", '{"run_dir": "/x"}',
+    '{"run_dir": "/x", "run_id": "r", "process": "p", "t0": "NaN-ish",'
+    ' "wall_start": []}',
+])
+def test_malformed_context_payload_raises_value_error(payload):
+    with pytest.raises(ValueError):
+        tctx.TraceContext.decode(payload)
+
+
+def test_inherited_malformed_env_is_counted_and_ignored(monkeypatch):
+    monkeypatch.setenv(tctx.ENV_VAR, "{broken")
+    tctx.reset_inherited()
+    before = telemetry.REGISTRY.counter("trace_ctx_malformed_total").value
+    try:
+        assert tctx.inherited() is None
+        assert tctx.inherited() is None  # cached, counted ONCE
+        after = telemetry.REGISTRY.counter(
+            "trace_ctx_malformed_total").value
+        assert after - before == 1
+    finally:
+        tctx.reset_inherited()
+
+
+def test_traceparent_parse_accepts_valid_and_rejects_malformed():
+    tid, sid = tctx.new_trace_id(), tctx.new_span_id()
+    assert tctx.parse_traceparent(tctx.make_traceparent(tid, sid)) == \
+        (tid, sid)
+    for bad in (None, "", "junk", f"00-{tid}-{sid}",  # missing flags
+                f"01-{tid}-{sid}-01",                 # unknown version
+                f"00-{'0' * 32}-{sid}-01",            # all-zero trace id
+                f"00-{tid}-{'0' * 16}-01",            # all-zero span id
+                f"00-{tid[:-1]}Z-{sid}-01"):          # non-hex
+        assert tctx.parse_traceparent(bad) is None
+
+
+def test_child_env_sets_context_only_under_active_run(tmp_path):
+    env = tctx.child_env("worker", base={"PATH": "/bin",
+                                         tctx.ENV_VAR: "stale"})
+    assert tctx.ENV_VAR not in env  # no run: stale payload scrubbed
+    assert env["PATH"] == "/bin"
+    with telemetry.run_scope(str(tmp_path)):
+        env = tctx.child_env("worker")
+        ctx = tctx.TraceContext.decode(env[tctx.ENV_VAR])
+        run = telemetry.current_run()
+        assert ctx.process == "worker"
+        assert ctx.run_id == run.run_id
+        assert ctx.run_dir == os.path.abspath(str(tmp_path))
+        assert ctx.t0 == run.t0
+
+
+# ---------------------------------------------------------------------------
+# Cross-process round-trip: a REAL subprocess child emits a shard
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_child_shard_merges_and_joins_by_trace_id(tmp_path):
+    """THE round-trip: a child process inherits the context via env,
+    writes its own shard, and the merged report (a) shows both processes
+    and (b) joins the parent's client span to the child's serve.request
+    span by trace id."""
+    trace_id = tctx.new_trace_id()
+    code = (
+        "import time\n"
+        "from deepdfa_tpu import telemetry\n"
+        "with telemetry.run_scope('should-be-overridden'):\n"
+        "    run = telemetry.current_run()\n"
+        "    assert run.inherited and run.process == 'fit-child', run\n"
+        "    t0 = telemetry.now()\n"
+        "    time.sleep(0.01)\n"
+        f"    telemetry.record_span('serve.request', t0, rid=1,"
+        f" trace_id={trace_id!r}, trace_continued=True)\n"
+        "    telemetry.event('child.mark')\n"
+    )
+    with telemetry.run_scope(str(tmp_path)):
+        t0 = telemetry.now()
+        env = tctx.child_env("fit-child", JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        telemetry.record_span("client.request", t0, trace_id=trace_id,
+                              path="/score")
+        assert not os.path.exists("should-be-overridden")
+    rep = trace_report(str(tmp_path))
+    assert set(rep["processes"]) == {"main", "fit-child"}
+    child = rep["processes"]["fit-child"]
+    assert child["spans"] == 1 and child["events"] >= 1
+    assert child["pid"] not in (None, os.getpid())
+    prop = rep["propagation"]
+    assert prop["continued_requests"] == 1
+    assert prop["coverage"] == 1.0
+    assert prop["joined_traces"] == 1
+    # Client-observed covers the child's span (one shared clock): the
+    # join's whole point is that the delta is computable and >= 0.
+    assert prop["client_ms_p50"] >= prop["server_ms_p50"] > 0
+    # The merged Chrome view renders the two under distinct named
+    # processes with the EMITTERS' pids (M-phase metadata).
+    with open(os.path.join(str(tmp_path), "telemetry", "trace.json")) as f:
+        doc = json.load(f)
+    metas = {m["args"]["name"]: m["pid"]
+             for m in doc["traceEvents"] if m.get("ph") == "M"}
+    assert set(metas) == {"main", "fit-child"}
+    assert metas["main"] == os.getpid() != metas["fit-child"]
+    child_events = [e for e in doc["traceEvents"]
+                    if e.get("ph") != "M" and e["pid"] == metas["fit-child"]]
+    assert any(e["name"] == "serve.request" for e in child_events)
+
+
+def test_forked_pmap_worker_writes_its_own_shard(tmp_path):
+    from deepdfa_tpu.etl.parallel import pmap
+
+    def probe(i):
+        telemetry.event("worker.mark", item=int(i))
+        return int(i) * 2
+
+    with telemetry.run_scope(str(tmp_path)):
+        out = pmap(probe, list(range(4)), workers=2, desc="shard-test")
+    assert out == [0, 2, 4, 6]
+    rep = trace_report(str(tmp_path))
+    workers = [p for p in rep["processes"] if p.startswith("etl-pool")]
+    assert workers, rep["processes"]
+    assert sum(rep["processes"][p]["events"] for p in workers) >= 4
+
+
+# ---------------------------------------------------------------------------
+# Rotation, retention, torn rows
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_seals_segments_and_report_reads_transparently(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.spans.ROTATE_ENV_VAR, "4096")
+    monkeypatch.setenv(telemetry.spans.RETAIN_ENV_VAR, str(64 * 1024 * 1024))
+    with telemetry.run_scope(str(tmp_path)):
+        for i in range(300):
+            telemetry.event("spam", i=i, pad="x" * 60)
+            if i % 50 == 49:
+                telemetry.flush()
+    tdir = os.path.join(str(tmp_path), "telemetry")
+    segs = [f for f in os.listdir(tdir) if ".seg-" in f]
+    assert segs, "rotation never sealed a segment"
+    rep = trace_report(str(tmp_path))
+    # Transparent reads: every event survives across segment boundaries.
+    main = rep["processes"]["main"]
+    assert main["rotations"] >= 1 and main["segments"] == len(segs)
+    events, _ = read_run_dir(str(tmp_path))
+    assert sum(1 for e in events if e.get("name") == "spam") == 300
+
+
+def test_retention_budget_drops_oldest_segments_with_accounting(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.spans.ROTATE_ENV_VAR, "4096")
+    monkeypatch.setenv(telemetry.spans.RETAIN_ENV_VAR, "8192")
+    before = telemetry.REGISTRY.counter(
+        "telemetry_retention_dropped_segments_total").value
+    with telemetry.run_scope(str(tmp_path)):
+        for i in range(1500):
+            telemetry.event("spam", i=i, pad="x" * 60)
+            if i % 50 == 49:
+                telemetry.flush()
+        run = telemetry.current_run()
+        assert run.segments_dropped > 0
+        assert run.segment_bytes_dropped > 0
+    dropped = telemetry.REGISTRY.counter(
+        "telemetry_retention_dropped_segments_total").value - before
+    assert dropped > 0
+    # The report never sees more bytes than the budget allows (active
+    # file + retained segments), and still parses clean.
+    rep = trace_report(str(tmp_path))
+    assert rep["processes"]["main"]["segments_dropped"] > 0
+    # The OLDEST history went: event i=0 is gone, the tail survived.
+    events, _ = read_run_dir(str(tmp_path))
+    spam = [int((e.get("attrs") or {})["i"]) for e in events
+            if e.get("name") == "spam"]
+    assert spam and min(spam) > 0 and max(spam) == 1499
+
+
+def test_torn_trailing_row_skips_and_counts_never_crashes(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        for i in range(5):
+            telemetry.event("ok", i=i)
+    path = os.path.join(str(tmp_path), "telemetry", "events.jsonl")
+    with open(path, "a") as f:
+        f.write('{"kind": "event", "name": "torn-mid')
+    rep = trace_report(str(tmp_path))  # must not raise
+    assert rep["processes"]["main"]["torn_rows"] == 1
+    events, shards = read_run_dir(str(tmp_path))
+    assert sum(1 for e in events if e.get("name") == "ok") == 5
+    assert shards[0]["torn_rows"] == 1
+
+
+def test_chrome_view_stamps_emitter_pid_not_readers(tmp_path):
+    """The ISSUE 14 satellite: events converted in a DIFFERENT process
+    than the emitter must wear the emitter's pid."""
+    from deepdfa_tpu.telemetry.export import events_to_chrome_trace
+
+    events = [
+        {"kind": "meta", "name": "telemetry.shard", "ts": 0.0,
+         "pid": 4242, "process": "remote-emitter"},
+        {"kind": "span", "name": "w", "ts": 0.1, "dur_ms": 1.0, "tid": 7,
+         "_pid": 4242, "_process": "remote-emitter"},
+    ]
+    doc = events_to_chrome_trace(events)
+    (meta,) = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    (span,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert span["pid"] == 4242 != os.getpid()
+    assert meta == {"ph": "M", "name": "process_name", "pid": 4242,
+                    "tid": 0, "ts": 0, "args": {"name": "remote-emitter"}}
+
+
+# ---------------------------------------------------------------------------
+# HTTP propagation: present -> continued, absent -> fresh, malformed ->
+# ignored with a counter bump
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    config = ServeConfig(batch_slots=2, deadline_ms=100.0)
+    model = FlowGNN(TINY)
+    eng = ServeEngine(model, random_gnn_params(model, config),
+                      config=config)
+    eng.warmup()
+    server = ServeHTTPServer(("127.0.0.1", 0), eng)
+    server.start_pump()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def _payload(n, seed=0):
+    return [
+        {"id": int(g["id"]),
+         "graph": {"num_nodes": int(g["num_nodes"]),
+                   "senders": np.asarray(g["senders"]).tolist(),
+                   "receivers": np.asarray(g["receivers"]).tolist(),
+                   "feats": {k: np.asarray(v).tolist()
+                             for k, v in g["feats"].items()}}}
+        for g in synthetic_bigvul(n, FEAT, positive_fraction=0.5,
+                                  seed=seed)
+    ]
+
+
+def _post(server, functions, header=None):
+    port = server.server_address[1]
+    headers = {"Content-Type": "application/json"}
+    if header is not None:
+        headers[tctx.TRACEPARENT_HEADER] = header
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/score",
+        data=json.dumps({"functions": functions}).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _server_spans_for(run_dir, rids, deadline_s=5.0):
+    """The serve.request spans for THIS test's rids. The pump thread
+    records a request's span after signalling its waiter, so poll until
+    every rid's span landed — and filter on rid so a straggler from a
+    sibling test's run can never pollute the assertion set."""
+    import time
+
+    rids = set(rids)
+    deadline = time.monotonic() + deadline_s
+    while True:
+        telemetry.flush()
+        events, _ = read_run_dir(run_dir)
+        spans = [e for e in events if e.get("kind") == "span"
+                 and e.get("name") == "serve.request"
+                 and (e.get("attrs") or {}).get("rid") in rids]
+        if len(spans) >= len(rids) or time.monotonic() > deadline:
+            return spans
+        time.sleep(0.01)
+
+
+def test_http_traceparent_present_continues_trace(http_server, tmp_path):
+    tid = tctx.new_trace_id()
+    with telemetry.run_scope(str(tmp_path)):
+        body = _post(http_server, _payload(2, seed=1),
+                     header=tctx.make_traceparent(tid))
+        assert all("prob" in r for r in body["results"])
+        spans = _server_spans_for(str(tmp_path),
+                                  [r["rid"] for r in body["results"]])
+    attrs = [s.get("attrs") or {} for s in spans]
+    assert len(attrs) == 2
+    assert all(a["trace_id"] == tid and a["trace_continued"]
+               for a in attrs)
+
+
+def test_http_traceparent_absent_starts_fresh_trace(http_server, tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        body = _post(http_server, _payload(2, seed=2))
+        spans = _server_spans_for(str(tmp_path),
+                                  [r["rid"] for r in body["results"]])
+    attrs = [s.get("attrs") or {} for s in spans]
+    assert len(attrs) == 2
+    # Fresh trace: a minted id (one per POST, shared by its functions),
+    # explicitly NOT continued — propagation coverage counts it as such.
+    assert len({a["trace_id"] for a in attrs}) == 1
+    assert all(not a["trace_continued"] for a in attrs)
+    assert summarize(spans)["propagation"]["coverage"] == 0.0
+
+
+def test_http_traceparent_malformed_ignored_with_counter(http_server,
+                                                         tmp_path):
+    counter = telemetry.REGISTRY.counter("trace_ctx_malformed_total")
+    before = counter.value
+    with telemetry.run_scope(str(tmp_path)):
+        body = _post(http_server, _payload(2, seed=3),
+                     header="garbage-not-a-traceparent")
+        assert all("prob" in r for r in body["results"])
+        spans = _server_spans_for(str(tmp_path),
+                                  [r["rid"] for r in body["results"]])
+    assert counter.value - before == 1
+    attrs = [s.get("attrs") or {} for s in spans]
+    assert len(attrs) == 2
+    assert all(a["trace_id"] and not a["trace_continued"] for a in attrs)
+
+
+# ---------------------------------------------------------------------------
+# Merged trace write while shards coexist
+# ---------------------------------------------------------------------------
+
+
+def test_write_merged_trace_is_idempotent_over_shards(tmp_path):
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("alpha"):
+            pass
+        env = tctx.child_env("kid", JAX_PLATFORMS="cpu")
+        code = ("from deepdfa_tpu import telemetry\n"
+                "with telemetry.run_scope('x'):\n"
+                "    telemetry.event('kid.mark')\n")
+        subprocess.run([sys.executable, "-c", code], env=env, check=True,
+                       capture_output=True, timeout=120)
+    n1 = write_merged_trace(str(tmp_path))
+    n2 = write_merged_trace(str(tmp_path))
+    assert n1 == n2 > 0
+    with open(os.path.join(str(tmp_path), "telemetry", "trace.json")) as f:
+        doc = json.load(f)
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m.get("ph") == "M"}
+    assert names == {"main", "kid"}
