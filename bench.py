@@ -210,7 +210,10 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     banded batched-matmul path, the measured winner — module docstring) on
     TPU and "segment" elsewhere. "tile" rides the extras as the A/B;
     "fused" is the single-pass Pallas megakernel (ops/fused_gnn.py) over
-    dense-slot-packed batches — the ISSUE-9 headline candidate.
+    dense-slot-packed batches — the ISSUE-9 headline candidate;
+    "persistent" is the K-step persistent megakernel (ISSUE 15) — the
+    whole n_steps unroll as one pallas_call per direction, A/B'd against
+    the fused rows.
 
     ``diagnostics``: also return {flops_per_step, mfu, ms_per_step} — the
     cost-model FLOPs and achieved MFU against the chip's peak. The fused
@@ -234,7 +237,8 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     data_cfg = DataConfig(batch_size=256)
     train_cfg = TrainConfig()
 
-    batch = _example_batch(data_cfg, model_cfg, slot_pack=impl == "fused")
+    batch = _example_batch(data_cfg, model_cfg,
+                           slot_pack=impl in ("fused", "persistent"))
     model = FlowGNN(model_cfg)
     state, tx = make_train_state(model, batch, train_cfg)
     inner = make_train_step(model, tx, train_cfg)
@@ -272,21 +276,20 @@ def bench_deepdfa(dtype: str = "bfloat16", diagnostics: bool = False,
     # Pallas custom calls count as ZERO in XLA's cost model; the fused
     # program's kernel FLOPs enter the one shared accounting analytically
     # (forward + hand-derived backward, per model step, times K unrolls).
-    extra_flops = extra_bytes = 0.0
-    if impl == "fused":
-        from deepdfa_tpu.ops.fused_gnn import fused_step_cost, resolve_impl
+    # ONE helper (ops/fused_gnn.analytic_extra_cost) owns every
+    # eligibility leg — band adjacency, backend (when the flag resolves
+    # to the XLA band composition the executed program's FLOPs are
+    # already in cost_analysis; adding the analytic count would double
+    # them), and the persistent VMEM budget — so this accounting tracks
+    # the program the model dispatch actually ran. Scaled by the K
+    # timing unrolls of this bench's dispatch.
+    from deepdfa_tpu.ops.fused_gnn import analytic_extra_cost
 
-        # Same guard as train/loop.py and serve/engine.py: when "fused"
-        # resolves to the XLA band composition (CPU, DEEPDFA_FUSED_IMPL=
-        # xla), the executed program's FLOPs are already in cost_analysis
-        # — adding the analytic count would double them.
-        if resolve_impl() != "xla":
-            cost = fused_step_cost(batch.band_adj, model_cfg.ggnn_hidden,
-                                   dtype)
-            extra_flops = K * model_cfg.n_steps * (cost["flops"]
-                                                   + cost["bwd_flops"])
-            extra_bytes = K * model_cfg.n_steps * (
-                cost["bytes_accessed"] + cost["bwd_bytes_accessed"])
+    extra_flops, extra_bytes = analytic_extra_cost(
+        impl, batch.band_adj, model_cfg.ggnn_hidden, model_cfg.n_steps,
+        dtype, include_bwd=True)
+    extra_flops *= K
+    extra_bytes *= K
     # Register the K-unrolled program in the cost-model registry (the
     # observatory's compiled-callable catalogue) — same executable that
     # was timed, so the roofline numbers describe the measured program.
@@ -329,7 +332,7 @@ def bench_deepdfa_infer(batch_size: int = 256, dtype: str = "bfloat16",
         impl = "band" if jax.default_backend() == "tpu" else "segment"
     model_cfg = FlowGNNConfig(message_impl=impl, dtype=dtype)
     batch = _example_batch(DataConfig(batch_size=batch_size), model_cfg,
-                           slot_pack=impl == "fused")
+                           slot_pack=impl in ("fused", "persistent"))
     model = FlowGNN(model_cfg)
     params = model.init(jax.random.PRNGKey(0), batch)
 
@@ -1233,6 +1236,21 @@ def main() -> None:
         bench_deepdfa("float32", impl="fused")
         if jax.default_backend() == "tpu" else None
     )
+    # The persistent K-step megakernel (ISSUE 15): the whole n_steps
+    # unroll as ONE pallas_call per direction — h VMEM-resident across
+    # steps, h_0 in / h_K out the only per-unroll h HBM traffic. A/B'd
+    # back-to-back against the PR-9 fused rows above under the same
+    # _timed variance protocol (same process, interleaved measurements).
+    # TPU-only — on CPU "persistent" resolves to the band composition
+    # and the A/B is a no-op.
+    graphs_per_sec_persistent = (
+        bench_deepdfa("bfloat16", impl="persistent", diagnostics=True)
+        if jax.default_backend() == "tpu" else None
+    )
+    graphs_per_sec_persistent_f32 = (
+        bench_deepdfa("float32", impl="persistent")
+        if jax.default_backend() == "tpu" else None
+    )
     # DeepDFA-standalone inference: the paper's 4.6 ms/example finally gets
     # a comparison point (the round-5 VERDICT gap).
     deepdfa_infer_ms = bench_deepdfa_infer()
@@ -1345,6 +1363,56 @@ def main() -> None:
                             "message_impl": "fused",
                             "dtype": "float32",
                         }] if graphs_per_sec_fused_f32 is not None else []
+                    ),
+                    *(
+                        [{
+                            "metric":
+                                "deepdfa_train_graphs_per_sec_persistent",
+                            "value": round(graphs_per_sec_persistent[0], 1),
+                            "unit": "graphs/s",
+                            "vs_baseline": round(
+                                graphs_per_sec_persistent[0] / baseline_gnn,
+                                3,
+                            ),
+                            # The in-protocol A/B this row exists for:
+                            # persistent vs the PR-9 per-step fused
+                            # megakernel, measured back-to-back.
+                            "vs_fused": round(
+                                graphs_per_sec_persistent[0]
+                                / graphs_per_sec_fused[0], 3
+                            ) if graphs_per_sec_fused else None,
+                            "message_impl": "persistent",
+                            "mfu": rnd(graphs_per_sec_persistent[1]["mfu"]),
+                            # The MFU's FLOPs numerator includes the
+                            # hand-counted Pallas kernel work — say so
+                            # (the roofline `source` discipline).
+                            "flops_source": "xla+analytic",
+                            "flops_per_step":
+                                graphs_per_sec_persistent[1][
+                                    "flops_per_step"],
+                            "ms_per_step": rnd(
+                                graphs_per_sec_persistent[1]["ms_per_step"]),
+                        }] if graphs_per_sec_persistent is not None else []
+                    ),
+                    *(
+                        [{
+                            "metric":
+                                "deepdfa_train_graphs_per_sec_persistent_f32",
+                            "value": round(
+                                graphs_per_sec_persistent_f32, 1),
+                            "unit": "graphs/s",
+                            "vs_baseline": round(
+                                graphs_per_sec_persistent_f32
+                                / baseline_gnn, 3
+                            ),
+                            "vs_fused": round(
+                                graphs_per_sec_persistent_f32
+                                / graphs_per_sec_fused_f32, 3
+                            ) if graphs_per_sec_fused_f32 else None,
+                            "message_impl": "persistent",
+                            "dtype": "float32",
+                        }] if graphs_per_sec_persistent_f32 is not None
+                        else []
                     ),
                     {
                         "metric": "deepdfa_infer_ms_per_example",

@@ -195,6 +195,26 @@ Rule catalogue (each backed by a positive+negative fixture in
                              deepdfa argvs and receivers of unknown
                              provenance stay unflagged — precision over
                              recall, the empty-baseline contract.
+  GL021 per-step-kernel-launch-in-scan  a module-local ``pallas_call``
+                             wrapper (a def whose body dispatches one)
+                             called inside a ``lax.scan``/``fori_loop``
+                             body when a persistent variant — a
+                             module-local def or imported name whose
+                             name says ``persistent`` — is importable
+                             from the same module. The scan then pays
+                             one kernel launch per step and round-trips
+                             the carry through HBM between launches,
+                             when the module already ships the
+                             cross-step fusion that keeps it VMEM-
+                             resident (the ISSUE-15 persistent unroll:
+                             h once in, h_K once out, instead of 2×K
+                             tile round-trips). Dispatching the
+                             persistent variant itself, scan bodies of
+                             unknown provenance (parameters, imported
+                             step functions), and modules with no
+                             persistent variant to offer stay unflagged
+                             — precision over recall, the
+                             empty-baseline contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -260,6 +280,7 @@ RULES: Dict[str, str] = {
     "GL018": "device-dispatch-under-shared-lock",
     "GL019": "per-hypothesis-decode-dispatch",
     "GL020": "subprocess-without-trace-context",
+    "GL021": "per-step-kernel-launch-in-scan",
 }
 
 _JIT_NAMES = frozenset({
@@ -487,17 +508,13 @@ class _Module:
                 for t in stmt.targets:
                     if isinstance(t, ast.Name):
                         self.true_constants.add(t.id)
+        # One pass serves both rules: GL021's kernel dispatchers (any def
+        # whose body calls pallas_call) are a superset of GL016's kernel
+        # wrappers (those that ALSO take an ``interpret`` parameter).
         self.kernel_wrappers: Dict[str, int] = {}
+        self.kernel_dispatchers: Set[str] = set()
         for node in ast.walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            a = node.args
-            positional = [x.arg for x in a.posonlyargs + a.args]
-            if "interpret" in positional:
-                idx = positional.index("interpret")
-            elif "interpret" in [x.arg for x in a.kwonlyargs]:
-                idx = -1
-            else:
                 continue
             calls_pallas = any(
                 isinstance(sub, ast.Call)
@@ -505,8 +522,25 @@ class _Module:
                 and dotted.rsplit(".", 1)[-1] == _PALLAS_CALL_LEAF
                 for sub in ast.walk(node)
             )
-            if calls_pallas:
-                self.kernel_wrappers[node.name] = idx
+            if not calls_pallas:
+                continue
+            self.kernel_dispatchers.add(node.name)
+            a = node.args
+            positional = [x.arg for x in a.posonlyargs + a.args]
+            if "interpret" in positional:
+                self.kernel_wrappers[node.name] = positional.index(
+                    "interpret")
+            elif "interpret" in [x.arg for x in a.kwonlyargs]:
+                self.kernel_wrappers[node.name] = -1
+        # GL021's other fact: the "persistent variants" whose
+        # availability makes a per-step launch inside a scan a finding —
+        # module defs or imported names whose leaf name says persistent
+        # (the ops/fused_gnn.persistent_unroll shape).
+        self.persistent_variants: Set[str] = {
+            name
+            for name in set(self.module_defs) | set(self.aliases)
+            if "persistent" in name.lower()
+        }
         # GL018 facts: shared-lock definitions. Module-level
         # ``NAME = threading.Lock()`` assignments and class-body
         # ``attr = threading.Lock()`` assignments (reached later as
@@ -715,6 +749,7 @@ class _FunctionChecker:
         self._check_lock_dispatch()
         if not self.jit_scope:
             self._check_per_hypothesis_dispatch()
+            self._check_scan_kernel_launch()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -1764,6 +1799,92 @@ class _FunctionChecker:
                         "the program as one lax.scan over the carry "
                         "(models/t5_generate.py's batched beam is the "
                         "accepted shape)")
+                    break  # one finding per loop: the loop is the hazard
+
+    # -- per-step kernel launch in a scan (GL021) ----------------------------
+
+    _SCAN_LOOP_LEAVES = frozenset({"scan", "fori_loop"})
+
+    def _scan_body_nodes(self, call: ast.Call) -> "List[ast.AST]":
+        """The AST to inspect for a lax.scan / lax.fori_loop call's body
+        function: the lambda body inline, or the named module-local def's
+        body. Receivers of unknown provenance (parameters, attributes of
+        imported objects) return nothing — the caller owns those."""
+        leaf = None
+        dotted = self.mod.resolve(call.func)
+        if dotted is not None and "lax" in dotted.split("."):
+            leaf = dotted.rsplit(".", 1)[-1]
+        if leaf not in self._SCAN_LOOP_LEAVES:
+            return []
+        if leaf == "scan":
+            body = call.args[0] if call.args else next(
+                (kw.value for kw in call.keywords if kw.arg == "f"), None)
+        else:  # fori_loop(lower, upper, body_fun, init_val)
+            body = call.args[2] if len(call.args) > 2 else next(
+                (kw.value for kw in call.keywords
+                 if kw.arg == "body_fun"), None)
+        if isinstance(body, ast.Lambda):
+            return [body.body]
+        if isinstance(body, ast.Name):
+            # Scope-aware lookup: a local def in THIS function shadows
+            # any same-named def elsewhere in the module (the module-wide
+            # first-definition-wins table would inspect the wrong body).
+            local = next(
+                (n for n in ast.walk(self.fi.node)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n is not self.fi.node and n.name == body.id),
+                None)
+            if local is not None:
+                return list(local.body)
+            # Otherwise only a module-TOP-LEVEL def resolves — a nested
+            # def inside some other function is not in scope here.
+            top = next(
+                (n for n in self.mod.tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n.name == body.id),
+                None)
+            if top is not None:
+                return list(top.body)
+        return []
+
+    def _check_scan_kernel_launch(self) -> None:
+        """A module-local pallas_call wrapper dispatched per scan step
+        when the same module ships a persistent cross-step variant: the
+        scan pays a kernel launch per step and round-trips the carry
+        through HBM between launches — the exact traffic the persistent
+        unroll exists to delete (ISSUE 15). One finding per loop body."""
+        if not (self.mod.kernel_dispatchers
+                and self.mod.persistent_variants):
+            return
+        variant = sorted(self.mod.persistent_variants)[0]
+        for node in _walk_skip_defs(self.fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            body_nodes = self._scan_body_nodes(node)
+            if not body_nodes:
+                continue
+            for stmt in body_nodes:
+                hit = next(
+                    (sub for sub in ast.walk(stmt)
+                     if isinstance(sub, ast.Call)
+                     and isinstance(sub.func, ast.Name)
+                     and sub.func.id in self.mod.kernel_dispatchers
+                     # Dispatching the persistent variant itself IS the
+                     # accepted shape, not the hazard.
+                     and "persistent" not in sub.func.id.lower()),
+                    None)
+                if hit is not None:
+                    self._report(
+                        "GL021", hit,
+                        f"per-step kernel launch: `{hit.func.id}(…)` (a "
+                        "module-local pallas_call wrapper) dispatched "
+                        "inside a lax.scan/fori_loop body while "
+                        f"`{variant}` is importable from this module — "
+                        "the scan pays one kernel launch per step and "
+                        "round-trips the carry through HBM between "
+                        "launches; dispatch the persistent K-step "
+                        "variant instead (ops/fused_gnn.persistent_"
+                        "unroll is the accepted shape)")
                     break  # one finding per loop: the loop is the hazard
 
     # -- swallowed device exceptions (GL009) ---------------------------------

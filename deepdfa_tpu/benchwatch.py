@@ -317,6 +317,11 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
 
     * ``smoke_gnn_train_graphs_per_sec`` — an AOT-compiled tiny FlowGNN
       train step (segment impl, the portable path) at batch 32;
+    * ``smoke_gnn_train_graphs_per_sec_persistent`` — the same
+      slot-packed batch through ``message_impl="persistent"`` (ISSUE 15:
+      the K-step unroll as one pallas_call per direction); on CPU the
+      flag degrades to the band composition, so the gated mechanism is
+      the dispatch/degrade path, like the fused row;
     * ``smoke_ingest_rows_per_sec`` — the contract-validated JSONL
       loader over a small synthetic corpus;
     * ``smoke_sigterm_to_durable_snapshot_ms`` — real self-SIGTERM →
@@ -386,49 +391,46 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
 
     batch = next(_batches(examples, np.arange(len(examples)), data_cfg,
                           subkeys_for(feat), data_cfg.batch_size))
-    model = FlowGNN(model_cfg)
-    state, tx = make_train_state(model, batch, TrainConfig())
-    step = jax.jit(make_train_step(model, tx, TrainConfig()),
-                   donate_argnums=(0,)).lower(state, batch).compile()
 
-    def call():
-        nonlocal state
-        state, loss, _ = step(state, batch)
-        return loss
+    def gnn_lane(message_impl: str, lane_batch) -> float:
+        """graphs/s of one AOT-compiled tiny train step — the one lane
+        protocol (jit + donation + _best_of) every GNN smoke row uses."""
+        cfg = FlowGNNConfig(feature=feat, hidden_dim=16, n_steps=2,
+                            message_impl=message_impl)
+        model = FlowGNN(cfg)
+        state, tx = make_train_state(model, lane_batch, TrainConfig())
+        step = jax.jit(make_train_step(model, tx, TrainConfig()),
+                       donate_argnums=(0,)).lower(state,
+                                                  lane_batch).compile()
 
-    dt = _best_of(call, n_steps, reps)
-    gps = n_steps * data_cfg.batch_size / dt
+        def call():
+            nonlocal state
+            state, loss, _ = step(state, lane_batch)
+            return loss
 
-    # The fused-step lane (ISSUE 9): slot-packed band batch through
-    # message_impl="fused". On the CPU gate this resolves to the XLA band
-    # composition — still the mechanism guard the smoke exists for (slot
-    # packing, band build, fused dispatch, and any host sync creeping in),
-    # while the TPU trajectory carries the kernel's real numbers.
+        dt = _best_of(call, n_steps, reps)
+        return n_steps * data_cfg.batch_size / dt
+
+    gps = gnn_lane("segment", batch)
+
+    # The fused-step lane (ISSUE 9) and the persistent-unroll lane
+    # (ISSUE 15): the same slot-packed band batch through
+    # message_impl="fused" / "persistent". On the CPU gate both resolve
+    # to the XLA band composition — still the mechanism guard the smoke
+    # exists for (slot packing, band build, dispatch/eligibility gating,
+    # param-tree identity, and any host sync creeping into the degrade
+    # paths), while the TPU trajectory carries the kernels' real numbers.
     from deepdfa_tpu.graphs.batch import batch_graphs, slot_nodes_for
     from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, align_to_tile
 
-    fused_cfg = FlowGNNConfig(feature=feat, hidden_dim=16, n_steps=2,
-                              message_impl="fused")
     slot = slot_nodes_for(examples, tile=DEFAULT_TILE)
     fused_batch = batch_graphs(
         examples, data_cfg.batch_size,
         align_to_tile(data_cfg.batch_size * slot), data_cfg.max_edges,
         subkeys_for(feat), build_band_adj=True, slot_nodes=slot,
     )
-    fused_model = FlowGNN(fused_cfg)
-    fused_state, fused_tx = make_train_state(fused_model, fused_batch,
-                                             TrainConfig())
-    fused_step = jax.jit(
-        make_train_step(fused_model, fused_tx, TrainConfig()),
-        donate_argnums=(0,)).lower(fused_state, fused_batch).compile()
-
-    def fused_call():
-        nonlocal fused_state
-        fused_state, loss, _ = fused_step(fused_state, fused_batch)
-        return loss
-
-    fused_dt = _best_of(fused_call, n_steps, reps)
-    fused_gps = n_steps * data_cfg.batch_size / fused_dt
+    fused_gps = gnn_lane("fused", fused_batch)
+    pers_gps = gnn_lane("persistent", fused_batch)
 
     corpus = synthetic_bigvul(n_rows, FeatureSpec(), positive_fraction=0.5,
                               seed=0)
@@ -452,7 +454,11 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
-    sigterm_ms = sigterm_to_snapshot_ms(state, reps=reps)
+    # The tiny trainer state the preemption-drain smoke snapshots (same
+    # shapes as the lane states above; content is irrelevant to timing).
+    sig_state, _ = make_train_state(FlowGNN(model_cfg), batch,
+                                    TrainConfig())
+    sigterm_ms = sigterm_to_snapshot_ms(sig_state, reps=reps)
 
     # Serving-fleet mechanism smoke (ISSUE 12): a 2-replica fleet's
     # saturation throughput over a tiny open-loop trace on per-replica
@@ -569,6 +575,8 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(gps, 1), "unit": "graphs/s"},
         "smoke_gnn_train_graphs_per_sec_fused": {
             "value": round(fused_gps, 1), "unit": "graphs/s"},
+        "smoke_gnn_train_graphs_per_sec_persistent": {
+            "value": round(pers_gps, 1), "unit": "graphs/s"},
         "smoke_ingest_rows_per_sec": {
             "value": round(n_rows / ingest_dt, 1), "unit": "rows/s"},
         "smoke_sigterm_to_durable_snapshot_ms": {

@@ -98,7 +98,11 @@ class FlowGNNConfig:
     # MXU work (bench.py); "fused": the single-pass Pallas megakernel
     # (ops/fused_gnn.py — edge message + band SpMM + GRU gate in one
     # pallas_call, band adjacency required; degrades to the bitwise band
-    # composition off-TPU and on sharded batches).
+    # composition off-TPU and on sharded batches); "persistent": the
+    # K-step megakernel (ISSUE 15) — the WHOLE n_steps unroll as one
+    # pallas_call per direction with h VMEM-resident across steps
+    # (degrades to the scan of fused steps, and from there to the bitwise
+    # band composition, off-TPU and on sharded batches).
     message_impl: str = "segment"
     # Rematerialize the gated steps in the backward pass. The step is
     # HBM-bound, so recomputing activations beats storing them: ~7% higher
@@ -129,7 +133,7 @@ class FlowGNNConfig:
         too; before this property existed, lanes testing
         ``message_impl == "band"`` literally would silently mis-build
         batches for new band-family impls."""
-        return self.message_impl in ("band", "fused")
+        return self.message_impl in ("band", "fused", "persistent")
 
     @property
     def uses_tile_adj(self) -> bool:

@@ -168,6 +168,33 @@ class GatedGraphStep(nn.Module):
         return new_h
 
 
+class _PersistentUnroll(nn.Module):
+    """The K-step persistent megakernel's flax face (ISSUE 15).
+
+    Declares the SAME param tree at the same scope paths as the scanned
+    ``GatedGraphStep`` (``edge_linear`` + ``gru/{ir,iz,in,hr,hz,hn}`` via
+    the fused_gnn holder modules, broadcast across steps — nn.scan with
+    ``variable_broadcast`` adds no scan axis), so checkpoints survive
+    flips between ``persistent``, ``fused``, and ``band``. Dispatches the
+    whole unroll as ONE ``pallas_call`` per direction
+    (``fused_gnn.persistent_unroll``): h VMEM-resident across all
+    ``n_steps``, bitwise equal to the scan-of-fused-step oracle in
+    forward AND gradients (pinned by tests/test_persistent_gnn.py).
+    """
+
+    hidden: int
+    n_steps: int
+
+    @nn.compact
+    def __call__(self, h, band_adj, impl: str):
+        from deepdfa_tpu.ops import fused_gnn
+
+        params = fused_gnn.declare_step_params(self.hidden,
+                                               int(h.shape[-1]))
+        return fused_gnn.persistent_unroll(params, h, band_adj,
+                                           self.n_steps, impl=impl)
+
+
 class GlobalAttentionPool(nn.Module):
     """Masked per-graph attention pooling.
 
@@ -280,32 +307,76 @@ class FlowGNN(nn.Module):
         # DGL's GatedGraphConv no zero-padding of the input is needed.
         h = feat_embed
 
-        # remat: recompute step activations in the backward instead of
-        # saving them — the step is HBM-bound, so this is faster on TPU
-        # (~7% at the published shape) and lighter on memory.
-        step_cls = nn.remat(GatedGraphStep) if cfg.remat_steps else GatedGraphStep
-        step = step_cls(
-            cfg.ggnn_hidden,
-            dtype=dtype,
-            message_impl=cfg.message_impl,
-            mesh=self.mesh,
-            name="ggnn_step",
-        )
-        # Weight sharing across steps (one GatedGraphConv applied n_steps
-        # times) — scan over a length-n_steps axis with broadcast params.
-        # Fully unrolled (capped at 8 iterations per loop step): at the
-        # published 5-step depth XLA fuses across step boundaries that the
-        # rolled scan's carry structure forbids — whole-step A/B on v5e:
-        # 405-410k vs 392-394k graphs/s (+3-4%), consistent across
-        # interleaved repeats (round-5 notes, bench.py).
-        scan = nn.scan(
-            lambda mod, carry, _: (mod(carry, batch), None),
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            length=cfg.n_steps,
-            unroll=min(cfg.n_steps, 8),
-        )
-        ggnn_out, _ = scan(step, h, None)
+        # message_impl="persistent" (ISSUE 15): the WHOLE K-step unroll as
+        # one pallas_call per direction — h stays VMEM-resident across all
+        # steps, so HBM sees h once in and h_K once out instead of 2×K
+        # per-step tile round-trips. Eligibility mirrors the fused flag
+        # (unsharded band adjacency, a real kernel backend); sharded and
+        # off-TPU batches degrade to the scan of fused steps below, which
+        # itself degrades to the bitwise band composition.
+        message_impl = cfg.message_impl
+        persistent_kernel = None
+        if message_impl == "persistent":
+            if batch.band_adj is None:
+                raise ValueError(
+                    "message_impl='persistent' needs batch_graphs("
+                    "build_band_adj=True) — the persistent kernel consumes "
+                    "the band adjacency"
+                )
+            from deepdfa_tpu.ops import fused_gnn
+
+            fimpl = fused_gnn.resolve_impl()
+            sharded = batch.band_adj.vals.ndim == 5
+            # The third eligibility leg: the resident h + windows must
+            # fit the VMEM budget, or Mosaic would fail the allocation
+            # at compile time — a batch the fused-scan degrade runs
+            # fine must never crash the persistent flag.
+            fits = fused_gnn.persistent_vmem_ok(
+                batch.band_adj, cfg.ggnn_hidden, dtype)
+            if fimpl != "xla" and not sharded and fits:
+                persistent_kernel = fimpl
+            else:
+                message_impl = "fused"
+        if persistent_kernel is not None:
+            ggnn_out = _PersistentUnroll(
+                cfg.ggnn_hidden, n_steps=cfg.n_steps, name="ggnn_step"
+            )(h, batch.band_adj, persistent_kernel)
+        else:
+            # remat: recompute step activations in the backward instead of
+            # saving them — the step is HBM-bound, so this is faster on TPU
+            # (~7% at the published shape) and lighter on memory.
+            step_cls = (nn.remat(GatedGraphStep) if cfg.remat_steps
+                        else GatedGraphStep)
+            step = step_cls(
+                cfg.ggnn_hidden,
+                dtype=dtype,
+                message_impl=message_impl,
+                mesh=self.mesh,
+                name="ggnn_step",
+            )
+            # Weight sharing across steps (one GatedGraphConv applied
+            # n_steps times) — scan over a length-n_steps axis with
+            # broadcast params. Fully unrolled (capped at 8 iterations per
+            # loop step): at the published 5-step depth XLA fuses across
+            # step boundaries that the rolled scan's carry structure
+            # forbids — whole-step A/B on v5e: 405-410k vs 392-394k
+            # graphs/s (+3-4%), consistent across interleaved repeats
+            # (round-5 notes, bench.py). The hint is gated on the
+            # RESOLVED impl structurally: when the persistent kernel
+            # dispatches above, no scan (and no unroll hint) exists at
+            # all — the hint would be dead weight on that path — while
+            # every path that actually scans (band/fused/segment/tile
+            # AND the persistent flag's degrade, which must stay
+            # program-identical to the fused scan) keeps today's unroll
+            # bit-for-bit.
+            scan = nn.scan(
+                lambda mod, carry, _: (mod(carry, batch), None),
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                length=cfg.n_steps,
+                unroll=min(cfg.n_steps, 8),
+            )
+            ggnn_out, _ = scan(step, h, None)
 
         # Skip-concat with the input embedding (ggnn.py:98).
         out = jnp.concatenate([ggnn_out, feat_embed], axis=-1)

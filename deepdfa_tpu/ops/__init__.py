@@ -13,6 +13,14 @@ when profiling shows XLA's generated code leaving the MXU idle.
   scalar-prefetched tile coordinates, sequential grid), with a custom VJP.
   Select with ``FlowGNNConfig(message_impl="tile")`` on batches built with
   ``batch_graphs(build_tile_adj=True)``.
+- ``fused_gnn``: the GatedGraphStep megakernels (Pallas; custom VJP with
+  in-kernel remat). ``fused_gate_step`` fuses one whole step (edge
+  message + band SpMM + GRU gate) into one ``pallas_call`` per
+  direction; ``persistent_unroll`` fuses the entire K-step unroll — h
+  VMEM-resident across steps, h_0 in / h_K out the only per-unroll h
+  HBM traffic. Select with ``FlowGNNConfig(message_impl="fused")`` /
+  ``message_impl="persistent"`` on band-adjacency batches (dense-slot
+  packed); both degrade to the bitwise band composition off-TPU.
 - ``attention``: blockwise streaming-softmax attention + Pallas flash
   kernels (forward and dq/dk/dv backward) — the long-context path.
 """

@@ -229,10 +229,11 @@ class ServeEngine:
 
     @staticmethod
     def _make_lane(name, infer, params, graph_cfg) -> _Lane:
-        if graph_cfg.message_impl not in ("segment", "band", "fused"):
+        if graph_cfg.message_impl not in ("segment", "band", "fused",
+                                          "persistent"):
             raise ValueError(
-                f"serving supports message_impl 'segment', 'band' or "
-                f"'fused' (pinned bandwidth), got "
+                f"serving supports message_impl 'segment', 'band', 'fused' "
+                f"or 'persistent' (pinned bandwidth), got "
                 f"{graph_cfg.message_impl!r} — per-batch adjacency budgets "
                 "would mint new compiled shapes at runtime"
             )
@@ -374,16 +375,16 @@ class ServeEngine:
 
         extra_flops = extra_bytes = 0.0
         cfg = lane.graph_cfg
-        if (cfg is not None and cfg.message_impl == "fused"
-                and empty.band_adj is not None
-                and empty.band_adj.vals.ndim == 4):
-            from deepdfa_tpu.ops.fused_gnn import fused_step_cost, resolve_impl
+        if cfg is not None:
+            # ONE helper owns every eligibility leg (band adjacency,
+            # backend, the persistent VMEM budget), so the serving
+            # roofline charges the program each lane actually compiles.
+            # Forward-only: serving never runs the backward.
+            from deepdfa_tpu.ops.fused_gnn import analytic_extra_cost
 
-            if resolve_impl() != "xla":
-                cost = fused_step_cost(
-                    empty.band_adj, cfg.ggnn_hidden, cfg.dtype)
-                extra_flops = cfg.n_steps * cost["flops"]
-                extra_bytes = cfg.n_steps * cost["bytes_accessed"]
+            extra_flops, extra_bytes = analytic_extra_cost(
+                cfg.message_impl, empty.band_adj, cfg.ggnn_hidden,
+                cfg.n_steps, cfg.dtype, include_bwd=False)
         costmodel.capture_compiled(
             f"serve.{lane_name}.s{slots}", exe, span="serve.flush",
             lane=lane_name, slots=slots, extra_flops=extra_flops,

@@ -386,10 +386,14 @@ def _propagation(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 # cost.model event keys that are capture metadata, not span-join attrs.
+# analytic_flops/analytic_bytes are the capture's hand-counted Pallas
+# component (costmodel extra_flops/extra_bytes) — metadata feeding the
+# roofline's `source` column, NOT a join key: treating them as one made
+# every analytic capture silently unmatchable against its spans.
 _CM_META = frozenset({
     "name", "span", "steps_per_call", "use_fenced_window", "flops",
     "bytes_accessed", "device_kind", "peak_flops",
-    "peak_hbm_bytes_per_sec",
+    "peak_hbm_bytes_per_sec", "analytic_flops", "analytic_bytes",
 })
 
 
@@ -403,7 +407,10 @@ def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
     The time source is honest about attribution: fenced spans (or the
     fenced-window amortized step time for the train step) measure
     device-inclusive duration; dispatch-only span p50 is used — and
-    labelled — only when nothing fenced matched.
+    labelled — only when nothing fenced matched. The ``source`` column
+    is the same honesty for the FLOPs/bytes side: rows whose numbers
+    include hand-counted Pallas work (analytic extra_flops/extra_bytes)
+    say "analytic"/"xla+analytic" instead of passing as XLA-measured.
     """
     latest: Dict[str, Dict[str, Any]] = {}
     for e in instants:
@@ -439,6 +446,35 @@ def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
             time_source = "fenced_window"
         flops = float(cm.get("flops", 0.0)) / steps_per_call
         bytes_accessed = float(cm.get("bytes_accessed", 0.0)) / steps_per_call
+        # Accounting provenance (the perf-evidence rule): "xla" = every
+        # number below came from XLA's cost model of the compiled HLO;
+        # "analytic" / "xla+analytic" = some or all FLOPs/bytes are
+        # hand-counted Pallas-kernel work (capture extra_flops/
+        # extra_bytes) that XLA counts as zero — those rows must never be
+        # quoted as if measured.
+        analytic_flops = float(cm.get("analytic_flops", 0.0))
+        analytic_bytes = float(cm.get("analytic_bytes", 0.0))
+        total_flops = float(cm.get("flops", 0.0))
+        total_bytes = float(cm.get("bytes_accessed", 0.0))
+
+        def _frac(part, total):
+            return round(part / total, 3) if total else None
+
+        if not (analytic_flops or analytic_bytes):
+            source = "xla"
+            analytic_flops_frac = analytic_bytes_frac = None
+        else:
+            # "analytic" only when BOTH sides are (essentially) entirely
+            # hand-counted — a bytes-only analytic component must not
+            # hide behind a 0.0 flops fraction.
+            flops_all = (not total_flops
+                         or analytic_flops >= total_flops * 0.999)
+            bytes_all = (not total_bytes
+                         or analytic_bytes >= total_bytes * 0.999)
+            source = "analytic" if flops_all and bytes_all else (
+                "xla+analytic")
+            analytic_flops_frac = _frac(analytic_flops, total_flops)
+            analytic_bytes_frac = _frac(analytic_bytes, total_bytes)
         peak_flops = cm.get("peak_flops")
         peak_bw = cm.get("peak_hbm_bytes_per_sec")
         oi = flops / bytes_accessed if bytes_accessed else None
@@ -447,6 +483,9 @@ def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
         row: Dict[str, Any] = {
             "name": name,
             "calls": len(matched),
+            "source": source,
+            "analytic_flops_frac": analytic_flops_frac,
+            "analytic_bytes_frac": analytic_bytes_frac,
             "flops_per_step": flops,
             "bytes_per_step": bytes_accessed,
             "operational_intensity": round(oi, 3) if oi else None,

@@ -944,28 +944,23 @@ def _fit_epochs_inner(
                     and window_steps:
                 from deepdfa_tpu.telemetry import costmodel
 
-                # The fused megakernel is a Pallas custom call — zero in
-                # XLA's cost model — so its hand-counted FLOPs join the
-                # roofline capture analytically (fwd + bwd per gated
-                # step; ops/fused_gnn.fused_step_cost).
+                # The fused/persistent megakernels are Pallas custom
+                # calls — zero in XLA's cost model — so their
+                # hand-counted FLOPs join the roofline capture
+                # analytically. ONE helper owns every eligibility leg
+                # (band adjacency, backend, the persistent VMEM budget),
+                # so the accounting tracks the program the model
+                # dispatch actually runs (ops/fused_gnn).
                 extra: Dict[str, Any] = {}
-                if (model.config.message_impl == "fused"
-                        and batch.band_adj is not None
-                        and batch.band_adj.vals.ndim == 4):
-                    from deepdfa_tpu.ops.fused_gnn import (
-                        fused_step_cost,
-                        resolve_impl,
-                    )
+                from deepdfa_tpu.ops.fused_gnn import analytic_extra_cost
 
-                    if resolve_impl() != "xla":
-                        c = fused_step_cost(batch.band_adj,
-                                            model.config.ggnn_hidden,
-                                            model.config.dtype)
-                        extra["extra_flops"] = model.config.n_steps * (
-                            c["flops"] + c["bwd_flops"])
-                        extra["extra_bytes"] = model.config.n_steps * (
-                            c["bytes_accessed"]
-                            + c["bwd_bytes_accessed"])
+                ef, eb = analytic_extra_cost(
+                    model.config.message_impl, batch.band_adj,
+                    model.config.ggnn_hidden, model.config.n_steps,
+                    model.config.dtype, include_bwd=True)
+                if ef or eb:
+                    extra["extra_flops"] = ef
+                    extra["extra_bytes"] = eb
                 costmodel.capture_jitted("train.step", train_step, state,
                                          batch, use_fenced_window=True,
                                          **extra)

@@ -1356,6 +1356,114 @@ def fork_pool(items, fn):
     assert "GL020" not in rules_of(src)
 
 
+def test_gl021_per_step_kernel_launch_in_scan_fires():
+    # The cross-step fusion hazard (ISSUE 15): a module-local pallas_call
+    # wrapper dispatched per lax.scan/fori_loop step while the module
+    # ships a persistent K-step variant — the scan round-trips the carry
+    # through HBM between launches the persistent kernel would keep
+    # VMEM-resident. Named-def scan bodies and fori_loop lambdas both
+    # count; one finding per loop.
+    src = """
+import jax
+from jax.experimental import pallas as pl
+
+def fused_step(params, h, adj):
+    return pl.pallas_call(_kernel, out_shape=h)(params, h, adj)
+
+def persistent_unroll(params, h, adj, n_steps):
+    return h
+
+def run_scan(params, h, adj, steps):
+    def body(carry, _):
+        return fused_step(params, carry, adj), None
+    out, _ = jax.lax.scan(body, h, None, length=steps)
+    return out
+
+def run_fori(params, h, adj, steps):
+    return jax.lax.fori_loop(
+        0, steps, lambda i, c: fused_step(params, c, adj), h)
+"""
+    found = findings_for(src, "GL021")
+    assert len(found) == 2
+    assert {f.function for f in found} == {"run_scan", "run_fori"}
+    assert all("persistent" in f.message for f in found)
+
+
+def test_gl021_negatives_unflagged():
+    # The accepted shapes: dispatching the persistent variant itself in
+    # a scan, a module with no persistent variant to offer (can't demand
+    # what doesn't exist), an imported step function (unknown
+    # provenance), and the wrapper called outside any loop.
+    src_persistent_dispatch = """
+import jax
+from jax.experimental import pallas as pl
+
+def fused_step(h):
+    return pl.pallas_call(_kernel, out_shape=h)(h)
+
+def persistent_chunk(h):
+    return pl.pallas_call(_kernel2, out_shape=h)(h)
+
+def run(h, steps):
+    out, _ = jax.lax.scan(lambda c, _: (persistent_chunk(c), None),
+                          h, None, length=steps)
+    return fused_step(out)
+"""
+    assert "GL021" not in rules_of(src_persistent_dispatch)
+
+    src_no_variant = """
+import jax
+from jax.experimental import pallas as pl
+
+def fused_step(h):
+    return pl.pallas_call(_kernel, out_shape=h)(h)
+
+def run(h, steps):
+    out, _ = jax.lax.scan(lambda c, _: (fused_step(c), None),
+                          h, None, length=steps)
+    return out
+"""
+    assert "GL021" not in rules_of(src_no_variant)
+
+    src_imported_step = """
+import jax
+from somewhere import fused_step
+from somewhere import persistent_unroll
+
+def run(h, steps):
+    out, _ = jax.lax.scan(lambda c, _: (fused_step(c), None),
+                          h, None, length=steps)
+    return out
+"""
+    assert "GL021" not in rules_of(src_imported_step)
+
+    # Scope fidelity: a clean local `body` must shadow another
+    # function's dirty def of the same name — the scan in `clean` runs
+    # ITS body, not `dirty`'s.
+    src_shadowed_body = """
+import jax
+from jax.experimental import pallas as pl
+
+def fused_step(h):
+    return pl.pallas_call(_kernel, out_shape=h)(h)
+
+def persistent_unroll(h, n):
+    return h
+
+def dirty_helper(h, steps):
+    def body(carry, _):
+        return fused_step(carry), None
+    return body
+
+def clean(h, steps):
+    def body(carry, _):
+        return carry + 1, None
+    out, _ = jax.lax.scan(body, h, None, length=steps)
+    return out
+"""
+    assert "GL021" not in rules_of(src_shadowed_body)
+
+
 def test_gl017_lifecycle_module_is_the_clean_reference():
     # The rule's docstring points at resilience/lifecycle.py as the
     # accepted shape; the module must stay GL017-clean (and clean of
@@ -1642,8 +1750,9 @@ def test_self_check_covers_every_rule_implementation():
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
-                             "GL016", "GL017", "GL018", "GL019", "GL020"})
-    assert len(RULES) == 20
+                             "GL016", "GL017", "GL018", "GL019", "GL020",
+                             "GL021"})
+    assert len(RULES) == 21
 
 
 def test_unparseable_file_is_a_finding(tmp_path):

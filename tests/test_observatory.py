@@ -164,6 +164,59 @@ def test_roofline_section_has_train_step_with_fenced_window_time(ddfa_run):
 
 
 @needs_cost
+def test_roofline_source_column_is_xla_for_pure_xla_capture(ddfa_run):
+    """The accounting-provenance column (ISSUE 15): a capture with no
+    analytic component says source="xla" — nothing hand-counted hides
+    behind a measured-looking row."""
+    run_dir = ddfa_run[0]
+    report = trace_report(run_dir)
+    rows = {r["name"]: r for r in report["roofline"]}
+    assert rows["train.step"]["source"] == "xla"
+    assert rows["train.step"]["analytic_flops_frac"] is None
+
+
+def test_roofline_source_column_labels_analytic_captures():
+    """A capture carrying analytic extra FLOPs/bytes (the Pallas
+    megakernels) must be labelled — and the analytic keys are capture
+    metadata, NOT span-join attrs (they used to silently unmatch every
+    analytic capture from its measured spans)."""
+    from deepdfa_tpu.telemetry.report import _roofline
+
+    instants = [{
+        "name": "cost.model",
+        "attrs": {
+            "name": "train.step", "span": "train.step",
+            "steps_per_call": 1, "use_fenced_window": False,
+            "flops": 10e9, "bytes_accessed": 4e8,
+            "analytic_flops": 8e9, "analytic_bytes": 3e8,
+            "device_kind": "cpu", "peak_flops": None,
+            "peak_hbm_bytes_per_sec": None,
+        },
+    }]
+    spans = [{"name": "train.step", "attrs": {}, "dur_ms": 5.0,
+              "fenced": True}]
+    (row,) = _roofline(spans, instants, {})
+    assert row["source"] == "xla+analytic"
+    assert row["analytic_flops_frac"] == pytest.approx(0.8)
+    assert row["analytic_bytes_frac"] == pytest.approx(0.75)
+    # The join survived: the analytic keys did not leak into the span
+    # match predicate.
+    assert row["calls"] == 1
+    assert row["time_source"] == "fenced_span"
+    # A bytes-only analytic component must not hide behind a 0.0 flops
+    # fraction — the row stays labelled mixed.
+    instants[0]["attrs"]["analytic_flops"] = 0.0
+    (row,) = _roofline(spans, instants, {})
+    assert row["source"] == "xla+analytic"
+    assert row["analytic_bytes_frac"] == pytest.approx(0.75)
+    # A capture that is entirely hand-counted on BOTH sides says so.
+    instants[0]["attrs"]["analytic_flops"] = 10e9
+    instants[0]["attrs"]["analytic_bytes"] = 4e8
+    (row,) = _roofline(spans, instants, {})
+    assert row["source"] == "analytic"
+
+
+@needs_cost
 def test_roofline_ddfa_flops_equal_bench_accounting(ddfa_run):
     """The satellite gate: the roofline's DDFA FLOPs must equal the
     bench.py accounting (``_costs_of_compiled`` of the same step at the

@@ -1,0 +1,513 @@
+"""Persistent K-step GNN megakernel (ISSUE 15): the whole message-passing
+unroll as ONE pallas_call per direction, h VMEM-resident across steps.
+
+The acceptance gates:
+  * persistent-vs-scan BITWISE parity — forward AND gradients against the
+    scan-of-fused-step oracle (interpret mode; 1 and 8 virtual devices);
+  * K=1 degenerates to the PR-9 single-step kernel;
+  * non-dividing tile counts / bandwidth extremes;
+  * the CPU/sharded degrade path is bitwise the band composition and the
+    param tree survives the flag flip;
+  * the persistent serving lane warms the same executable count as band
+    and stays zero-recompile after warmup;
+  * persistent_unroll_cost shows the 2×K h-tile HBM term eliminated
+    (only h_in + h_out remain on the forward).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig, subkeys_for
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.graphs.batch import batch_graphs, slot_nodes_for
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.ops import fused_gnn
+from deepdfa_tpu.ops.band_spmm import build_band_adjacency
+from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, align_to_tile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+
+
+@pytest.fixture
+def force_interpret(monkeypatch):
+    """Route the persistent/fused flags through the REAL Pallas kernels
+    on the CPU tier-1 host (the interpreter runs the same programs)."""
+    monkeypatch.setenv("DEEPDFA_FUSED_IMPL", "interpret")
+
+
+def _random_params(key, hidden):
+    ks = iter(jax.random.split(key, 20))
+    dense = lambda bias: (
+        {"kernel": jax.random.normal(next(ks), (hidden, hidden)) * 0.2,
+         **({"bias": jax.random.normal(next(ks), (hidden,)) * 0.2}
+            if bias else {})})
+    return {
+        "edge_linear": dense(True),
+        "gru": {name: dense(bias) for name, bias in
+                (("ir", True), ("iz", True), ("in", True),
+                 ("hr", False), ("hz", False), ("hn", True))},
+    }
+
+
+def _band_fixture(rng, tile, n_tiles, spread):
+    n = tile * n_tiles
+    s = rng.integers(0, n, 6 * n)
+    r = np.clip(s + rng.integers(-spread, spread + 1, 6 * n), 0, n - 1)
+    return build_band_adjacency(s, r, np.ones(len(s), bool), n, tile=tile)
+
+
+def _scan_oracle(params, h, adj, n_steps, impl):
+    """THE parity oracle: n_steps applications of the single-step fused
+    kernel with shared weights — what models/flowgnn.py's scan runs."""
+    for _ in range(n_steps):
+        h = fused_gnn.fused_gate_step(params, h, adj, impl=impl)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Kernel vs scan-of-fused-step oracle: BITWISE, forward + backward
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "tile,n_tiles,spread,hidden,n_steps",
+    [
+        (8, 4, 2, 16, 3),     # the small regular case
+        (8, 5, 12, 8, 4),     # non-dividing tile count, wide band
+        (16, 3, 1, 32, 2),    # window ≈ whole batch
+        (8, 6, 20, 8, 5),     # bandwidth at the n_tiles ceiling
+    ])
+def test_persistent_bitwise_equals_scan_oracle(tile, n_tiles, spread,
+                                               hidden, n_steps):
+    rng = np.random.default_rng(0)
+    adj = _band_fixture(rng, tile, n_tiles, spread)
+    params = _random_params(jax.random.PRNGKey(1), hidden)
+    h = jnp.asarray(
+        rng.standard_normal((tile * n_tiles, hidden)).astype(np.float32))
+    cot = jnp.asarray(
+        rng.standard_normal((tile * n_tiles, hidden)).astype(np.float32))
+
+    ref = _scan_oracle(params, h, adj, n_steps, "interpret")
+    got = fused_gnn.persistent_unroll(params, h, adj, n_steps,
+                                      impl="interpret")
+    assert np.asarray(got).tobytes() == np.asarray(ref).tobytes()
+
+    gref = jax.grad(
+        lambda p, x: jnp.vdot(_scan_oracle(p, x, adj, n_steps,
+                                           "interpret"), cot),
+        argnums=(0, 1))(params, h)
+    ggot = jax.grad(
+        lambda p, x: jnp.vdot(fused_gnn.persistent_unroll(
+            p, x, adj, n_steps, impl="interpret"), cot),
+        argnums=(0, 1))(params, h)
+    for a, b in zip(jax.tree_util.tree_leaves(gref),
+                    jax.tree_util.tree_leaves(ggot)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_persistent_bf16_and_zero_bandwidth():
+    """The bf16 lane (f32 adjacency upcast rule rides along) and the
+    true window-of-one kernel, both bitwise against the scan oracle."""
+    rng = np.random.default_rng(3)
+    tile, n_tiles, hidden, k = 8, 4, 16, 3
+    n = tile * n_tiles
+    base = (rng.integers(0, n, 4 * n) // tile) * tile
+    s = base + rng.integers(0, tile, 4 * n)
+    r = base + rng.integers(0, tile, 4 * n)
+    adj = build_band_adjacency(s, r, np.ones(len(s), bool), n, tile=tile)
+    params = _random_params(jax.random.PRNGKey(2), hidden)
+    h = jnp.asarray(rng.standard_normal((n, hidden)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    ref = _scan_oracle(params, h, adj, k, "interpret")
+    got = fused_gnn.persistent_unroll(params, h, adj, k, impl="interpret")
+    assert got.dtype == jnp.bfloat16
+    assert np.asarray(got).tobytes() == np.asarray(ref).tobytes()
+    # Bandwidth pinned 0: window of ONE tile, zero warm-up.
+    from deepdfa_tpu.ops.band_spmm import BandAdjacency
+
+    adj0 = BandAdjacency(vals=adj.vals[1:2], tile=tile, n_tiles=n_tiles,
+                         bandwidth=0)
+    ref0 = _scan_oracle(params, h, adj0, k, "interpret")
+    got0 = fused_gnn.persistent_unroll(params, h, adj0, k,
+                                       impl="interpret")
+    assert np.asarray(got0).tobytes() == np.asarray(ref0).tobytes()
+
+
+def test_persistent_k1_degenerates_to_single_step_kernel():
+    """n_steps=1 must dispatch the PR-9 single-step kernel — same
+    program, bitwise outputs AND gradients, no persistent machinery."""
+    rng = np.random.default_rng(5)
+    adj = _band_fixture(rng, 8, 4, 2)
+    params = _random_params(jax.random.PRNGKey(1), 16)
+    h = jnp.asarray(rng.standard_normal((32, 16)).astype(np.float32))
+    cot = jnp.asarray(rng.standard_normal((32, 16)).astype(np.float32))
+    one = fused_gnn.fused_gate_step(params, h, adj, impl="interpret")
+    got = fused_gnn.persistent_unroll(params, h, adj, 1, impl="interpret")
+    assert np.asarray(got).tobytes() == np.asarray(one).tobytes()
+    g1 = jax.grad(lambda p, x: jnp.vdot(fused_gnn.fused_gate_step(
+        p, x, adj, impl="interpret"), cot), argnums=(0, 1))(params, h)
+    gp = jax.grad(lambda p, x: jnp.vdot(fused_gnn.persistent_unroll(
+        p, x, adj, 1, impl="interpret"), cot), argnums=(0, 1))(params, h)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(gp)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    with pytest.raises(ValueError, match="n_steps"):
+        fused_gnn.persistent_unroll(params, h, adj, 0, impl="interpret")
+
+
+# ---------------------------------------------------------------------------
+# Model-level parity + the degrade contract
+# ---------------------------------------------------------------------------
+
+
+def _slot_batch(n_graphs=12, seed=3):
+    graphs = synthetic_bigvul(n_graphs, FEAT, positive_fraction=0.5,
+                              seed=seed)
+    slot = slot_nodes_for(graphs, tile=DEFAULT_TILE)
+    return batch_graphs(
+        graphs, n_graphs, align_to_tile(n_graphs * slot), 4096,
+        subkeys_for(FEAT), build_band_adj=True, slot_nodes=slot,
+    )
+
+
+def _loss(model, params, batch):
+    return jnp.sum(model.apply(params, batch) ** 2)
+
+
+def test_persistent_model_bitwise_equals_fused_scan(force_interpret):
+    """The flowgnn dispatch: message_impl='persistent' (one kernel for
+    the whole unroll) against 'fused' (the nn.scan of single-step
+    kernels) — identical param trees, bitwise forward and grads."""
+    batch = _slot_batch()
+    cfg_f = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                          message_impl="fused")
+    cfg_p = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                          message_impl="persistent")
+    mf, mp = FlowGNN(cfg_f), FlowGNN(cfg_p)
+    pf = mf.init(jax.random.PRNGKey(0), batch)
+    pp = mp.init(jax.random.PRNGKey(0), batch)
+    assert (jax.tree_util.tree_structure(pf)
+            == jax.tree_util.tree_structure(pp))
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), pf, pp))
+    of, op = mf.apply(pf, batch), mp.apply(pf, batch)
+    assert np.asarray(of).tobytes() == np.asarray(op).tobytes()
+    gf = jax.grad(lambda p: _loss(mf, p, batch))(pf)
+    gp = jax.grad(lambda p: _loss(mp, p, batch))(pf)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), gf, gp))
+
+
+def test_persistent_cpu_fallback_is_bitwise_band():
+    """Off-TPU (auto resolves to xla) the persistent flag degrades to
+    the scan of fused steps and from there to the band composition —
+    init, forward AND gradients bit-for-bit the band path."""
+    batch = _slot_batch()
+    cfg_b = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="band")
+    cfg_p = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                          message_impl="persistent")
+    mb, mp = FlowGNN(cfg_b), FlowGNN(cfg_p)
+    pb = mb.init(jax.random.PRNGKey(0), batch)
+    pp = mp.init(jax.random.PRNGKey(0), batch)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), pb, pp))
+    ob, op = mb.apply(pb, batch), mp.apply(pb, batch)
+    assert np.asarray(ob).tobytes() == np.asarray(op).tobytes()
+    gb = jax.grad(lambda p: _loss(mb, p, batch))(pb)
+    gp = jax.grad(lambda p: _loss(mp, p, batch))(pb)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()), gb, gp))
+
+
+def test_persistent_vmem_gate_degrades_instead_of_crashing(
+        force_interpret, monkeypatch):
+    """The third eligibility leg: a batch whose resident h + windows
+    exceed the VMEM budget must take the fused-scan degrade (which runs)
+    instead of dying in the Mosaic allocator — the persistent kernel is
+    never invoked."""
+    batch = _slot_batch()
+    cfg = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                        message_impl="persistent")
+    model = FlowGNN(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    baseline = model.apply(params, batch)
+
+    # The budget arithmetic: tiny shapes fit, and scaling the tile count
+    # far past the budget flips the gate.
+    adj = batch.band_adj
+    assert fused_gnn.persistent_vmem_ok(adj, cfg.ggnn_hidden, "float32")
+    big = adj.__class__(
+        vals=jnp.zeros((adj.vals.shape[0], 4096, adj.tile, adj.tile),
+                       adj.vals.dtype),
+        tile=adj.tile, n_tiles=4096, bandwidth=adj.bandwidth)
+    assert not fused_gnn.persistent_vmem_ok(big, 512, "float32")
+
+    def boom(*a, **k):  # the gate must keep this unreachable
+        raise AssertionError("persistent kernel dispatched over budget")
+
+    monkeypatch.setattr(fused_gnn, "PERSISTENT_VMEM_BUDGET_BYTES", 0)
+    monkeypatch.setattr(fused_gnn, "persistent_unroll", boom)
+    degraded = model.apply(params, batch)
+    # The degrade is the fused scan — interpret kernels here, bitwise
+    # the same unroll.
+    assert np.asarray(degraded).tobytes() == np.asarray(baseline).tobytes()
+
+
+def test_persistent_without_band_adj_raises():
+    from deepdfa_tpu.graphs.batch import pad_budget_for
+
+    graphs = synthetic_bigvul(4, FEAT, seed=0)
+    budget = pad_budget_for(graphs, 4)
+    batch = batch_graphs(graphs, 4, budget["max_nodes"],
+                         budget["max_edges"], subkeys_for(FEAT))
+    cfg = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                        message_impl="persistent")
+    with pytest.raises(ValueError, match="build_band_adj"):
+        FlowGNN(cfg).init(jax.random.PRNGKey(0), batch)
+
+
+def test_uses_band_adj_covers_persistent():
+    assert FlowGNNConfig(message_impl="persistent").uses_band_adj
+    assert not FlowGNNConfig(message_impl="persistent").uses_tile_adj
+
+
+# ---------------------------------------------------------------------------
+# 8 virtual devices: kernel parity + the sharded degrade, one subprocess
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_parity_on_8_virtual_devices(tmp_path):
+    """The same bitwise gates on a forced-8-device CPU backend: the
+    unsharded interpret-mode kernel against the scan oracle, and the
+    shard-stacked batch (vals ndim 5) degrading to band bitwise."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_EIGHT_DEVICE_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("DEEPDFA_FUSED_IMPL", None)
+    proc = subprocess.run([sys.executable, str(worker)], env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout[-2000:]
+    result = json.loads(line[0][len("RESULT "):])
+    assert result["n_devices"] == 8
+    assert result["fwd_bitwise"] and result["grad_bitwise"]
+    assert result["sharded_degrade_bitwise"]
+
+
+_EIGHT_DEVICE_WORKER = """
+import json
+import os
+
+import numpy as np
+
+os.environ["DEEPDFA_FUSED_IMPL"] = "interpret"
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig, subkeys_for
+from deepdfa_tpu.data.synthetic import synthetic_bigvul
+from deepdfa_tpu.graphs.batch import batch_graphs, slot_nodes_for
+from deepdfa_tpu.models.flowgnn import FlowGNN
+from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE, align_to_tile
+
+FEAT = FeatureSpec(limit_all=20, limit_subkeys=20)
+graphs = synthetic_bigvul(8, FEAT, positive_fraction=0.5, seed=3)
+slot = slot_nodes_for(graphs, tile=DEFAULT_TILE)
+batch = batch_graphs(graphs, 8, align_to_tile(8 * slot), 4096,
+                     subkeys_for(FEAT), build_band_adj=True,
+                     slot_nodes=slot)
+
+cfg_f = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="fused")
+cfg_p = FlowGNNConfig(feature=FEAT, hidden_dim=8,
+                      message_impl="persistent")
+mf, mp = FlowGNN(cfg_f), FlowGNN(cfg_p)
+params = mf.init(jax.random.PRNGKey(0), batch)
+
+
+def loss(model, p):
+    return jnp.sum(model.apply(p, batch) ** 2)
+
+
+of, op = mf.apply(params, batch), mp.apply(params, batch)
+gf = jax.grad(lambda p: loss(mf, p))(params)
+gp = jax.grad(lambda p: loss(mp, p))(params)
+fwd_bitwise = np.asarray(of).tobytes() == np.asarray(op).tobytes()
+grad_bitwise = all(
+    (np.asarray(a) == np.asarray(b)).all()
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gp)))
+
+# Shard-stacked batch (vals ndim 5): persistent must degrade to the
+# band composition, bitwise, on the same 8-device mesh.
+from deepdfa_tpu.parallel.mesh import make_mesh, shard_concat
+
+mesh = make_mesh(n_data=8)
+per_shard = [
+    batch_graphs([g], 1, align_to_tile(slot), 4096, subkeys_for(FEAT),
+                 build_band_adj=True, slot_nodes=slot)
+    for g in graphs
+]
+sharded = shard_concat(per_shard)
+assert sharded.band_adj.vals.ndim == 5
+cfg_b = FlowGNNConfig(feature=FEAT, hidden_dim=8, message_impl="band")
+mb = FlowGNN(cfg_b, mesh=mesh)
+mps = FlowGNN(cfg_p, mesh=mesh)
+ob = mb.apply(params, sharded)
+ops_ = mps.apply(params, sharded)
+sharded_degrade_bitwise = (
+    np.asarray(ob).tobytes() == np.asarray(ops_).tobytes())
+
+print("RESULT " + json.dumps({
+    "n_devices": jax.device_count(),
+    "fwd_bitwise": bool(fwd_bitwise),
+    "grad_bitwise": bool(grad_bitwise),
+    "sharded_degrade_bitwise": bool(sharded_degrade_bitwise),
+}))
+"""
+
+
+# ---------------------------------------------------------------------------
+# Serving: the persistent lane warms like band and never recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_serve_persistent_lane_same_executables_and_zero_recompile():
+    """The persistent option changes NOTHING about the warmed-executable
+    accounting — a persistent-lane engine warms exactly the same
+    (lane, slot-bucket) count as a band engine, rides band-shaped
+    buckets, and scoring after warmup compiles nothing."""
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+
+    tiny_band = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=2,
+                              num_output_layers=1, message_impl="band")
+    tiny_pers = FlowGNNConfig(feature=FEAT, hidden_dim=4, n_steps=2,
+                              num_output_layers=1,
+                              message_impl="persistent")
+    config = ServeConfig(batch_slots=4, queue_capacity=8)
+    engines = {}
+    for name, cfg in (("band", tiny_band), ("persistent", tiny_pers)):
+        model = FlowGNN(cfg)
+        eng = ServeEngine(model, random_gnn_params(model, config),
+                          config=config)
+        assert eng._lanes["gnn"].band, name
+        eng.warmup()
+        engines[name] = eng
+    assert engines["persistent"].n_warm == engines["band"].n_warm
+    assert (engines["persistent"].warm_buckets()
+            == engines["band"].warm_buckets())
+    eng = engines["persistent"]
+    results = eng.score_sync(synthetic_bigvul(5, FEAT, seed=9))
+    assert all("prob" in r for r in results)
+    assert eng.compiles_after_warmup == 0
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost accounting: the 2×K h-tile term is gone
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_unroll_cost_eliminates_h_roundtrips():
+    rng = np.random.default_rng(0)
+    adj = _band_fixture(rng, 8, 4, 2)
+    hidden, k = 16, 5
+    n = adj.n_tiles * adj.tile
+    itemsize = 4  # float32
+    base = fused_gnn.fused_step_cost(adj, hidden, dtype="float32")
+    cost = fused_gnn.persistent_unroll_cost(adj, hidden, k,
+                                            dtype="float32")
+    h_bytes = n * hidden * itemsize
+    adj_bytes = adj.vals.size * adj.vals.dtype.itemsize
+    w_bytes = (8 * hidden * hidden + 7 * hidden) * itemsize
+    # THE acceptance: the forward's h traffic is h_in + h_out, full stop
+    # — the 2×K per-step round-trips are gone. Everything else in the
+    # forward budget is the K adjacency streams and the weights (once).
+    assert cost["bytes_accessed"] == pytest.approx(
+        2 * h_bytes + k * adj_bytes + w_bytes)
+    assert cost["h_bytes_per_step"] == pytest.approx(2 * h_bytes / k)
+    assert cost["scan_h_bytes_per_step"] == pytest.approx(3 * h_bytes)
+    # FLOPs are conserved: fusion moves bytes, not work.
+    assert cost["flops"] == pytest.approx(k * base["flops"])
+    # The scan columns are K dispatches of the single-step kernel, and
+    # the persistent program strictly beats them on bytes both ways.
+    assert cost["scan_bytes_accessed"] == pytest.approx(
+        k * base["bytes_accessed"])
+    assert cost["bytes_accessed"] < cost["scan_bytes_accessed"]
+    assert cost["bwd_bytes_accessed"] < cost["scan_bwd_bytes_accessed"]
+    # The backward is honest about the recompute sweep: its FLOPs charge
+    # the K-1 extra forward steps that rebuild the hist.
+    assert cost["bwd_flops"] == pytest.approx(
+        (k - 1) * base["flops"] + k * base["bwd_flops"])
+    # K=1 degenerates to the single-step kernel's accounting.
+    one = fused_gnn.persistent_unroll_cost(adj, hidden, 1,
+                                           dtype="float32")
+    assert one["bytes_accessed"] == pytest.approx(base["bytes_accessed"])
+    assert one["bwd_bytes_accessed"] == pytest.approx(
+        base["bwd_bytes_accessed"])
+
+
+def test_analytic_extra_cost_tracks_the_dispatch_gate(monkeypatch):
+    """The ONE capture-site helper must charge exactly the program the
+    model dispatch runs: persistent numbers when eligible, the fused
+    scan's when the VMEM budget degrades it, zero on the XLA fallback —
+    the accounting can never desynchronize from the gate."""
+    rng = np.random.default_rng(0)
+    adj = _band_fixture(rng, 8, 4, 2)
+    hidden, k = 16, 5
+    base = fused_gnn.fused_step_cost(adj, hidden, dtype="float32")
+    per = fused_gnn.persistent_unroll_cost(adj, hidden, k,
+                                           dtype="float32")
+
+    monkeypatch.setenv("DEEPDFA_FUSED_IMPL", "interpret")
+    f, b = fused_gnn.analytic_extra_cost("persistent", adj, hidden, k,
+                                         "float32", include_bwd=True)
+    assert f == pytest.approx(per["flops"] + per["bwd_flops"])
+    assert b == pytest.approx(per["bytes_accessed"]
+                              + per["bwd_bytes_accessed"])
+    # Forward-only (the serving lanes).
+    f, b = fused_gnn.analytic_extra_cost("persistent", adj, hidden, k,
+                                         "float32", include_bwd=False)
+    assert f == pytest.approx(per["flops"])
+    # Over the VMEM budget the model runs the fused scan — so must the
+    # accounting.
+    monkeypatch.setattr(fused_gnn, "PERSISTENT_VMEM_BUDGET_BYTES", 0)
+    f, b = fused_gnn.analytic_extra_cost("persistent", adj, hidden, k,
+                                         "float32", include_bwd=True)
+    assert f == pytest.approx(k * (base["flops"] + base["bwd_flops"]))
+    assert b == pytest.approx(
+        k * (base["bytes_accessed"] + base["bwd_bytes_accessed"]))
+    # The XLA fallback's program is already in cost_analysis: charge 0.
+    monkeypatch.setenv("DEEPDFA_FUSED_IMPL", "xla")
+    assert fused_gnn.analytic_extra_cost(
+        "persistent", adj, hidden, k, "float32") == (0.0, 0.0)
+    # Non-kernel impls and missing/sharded adjacencies charge 0.
+    monkeypatch.setenv("DEEPDFA_FUSED_IMPL", "interpret")
+    assert fused_gnn.analytic_extra_cost(
+        "band", adj, hidden, k, "float32") == (0.0, 0.0)
+    assert fused_gnn.analytic_extra_cost(
+        "persistent", None, hidden, k, "float32") == (0.0, 0.0)
+
+
+def test_bench_smoke_shapes_include_persistent_row():
+    """The gated smoke row exists and rides the same units as the fused
+    row (the `cli bench diff --smoke` contract) — shape-only, the
+    measurement itself runs in scripts/test.sh."""
+    import inspect
+
+    from deepdfa_tpu import benchwatch
+
+    src = inspect.getsource(benchwatch.bench_smoke)
+    assert "smoke_gnn_train_graphs_per_sec_persistent" in src
