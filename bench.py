@@ -1193,6 +1193,23 @@ BASELINE_COMBINED_INFER_MS = 15.4
 BASELINE_DEEPDFA_INFER_MS = 4.6
 
 
+def bench_graftlint_full_repo(reps: int = 2) -> float:
+    """Cold full-repo graftlint wall time in ms (per-file rules + the
+    GL022-25 interprocedural phase; no incremental cache), best of
+    ``reps``. Pure-CPU stdlib work — deterministic enough that two reps
+    pin the floor."""
+    from deepdfa_tpu.analysis.runner import run_analysis
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        report = run_analysis()
+        dt = time.perf_counter() - t0
+        assert report["files"] > 50  # measured the real package, not a stub
+        best = min(best, dt)
+    return best * 1e3
+
+
 def main() -> None:
     graphs_per_sec, gnn_diag = bench_deepdfa("bfloat16", diagnostics=True)
     # Provisional line the moment the headline exists: the full run takes
@@ -1739,6 +1756,17 @@ def main() -> None:
             "beam_impl": "reference",
         },
     ]
+    # graftlint cost trajectory: the analyzer just went interprocedural
+    # (call graph + GL022-25 concurrency phase over every file), so its
+    # full-repo cold wall time is gated like kernel perf — a rule that
+    # quietly goes quadratic should fail bench diff, not CI patience.
+    extras.append({
+        "metric": "graftlint_full_repo_ms",
+        "value": round(bench_graftlint_full_repo(), 1),
+        "unit": "ms",
+        "vs_baseline": None,
+    })
+
     final = headline(extras)
     print(json.dumps(final))
 

@@ -6,7 +6,12 @@ this package runs the real thing over our own sources. A reaching-definitions
 drives hazard rules (``rules.py``) for the failure modes that cost TPU runs:
 silent host-device syncs in jitted or step-loop code, tracer leaks into
 Python control flow, recompilation triggers, impurity under ``jit``, and
-``jax.random`` key reuse. ``runner.py`` walks the package, diffs against a
+``jax.random`` key reuse. On top, a whole-program layer (``callgraph.py``
+summaries composed into a call graph, ``concurrency.py`` rules) checks the
+concurrency hazards no per-function view can see: cross-thread races on
+module globals, lock-order inversion cycles, fork-after-thread spawns, and
+unbounded joins on targets that can block forever. ``runner.py`` walks the
+package (with an optional content-hash incremental cache), diffs against a
 committed baseline, and reports only new findings with the def-use chain
 that triggered each one.
 
@@ -16,4 +21,4 @@ anywhere in milliseconds.
 """
 
 from deepdfa_tpu.analysis.rules import Finding, analyze_source  # noqa: F401
-from deepdfa_tpu.analysis.runner import run_analysis  # noqa: F401
+from deepdfa_tpu.analysis.runner import analyze_files, run_analysis  # noqa: F401

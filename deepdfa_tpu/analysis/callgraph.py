@@ -1,0 +1,1202 @@
+"""Whole-program layer for graftlint: import graph, call graph, summaries.
+
+The per-function rules in ``rules.py`` see one CFG at a time; every hazard
+the multi-process serving arc is about to create — fork-after-thread,
+lock-order inversion across modules, a module global mutated from a pump
+thread AND the main path — spans functions. This module lifts the analysis:
+
+* :func:`summarize_module` — one pure, JSON-serializable
+  :class:`ModuleSummary` per file (so the incremental cache can persist it
+  keyed on the file's content hash): imports, module globals, lock
+  definitions (the GL018 provenance facts: module-level and class-body
+  ``threading.Lock()``/``RLock()``, plus ``self.x = Lock()`` instance
+  locks), and a :class:`FunctionSummary` per function — calls made, locks
+  held at each, threads/processes spawned, shared names read/written,
+  unbounded joins, and calls that can block forever.
+* :class:`Program` — composes the summaries: resolves call sites to
+  function ids, memoizes reachability closures, validates lock ids, and
+  derives the thread model (every spawn target's closure) and the
+  main-path reachability set that ``concurrency.py`` checks GL022–GL025
+  against.
+
+Resolution is deliberately conservative — the empty-baseline contract:
+a call we cannot attribute (dynamic dispatch, a callable stored in a
+variable, ``**kwargs`` trampolines) produces NO edge rather than a guess,
+and an acquisition through a lock we cannot identify marks the region
+"unknown" (``?``), which suppresses race findings under it instead of
+manufacturing them. What IS resolved: bare names to module/nested defs,
+``self.meth``/``cls.meth`` to methods of the lexically enclosing class,
+absolute dotted names through the per-module import alias tables
+(including one re-export hop through package ``__init__`` files), and
+``obj.meth`` only when exactly one class in the whole program defines
+``meth`` and the name is not in the ubiquitous-method stoplist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "ModuleSummary", "FunctionSummary", "Program", "summarize_module",
+    "modname_for_path",
+]
+
+#: Unidentifiable lock sentinel: a non-call ``with`` context we could not
+#: resolve (a local ``lock = Lock()``, an attribute of unknown provenance).
+#: Regions under it are *possibly* guarded — GL022 skips writes under it.
+UNKNOWN_LOCK = "?"
+
+_LOCK_CONSTRUCTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+}
+_THREAD_CTORS = frozenset({"threading.Thread"})
+_TPE = "concurrent.futures.ThreadPoolExecutor"
+_PPE = "concurrent.futures.ProcessPoolExecutor"
+_MP_PROCESS_LEAF = "Process"
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "add", "update", "insert", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "put", "appendleft",
+})
+#: Child-side fork re-init helpers (``telemetry.context.init_forked_worker``
+#: is the repo's blessed shape — GL020 precedent): a fork-class spawn whose
+#: target or initializer reaches one is considered re-initialized.
+_REINIT_RE = re.compile(r"init_forked|forked_worker|fork_reinit|reinit_fork")
+#: ``obj.meth()`` unique-method fallback never fires for these — too many
+#: unrelated classes (stdlib included) define them.
+_METHOD_STOPLIST = frozenset({
+    "get", "put", "set", "close", "run", "start", "join", "wait", "result",
+    "submit", "append", "add", "update", "pop", "items", "values", "keys",
+    "read", "write", "send", "recv", "open", "clear", "copy", "flush",
+    "acquire", "release", "encode", "decode", "format", "strip", "split",
+})
+
+
+def modname_for_path(path: str) -> str:
+    """Dotted module name for a (repo-relative) file path.
+
+    ``deepdfa_tpu/telemetry/spans.py`` → ``deepdfa_tpu.telemetry.spans``;
+    package ``__init__.py`` files name the package itself. Paths outside
+    any package (test fixtures) degrade to their stem — still unique
+    within one program, which is all resolution needs.
+    """
+    norm = path.replace("\\", "/").strip("/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [p for p in norm.split("/") if p not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else "<module>"
+
+
+# ---------------------------------------------------------------------------
+# Summary dataclasses (all JSON-round-trippable for the incremental cache)
+# ---------------------------------------------------------------------------
+
+
+def _asdict_list(items: List[Any]) -> List[Dict[str, Any]]:
+    return [dataclasses.asdict(i) for i in items]
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str              # alias-resolved dotted text, or raw expr text
+    line: int
+    locks: List[str]         # lock-id candidates held lexically at the call
+    after_thread_spawn: bool = False
+
+
+@dataclasses.dataclass
+class SpawnSite:
+    kind: str                # thread | process | process_pool | fork | popen_preexec
+    target: str              # alias-resolved target text ("" when unknown)
+    line: int
+    locks: List[str]
+    start_method: str = ""   # fork | spawn | forkserver | default | unknown
+    initializer: str = ""    # process-pool initializer (resolved text)
+    after_thread_spawn: bool = False
+
+
+@dataclasses.dataclass
+class LockAcquire:
+    lock: str                # lock-id candidate
+    line: int
+    held: List[str]          # candidates already held when acquiring
+
+
+@dataclasses.dataclass
+class SharedAccess:
+    name: str                # shared-id candidate (modname.NAME / modname.Cls.attr)
+    line: int
+    locks: List[str]
+    write: bool
+
+
+@dataclasses.dataclass
+class JoinSite:
+    kind: str                # join | result
+    receiver: str            # receiver expr text
+    target: str              # resolved spawn-target text ("" unknown)
+    line: int
+    timeout: bool            # a timeout/arg bounds the wait
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    what: str                # e.g. ".get()", ".wait()", "serve_forever"
+    line: int
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    qualname: str
+    line: int
+    cls: str = ""            # lexically enclosing class name ("" = free fn)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    spawns: List[SpawnSite] = dataclasses.field(default_factory=list)
+    locks: List[LockAcquire] = dataclasses.field(default_factory=list)
+    accesses: List[SharedAccess] = dataclasses.field(default_factory=list)
+    joins: List[JoinSite] = dataclasses.field(default_factory=list)
+    blocking: List[BlockingCall] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FunctionSummary":
+        return cls(
+            qualname=d["qualname"], line=d["line"], cls=d.get("cls", ""),
+            calls=[CallSite(**c) for c in d.get("calls", [])],
+            spawns=[SpawnSite(**s) for s in d.get("spawns", [])],
+            locks=[LockAcquire(**a) for a in d.get("locks", [])],
+            accesses=[SharedAccess(**a) for a in d.get("accesses", [])],
+            joins=[JoinSite(**j) for j in d.get("joins", [])],
+            blocking=[BlockingCall(**b) for b in d.get("blocking", [])],
+        )
+
+
+@dataclasses.dataclass
+class ModuleSummary:
+    path: str
+    modname: str
+    imports: List[str]                     # dotted candidates this module imports
+    aliases: Dict[str, str]                # local name -> dotted (re-export hops)
+    module_globals: List[str]
+    mutable_globals: List[str]             # globals bound to mutable objects
+    module_locks: Dict[str, str]           # name -> Lock | RLock
+    classes: List[str]
+    class_attrs: Dict[str, List[str]]      # class -> class-body attr names
+    class_locks: Dict[str, List[str]]      # class -> lock attrs (class/instance)
+    thread_subclasses: List[str]
+    class_thread_attrs: Dict[str, Dict[str, str]]  # cls -> attr -> target text
+    functions: Dict[str, FunctionSummary]  # qualname -> summary
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["functions"] = {q: fs.to_dict() for q, fs in self.functions.items()}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModuleSummary":
+        return cls(
+            path=d["path"], modname=d["modname"],
+            imports=list(d.get("imports", [])),
+            aliases=dict(d.get("aliases", {})),
+            module_globals=list(d.get("module_globals", [])),
+            mutable_globals=list(d.get("mutable_globals", [])),
+            module_locks=dict(d.get("module_locks", {})),
+            classes=list(d.get("classes", [])),
+            class_attrs={k: list(v) for k, v in d.get("class_attrs", {}).items()},
+            class_locks={k: list(v) for k, v in d.get("class_locks", {}).items()},
+            thread_subclasses=list(d.get("thread_subclasses", [])),
+            class_thread_attrs={k: dict(v) for k, v
+                                in d.get("class_thread_attrs", {}).items()},
+            functions={q: FunctionSummary.from_dict(f)
+                       for q, f in d.get("functions", {}).items()},
+        )
+
+
+# ---------------------------------------------------------------------------
+# Module summarization
+# ---------------------------------------------------------------------------
+
+
+def _dotted(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chains to text; None for anything else."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_mutable_init(value: ast.AST) -> bool:
+    return isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp, ast.Call))
+
+
+class _ModuleScan:
+    """One pass over a module AST building its :class:`ModuleSummary`."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path.replace("\\", "/")
+        self.modname = modname_for_path(path)
+        self.is_package = self.path.endswith("__init__.py")
+        self.summary = ModuleSummary(
+            path=self.path, modname=self.modname, imports=[], aliases={},
+            module_globals=[], mutable_globals=[], module_locks={},
+            classes=[], class_attrs={}, class_locks={},
+            thread_subclasses=[], class_thread_attrs={}, functions={},
+        )
+        self._scan_imports(tree)
+        self._scan_toplevel(tree)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(node, qual=node.name, cls="")
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+        self._aggregate_class_facts()
+
+    # -- imports ----------------------------------------------------------
+
+    def _relative_base(self, level: int) -> str:
+        parts = self.modname.split(".")
+        if not self.is_package:
+            parts = parts[:-1]
+        up = level - 1
+        if up:
+            parts = parts[:-up] if up < len(parts) else []
+        return ".".join(parts)
+
+    def _scan_imports(self, tree: ast.Module) -> None:
+        al = self.summary.aliases
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        al[a.asname] = a.name
+                    else:
+                        al[a.name.split(".")[0]] = a.name.split(".")[0]
+                    self.summary.imports.append(a.name)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    rel = self._relative_base(node.level)
+                    base = f"{rel}.{base}".strip(".") if base else rel
+                if not base:
+                    continue
+                self.summary.imports.append(base)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    al[a.asname or a.name] = f"{base}.{a.name}"
+                    self.summary.imports.append(f"{base}.{a.name}")
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        """Alias-resolved dotted text of a Name/Attribute chain."""
+        text = _dotted(expr)
+        if text is None:
+            return None
+        head, _, rest = text.partition(".")
+        mapped = self.summary.aliases.get(head)
+        if mapped:
+            return f"{mapped}.{rest}" if rest else mapped
+        return text
+
+    # -- module top level -------------------------------------------------
+
+    def _scan_toplevel(self, tree: ast.Module) -> None:
+        s = self.summary
+        for node in tree.body:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            for t in targets:
+                names = ([e for e in t.elts if isinstance(e, ast.Name)]
+                         if isinstance(t, ast.Tuple) else
+                         ([t] if isinstance(t, ast.Name) else []))
+                for n in names:
+                    if n.id not in s.module_globals:
+                        s.module_globals.append(n.id)
+                    if value is not None and _is_mutable_init(value) \
+                            and n.id not in s.mutable_globals:
+                        kind = self._lock_ctor_kind(value)
+                        if kind:
+                            s.module_locks[n.id] = kind
+                        else:
+                            s.mutable_globals.append(n.id)
+
+    def _lock_ctor_kind(self, value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            name = self.resolve(value.func)
+            if name in _LOCK_CONSTRUCTORS:
+                return _LOCK_CONSTRUCTORS[name]
+            if name in ("Lock", "RLock"):  # from threading import Lock
+                mapped = self.summary.aliases.get(name, "")
+                if mapped.startswith("threading."):
+                    return name
+        return None
+
+    # -- classes ----------------------------------------------------------
+
+    def _scan_class(self, node: ast.ClassDef) -> None:
+        s = self.summary
+        s.classes.append(node.name)
+        attrs: List[str] = []
+        locks: List[str] = []
+        for b in node.bases:
+            base = self.resolve(b)
+            if base in _THREAD_CTORS or base == "Thread" and \
+                    self.summary.aliases.get("Thread", "").startswith("threading"):
+                s.thread_subclasses.append(node.name)
+            elif base in s.thread_subclasses:
+                s.thread_subclasses.append(node.name)
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        attrs.append(t.id)
+                        if self._lock_ctor_kind(value):
+                            locks.append(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and stmt.value is not None:
+                attrs.append(stmt.target.id)
+                if self._lock_ctor_kind(stmt.value):
+                    locks.append(stmt.target.id)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, qual=f"{node.name}.{stmt.name}",
+                                    cls=node.name)
+        s.class_attrs[node.name] = attrs
+        if locks:
+            s.class_locks[node.name] = locks
+
+    def _aggregate_class_facts(self) -> None:
+        """Fold instance-lock and thread-attr binds out of method bodies
+        into class-level maps (``self._lock = Lock()`` in ``__init__`` is
+        the GL018-exempt idiom; ``self._t = Thread(target=...)`` is how the
+        checkpoint writer binds its thread)."""
+        s = self.summary
+        for fs in s.functions.values():
+            if not fs.cls:
+                continue
+            for recv, kind in getattr(fs, "_lock_binds", []):
+                if recv.startswith("self."):
+                    attr = recv[5:]
+                    s.class_locks.setdefault(fs.cls, [])
+                    if attr not in s.class_locks[fs.cls]:
+                        s.class_locks[fs.cls].append(attr)
+            for recv, target in getattr(fs, "_thread_binds", []):
+                if recv.startswith("self."):
+                    s.class_thread_attrs.setdefault(fs.cls, {})[recv[5:]] = \
+                        target
+
+    # -- functions --------------------------------------------------------
+
+    def _scan_function(self, node: ast.AST, qual: str, cls: str) -> None:
+        # nested defs are summarized by _FunctionScanNested as the body scan
+        # reaches them, each under its dotted qualname.
+        self.summary.functions[qual] = _FunctionScan(self, node, qual,
+                                                     cls).run()
+
+
+class _FunctionScan:
+    """Summarize one function body; nested defs get their own summaries."""
+
+    def __init__(self, mod: _ModuleScan, node: ast.AST, qual: str, cls: str):
+        self.mod = mod
+        self.node = node
+        self.fs = FunctionSummary(qualname=qual, line=node.lineno, cls=cls)
+        self.qual = qual
+        self.cls = cls
+        self.global_decls: Set[str] = set()
+        self.local_names: Set[str] = set()
+        self.pools: Dict[str, str] = {}        # var -> thread | process
+        self.ctx_methods: Dict[str, str] = {}  # var -> fork | spawn | ...
+        self.thread_vars: Dict[str, str] = {}  # var/attr text -> target text
+        self.future_vars: Dict[str, str] = {}  # var -> submitted target text
+        self.future_lists: Dict[str, str] = {} # list var -> submitted target
+        self.thread_lists: Dict[str, str] = {} # list var -> thread target
+        self.killed: Set[str] = set()          # receivers .kill()/.terminate()d
+        self._lock_binds: List[Tuple[str, str]] = []
+        self._thread_binds: List[Tuple[str, str]] = []
+        self._prescan()
+
+    def _prescan(self) -> None:
+        args = self.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            self.local_names.add(a.arg)
+        for n in _walk_skip_nested(self.node):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                self.global_decls.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.local_names.add(n.id)
+        self.local_names -= self.global_decls
+
+    def run(self) -> FunctionSummary:
+        self._visit_block(self.node.body, held=())
+        first = min((s.line for s in self.fs.spawns if s.kind == "thread"),
+                    default=None)
+        if first is not None:
+            for c in self.fs.calls:
+                c.after_thread_spawn = c.line > first
+            for s in self.fs.spawns:
+                s.after_thread_spawn = s.line > first
+        # expose binds to the module aggregation pass
+        self.fs._lock_binds = self._lock_binds      # type: ignore[attr-defined]
+        self.fs._thread_binds = self._thread_binds  # type: ignore[attr-defined]
+        return self.fs
+
+    # -- statements -------------------------------------------------------
+
+    def _visit_block(self, stmts: List[ast.stmt], held: Tuple[str, ...]) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt, held)
+
+    def _visit_stmt(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionScanNested(self.mod, stmt, f"{self.qual}.{stmt.name}",
+                                self.cls)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # function-local classes: out of model
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._visit_assign(stmt, held)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._visit_with(stmt, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, held)
+            self._map_loop_target(stmt)
+            self._visit_block(stmt.body, held)
+            self._visit_block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, held)
+            self._visit_block(stmt.body, held)
+            self._visit_block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test, held)
+            self._visit_block(stmt.body, held)
+            self._visit_block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._visit_block(stmt.body, held)
+            for h in stmt.handlers:
+                self._visit_block(h.body, held)
+            self._visit_block(stmt.orelse, held)
+            self._visit_block(stmt.finalbody, held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+
+    def _visit_assign(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        value = getattr(stmt, "value", None)
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        flat: List[ast.AST] = []
+        for t in targets:
+            flat.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+        if value is not None:
+            self._bind_provenance(flat, value)
+            self._scan_expr(value, held)
+        for t in flat:
+            if isinstance(t, ast.Name):
+                name = t.id
+                if name in self.global_decls or (
+                        isinstance(stmt, ast.AugAssign)
+                        and name not in self.local_names
+                        and name in self.mod.summary.module_globals):
+                    self._record_write(self._global_id(name), t.lineno, held)
+            elif isinstance(t, ast.Subscript):
+                self._record_container_write(t.value, t.lineno, held)
+            # plain attribute stores (obj.x = v) rebind per-object state —
+            # not shared-by-class/module state; out of model.
+
+    def _bind_provenance(self, targets: List[ast.AST], value: ast.AST) -> None:
+        """Track what a binding makes of its name: a thread, a pool, a
+        future, an mp context, a list of threads/futures."""
+        recvs = []
+        for t in targets:
+            text = _dotted(t)
+            if text:
+                recvs.append(text)
+        if not recvs:
+            return
+        info = self._classify_value(value)
+        if info is None:
+            return
+        kind, payload = info
+        for recv in recvs:
+            if kind == "thread":
+                self.thread_vars[recv] = payload
+                self._thread_binds.append((recv, payload))
+            elif kind == "lock":
+                self._lock_binds.append((recv, payload))
+            elif kind == "pool":
+                self.pools[recv] = payload
+            elif kind == "ctx":
+                self.ctx_methods[recv] = payload
+            elif kind == "future":
+                self.future_vars[recv] = payload
+            elif kind == "future_list":
+                self.future_lists[recv] = payload
+            elif kind == "thread_list":
+                self.thread_lists[recv] = payload
+
+    def _classify_value(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(value, ast.Call):
+            name = self.resolve_call_name(value.func)
+            if name in _THREAD_CTORS:
+                return ("thread", self._thread_target(value))
+            if name and self._thread_subclass(name):
+                return ("thread", f"{name}.run")
+            if self.mod._lock_ctor_kind(value):
+                return ("lock", "")
+            if name == _TPE or (name or "").endswith("ThreadPoolExecutor"):
+                return ("pool", "thread")
+            if name == _PPE or (name or "").endswith("ProcessPoolExecutor"):
+                return ("pool", "process")
+            if name and name.endswith(".get_context") and value.args and \
+                    isinstance(value.args[0], ast.Constant):
+                return ("ctx", str(value.args[0].value))
+            if isinstance(value.func, ast.Attribute) and \
+                    value.func.attr == "submit":
+                base = _dotted(value.func.value)
+                if base in self.pools and value.args:
+                    tgt = self.resolve_call_name(value.args[0]) or ""
+                    return ("future", tgt)
+        elif isinstance(value, ast.ListComp):
+            elt = value.elt
+            if isinstance(elt, ast.Call):
+                info = self._classify_value(elt)
+                if info and info[0] == "future":
+                    return ("future_list", info[1])
+                if info and info[0] == "thread":
+                    return ("thread_list", info[1])
+        elif isinstance(value, ast.List):
+            for elt in value.elts:
+                if isinstance(elt, ast.Call):
+                    info = self._classify_value(elt)
+                    if info and info[0] == "thread":
+                        return ("thread_list", info[1])
+        return None
+
+    def _map_loop_target(self, stmt: ast.stmt) -> None:
+        it = _dotted(stmt.iter) if isinstance(stmt.iter, (ast.Name, ast.Attribute)) else None
+        tgt = stmt.target
+        if it is None or not isinstance(tgt, ast.Name):
+            return
+        if it in self.future_lists:
+            self.future_vars[tgt.id] = self.future_lists[it]
+        elif it in self.thread_lists:
+            self.thread_vars[tgt.id] = self.thread_lists[it]
+
+    def _visit_with(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        new_held = list(held)
+        for item in stmt.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                # not a lock (span(), open(), pool ctor, ...) — but the
+                # expression itself may spawn/bind (with PPE(...) as pool:)
+                self._scan_expr(ctx, tuple(new_held))
+                if item.optional_vars is not None:
+                    self._bind_provenance(
+                        [item.optional_vars] if not isinstance(
+                            item.optional_vars, ast.Tuple)
+                        else list(item.optional_vars.elts), ctx)
+            elif isinstance(ctx, (ast.Name, ast.Attribute)):
+                lock_id = self._lock_id(ctx)
+                self.fs.locks.append(LockAcquire(
+                    lock=lock_id, line=ctx.lineno, held=list(new_held)))
+                new_held.append(lock_id)
+            else:
+                self._scan_expr(ctx, tuple(new_held))
+        self._visit_block(stmt.body, tuple(new_held))
+
+    # -- lock / shared-name identity --------------------------------------
+
+    def _global_id(self, name: str) -> str:
+        return f"{self.mod.modname}.{name}"
+
+    def _lock_id(self, expr: ast.AST) -> str:
+        s = self.mod.summary
+        text = _dotted(expr)
+        if text is None:
+            return UNKNOWN_LOCK
+        if "." not in text:
+            if text in s.module_locks:
+                return f"{s.modname}.{text}"
+            mapped = s.aliases.get(text)
+            if mapped and "." in mapped:
+                return mapped  # cross-module import; validated in Program
+            return UNKNOWN_LOCK
+        head, _, attr = text.partition(".")
+        if head in ("self", "cls") and self.cls and "." not in attr:
+            if attr in s.class_locks.get(self.cls, ()):
+                return f"{s.modname}.{self.cls}.{attr}"
+            # unresolved instance attr: possibly a lock bound elsewhere
+            return UNKNOWN_LOCK
+        resolved = self.mod.resolve(expr)
+        return resolved if resolved and "." in resolved else UNKNOWN_LOCK
+
+    def resolve_call_name(self, func: ast.AST) -> Optional[str]:
+        """Resolved dotted text for a callee; self./cls. kept as prefix."""
+        text = _dotted(func)
+        if text is None:
+            return None
+        if text.startswith("self.") or text.startswith("cls."):
+            return text
+        return self.mod.resolve(func) or text
+
+    # -- expressions ------------------------------------------------------
+
+    def _scan_expr(self, expr: ast.AST, held: Tuple[str, ...]) -> None:
+        for node in _walk_expr(expr):
+            if isinstance(node, ast.Call):
+                self._visit_call(node, held)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.mod.summary.mutable_globals and \
+                        node.id not in self.local_names:
+                    self.fs.accesses.append(SharedAccess(
+                        name=self._global_id(node.id), line=node.lineno,
+                        locks=list(held), write=False))
+
+    def _visit_call(self, call: ast.Call, held: Tuple[str, ...]) -> None:
+        func = call.func
+        name = self.resolve_call_name(func)
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        recv_text = _dotted(func.value) if isinstance(func, ast.Attribute) else None
+
+        if self._spawn_site(call, name, attr, recv_text, held):
+            return
+        if attr in ("kill", "terminate") and recv_text:
+            self.killed.add(recv_text)
+        if attr == "join" and recv_text is not None:
+            self._join_site(call, recv_text, held)
+        elif attr == "result" and recv_text is not None:
+            tgt = self.future_vars.get(recv_text)
+            if tgt is not None:
+                self.fs.joins.append(JoinSite(
+                    kind="result", receiver=recv_text, target=tgt,
+                    line=call.lineno, timeout=_has_timeout(call)))
+        if attr in ("get", "wait") and not call.args and \
+                not _has_timeout(call):
+            self.fs.blocking.append(BlockingCall(
+                what=f".{attr}()", line=call.lineno))
+        elif attr == "serve_forever":
+            self.fs.blocking.append(BlockingCall(
+                what="serve_forever", line=call.lineno))
+
+        if attr in _MUTATOR_METHODS and recv_text:
+            self._mutation_site(recv_text, call.lineno, held)
+
+        if name:
+            self.fs.calls.append(CallSite(
+                callee=name, line=call.lineno, locks=list(held)))
+
+    def _spawn_site(self, call: ast.Call, name: Optional[str],
+                    attr: Optional[str], recv_text: Optional[str],
+                    held: Tuple[str, ...]) -> bool:
+        if name in _THREAD_CTORS or (name and self._thread_subclass(name)):
+            target = (self._thread_target(call) if name in _THREAD_CTORS
+                      else f"{name}.run")
+            self.fs.spawns.append(SpawnSite(
+                kind="thread", target=target, line=call.lineno,
+                locks=list(held)))
+            return True
+        if name == _PPE or (name or "").endswith("ProcessPoolExecutor"):
+            self.fs.spawns.append(SpawnSite(
+                kind="process_pool", target="", line=call.lineno,
+                locks=list(held),
+                start_method=self._pool_start_method(call),
+                initializer=self._kw_name(call, "initializer")))
+            return True
+        if name == "os.fork":
+            self.fs.spawns.append(SpawnSite(
+                kind="fork", target="", line=call.lineno, locks=list(held),
+                start_method="fork"))
+            return True
+        if attr == _MP_PROCESS_LEAF and recv_text:
+            method = ""
+            if recv_text in self.ctx_methods:
+                method = self.ctx_methods[recv_text]
+            elif name in ("multiprocessing.Process",):
+                method = "default"
+            if method:
+                self.fs.spawns.append(SpawnSite(
+                    kind="process", target=self._thread_target(call),
+                    line=call.lineno, locks=list(held), start_method=method))
+                return True
+        if name == "subprocess.Popen" or (name or "").endswith(".Popen") \
+                or name == "Popen":
+            pre = self._kw_name(call, "preexec_fn")
+            if pre and pre != "None":
+                self.fs.spawns.append(SpawnSite(
+                    kind="popen_preexec", target=pre, line=call.lineno,
+                    locks=list(held), start_method="fork"))
+                return True
+            return False  # plain Popen: fork+exec, out of the fork model
+        if attr == "submit" and recv_text in self.pools and call.args:
+            tgt = self.resolve_call_name(call.args[0]) or ""
+            kind = self.pools[recv_text]
+            self.fs.spawns.append(SpawnSite(
+                kind="thread" if kind == "thread" else "pool_submit",
+                target=tgt, line=call.lineno, locks=list(held)))
+            return False  # submit is also a call-shaped fact; keep scanning
+        return False
+
+    def _join_site(self, call: ast.Call, recv_text: str,
+                   held: Tuple[str, ...]) -> None:
+        target = self.thread_vars.get(recv_text)
+        if target is None and recv_text.startswith("self.") and self.cls:
+            target = self.mod.summary.class_thread_attrs.get(
+                self.cls, {}).get(recv_text[5:])
+        if target is None:
+            # look ahead: binds recorded later in the module pass (a join
+            # in close() on a thread bound in __init__) resolve during the
+            # program phase through class_thread_attrs; locals only here.
+            return
+        if recv_text in self.killed:
+            return  # kill-then-join is the bounded GL015 shape
+        self.fs.joins.append(JoinSite(
+            kind="join", receiver=recv_text, target=target,
+            line=call.lineno, timeout=_has_timeout(call)))
+
+    def _mutation_site(self, recv_text: str, line: int,
+                       held: Tuple[str, ...]) -> None:
+        s = self.mod.summary
+        if "." not in recv_text:
+            if recv_text in s.module_globals and \
+                    recv_text not in self.local_names:
+                self._record_write(self._global_id(recv_text), line, held)
+            return
+        head, _, attr = recv_text.partition(".")
+        if head in ("self", "cls") and self.cls and "." not in attr:
+            if attr in s.class_attrs.get(self.cls, ()):
+                self._record_write(f"{s.modname}.{self.cls}.{attr}",
+                                   line, held)
+            return
+        mapped = s.aliases.get(head)
+        if mapped and "." not in attr.partition(".")[2]:
+            self._record_write(f"{mapped}.{attr}", line, held)
+
+    def _record_container_write(self, base: ast.AST, line: int,
+                                held: Tuple[str, ...]) -> None:
+        text = _dotted(base)
+        if text:
+            self._mutation_site_for_subscript(text, line, held)
+
+    def _mutation_site_for_subscript(self, text: str, line: int,
+                                     held: Tuple[str, ...]) -> None:
+        s = self.mod.summary
+        if "." not in text:
+            if text in s.module_globals and text not in self.local_names:
+                self._record_write(self._global_id(text), line, held)
+            return
+        self._mutation_site(text, line, held)
+
+    def _record_write(self, shared_id: str, line: int,
+                      held: Tuple[str, ...]) -> None:
+        self.fs.accesses.append(SharedAccess(
+            name=shared_id, line=line, locks=list(held), write=True))
+
+    # -- helpers ----------------------------------------------------------
+
+    def _thread_subclass(self, name: str) -> bool:
+        s = self.mod.summary
+        leaf = name.rsplit(".", 1)[-1]
+        return leaf in s.thread_subclasses and (
+            "." not in name or name == f"{s.modname}.{leaf}" or name == leaf)
+
+    def _thread_target(self, call: ast.Call) -> str:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return self.resolve_call_name(kw.value) or ""
+        return ""
+
+    def _pool_start_method(self, call: ast.Call) -> str:
+        for kw in call.keywords:
+            if kw.arg == "mp_context":
+                v = kw.value
+                if isinstance(v, ast.Call) and v.args and \
+                        isinstance(v.args[0], ast.Constant):
+                    return str(v.args[0].value)
+                text = _dotted(v)
+                if text and text in self.ctx_methods:
+                    return self.ctx_methods[text]
+                return "unknown"
+        return "default"
+
+    def _kw_name(self, call: ast.Call, kw_name: str) -> str:
+        for kw in call.keywords:
+            if kw.arg == kw_name:
+                if isinstance(kw.value, ast.Constant):
+                    return str(kw.value.value)
+                return self.resolve_call_name(kw.value) or ""
+        return ""
+
+
+class _FunctionScanNested(_FunctionScan):
+    """Nested def: summarize into the module like any other function."""
+
+    def __init__(self, mod: _ModuleScan, node: ast.AST, qual: str, cls: str):
+        super().__init__(mod, node, qual, cls)
+        mod.summary.functions[qual] = self.run()
+
+
+def _walk_skip_nested(func_node: ast.AST):
+    """Walk a function body without descending into nested defs/classes."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _walk_expr(expr: ast.AST):
+    """Walk an expression tree, skipping Lambda bodies (deferred code)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is None)
+        for kw in call.keywords)
+
+
+def summarize_module(path: str, source: str) -> Optional[ModuleSummary]:
+    """Concurrency summary of one file; None when it does not parse
+    (rules.py already reports GL000 for that)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    return _ModuleScan(path, tree).summary
+
+
+# ---------------------------------------------------------------------------
+# Program: composition + resolution + closures
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    """All module summaries composed into one resolvable call graph."""
+
+    def __init__(self, modules: List[ModuleSummary]):
+        self.modules: Dict[str, ModuleSummary] = {
+            m.modname: m for m in sorted(modules, key=lambda m: m.path)}
+        self.by_path: Dict[str, ModuleSummary] = {
+            m.path: m for m in self.modules.values()}
+        self.functions: Dict[str, Tuple[ModuleSummary, FunctionSummary]] = {}
+        self._method_index: Dict[str, List[str]] = {}
+        for m in self.modules.values():
+            for q, fs in m.functions.items():
+                fid = f"{m.modname}:{q}"
+                self.functions[fid] = (m, fs)
+                leaf = q.rsplit(".", 1)[-1]
+                if fs.cls:
+                    self._method_index.setdefault(leaf, []).append(fid)
+        self._edges: Dict[str, List[str]] = {}
+        self._closures: Dict[str, Set[str]] = {}
+        self._lock_kinds: Dict[str, str] = {}
+        for m in self.modules.values():
+            for name, kind in m.module_locks.items():
+                self._lock_kinds[f"{m.modname}.{name}"] = kind
+            for cls, attrs in m.class_locks.items():
+                for a in attrs:
+                    self._lock_kinds[f"{m.modname}.{cls}.{a}"] = "Lock"
+        self._lock_alias_cache: Dict[str, Optional[str]] = {}
+
+    # -- import graph ------------------------------------------------------
+
+    def importers_of(self, path: str) -> Set[str]:
+        """Paths of modules that import the module at ``path`` (direct
+        reverse edges — what an incremental edit must re-analyze)."""
+        target = self.by_path.get(path)
+        if target is None:
+            return set()
+        out: Set[str] = set()
+        name = target.modname
+        for m in self.modules.values():
+            if m.path == path:
+                continue
+            for imp in m.imports:
+                if imp == name or imp.startswith(name + "."):
+                    out.add(m.path)
+                    break
+        return out
+
+    # -- lock identity -----------------------------------------------------
+
+    def lock_id(self, candidate: str) -> Optional[str]:
+        """Validate a summarize-time lock candidate against known lock
+        definitions, following one import/re-export alias hop. None for
+        candidates that name no known lock (``?`` stays ``?``-like)."""
+        if candidate == UNKNOWN_LOCK:
+            return None
+        if candidate in self._lock_alias_cache:
+            return self._lock_alias_cache[candidate]
+        result: Optional[str] = None
+        if candidate in self._lock_kinds:
+            result = candidate
+        else:
+            resolved = self._resolve_dotted_value(candidate)
+            if resolved in self._lock_kinds:
+                result = resolved
+        self._lock_alias_cache[candidate] = result
+        return result
+
+    def lock_kind(self, lock_id: str) -> str:
+        return self._lock_kinds.get(lock_id, "Lock")
+
+    def held_locks(self, candidates: List[str]) -> Tuple[Set[str], bool]:
+        """(validated lock ids, had_unknown) for a held-candidates list."""
+        out: Set[str] = set()
+        unknown = False
+        for c in candidates:
+            if c == UNKNOWN_LOCK:
+                unknown = True
+                continue
+            lid = self.lock_id(c)
+            if lid:
+                out.add(lid)
+            else:
+                unknown = True
+        return out, unknown
+
+    def _resolve_dotted_value(self, dotted: str, hops: int = 3) -> Optional[str]:
+        """Resolve ``pkg.sub.NAME`` through module membership and package
+        ``__init__`` re-export aliases to its defining module's id."""
+        for _ in range(hops):
+            mod, leaf = self._split_known_module(dotted)
+            if mod is None:
+                return None
+            if leaf in mod.module_locks or leaf in mod.module_globals:
+                return f"{mod.modname}.{leaf}"
+            mapped = mod.aliases.get(leaf)
+            if mapped is None or mapped == dotted:
+                return None
+            dotted = mapped
+        return None
+
+    def _split_known_module(
+            self, dotted: str) -> Tuple[Optional[ModuleSummary], str]:
+        """Longest known-module prefix of ``dotted``; (module, rest)."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            name = ".".join(parts[:i])
+            if name in self.modules:
+                return self.modules[name], ".".join(parts[i:])
+        return None, dotted
+
+    # -- shared-id identity ------------------------------------------------
+
+    def shared_id(self, candidate: str) -> Optional[str]:
+        """Validate a shared-name candidate (modname.NAME or
+        modname.Cls.attr) against known module globals / class attrs,
+        following re-export hops for cross-module mutations."""
+        mod, rest = self._split_known_module(candidate)
+        if mod is None:
+            return None
+        if "." not in rest:
+            if rest in mod.module_globals:
+                return f"{mod.modname}.{rest}"
+            mapped = mod.aliases.get(rest)
+            if mapped:
+                resolved = self._resolve_dotted_value(mapped)
+                return self.shared_id(resolved) if resolved else None
+            return None
+        cls, _, attr = rest.partition(".")
+        if attr in mod.class_attrs.get(cls, ()):
+            return f"{mod.modname}.{cls}.{attr}"
+        return None
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_callee(self, mod: ModuleSummary, fs: FunctionSummary,
+                       raw: str) -> Optional[str]:
+        if not raw:
+            return None
+        if raw.startswith("self.") or raw.startswith("cls."):
+            attr = raw.split(".", 1)[1]
+            if "." in attr or not fs.cls:
+                return None
+            fid = f"{mod.modname}:{fs.cls}.{attr}"
+            return fid if fid in self.functions else None
+        if "." not in raw:
+            # innermost lexical scope first: own nested defs, then sibling
+            # nested defs up the enclosing-function chain, then module level
+            scope = fs.qualname.split(".")
+            for depth in range(len(scope), -1, -1):
+                if fs.cls and depth == 1:
+                    continue  # class bodies are not an enclosing scope
+                prefix = ".".join(scope[:depth] + [raw])
+                fid = f"{mod.modname}:{prefix}"
+                if fid in self.functions:
+                    return fid
+            if raw in mod.classes:
+                init = f"{mod.modname}:{raw}.__init__"
+                return init if init in self.functions else None
+            return None
+        known, rest = self._split_known_module(raw)
+        if known is not None:
+            return self._resolve_in_module(known, rest)
+        # obj.meth(): unique-method fallback, stoplisted
+        leaf = raw.rsplit(".", 1)[-1]
+        if leaf in _METHOD_STOPLIST or leaf.startswith("__"):
+            return None
+        cands = self._method_index.get(leaf, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _resolve_in_module(self, mod: ModuleSummary,
+                           rest: str, hops: int = 3) -> Optional[str]:
+        if not rest:
+            return None
+        fid = f"{mod.modname}:{rest}"
+        if fid in self.functions:
+            return fid
+        head = rest.split(".")[0]
+        if head in mod.classes:
+            if "." not in rest:
+                init = f"{mod.modname}:{rest}.__init__"
+                return init if init in self.functions else None
+            return None
+        mapped = mod.aliases.get(head)
+        if mapped and hops > 0:
+            full = mapped + rest[len(head):]
+            known, new_rest = self._split_known_module(full)
+            if known is not None:
+                return self._resolve_in_module(known, new_rest, hops - 1)
+        return None
+
+    def edges_of(self, fid: str) -> List[str]:
+        if fid in self._edges:
+            return self._edges[fid]
+        mod, fs = self.functions[fid]
+        out: List[str] = []
+        seen: Set[str] = set()
+        for c in fs.calls:
+            r = self.resolve_callee(mod, fs, c.callee)
+            if r and r not in seen:
+                seen.add(r)
+                out.append(r)
+        self._edges[fid] = out
+        return out
+
+    def closure(self, fid: str) -> Set[str]:
+        """All functions reachable from ``fid`` through resolved calls,
+        including itself."""
+        if fid in self._closures:
+            return self._closures[fid]
+        result: Set[str] = set()
+        stack = [fid]
+        while stack:
+            cur = stack.pop()
+            if cur in result or cur not in self.functions:
+                continue
+            result.add(cur)
+            stack.extend(self.edges_of(cur))
+        self._closures[fid] = result
+        return result
+
+    # -- thread / main models ---------------------------------------------
+
+    def resolve_spawn_target(self, mod: ModuleSummary, fs: FunctionSummary,
+                             spawn: SpawnSite) -> Optional[str]:
+        return self.resolve_callee(mod, fs, spawn.target)
+
+    def thread_entries(self) -> List[Tuple[str, str, SpawnSite, str]]:
+        """Every resolvable thread spawn: (entry_fid, spawner_fid, site,
+        description)."""
+        out = []
+        for fid, (mod, fs) in sorted(self.functions.items()):
+            for s in fs.spawns:
+                if s.kind != "thread":
+                    continue
+                entry = self.resolve_spawn_target(mod, fs, s)
+                if entry:
+                    desc = f"{mod.path}:{s.line}"
+                    out.append((entry, fid, s, desc))
+        return out
+
+    def main_reachable(self) -> Set[str]:
+        """Functions reachable without passing through a thread target:
+        the 'main path'. Seed = every function that is not inside any
+        thread entry's closure; the closure of the seed adds the shared
+        helpers both worlds call."""
+        in_thread: Set[str] = set()
+        for entry, _, _, _ in self.thread_entries():
+            in_thread |= self.closure(entry)
+        seed = [fid for fid in self.functions if fid not in in_thread]
+        out: Set[str] = set()
+        for fid in seed:
+            out |= self.closure(fid)
+        return out
+
+    # -- derived facts for the rules --------------------------------------
+
+    def closure_locks(self, fid: str) -> Set[str]:
+        """Validated lock ids acquired anywhere in ``fid``'s closure."""
+        out: Set[str] = set()
+        for f in self.closure(fid):
+            _, fs = self.functions[f]
+            for la in fs.locks:
+                lid = self.lock_id(la.lock)
+                if lid:
+                    out.add(lid)
+        return out
+
+    def closure_blocks_forever(self, fid: str) -> Optional[str]:
+        """A 'can block forever' witness in ``fid``'s closure, or None."""
+        for f in sorted(self.closure(fid)):
+            mod, fs = self.functions[f]
+            if fs.blocking:
+                b = fs.blocking[0]
+                return f"{b.what} at {mod.path}:{b.line} in {fs.qualname}"
+        return None
+
+    def closure_spawns_thread(self, fid: str) -> bool:
+        return any(s.kind == "thread"
+                   for f in self.closure(fid)
+                   for s in self.functions[f][1].spawns)
+
+    def calls_reinit_helper(self, fid: Optional[str]) -> bool:
+        """Does the closure of ``fid`` call a fork re-init helper
+        (``init_forked_worker``-shaped name)?"""
+        if fid is None:
+            return False
+        for f in self.closure(fid):
+            _, fs = self.functions[f]
+            for c in fs.calls:
+                if _REINIT_RE.search(c.callee.rsplit(".", 1)[-1]):
+                    return True
+        return False

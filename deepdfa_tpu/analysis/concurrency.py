@@ -1,0 +1,411 @@
+"""GL022–GL025: interprocedural concurrency rules over the Program model.
+
+Consumes :class:`~deepdfa_tpu.analysis.callgraph.Program` (per-function
+summaries composed into a call graph) and emits the same
+:class:`~deepdfa_tpu.analysis.rules.Finding` records the intraprocedural
+rules do, so the baseline diff, the CLI, and SARIF export are unchanged.
+
+The four hazards — each one a failure mode the multi-process serving arc
+walks straight into:
+
+* **GL022 unguarded-shared-mutation-across-threads** — a module global or
+  class-body attribute written from two execution contexts (at least one a
+  spawned-thread closure) with no common lock across all writes. Shared
+  state is module globals and class-body attrs ONLY: instance attributes
+  and locals are per-object/per-frame, and flagging them would trade the
+  empty-baseline contract for noise. A write under an *unidentifiable*
+  lock (``with lock:`` on a local or unknown attr) marks the name
+  possibly-guarded and suppresses the finding — precision over recall.
+* **GL023 lock-order-inversion** — a cycle in the interprocedural lock
+  acquisition graph: edges from lexically nested ``with`` regions plus
+  edges from locks acquired anywhere in the closure of a call made while
+  a lock is held. Same-lock re-entry is a different hazard (and fine for
+  RLock) — self-edges are excluded.
+* **GL024 fork-unsafe-spawn** — a fork-class spawn (``os.fork``,
+  fork/default-method ``multiprocessing``, ``Popen(preexec_fn=...)``)
+  reachable after a thread exists, or while a known lock is held (the
+  child inherits the locked lock). Plain ``Popen`` is exempt (fork+exec
+  resets the child); ``spawn``/``forkserver`` start methods are exempt;
+  and a child entry or pool initializer whose closure calls a
+  ``init_forked_worker``-shaped re-init helper is the repo's blessed
+  shape (GL020 precedent) and is exempt.
+* **GL025 blocking-join-on-main-path** — an unbounded ``.join()`` /
+  ``.result()`` on a thread or future whose target's reachable closure
+  can block forever (a no-timeout ``.get()``/``.wait()``,
+  ``serve_forever``). A timeout argument, a kill-then-join sequence, or
+  a target with no blocking witness all stay unflagged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from deepdfa_tpu.analysis.callgraph import (
+    _REINIT_RE, FunctionSummary, ModuleSummary, Program,
+)
+from deepdfa_tpu.analysis.rules import Finding
+
+__all__ = ["analyze_concurrency"]
+
+_FORK_KINDS = frozenset({"fork", "process", "process_pool", "popen_preexec"})
+_SAFE_START_METHODS = frozenset({"spawn", "forkserver"})
+
+
+def _mk(rule: str, mod: ModuleSummary, fs: FunctionSummary, line: int,
+        message: str, trace: Tuple[str, ...],
+        line_lookup) -> Finding:
+    return Finding(
+        rule=rule, path=mod.path, line=line, col=0,
+        function=fs.qualname, message=message, trace=trace,
+        source_line=line_lookup(mod.path, line))
+
+
+def analyze_concurrency(program: Program, line_lookup) -> List[Finding]:
+    """All GL022–GL025 findings for one composed program.
+
+    ``line_lookup(path, lineno) -> str`` supplies the source line for the
+    fingerprint (the runner reads files lazily; fixtures pass a dict-backed
+    lookup).
+    """
+    findings: List[Finding] = []
+    findings.extend(_check_shared_mutation(program, line_lookup))
+    findings.extend(_check_lock_order(program, line_lookup))
+    findings.extend(_check_fork_safety(program, line_lookup))
+    findings.extend(_check_blocking_joins(program, line_lookup))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL022: unguarded shared mutation across threads
+# ---------------------------------------------------------------------------
+
+
+def _check_shared_mutation(program: Program, line_lookup) -> List[Finding]:
+    entries = program.thread_entries()
+    thread_members: Dict[str, List[Tuple[str, str]]] = {}  # fid -> [(entry, where)]
+    for entry, _spawner, _site, desc in entries:
+        for fid in program.closure(entry):
+            thread_members.setdefault(fid, []).append((entry, desc))
+    main = program.main_reachable()
+
+    # shared id -> write records (contexts, validated locks, unknown?, site)
+    writes: Dict[str, List[dict]] = {}
+    for fid, (mod, fs) in sorted(program.functions.items()):
+        contexts: Set[str] = set()
+        for entry, desc in thread_members.get(fid, ()):
+            contexts.add(f"thread {entry.split(':', 1)[1]} (spawned {desc})")
+        if fid in main:
+            contexts.add("main path")
+        if not contexts:
+            continue
+        for acc in fs.accesses:
+            if not acc.write:
+                continue
+            sid = program.shared_id(acc.name)
+            if sid is None:
+                continue
+            locks, unknown = program.held_locks(acc.locks)
+            writes.setdefault(sid, []).append({
+                "contexts": contexts, "locks": locks, "unknown": unknown,
+                "mod": mod, "fs": fs, "line": acc.line,
+                "in_thread": fid in thread_members,
+            })
+
+    findings: List[Finding] = []
+    for sid in sorted(writes):
+        recs = writes[sid]
+        all_contexts: Set[str] = set()
+        for r in recs:
+            all_contexts |= r["contexts"]
+        thread_ctx = sorted(c for c in all_contexts if c != "main path")
+        if len(all_contexts) < 2 or not thread_ctx:
+            continue
+        if any(r["unknown"] for r in recs):
+            continue  # possibly guarded by a lock we cannot identify
+        common = set.intersection(*(r["locks"] for r in recs))
+        if common:
+            continue
+        site = min((r for r in recs if r["in_thread"]), default=recs[0],
+                   key=lambda r: (r["mod"].path, r["line"]))
+        others = [f"{r['mod'].path}:{r['line']}" for r in recs
+                  if r is not site]
+        trace = tuple(
+            [f"contexts writing {sid}: " + "; ".join(sorted(all_contexts))]
+            + ([f"other write sites: {', '.join(others)}"] if others else []))
+        findings.append(_mk(
+            "GL022", site["mod"], site["fs"], site["line"],
+            f"shared name {sid} is written from "
+            f"{len(all_contexts)} execution contexts "
+            f"({len(thread_ctx)} thread) with no common lock",
+            trace, line_lookup))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL023: lock-order inversion
+# ---------------------------------------------------------------------------
+
+
+def _check_lock_order(program: Program, line_lookup) -> List[Finding]:
+    # edge (A, B): A held while B acquired; keep the first witness site
+    edges: Dict[Tuple[str, str], dict] = {}
+
+    def add_edge(a: str, b: str, mod: ModuleSummary, fs: FunctionSummary,
+                 line: int, note: str) -> None:
+        if a == b:
+            return  # same-lock re-entry is not an ordering inversion
+        edges.setdefault((a, b), {
+            "mod": mod, "fs": fs, "line": line, "note": note})
+
+    for fid, (mod, fs) in sorted(program.functions.items()):
+        for la in fs.locks:
+            inner = program.lock_id(la.lock)
+            if inner is None:
+                continue
+            held, _ = program.held_locks(la.held)
+            for outer in held:
+                add_edge(outer, inner, mod, fs, la.line,
+                         f"nested with-regions in {fs.qualname}")
+        for c in fs.calls:
+            held, _ = program.held_locks(c.locks)
+            if not held:
+                continue
+            callee = program.resolve_callee(mod, fs, c.callee)
+            if callee is None:
+                continue
+            for inner in program.closure_locks(callee):
+                for outer in held:
+                    add_edge(outer, inner, mod, fs, c.line,
+                             f"{fs.qualname} holds it while calling "
+                             f"{callee.split(':', 1)[1]}")
+
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    findings: List[Finding] = []
+    for scc in _tarjan_sccs(graph):
+        if len(scc) < 2:
+            continue
+        cycle = _cycle_through(sorted(scc), graph)
+        first = edges.get((cycle[0], cycle[1])) or next(
+            iter(edges[e] for e in edges if e[0] in scc and e[1] in scc))
+        path = " -> ".join(cycle + [cycle[0]])
+        trace = []
+        for a, b in zip(cycle, cycle[1:] + [cycle[0]]):
+            e = edges.get((a, b))
+            if e:
+                trace.append(f"{a} held while acquiring {b} "
+                             f"({e['mod'].path}:{e['line']}; {e['note']})")
+        findings.append(_mk(
+            "GL023", first["mod"], first["fs"], first["line"],
+            f"lock acquisition order cycle: {path}",
+            tuple(trace), line_lookup))
+    return findings
+
+
+def _tarjan_sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the lock graph is tiny, but no recursion limits)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _cycle_through(nodes: List[str], graph: Dict[str, Set[str]]) -> List[str]:
+    """A concrete cycle visiting nodes of one SCC, starting at the
+    lexicographically smallest (deterministic finding text)."""
+    start = nodes[0]
+    scc = set(nodes)
+    path = [start]
+    seen = {start}
+    cur = start
+    while True:
+        nxt = None
+        for cand in sorted(graph.get(cur, ())):
+            if cand == start and len(path) > 1:
+                return path
+            if cand in scc and cand not in seen:
+                nxt = cand
+                break
+        if nxt is None:
+            return path
+        path.append(nxt)
+        seen.add(nxt)
+        cur = nxt
+
+
+# ---------------------------------------------------------------------------
+# GL024: fork-unsafe spawn
+# ---------------------------------------------------------------------------
+
+
+def _fork_sites(program: Program):
+    for fid, (mod, fs) in sorted(program.functions.items()):
+        for s in fs.spawns:
+            if s.kind in _FORK_KINDS and \
+                    s.start_method not in _SAFE_START_METHODS:
+                yield fid, mod, fs, s
+
+
+def _check_fork_safety(program: Program, line_lookup) -> List[Finding]:
+    entries = program.thread_entries()
+    thread_closure: Set[str] = set()
+    thread_descs: Dict[str, str] = {}
+    for entry, _spawner, _site, desc in entries:
+        for fid in program.closure(entry):
+            thread_closure.add(fid)
+            thread_descs.setdefault(fid, f"{entry.split(':', 1)[1]} "
+                                          f"(spawned {desc})")
+
+    # call sites that can only execute after a thread exists: an earlier
+    # intra-function thread spawn, or an earlier call whose closure spawns
+    caller_after_thread: Dict[str, List[Tuple[str, str, int]]] = {}
+    for fid, (mod, fs) in sorted(program.functions.items()):
+        first_thread: Optional[int] = min(
+            (s.line for s in fs.spawns if s.kind == "thread"), default=None)
+        for c in fs.calls:
+            callee = program.resolve_callee(mod, fs, c.callee)
+            if callee is None:
+                continue
+            if callee != fid and program.closure_spawns_thread(callee):
+                line = c.line
+                if first_thread is None or line < first_thread:
+                    first_thread = line
+        if first_thread is None:
+            continue
+        for c in fs.calls:
+            if c.line <= first_thread:
+                continue
+            callee = program.resolve_callee(mod, fs, c.callee)
+            if callee is None:
+                continue
+            for member in program.closure(callee):
+                caller_after_thread.setdefault(member, []).append(
+                    (fid, mod.path, first_thread))
+
+    findings: List[Finding] = []
+    for fid, mod, fs, s in _fork_sites(program):
+        child = program.resolve_callee(mod, fs, s.target) if s.target else None
+        init = (program.resolve_callee(mod, fs, s.initializer)
+                if s.initializer else None)
+        blessed = (
+            program.calls_reinit_helper(child)
+            or program.calls_reinit_helper(init)
+            or bool(s.target and _REINIT_RE.search(s.target))
+            or bool(s.initializer and _REINIT_RE.search(s.initializer)))
+        if blessed:
+            continue
+
+        reasons: List[str] = []
+        first_thread = min(
+            (sp.line for sp in fs.spawns
+             if sp.kind == "thread" and sp.line < s.line), default=None)
+        if s.after_thread_spawn or first_thread is not None:
+            reasons.append(
+                f"a thread is spawned earlier in {fs.qualname} "
+                f"(line {first_thread})")
+        elif fid in thread_closure:
+            reasons.append(
+                f"reachable from thread target {thread_descs[fid]}")
+        elif fid in caller_after_thread:
+            caller, cpath, tline = caller_after_thread[fid][0]
+            reasons.append(
+                f"reached from {caller.split(':', 1)[1]} after it has "
+                f"spawned a thread ({cpath}:{tline})")
+        locks, _ = program.held_locks(s.locks)
+        if locks:
+            reasons.append(
+                f"forked while holding {', '.join(sorted(locks))} — the "
+                f"child inherits the locked lock")
+        if not reasons:
+            continue
+        kind_desc = {
+            "fork": "os.fork()",
+            "process": "fork-method multiprocessing.Process",
+            "process_pool": "fork-method ProcessPoolExecutor",
+            "popen_preexec": "Popen with preexec_fn",
+        }[s.kind]
+        findings.append(_mk(
+            "GL024", mod, fs, s.line,
+            f"{kind_desc} is fork-unsafe here: {reasons[0]}",
+            tuple(reasons[1:]) + (
+                "fix: use a spawn start method, move the fork before any "
+                "thread exists, or re-init the child with an "
+                "init_forked_worker-style helper",),
+            line_lookup))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GL025: blocking join on the main path
+# ---------------------------------------------------------------------------
+
+
+def _check_blocking_joins(program: Program, line_lookup) -> List[Finding]:
+    findings: List[Finding] = []
+    for fid, (mod, fs) in sorted(program.functions.items()):
+        for j in fs.joins:
+            if j.timeout:
+                continue
+            target = program.resolve_callee(mod, fs, j.target)
+            if target is None:
+                continue
+            witness = program.closure_blocks_forever(target)
+            if witness is None:
+                continue
+            what = ".join()" if j.kind == "join" else ".result()"
+            findings.append(_mk(
+                "GL025", mod, fs, j.line,
+                f"unbounded {what} on {j.receiver}: its target "
+                f"{target.split(':', 1)[1]} can block forever",
+                (f"blocking witness: {witness}",
+                 "fix: pass a timeout (and escalate on expiry) or bound "
+                 "the target's own waits"),
+                line_lookup))
+    return findings

@@ -281,7 +281,25 @@ RULES: Dict[str, str] = {
     "GL019": "per-hypothesis-decode-dispatch",
     "GL020": "subprocess-without-trace-context",
     "GL021": "per-step-kernel-launch-in-scan",
+    # GL022–GL025 are interprocedural: implemented in concurrency.py over
+    # the callgraph.py whole-program model, not in _FunctionChecker.
+    "GL022": "unguarded-shared-mutation-across-threads",
+    "GL023": "lock-order-inversion",
+    "GL024": "fork-unsafe-spawn",
+    "GL025": "blocking-join-on-main-path",
 }
+
+#: Bump when analysis semantics change in a way file hashes cannot see —
+#: invalidates every incremental-cache entry.
+ANALYSIS_VERSION = 1
+
+
+def ruleset_fingerprint() -> str:
+    """Cache key component: the registered rules + the analysis version.
+    A rule added/renamed or a semantics bump invalidates cached results."""
+    payload = f"{ANALYSIS_VERSION}|" + "|".join(
+        f"{k}={v}" for k, v in sorted(RULES.items()))
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
 
 _JIT_NAMES = frozenset({
     "jax.jit", "jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit",

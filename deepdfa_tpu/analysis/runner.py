@@ -4,6 +4,16 @@
 package), analyzes every ``.py`` file, and diffs the findings against a
 committed baseline-suppressions file so CI fails only on NEW findings.
 
+Two phases since the interprocedural lift: a **per-file** phase (the
+GL001–GL021 rules plus a :class:`callgraph.ModuleSummary` per file — both
+pure functions of one file's content, so both cache under the file's
+sha256 + the rule-registry fingerprint), then a **whole-program** phase
+that composes all summaries into a :class:`callgraph.Program` and runs
+the GL022–GL025 concurrency rules. The program phase is cheap (no AST
+work, just graph composition) and always runs — on a warm
+``--incremental`` pass only changed files and their import-graph
+dependents repeat the per-file phase.
+
 Baseline entries are keyed by a line-number-free fingerprint (file, rule,
 function, normalized source line — ``Finding.fingerprint``), so unrelated
 edits above a suppressed finding don't resurrect it; identical fingerprints
@@ -14,11 +24,16 @@ fails. Regenerate with ``--write-baseline`` after deliberate suppressions.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from deepdfa_tpu.analysis.rules import Finding, analyze_source
+from deepdfa_tpu.analysis import callgraph
+from deepdfa_tpu.analysis.concurrency import analyze_concurrency
+from deepdfa_tpu.analysis.rules import (
+    Finding, analyze_source, ruleset_fingerprint,
+)
 
 BASELINE_VERSION = 1
 
@@ -51,27 +66,100 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return sorted(set(out))
 
 
+def default_cache_path() -> str:
+    return os.path.join(repo_root(), ".graftlint_cache.json")
+
+
 def collect_findings(paths: Sequence[str],
                      root: Optional[str] = None) -> List[Finding]:
-    return _findings_for_files(iter_python_files(paths), root)
-
-
-def _findings_for_files(files: Sequence[str],
-                        root: Optional[str] = None) -> List[Finding]:
+    """Per-file (intraprocedural) findings only — the legacy surface;
+    ``run_analysis``/``analyze_files`` add the program phase."""
     root = root or repo_root()
     findings: List[Finding] = []
-    for path in files:
-        rel = os.path.relpath(path, root)
-        if rel.startswith(".."):  # outside the root: keep absolute
-            rel = path
+    for path in iter_python_files(paths):
+        rel = _rel(path, root)
         findings.extend(analyze_source(rel, source=_read(path)))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
+def _rel(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    if rel.startswith(".."):  # outside the root: keep absolute
+        rel = path
+    return rel.replace("\\", "/")
+
+
 def _read(path: str) -> str:
     with open(path, encoding="utf-8") as f:
         return f.read()
+
+
+def analyze_files(sources: Dict[str, str]) -> List[Finding]:
+    """Full analysis (per-file rules + GL022–GL025 program phase) over an
+    in-memory ``{path: source}`` program — the multi-file fixture hook."""
+    findings: List[Finding] = []
+    summaries: List[callgraph.ModuleSummary] = []
+    split: Dict[str, List[str]] = {}
+    for path in sorted(sources):
+        src = sources[path]
+        split[path.replace("\\", "/")] = src.splitlines()
+        findings.extend(analyze_source(path, source=src))
+        summary = callgraph.summarize_module(path, src)
+        if summary is not None:
+            summaries.append(summary)
+
+    def lookup(path: str, line: int) -> str:
+        lines = split.get(path, [])
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+
+    findings.extend(analyze_concurrency(callgraph.Program(summaries), lookup))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Incremental cache: per-file findings + summaries keyed on content hash
+# ---------------------------------------------------------------------------
+
+
+def _finding_to_cache(f: Finding) -> Dict:
+    return {
+        "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+        "function": f.function, "message": f.message,
+        "trace": list(f.trace), "source_line": f.source_line,
+    }
+
+
+def _finding_from_cache(d: Dict) -> Finding:
+    return Finding(
+        rule=d["rule"], path=d["path"], line=d["line"], col=d["col"],
+        function=d["function"], message=d["message"],
+        trace=tuple(d.get("trace", ())), source_line=d.get("source_line", ""))
+
+
+def _load_cache(path: str) -> Dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"version": "", "files": {}}
+    if doc.get("version") != ruleset_fingerprint():
+        return {"version": "", "files": {}}  # registry changed: all stale
+    if not isinstance(doc.get("files"), dict):
+        return {"version": "", "files": {}}
+    return doc
+
+
+def _save_cache(path: str, entries: Dict[str, Dict]) -> None:
+    doc = {"version": ruleset_fingerprint(), "files": entries}
+    try:
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a read-only checkout just runs cold every time
 
 
 def load_baseline(path: str) -> Dict[str, int]:
@@ -135,20 +223,107 @@ def run_analysis(
     baseline_path: Optional[str] = None,
     write_baseline_file: bool = False,
     root: Optional[str] = None,
+    incremental: bool = False,
+    cache_path: Optional[str] = None,
 ) -> Dict:
     """The analyze-code engine. Returns a JSON-able report:
 
     ``{"files", "findings" (all), "new" (non-baselined), "stale_suppressions",
     "exit_code"}`` — exit_code 1 iff new findings exist (and we're not
-    regenerating the baseline)."""
+    regenerating the baseline). With ``incremental=True``, per-file results
+    for content-unchanged files come from the cache (which a cold run
+    primes) and ``"reanalyzed"`` lists the files that actually re-ran the
+    per-file phase: changed files plus their direct import-graph
+    dependents. The GL022–GL025 program phase always runs — it is graph
+    composition over the (cached) summaries, not AST work."""
     paths = list(paths) if paths else default_paths()
     baseline_path = baseline_path or default_baseline_path()
+    root = root or repo_root()
+    cache_path = cache_path or default_cache_path()
     files = iter_python_files(paths)
-    findings = _findings_for_files(files, root=root)
+
+    cache = _load_cache(cache_path) if incremental else \
+        {"version": "", "files": {}}
+    cached_files: Dict[str, Dict] = cache["files"]
+    entries: Dict[str, Dict] = {}
+    findings: List[Finding] = []
+    summaries: Dict[str, callgraph.ModuleSummary] = {}
+    abs_of: Dict[str, str] = {}
+    changed: List[str] = []
+
+    def analyze_one(rel: str, path: str) -> Dict:
+        source = _read(path)
+        digest = hashlib.sha256(source.encode()).hexdigest()
+        file_findings = analyze_source(rel, source=source)
+        summary = callgraph.summarize_module(rel, source)
+        return {
+            "sha256": digest,
+            "findings": [_finding_to_cache(f) for f in file_findings],
+            "summary": summary.to_dict() if summary is not None else None,
+        }
+
+    for path in files:
+        rel = _rel(path, root)
+        abs_of[rel] = path
+        entry = cached_files.get(rel)
+        if entry is not None:
+            digest = hashlib.sha256(_read(path).encode()).hexdigest()
+            if digest != entry.get("sha256"):
+                entry = None
+        if entry is None:
+            entry = analyze_one(rel, path)
+            changed.append(rel)
+        entries[rel] = entry
+
+    # a changed file invalidates its direct import-graph dependents: their
+    # per-file results cannot change (per-file analysis sees one file), but
+    # the contract is that an edit re-checks everything that imports it.
+    if incremental and changed:
+        probe = callgraph.Program([
+            callgraph.ModuleSummary.from_dict(e["summary"])
+            for e in entries.values() if e.get("summary")])
+        dependents: List[str] = []
+        for rel in changed:
+            for dep in probe.importers_of(rel):
+                if dep in entries and dep not in changed and \
+                        dep not in dependents:
+                    dependents.append(dep)
+        for rel in dependents:
+            entries[rel] = analyze_one(rel, abs_of[rel])
+        reanalyzed = sorted(changed + dependents)
+    else:
+        reanalyzed = sorted(changed)
+
+    for rel in sorted(entries):
+        entry = entries[rel]
+        findings.extend(_finding_from_cache(d) for d in entry["findings"])
+        if entry.get("summary"):
+            summaries[rel] = callgraph.ModuleSummary.from_dict(
+                entry["summary"])
+
+    _save_cache(cache_path, entries)
+
+    program = callgraph.Program(list(summaries.values()))
+    line_cache: Dict[str, List[str]] = {}
+
+    def lookup(rel_path: str, line: int) -> str:
+        if rel_path not in line_cache:
+            try:
+                line_cache[rel_path] = _read(
+                    abs_of.get(rel_path, rel_path)).splitlines()
+            except OSError:
+                line_cache[rel_path] = []
+        lines = line_cache[rel_path]
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+
+    findings.extend(analyze_concurrency(program, lookup))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     if write_baseline_file:
         write_baseline(findings, baseline_path)
         return {
             "files": len(files),
+            "reanalyzed": reanalyzed,
             "findings": [_as_dict(f) for f in findings],
             "new": [],
             "stale_suppressions": {},
@@ -160,6 +335,7 @@ def run_analysis(
     new, stale = apply_baseline(findings, baseline)
     return {
         "files": len(files),
+        "reanalyzed": reanalyzed,
         "findings": [_as_dict(f) for f in findings],
         "new": [_as_dict(f) for f in new],
         "new_findings": new,

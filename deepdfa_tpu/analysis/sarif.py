@@ -1,0 +1,86 @@
+"""SARIF 2.1.0 export for graftlint findings.
+
+``cli analyze-code --sarif <path>`` writes one run in the static-analysis
+interchange format every major CI renders as inline annotations. The
+mapping is deliberately minimal and standard: one ``rule`` per registered
+GLxxx id, one ``result`` per finding with a file/line/column region, level
+``error`` for findings NOT covered by the committed baseline and ``note``
+for baselined ones, and the finding's trace steps as the message's
+continuation lines. The JSON report and the baseline diff are unchanged —
+SARIF is a second serialization of the same run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from deepdfa_tpu.analysis.rules import RULES
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def report_to_sarif(report: Dict) -> Dict:
+    """One SARIF ``run`` from a ``run_analysis`` report dict."""
+    new_fps = {f["fingerprint"] for f in report.get("new", [])}
+    rules_used: List[str] = sorted({f["rule"]
+                                    for f in report.get("findings", [])})
+    rule_index = {rid: i for i, rid in enumerate(rules_used)}
+    results = []
+    for f in report.get("findings", []):
+        message = f["message"]
+        if f.get("trace"):
+            message = "\n".join([message] + list(f["trace"]))
+        results.append({
+            "ruleId": f["rule"],
+            "ruleIndex": rule_index[f["rule"]],
+            "level": ("error" if f["fingerprint"] in new_fps else "note"),
+            "message": {"text": message},
+            "partialFingerprints": {"graftlint/v1": f["fingerprint"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f["path"].replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": max(1, int(f["line"])),
+                        "startColumn": int(f["col"]) + 1,
+                    },
+                },
+                "logicalLocations": [{
+                    "name": f["function"],
+                    "kind": "function",
+                }],
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "informationUri":
+                        "https://github.com/deepdfa-tpu/deepdfa-tpu",
+                    "rules": [{
+                        "id": rid,
+                        "name": RULES.get(rid, rid),
+                        "shortDescription": {"text": RULES.get(rid, rid)},
+                    } for rid in rules_used],
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(report: Dict, path: str) -> None:
+    doc = report_to_sarif(report)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")

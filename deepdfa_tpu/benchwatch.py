@@ -570,6 +570,16 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     trace_on, trace_off = min(t_on), min(t_off)
     trace_overhead_pct = (trace_on - trace_off) / trace_off * 100.0
 
+    # graftlint full-repo cold pass (stdlib AST work, no jax): the
+    # analyzer's own cost rides the same gate as kernel perf. One rep —
+    # deterministic CPU work, and the smoke budget matters.
+    from deepdfa_tpu.analysis.runner import run_analysis
+
+    t0 = time.perf_counter()
+    lint_report = run_analysis()
+    lint_ms = (time.perf_counter() - t0) * 1e3
+    assert lint_report["files"] > 50
+
     return {
         "smoke_gnn_train_graphs_per_sec": {
             "value": round(gps, 1), "unit": "graphs/s"},
@@ -585,6 +595,8 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(fleet_rps, 1), "unit": "req/s"},
         "smoke_gen_decode_tok_per_sec": {
             "value": round(gen_tps, 1), "unit": "tok/s"},
+        "smoke_graftlint_full_repo_ms": {
+            "value": round(lint_ms, 1), "unit": "ms"},
         "smoke_trace_propagation_rps": {
             "value": round(len(trace_graphs) / trace_on, 1),
             "unit": "req/s",

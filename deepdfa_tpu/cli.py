@@ -1612,7 +1612,12 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
         paths=args.paths or None,
         baseline_path=args.baseline,
         write_baseline_file=args.write_baseline,
+        incremental=args.incremental,
     )
+    if args.sarif:
+        from deepdfa_tpu.analysis.sarif import write_sarif
+
+        write_sarif(report, args.sarif)
     if args.json:
         # new_findings holds Finding objects for the text formatter only
         print(json.dumps({k: v for k, v in report.items()
@@ -2294,6 +2299,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                       help="machine-readable report on stdout")
     p_ac.add_argument("--verbose", action="store_true",
                       help="also list baselined findings")
+    p_ac.add_argument("--incremental", action="store_true",
+                      help="reuse the content-hash cache "
+                           "(.graftlint_cache.json): re-analyze only "
+                           "changed files + their importers; CI runs cold")
+    p_ac.add_argument("--sarif", default=None, metavar="PATH",
+                      help="also write the report as SARIF 2.1.0 (CI "
+                           "annotation format)")
     p_ac.set_defaults(func=cmd_analyze_code)
 
     p_ch = sub.add_parser(

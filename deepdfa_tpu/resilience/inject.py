@@ -95,6 +95,7 @@ import json
 import logging
 import os
 import random
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -254,12 +255,18 @@ class FaultPlan:
 
 _PLAN: Optional[FaultPlan] = None
 _ENV_CHECKED = False
+# Guards writes to the arming pair (GL022): active() runs inside every
+# fire() call, including from the checkpoint-writer and Joern-pool thread
+# closures, and its lazy env arming raced install()/clear() on the main
+# path. Reads stay lock-free — only writers serialize.
+_ARM_LOCK = threading.Lock()
 
 
 def install(plan: FaultPlan) -> FaultPlan:
     global _PLAN, _ENV_CHECKED
-    _PLAN = plan
-    _ENV_CHECKED = True
+    with _ARM_LOCK:
+        _PLAN = plan
+        _ENV_CHECKED = True
     from deepdfa_tpu import telemetry
 
     telemetry.event("fault.armed", specs=len(plan.faults), seed=plan.seed)
@@ -268,19 +275,22 @@ def install(plan: FaultPlan) -> FaultPlan:
 
 def clear() -> None:
     global _PLAN, _ENV_CHECKED
-    _PLAN = None
-    _ENV_CHECKED = True
+    with _ARM_LOCK:
+        _PLAN = None
+        _ENV_CHECKED = True
 
 
 def active() -> Optional[FaultPlan]:
     global _PLAN, _ENV_CHECKED
     if _PLAN is None and not _ENV_CHECKED:
-        _ENV_CHECKED = True
-        raw = os.environ.get(ENV_VAR)
-        if raw:
-            _PLAN = FaultPlan.from_source(raw)
-            logger.warning("fault plan armed from %s (%d specs)", ENV_VAR,
-                           len(_PLAN.faults))
+        with _ARM_LOCK:
+            if _PLAN is None and not _ENV_CHECKED:
+                _ENV_CHECKED = True
+                raw = os.environ.get(ENV_VAR)
+                if raw:
+                    _PLAN = FaultPlan.from_source(raw)
+                    logger.warning("fault plan armed from %s (%d specs)",
+                                   ENV_VAR, len(_PLAN.faults))
     return _PLAN
 
 
@@ -289,12 +299,14 @@ def armed(plan: FaultPlan):
     """Arm ``plan`` for the duration of the block, restoring the previous
     arming state after — the test/soak entry point."""
     global _PLAN, _ENV_CHECKED
-    prev, prev_checked = _PLAN, _ENV_CHECKED
+    with _ARM_LOCK:
+        prev, prev_checked = _PLAN, _ENV_CHECKED
     install(plan)
     try:
         yield plan
     finally:
-        _PLAN, _ENV_CHECKED = prev, prev_checked
+        with _ARM_LOCK:
+            _PLAN, _ENV_CHECKED = prev, prev_checked
 
 
 # ---------------------------------------------------------------------------

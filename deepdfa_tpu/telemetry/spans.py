@@ -53,6 +53,11 @@ DEFAULT_ROTATE_BYTES = 64 * 1024 * 1024
 DEFAULT_RETAIN_BYTES = 512 * 1024 * 1024
 
 _ENABLED: Optional[bool] = None  # tri-state: None = read the env lazily
+# Guards writes to _ENABLED only (GL022): every thread closure reaches
+# enabled() through event()/span(), so the lazy env read raced set_enabled.
+# The hot path still reads lock-free — only the None->value transition and
+# the explicit override serialize.
+_ENABLED_LOCK = threading.Lock()
 
 
 def enabled() -> bool:
@@ -61,7 +66,9 @@ def enabled() -> bool:
     are timing semantics, not telemetry)."""
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = os.environ.get(ENV_VAR, "1") != "0"
+        with _ENABLED_LOCK:
+            if _ENABLED is None:
+                _ENABLED = os.environ.get(ENV_VAR, "1") != "0"
     return _ENABLED
 
 
@@ -69,7 +76,8 @@ def set_enabled(value: Optional[bool]) -> None:
     """Override the env switch (``None`` re-reads the env) — the
     bench A/B and test hook."""
     global _ENABLED
-    _ENABLED = value
+    with _ENABLED_LOCK:
+        _ENABLED = value
 
 
 # ---------------------------------------------------------------------------

@@ -4,6 +4,11 @@
 # finding not in configs/lint_baseline.json — the CI gate. Regenerate the
 # baseline after a deliberate suppression with:
 #   python -m deepdfa_tpu.cli analyze-code --write-baseline
+# CI runs cold (full repo, every rule incl. the GL022-GL025 interprocedural
+# concurrency phase). For fast local iteration pass --incremental: only
+# changed files + their importers re-run the per-file phase, keyed on each
+# file's sha256 in .graftlint_cache.json (gitignored):
+#   scripts/lint.sh --incremental
 set -e
 cd "$(dirname "$0")/.."
 # The analyzer is stdlib-only, but the CLI module imports jax-adjacent

@@ -15,6 +15,7 @@ from deepdfa_tpu.analysis import analyze_source
 from deepdfa_tpu.analysis.cfg import build_cfg
 from deepdfa_tpu.analysis.dataflow import reaching_definitions
 from deepdfa_tpu.analysis.runner import (
+    analyze_files,
     apply_baseline,
     load_baseline,
     run_analysis,
@@ -27,6 +28,16 @@ def rules_of(src: str):
 
 def findings_for(src: str, rule: str):
     return [f for f in analyze_source("fixture.py", src) if f.rule == rule]
+
+
+def program_rules(src: str, name: str = "prog.py"):
+    """Whole-program rule ids (per-file + interprocedural phase) for one
+    in-memory module — the GL022-GL025 analogue of ``rules_of``."""
+    return {f.rule for f in analyze_files({name: src})}
+
+
+def program_findings(src: str, rule: str, name: str = "prog.py"):
+    return [f for f in analyze_files({name: src}) if f.rule == rule]
 
 
 # ---------------------------------------------------------------------------
@@ -1743,16 +1754,16 @@ def test_package_self_check_clean_and_fast():
 
 
 def test_self_check_covers_every_rule_implementation():
-    """All 12 hazard rule ids (plus the parse-error sentinel) are wired:
-    each hazard has at least one firing fixture above; this guards the
-    registry/implementation agreement."""
+    """Every registered hazard rule id (plus the parse-error sentinel) is
+    wired: each hazard has at least one firing fixture in this file; this
+    guards the registry/implementation agreement."""
     from deepdfa_tpu.analysis.rules import RULES
 
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
                              "GL016", "GL017", "GL018", "GL019", "GL020",
-                             "GL021"})
-    assert len(RULES) == 21
+                             "GL021", "GL022", "GL023", "GL024", "GL025"})
+    assert len(RULES) == 25
 
 
 def test_unparseable_file_is_a_finding(tmp_path):
@@ -1761,3 +1772,546 @@ def test_unparseable_file_is_a_finding(tmp_path):
                           baseline_path=str(tmp_path / "b.json"))
     assert report["exit_code"] == 1
     assert report["new"][0]["rule"] == "GL000"
+
+
+# ---------------------------------------------------------------------------
+# GL022 unguarded-shared-mutation-across-threads (whole-program phase)
+# ---------------------------------------------------------------------------
+
+
+_GL022_RACE = """
+import threading
+
+EVENTS = []
+
+def worker():
+    EVENTS.append(1)
+
+def start():
+    t = threading.Thread(target=worker)
+    t.start()
+    EVENTS.append(2)
+"""
+
+
+def test_gl022_unguarded_module_global_written_from_thread_and_main():
+    fs = program_findings(_GL022_RACE, "GL022")
+    assert len(fs) == 1
+    f = fs[0]
+    assert "prog.EVENTS" in f.message and "no common lock" in f.message
+    # the trace names both execution contexts and the other write site
+    assert any("thread worker" in t for t in f.trace)
+    assert any("main path" in t for t in f.trace)
+
+
+def test_gl022_negative_common_lock_guards_every_write():
+    src = """
+import threading
+
+EVENTS = []
+_LOCK = threading.Lock()
+
+def worker():
+    with _LOCK:
+        EVENTS.append(1)
+
+def start():
+    t = threading.Thread(target=worker)
+    t.start()
+    with _LOCK:
+        EVENTS.append(2)
+"""
+    assert "GL022" not in program_rules(src)
+
+
+def test_gl022_negative_unknown_lock_suppresses():
+    # precision over recall: a write under a lock whose identity the
+    # analyzer can't resolve (a local) might be guarded — stay silent.
+    src = """
+import threading
+
+EVENTS = []
+
+def worker():
+    EVENTS.append(1)
+
+def start(lock):
+    t = threading.Thread(target=worker)
+    t.start()
+    with lock:
+        EVENTS.append(2)
+"""
+    assert "GL022" not in program_rules(src)
+
+
+def test_gl022_negative_single_context_is_not_a_race():
+    src = """
+EVENTS = []
+
+def start():
+    EVENTS.append(2)
+"""
+    assert "GL022" not in program_rules(src)
+
+
+# ---------------------------------------------------------------------------
+# GL023 lock-order-inversion (interprocedural cycle)
+# ---------------------------------------------------------------------------
+
+
+_GL023_CYCLE = """
+import threading
+
+L1 = threading.Lock()
+L2 = threading.Lock()
+
+def a():
+    with L1:
+        b()
+
+def b():
+    with L2:
+        pass
+
+def c():
+    with L2:
+        d()
+
+def d():
+    with L1:
+        pass
+"""
+
+
+def test_gl023_interprocedural_lock_order_cycle():
+    """The acceptance fixture: each function acquires at most ONE lock, so
+    no per-function view can see an ordering at all — the cycle only exists
+    once a's call to b and c's call to d compose through the call graph."""
+    assert "GL023" not in rules_of(_GL023_CYCLE)  # per-file phase: blind
+    fs = program_findings(_GL023_CYCLE, "GL023")
+    assert len(fs) == 1
+    f = fs[0]
+    assert "prog.L1 -> prog.L2 -> prog.L1" in f.message
+    assert any("a holds it while calling b" in t for t in f.trace)
+    assert any("c holds it while calling d" in t for t in f.trace)
+
+
+def test_gl023_negative_consistent_lock_order():
+    src = """
+import threading
+
+L1 = threading.Lock()
+L2 = threading.Lock()
+
+def a():
+    with L1:
+        b()
+
+def b():
+    with L2:
+        pass
+
+def c():
+    with L1:
+        d()
+
+def d():
+    with L2:
+        pass
+"""
+    assert "GL023" not in program_rules(src)
+
+
+def test_gl023_negative_reentrant_self_edge_is_not_a_cycle():
+    src = """
+import threading
+
+L1 = threading.RLock()
+
+def a():
+    with L1:
+        b()
+
+def b():
+    with L1:
+        pass
+"""
+    assert "GL023" not in program_rules(src)
+
+
+# ---------------------------------------------------------------------------
+# GL024 fork-unsafe-spawn
+# ---------------------------------------------------------------------------
+
+
+def test_gl024_fork_after_thread_spawn():
+    src = """
+import os
+import threading
+
+def pump():
+    pass
+
+def serve():
+    t = threading.Thread(target=pump)
+    t.start()
+    os.fork()
+"""
+    fs = program_findings(src, "GL024")
+    assert len(fs) == 1
+    assert "thread is spawned earlier" in fs[0].message
+
+
+def test_gl024_fork_start_while_lock_held():
+    src = """
+import multiprocessing as mp
+import threading
+
+_LOCK = threading.Lock()
+
+def child():
+    pass
+
+def launch():
+    with _LOCK:
+        ctx = mp.get_context("fork")
+        p = ctx.Process(target=child)
+        p.start()
+"""
+    fs = program_findings(src, "GL024")
+    assert len(fs) == 1
+    assert "prog._LOCK" in fs[0].message
+
+
+def test_gl024_negative_fork_before_any_thread():
+    src = """
+import os
+import threading
+
+def pump():
+    pass
+
+def serve():
+    os.fork()
+    t = threading.Thread(target=pump)
+    t.start()
+"""
+    assert "GL024" not in program_rules(src)
+
+
+def test_gl024_negative_spawn_start_method():
+    src = """
+import multiprocessing as mp
+import threading
+
+_LOCK = threading.Lock()
+
+def child():
+    pass
+
+def launch():
+    with _LOCK:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=child)
+        p.start()
+"""
+    assert "GL024" not in program_rules(src)
+
+
+def test_gl024_negative_reinit_helper_blesses_the_child():
+    src = """
+import multiprocessing as mp
+import threading
+
+def init_forked_worker(name):
+    pass
+
+def child():
+    init_forked_worker("w")
+
+def pump():
+    pass
+
+def serve():
+    t = threading.Thread(target=pump)
+    t.start()
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=child)
+    p.start()
+"""
+    assert "GL024" not in program_rules(src)
+
+
+# ---------------------------------------------------------------------------
+# GL025 blocking-join-on-main-path
+# ---------------------------------------------------------------------------
+
+
+def test_gl025_unbounded_join_on_blocking_target():
+    src = """
+import queue
+import threading
+
+_Q = queue.Queue()
+
+def worker():
+    while True:
+        item = _Q.get()
+
+def run():
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+"""
+    fs = program_findings(src, "GL025")
+    assert len(fs) == 1
+    f = fs[0]
+    assert "can block forever" in f.message
+    assert any(".get()" in t for t in f.trace)
+
+
+def test_gl025_unbounded_future_result_on_blocking_target():
+    src = """
+import queue
+from concurrent.futures import ThreadPoolExecutor
+
+_Q = queue.Queue()
+
+def worker():
+    return _Q.get()
+
+def run():
+    with ThreadPoolExecutor() as pool:
+        fut = pool.submit(worker)
+        return fut.result()
+"""
+    assert "GL025" in program_rules(src)
+
+
+def test_gl025_negative_timeout_bearing_join():
+    src = """
+import queue
+import threading
+
+_Q = queue.Queue()
+
+def worker():
+    while True:
+        item = _Q.get()
+
+def run():
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=5.0)
+"""
+    assert "GL025" not in program_rules(src)
+
+
+def test_gl025_negative_target_cannot_block_forever():
+    src = """
+import threading
+
+def worker():
+    x = 1
+
+def run():
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+"""
+    assert "GL025" not in program_rules(src)
+
+
+# ---------------------------------------------------------------------------
+# callgraph unit surface: summaries, resolution, import graph
+# ---------------------------------------------------------------------------
+
+
+def test_callgraph_cross_module_resolution_and_closure():
+    from deepdfa_tpu.analysis.callgraph import Program, summarize_module
+
+    util = summarize_module("pkg/util.py", """
+def leaf():
+    pass
+
+def middle():
+    leaf()
+""")
+    app = summarize_module("pkg/app.py", """
+from pkg import util
+
+def top():
+    util.middle()
+""")
+    prog = Program([util, app])
+    mod, fs = prog.functions["pkg.app:top"]
+    # the scan expanded the `from pkg import util` alias at summarize time
+    assert fs.calls[0].callee == "pkg.util.middle"
+    assert prog.resolve_callee(mod, fs, fs.calls[0].callee) == "pkg.util:middle"
+    assert prog.closure("pkg.app:top") == {
+        "pkg.app:top", "pkg.util:middle", "pkg.util:leaf"}
+    # reverse import edges are what --incremental re-analyzes
+    assert prog.importers_of("pkg/util.py") == {"pkg/app.py"}
+    assert prog.importers_of("pkg/app.py") == set()
+
+
+def test_callgraph_module_summary_roundtrip():
+    from deepdfa_tpu.analysis.callgraph import ModuleSummary, summarize_module
+
+    ms = summarize_module("pkg/mod.py", """
+import threading
+
+_LOCK = threading.Lock()
+STATE = {}
+
+class Worker:
+    def __init__(self):
+        self._t = threading.Thread(target=self.run)
+
+    def run(self):
+        with _LOCK:
+            STATE["k"] = 1
+""")
+    back = ModuleSummary.from_dict(ms.to_dict())
+    assert back.modname == "pkg.mod"
+    assert back.module_locks == {"_LOCK": "Lock"}
+    assert "STATE" in back.mutable_globals
+    assert set(back.functions) == set(ms.functions)
+    run = back.functions["Worker.run"]
+    assert [a.name for a in run.accesses if a.write] == ["pkg.mod.STATE"]
+    assert list(run.accesses[0].locks) == ["pkg.mod._LOCK"]
+
+
+# ---------------------------------------------------------------------------
+# incremental cache (--incremental)
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_reanalyzes_exactly_changed_file_plus_importers(tmp_path):
+    """Satellite acceptance: after a one-file edit, a warm incremental run
+    re-analyzes exactly that file plus its direct import-graph dependents."""
+    (tmp_path / "m1.py").write_text("X = 1\n")
+    (tmp_path / "m2.py").write_text("import m1\n\nY = m1.X\n")
+    (tmp_path / "m3.py").write_text("Z = 3\n")
+    kw = dict(paths=[str(tmp_path)],
+              baseline_path=str(tmp_path / "baseline.json"),
+              root=str(tmp_path),
+              cache_path=str(tmp_path / "cache.json"),
+              incremental=True)
+
+    cold = run_analysis(**kw)
+    assert sorted(cold["reanalyzed"]) == ["m1.py", "m2.py", "m3.py"]
+
+    warm = run_analysis(**kw)
+    assert warm["reanalyzed"] == []
+    assert warm["findings"] == cold["findings"]
+
+    (tmp_path / "m1.py").write_text("X = 2\n")
+    edited = run_analysis(**kw)
+    assert sorted(edited["reanalyzed"]) == ["m1.py", "m2.py"]
+    assert edited["exit_code"] == 0
+
+
+def test_incremental_cache_rejected_on_ruleset_version_change(tmp_path):
+    (tmp_path / "m1.py").write_text("X = 1\n")
+    cache = tmp_path / "cache.json"
+    kw = dict(paths=[str(tmp_path)],
+              baseline_path=str(tmp_path / "baseline.json"),
+              root=str(tmp_path), cache_path=str(cache), incremental=True)
+    run_analysis(**kw)
+    blob = json.loads(cache.read_text())
+    blob["version"] = "stale-ruleset"
+    cache.write_text(json.dumps(blob))
+    report = run_analysis(**kw)
+    assert report["reanalyzed"] == ["m1.py"]  # cache dropped, full re-run
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 export
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_export_schema_shape(tmp_path):
+    from deepdfa_tpu.analysis.sarif import report_to_sarif
+
+    path = _write_fixture(tmp_path, _HAZARD)
+    report = run_analysis(paths=[path],
+                          baseline_path=str(tmp_path / "b.json"),
+                          root=str(tmp_path))
+    assert report["exit_code"] == 1
+    doc = report_to_sarif(report)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].startswith("https://")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(set(rule_ids))
+    res = run["results"][0]
+    assert res["ruleId"] in rule_ids
+    assert driver["rules"][res["ruleIndex"]]["id"] == res["ruleId"]
+    assert res["level"] == "error"  # new finding
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] >= 1
+    assert res["partialFingerprints"]["graftlint/v1"]
+
+
+def test_sarif_baselined_findings_downgrade_to_note(tmp_path):
+    from deepdfa_tpu.analysis.sarif import report_to_sarif
+
+    path = _write_fixture(tmp_path, _HAZARD)
+    baseline = str(tmp_path / "b.json")
+    run_analysis(paths=[path], baseline_path=baseline,
+                 write_baseline_file=True)
+    report = run_analysis(paths=[path], baseline_path=baseline)
+    doc = report_to_sarif(report)
+    levels = [r["level"] for r in doc["runs"][0]["results"]]
+    assert levels == ["note"]
+
+
+def test_cli_analyze_code_sarif_flag(tmp_path, capsys):
+    from deepdfa_tpu.cli import main
+
+    path = _write_fixture(tmp_path, _HAZARD)
+    out = tmp_path / "lint.sarif"
+    rc = main(["analyze-code", path,
+               "--baseline", str(tmp_path / "none.json"),
+               "--sarif", str(out)])
+    capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert len(doc["runs"][0]["results"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# fixture-coverage meta-test
+# ---------------------------------------------------------------------------
+
+
+def test_every_rule_has_positive_and_negative_fixture():
+    """The synthetic-snippet contract, enforced: every registered rule id
+    has at least one positive fixture (hazard detected) and one negative
+    fixture (idiomatic fix, clean) in this file. Negatives are recognized
+    by name: 'negative', 'unflagged', or 'clean'. GL000 (parse error) is
+    exercised by test_unparseable_file_is_a_finding instead."""
+    import pathlib
+    import re
+
+    from deepdfa_tpu.analysis.rules import RULES
+
+    src = pathlib.Path(__file__).read_text()
+    positives, negatives = set(), set()
+    for name, num in re.findall(r"def (test_gl(\d{3})[a-z0-9_]*)\(", src):
+        rule = f"GL{num}"
+        if any(m in name for m in ("negative", "unflagged", "clean")):
+            negatives.add(rule)
+        else:
+            positives.add(rule)
+    checkable = set(RULES) - {"GL000"}
+    assert checkable <= positives, \
+        f"rules missing a positive fixture: {sorted(checkable - positives)}"
+    assert checkable <= negatives, \
+        f"rules missing a negative fixture: {sorted(checkable - negatives)}"
