@@ -332,6 +332,12 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
       saturation throughput over a tiny open-loop trace (the ISSUE-12
       fleet mechanism: routing, per-replica batchers, continuous
       batching; bench.py carries the 4-replica headline).
+    * ``smoke_serve_multiproc_rps`` — TWO real engine OS processes
+      (tiny-model ``cli serve`` children) behind the real router tier,
+      wall-clock items/s through ``POST /score`` (ISSUE 17: the
+      spawn/warm handshake, content routing, sub-batch forwarding and
+      zero-post-warmup-compiles baseline are all on the measured path;
+      bench.py carries the calibrated 1-vs-3 capacity headline).
     * ``smoke_gen_decode_tok_per_sec`` — an AOT-compiled batched-beam
       decode (ISSUE 13: one physical KV cache, ancestry resolved at
       attention-read time, fixed trip count) on a tiny T5 — the
@@ -499,6 +505,70 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             raise AssertionError("fleet smoke recompiled after warmup")
         fleet_rps = max(fleet_rps, rep["rps"])
 
+    # Shared-nothing process-fleet mechanism smoke (ISSUE 17): two REAL
+    # engine children behind the real router tier — spawn/warm
+    # handshake, content routing, sub-batch forwarding, and the
+    # per-child compile baseline all on the measured path. The value is
+    # wall-clock items/s through the router (a mechanism gate — a slow
+    # forward or a lost sub-batch fails here, not in a soak); the
+    # calibrated 1-vs-N capacity headline lives in bench.py. One pass,
+    # not best-of-reps: spawning dominates and re-spawning would buy
+    # variance, not signal.
+    import threading
+    import urllib.request as _urllib_request
+
+    from deepdfa_tpu.core.config import FeatureSpec as _FeatureSpec
+    from deepdfa_tpu.serve.procfleet import ProcFleet
+    from deepdfa_tpu.serve.router import RouterHTTPServer
+
+    mp_cfg = ServeConfig(batch_slots=4, deadline_ms=200.0,
+                         queue_capacity=32, cache_capacity=0)
+    mp_fleet = ProcFleet(2, child_args=[
+        "--set", "model.hidden_dim=8", "--set", "model.n_steps=2",
+        "--batch-slots", "4", "--deadline-ms", "200",
+        "--cache-capacity", "0",
+        "--replicas", "1", "--processes", "1", "--slo", "none"])
+    mp_fleet.start()
+    mp_server = RouterHTTPServer(("127.0.0.1", 0), mp_fleet, mp_cfg)
+    threading.Thread(target=mp_server.serve_forever, daemon=True).start()
+    try:
+        # Default feature spec: the children run the default config.
+        mp_graphs = synthetic_bigvul(48, _FeatureSpec(),
+                                     positive_fraction=0.5, seed=11)
+        mp_payload = [
+            {"id": int(g["id"]),
+             "graph": {"num_nodes": int(g["num_nodes"]),
+                       "senders": np.asarray(g["senders"]).tolist(),
+                       "receivers": np.asarray(g["receivers"]).tolist(),
+                       "feats": {k: np.asarray(v).tolist()
+                                 for k, v in g["feats"].items()}}}
+            for g in mp_graphs
+        ]
+        mp_base = f"http://127.0.0.1:{mp_server.server_address[1]}"
+
+        def mp_post(chunk) -> None:
+            req = _urllib_request.Request(
+                f"{mp_base}/score",
+                data=json.dumps({"functions": chunk}).encode(),
+                headers={"Content-Type": "application/json"})
+            with _urllib_request.urlopen(req, timeout=60.0) as resp:
+                body = json.loads(resp.read())
+            if not all("prob" in r for r in body["results"]):
+                raise AssertionError(f"multiproc smoke scoring failed: "
+                                     f"{body['results'][:2]}")
+
+        mp_post(mp_payload[:8])  # warm the HTTP/forward path
+        t0 = time.perf_counter()
+        for start in range(0, len(mp_payload), 8):
+            mp_post(mp_payload[start:start + 8])
+        mp_dt = time.perf_counter() - t0
+        if mp_fleet.compiles_after_warmup():
+            raise AssertionError("multiproc smoke recompiled after warmup")
+        multiproc_rps = len(mp_payload) / mp_dt
+    finally:
+        mp_server.shutdown()
+        mp_fleet.shutdown()
+
     # Batched-beam decode mechanism smoke (ISSUE 13): tiny T5, beam 4,
     # early exit OFF so tokens/s counts exactly batch * max_len steps
     # (the comparable-trajectory rule bench_gen_decode documents).
@@ -593,6 +663,9 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(sigterm_ms, 2), "unit": "ms"},
         "smoke_serve_fleet_rps": {
             "value": round(fleet_rps, 1), "unit": "req/s"},
+        "smoke_serve_multiproc_rps": {
+            "value": round(multiproc_rps, 1), "unit": "req/s",
+            "processes": 2},
         "smoke_gen_decode_tok_per_sec": {
             "value": round(gen_tps, 1), "unit": "tok/s"},
         "smoke_graftlint_full_repo_ms": {

@@ -1300,6 +1300,13 @@ def cmd_serve(args) -> Dict[str, Any]:
     from deepdfa_tpu.serve.http import serve_forever
     from deepdfa_tpu.telemetry import slo as slo_mod
 
+    if int(args.processes) > 1:
+        # Shared-nothing multi-process serving (ISSUE 17): N engine OS
+        # processes behind the router tier. --processes 1 (the default)
+        # never reaches this branch, so the single-process server — and
+        # its /metrics JSON body — stays byte-for-byte the historic path.
+        return _cmd_serve_multiproc(args, int(args.processes))
+
     # Telemetry sink: --run-dir (default runs/serve_smoke under --smoke);
     # without one, live serving runs untraced (hooks stay no-ops).
     run_dir = args.run_dir or ("runs/serve_smoke"
@@ -1349,19 +1356,25 @@ def cmd_serve(args) -> Dict[str, Any]:
     # gate over the trace the smoke just produced. DEEPDFA_TELEMETRY=0
     # leaves no trace — the observatory is fully disabled, and the smoke
     # reports only its own functional checks.
+    return _serve_smoke_gates(report, run_dir, args.slo)
+
+
+def _serve_smoke_gates(report: Dict[str, Any], run_dir: Optional[str],
+                       slo_spec: str) -> Dict[str, Any]:
+    """The serve-smoke trace gates shared by the single-process and
+    multi-process paths: the offline SLO gate over the run the smoke
+    just produced, plus the trace-plane propagation gate (ISSUE 14) —
+    every smoke POST sent a traceparent, so coverage must be complete
+    and at least one trace id must join a client span to its
+    serve.request (across the process boundary in the multiproc case)."""
     if run_dir:
         report["telemetry"] = os.path.join(run_dir, "telemetry")
         if telemetry.enabled():
             from deepdfa_tpu.telemetry.report import trace_report
 
             trace_rep = trace_report(run_dir)
-            if args.slo != "none":
-                _apply_slo_gate(report, trace_rep, args.slo)
-            # Trace-plane gate (ISSUE 14): the smoke's merged-shard
-            # report must round-trip and show the client↔server join —
-            # every _smoke_http POST sent a traceparent, so propagation
-            # coverage on this trace must be complete and at least one
-            # trace id must join a client span to its serve.request.
+            if slo_spec != "none":
+                _apply_slo_gate(report, trace_rep, slo_spec)
             prop = trace_rep.get("propagation") or {}
             report["propagation"] = {
                 k: prop.get(k)
@@ -1380,6 +1393,186 @@ def cmd_serve(args) -> Dict[str, Any]:
         report["exit_code"] = 1
     print(json.dumps(report))
     return report
+
+
+def _multiproc_child_args(args, run_dir: Optional[str]) -> List[str]:
+    """The argv tail every engine child gets: the parent's model/
+    checkpoint/batching/lane knobs forwarded verbatim, pinned to one
+    replica and one process (no recursive fleets), child-level SLO off
+    (the router owns fleet health), and the parent's run dir so an
+    untraced parent still yields traced children."""
+    out: List[str] = []
+    for c in args.config or []:
+        out += ["--config", c]
+    for s in args.set or []:
+        out += ["--set", s]
+    if args.checkpoint_dir:
+        out += ["--checkpoint-dir", args.checkpoint_dir,
+                "--which", args.which]
+    if args.combined_checkpoint_dir:
+        out += ["--combined-checkpoint-dir", args.combined_checkpoint_dir,
+                "--combined-which", args.combined_which]
+    out += ["--batch-slots", str(args.batch_slots),
+            "--deadline-ms", str(args.deadline_ms),
+            "--queue-capacity", str(args.queue_capacity),
+            "--cache-capacity", str(args.cache_capacity),
+            "--replicas", "1", "--processes", "1", "--slo", "none"]
+    if args.adaptive_flush:
+        out += ["--adaptive-flush"]
+    if args.gen_lane or args.gen_checkpoint_dir:
+        out += ["--gen-lane", "--gen-model", args.gen_model]
+        if args.gen_checkpoint_dir:
+            out += ["--gen-checkpoint-dir", args.gen_checkpoint_dir,
+                    "--gen-which", args.gen_which]
+        if args.gen_tokenizer:
+            out += ["--gen-tokenizer", args.gen_tokenizer]
+        for flag, value in (("--gen-src-len", args.gen_src_len),
+                            ("--gen-max-len", args.gen_max_len),
+                            ("--gen-beam", args.gen_beam)):
+            if value is not None:
+                out += [flag, str(value)]
+    if getattr(args, "scan_transport", "none") != "none":
+        out += ["--scan-transport", args.scan_transport,
+                "--scan-pool-size", str(args.scan_pool_size),
+                "--scan-timeout-s", str(args.scan_timeout_s),
+                "--scan-attempts", str(args.scan_attempts),
+                "--scan-workdir", args.scan_workdir]
+        if args.scan_cache:
+            out += ["--scan-cache", args.scan_cache]
+        if getattr(args, "scan_vocabs", None):
+            out += ["--scan-vocabs", args.scan_vocabs]
+    if run_dir:
+        # Joined to the parent's run via DEEPDFA_TRACE_CONTEXT (the env
+        # wins inside the child); the flag covers the untraced-parent
+        # case so children never scatter default run dirs.
+        out += ["--run-dir", run_dir]
+    return out
+
+
+def _cmd_serve_multiproc(args, processes: int) -> Dict[str, Any]:
+    """``serve --processes N``: spawn N engine OS processes (each a
+    plain ``cli serve`` child with its own warmed engine and lifecycle)
+    and run the router tier in THIS process — the shared-nothing fleet
+    of ISSUE 17. ``--smoke N`` self-drives the router surface and runs
+    the same trace gates as the single-process smoke."""
+    import contextlib
+
+    from deepdfa_tpu.serve import router as router_mod
+    from deepdfa_tpu.serve.config import ServeConfig
+    from deepdfa_tpu.serve.procfleet import ProcFleet
+
+    run_dir = args.run_dir or ("runs/serve_smoke"
+                               if args.smoke is not None else None)
+    scope = (telemetry.run_scope(run_dir) if run_dir
+             else contextlib.nullcontext())
+    with scope:
+        config = ServeConfig(batch_slots=args.batch_slots,
+                             deadline_ms=args.deadline_ms,
+                             queue_capacity=max(args.queue_capacity,
+                                                args.batch_slots),
+                             cache_capacity=args.cache_capacity)
+        fleet = ProcFleet(processes,
+                          child_args=_multiproc_child_args(args, run_dir),
+                          host=args.host)
+        with telemetry.span("procfleet.start", n=processes):
+            fleet.start()
+        logger.info("engine fleet live: %d processes, pids %s", processes,
+                    [p["pid"] for p in fleet.processes().values()])
+        try:
+            if args.smoke is not None:
+                report = _smoke_multiproc(fleet, config, args.host,
+                                          args.smoke, args)
+            else:
+                from deepdfa_tpu.resilience import lifecycle
+
+                coordinator = lifecycle.fresh()
+                try:
+                    notice = router_mod.serve_forever_router(
+                        fleet, config, args.host, args.port,
+                        port_file=getattr(args, "port_file", None))
+                finally:
+                    coordinator.uninstall()
+                if notice is not None:
+                    coordinator.complete()
+                    return {"preempted": True, "reason": notice.reason,
+                            "exit_code": lifecycle.EXIT_PREEMPTED}
+                return {}
+        finally:
+            fleet.shutdown()
+    return _serve_smoke_gates(report, run_dir, args.slo)
+
+
+def _smoke_multiproc(fleet, config, host: str, n: int,
+                     args) -> Dict[str, Any]:
+    """Self-drive the multi-process stack: synthetic chunks through the
+    router (batching + rendezvous affinity), a duplicated chunk that
+    must answer from the children's content caches, then the aggregated
+    /metrics — all processes live, zero post-warmup compiles through
+    the router."""
+    import threading
+    import urllib.request
+
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.serve.router import RouterHTTPServer
+    from deepdfa_tpu.telemetry import context as trace_context
+
+    model_cfg = build_configs(args.config, args.set)["model"]
+    server = RouterHTTPServer((host, 0), fleet, config)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://{host}:{server.server_address[1]}"
+
+    def post(doc, path="/score"):
+        trace_id = trace_context.new_trace_id()
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json",
+                     trace_context.TRACEPARENT_HEADER:
+                         trace_context.make_traceparent(trace_id)},
+        )
+        t0 = telemetry.now()
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())
+        finally:
+            telemetry.record_span("client.request", t0,
+                                  trace_id=trace_id, path=path,
+                                  n=len(doc.get("functions", [])))
+
+    try:
+        graphs = synthetic_bigvul(n, model_cfg.feature,
+                                  positive_fraction=0.5, seed=0)
+        payload = [
+            {"id": int(g["id"]),
+             "graph": {"num_nodes": int(g["num_nodes"]),
+                       "senders": np.asarray(g["senders"]).tolist(),
+                       "receivers": np.asarray(g["receivers"]).tolist(),
+                       "feats": {k: np.asarray(v).tolist()
+                                 for k, v in g["feats"].items()}}}
+            for g in graphs
+        ]
+        results = []
+        chunk = max(config.batch_slots // 2, 1)
+        for start in range(0, n, chunk):
+            results += post(
+                {"functions": payload[start:start + chunk]}
+            )["results"]
+        # Duplicate the first chunk: rendezvous affinity must land each
+        # function on the process whose cache already holds it.
+        dup = post({"functions": payload[:chunk]})["results"]
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        caw = fleet.compiles_after_warmup()
+        live = sum(1 for p in fleet.processes().values()
+                   if p["state"] == "live")
+        ok = (all("prob" in r for r in results)
+              and all(r.get("cached") for r in dup)
+              and live == fleet.n and caw == 0)
+        return {"smoke": n, "ok": ok, "cached_replay": len(dup),
+                "processes": live, "compiles_after_warmup": caw,
+                "metrics": metrics}
+    finally:
+        server.shutdown()
 
 
 def cmd_score(args) -> Dict[str, Any]:
@@ -2105,6 +2298,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                             "the device mesh with its own micro-batcher "
                             "and pump thread (env DEEPDFA_SERVE_REPLICAS; "
                             "bounded by the static replica-id set, max 8)")
+        # Same string-default discipline for the engine-PROCESS count
+        # (ISSUE 17): a malformed DEEPDFA_SERVE_PROCESSES surfaces as a
+        # clean parser error, never an import-time crash.
+        p.add_argument("--processes", type=int,
+                       default=os.environ.get(
+                           "DEEPDFA_SERVE_PROCESSES", "1"),
+                       help="engine OS processes behind an in-process "
+                            "router tier: each child owns its own AOT-"
+                            "warmed engine, batcher, and lifecycle; the "
+                            "router preserves content-affine routing and "
+                            "re-routes around dead children (env "
+                            "DEEPDFA_SERVE_PROCESSES; bounded by the "
+                            "static process-id set, max 8; 1 = the "
+                            "historic single-process server)")
         p.add_argument("--adaptive-flush", action="store_true",
                        default=os.environ.get(
                            "DEEPDFA_ADAPTIVE_FLUSH", "") not in ("", "0"),

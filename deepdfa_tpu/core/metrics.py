@@ -164,6 +164,13 @@ class ServingStats:
         self._replica = replica
         self.occupancy_used = 0   # real requests over all flushed batches
         self.occupancy_slots = 0  # padded slots over all flushed batches
+        # Per-(lane, bucket) flush shapes (ISSUE 17): how much of each
+        # compiled bucket's slot budget real traffic actually fills —
+        # the measured input the traffic-shaped dynamic-batching work
+        # needs. Keys are (lane, n_slots); both come from code-
+        # enumerated sets (lane names, the config slot ladder), so the
+        # derived gauge names stay GL014-bounded.
+        self._padding: Dict[tuple, list] = {}
         self._latency_window = latency_window
         self._latencies_ms = np.zeros(latency_window, np.float64)
         self._latency_count = 0  # total ever observed (ring write cursor)
@@ -193,11 +200,29 @@ class ServingStats:
                 f"serve_{self._replica}_latency_ms").observe(
                     seconds * 1000.0)
 
-    def record_batch(self, n_real: int, n_slots: int) -> None:
-        with self._lock:
-            self.batches += 1
-            self.occupancy_used += n_real
-            self.occupancy_slots += n_slots
+    def record_batch(self, n_real: int, n_slots: int,
+                     lane: "str | None" = None) -> None:
+        if lane is not None:
+            with self._lock:
+                self.batches += 1
+                self.occupancy_used += n_real
+                self.occupancy_slots += n_slots
+                cell = self._padding.setdefault((lane, int(n_slots)), [0, 0])
+                cell[0] += n_real
+                cell[1] += n_slots
+                waste_pct = 100.0 * (1.0 - cell[0] / cell[1])
+            # Gauge name formatted from the lane parameter, the config
+            # slot ladder, and the statically-enumerated replica id —
+            # never from per-request data (GL014).
+            suffix = f"_{self._replica}" if self._replica else ""
+            REGISTRY.gauge(
+                f"serve_padding_waste_pct_{lane}_b{int(n_slots)}{suffix}"
+            ).set(round(waste_pct, 4))
+        else:
+            with self._lock:
+                self.batches += 1
+                self.occupancy_used += n_real
+                self.occupancy_slots += n_slots
         REGISTRY.counter("serve_batches_total").inc()
         REGISTRY.counter("serve_slots_occupied_total").inc(n_real)
         REGISTRY.counter("serve_slots_padded_total").inc(n_slots - n_real)
@@ -233,7 +258,17 @@ class ServingStats:
             latency_p50_ms=latency_quantile(lat, 0.50),
             latency_p99_ms=latency_quantile(lat, 0.99),
             latency_samples=int(lat.size),
+            padding_waste_pct=round(100.0 * (1.0 - self.occupancy), 4)
+            if self.occupancy_slots else 0.0,
         )
+        with self._lock:
+            padding = {f"{lane}:b{slots}": {
+                "used": used, "slots": total,
+                "waste_pct": round(100.0 * (1.0 - used / total), 2)}
+                for (lane, slots), (used, total)
+                in sorted(self._padding.items())}
+        if padding:
+            out["padding_waste"] = padding
         return out
 
 

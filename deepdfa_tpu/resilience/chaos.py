@@ -1,7 +1,7 @@
 """Chaos soak: the ``cli chaos`` engine.
 
 One deterministic end-to-end run that provokes every fault class the
-resilience layer claims to survive (eleven distinct fault kinds — the
+resilience layer claims to survive (twelve distinct fault kinds — the
 acceptance gate asks for >= 3) and verifies the recovery behavior, on a
 tiny synthetic workload sized for seconds on CPU:
 
@@ -59,6 +59,15 @@ tiny synthetic workload sized for seconds on CPU:
   other two keep serving every POST, fleet ``/healthz`` degrades then
   recovers, and compiles stay flat across the roll (re-entry reuses the
   warmed executables).
+* ``proc_crash`` — a **real SIGKILL** to one of THREE engine OS
+  processes behind the router tier (serve/procfleet.py) under
+  three-thread live HTTP load: every admitted POST is still answered
+  with scores (the forward that died with the victim re-routes to a
+  sibling), the router sheds to the survivors while ``/healthz``
+  degrades, a warmed replacement rejoins at a bumped generation with
+  zero post-warmup compiles measured THROUGH the router, and ONE merged
+  trace shows kill/shed/rejoin across >= 4 real (process, pid)
+  identities.
 
 Every scenario reports ``ok`` plus enough detail to debug a regression;
 ``run_soak`` aggregates them and the CLI exits nonzero unless all pass.
@@ -1426,6 +1435,268 @@ def scenario_fleet_roll(out_dir: str) -> Dict[str, Any]:
     }
 
 
+def scenario_proc_crash(out_dir: str) -> Dict[str, Any]:
+    """The shared-nothing crash-isolation scenario (ISSUE 17): a real
+    **SIGKILL** to one of THREE engine OS processes (each a spawned
+    ``cli serve`` child with its own warmed engine) in the middle of
+    three-thread live HTTP load through the router tier. Demands:
+
+    * **zero dropped admitted requests** — every load POST the router
+      admits is answered 200 with scores: a forward that dies with the
+      victim is re-routed to a live sibling, never surfaced to the
+      client (scoring is pure, so re-execution is safe);
+    * **the router sheds to siblings** — ``/healthz`` degrades (503,
+      live < 3) after the kill and routing excludes the dead slot while
+      the replacement warms, yet a mid-outage POST still scores;
+    * **a warmed replacement rejoins** — ``/healthz`` recovers to 200
+      "ok" with 3 live and the victim slot at generation >= 1, with
+      zero post-warmup compiles fleet-wide measured THROUGH the router
+      (the per-child baseline recorded at spawn);
+    * **one merged trace shows the whole story** — ``proc.spawn`` /
+      ``proc.dead`` / ``proc.live`` instants across >= 4 distinct
+      (process, pid) shard identities, zero ``jax.compile`` after each
+      engine shard's last warmup marker, and every *surviving* engine's
+      admitted rids completed (the victim's mid-flight admissions are
+      exactly the re-routed ones).
+    """
+    import json as _json
+    import signal as _signal
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.cli import build_configs
+    from deepdfa_tpu.serve import ServeConfig
+    from deepdfa_tpu.serve.procfleet import ProcFleet
+    from deepdfa_tpu.serve.router import RouterHTTPServer
+
+    active = telemetry.current_run()
+    t_window = active.now() if active is not None else 0.0
+    sets = ["model.hidden_dim=8", "model.n_steps=2"]
+    child_args: List[str] = []
+    for s in sets:
+        child_args += ["--set", s]
+    child_args += ["--batch-slots", "4", "--deadline-ms", "500",
+                   "--queue-capacity", "64", "--cache-capacity", "512",
+                   "--replicas", "1", "--processes", "1", "--slo", "none",
+                   # Joined to this run via DEEPDFA_TRACE_CONTEXT (env
+                   # wins); the flag covers the untraced-soak case so
+                   # children never scatter default run dirs.
+                   "--run-dir", os.path.join(out_dir, "proc_crash_children")]
+    config = ServeConfig(batch_slots=4, deadline_ms=500.0,
+                         queue_capacity=64, cache_capacity=512)
+    fleet = ProcFleet(3, child_args=child_args,
+                      probe_interval_s=0.25, probe_timeout_s=1.0,
+                      probe_failures=2, spawn_deadline_s=240.0,
+                      drain_grace_s=5.0)
+    fleet.start()
+    server = RouterHTTPServer(("127.0.0.1", 0), fleet, config)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    model_cfg = build_configs([], sets)["model"]
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+
+    graphs = synthetic_bigvul(32, model_cfg.feature, positive_fraction=0.5,
+                              seed=23)
+    payload = [
+        {"id": int(g["id"]),
+         "graph": {"num_nodes": int(g["num_nodes"]),
+                   "senders": np.asarray(g["senders"]).tolist(),
+                   "receivers": np.asarray(g["receivers"]).tolist(),
+                   "feats": {k: np.asarray(v).tolist()
+                             for k, v in g["feats"].items()}}}
+        for g in graphs
+    ]
+
+    def post(chunk, timeout=90.0):
+        req = urllib.request.Request(
+            f"{base}/score", data=_json.dumps({"functions": chunk}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+        except (urllib.error.URLError, OSError) as e:
+            return None, {"error": str(e)}
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=10.0) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+
+    # Sustained load: three client threads, two functions per POST —
+    # partial sub-batches in flight across the kill.
+    load_results: List[Any] = []
+    load_lock = threading.Lock()
+    stop_load = threading.Event()
+
+    def load_thread(tid: int):
+        i = 0
+        while not stop_load.is_set():
+            start = (8 * tid + 2 * (i % 4)) % (len(payload) - 2)
+            status, body = post(payload[start:start + 2])
+            with load_lock:
+                load_results.append((status, body))
+            i += 1
+
+    threads = [threading.Thread(target=load_thread, args=(tid,))
+               for tid in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)  # load established, forwards cycling
+
+    # The SIGKILL: victim pid read from the fleet's own routing table
+    # (what /metrics exposes under "processes").
+    victim = "p1"
+    victim_pid = int(fleet.processes()[victim]["pid"])
+    os.kill(victim_pid, _signal.SIGKILL)
+
+    # Shed: /healthz degrades and routing excludes the dead slot while
+    # the auto-respawned replacement warms; a fresh POST still scores.
+    saw_degraded = False
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and not saw_degraded:
+        status, doc = healthz()
+        if status == 503 and doc.get("status") == "degraded" \
+                and doc.get("live") == 2:
+            saw_degraded = True
+        time.sleep(0.02)
+    routed_clean = all(fleet.route(f"probe-{i}").rid != victim
+                       for i in range(16))
+    mid_status, mid_body = post(payload[-2:])
+    mid_ok = (mid_status == 200
+              and all("prob" in r for r in mid_body.get("results", [])))
+
+    # Rejoin: the replacement (generation >= 1) warms and goes live —
+    # minutes-scale on a shared CPU, so the deadline is generous.
+    saw_recovered = False
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline and not saw_recovered:
+        status, doc = healthz()
+        slot = doc.get("processes", {}).get(victim, {})
+        if status == 200 and doc.get("status") == "ok" \
+                and doc.get("live") == 3 \
+                and int(slot.get("generation", 0)) >= 1:
+            saw_recovered = True
+        time.sleep(0.1)
+    time.sleep(1.0)  # a post-rejoin load slice lands on the replacement too
+    stop_load.set()
+    for t in threads:
+        t.join(timeout=120.0)
+    compiles_after = fleet.compiles_after_warmup()
+    server.shutdown()
+    fleet.shutdown()  # SIGTERM drain: children flush their trace shards
+
+    with load_lock:
+        results = list(load_results)
+    all_answered = bool(results) and all(
+        status == 200 and all("prob" in r for r in body.get("results", []))
+        for status, body in results
+    )
+
+    # Merged-trace audit (skipped untraced): the kill/shed/rejoin story
+    # across real pids, from ONE run's shards.
+    trace: Dict[str, Any] = {"checked": False}
+    run = telemetry.current_run()
+    if run is not None and telemetry.enabled():
+        telemetry.flush()
+        events = [e for e in _read_events(run.run_dir)
+                  if float(e.get("ts", 0.0)) >= t_window]
+
+        def _attr(e, key, default=None):
+            return (e.get("attrs") or {}).get(key, default)
+
+        spawns = [e for e in events if e.get("name") == "proc.spawn"]
+        deaths = [e for e in events if e.get("name") == "proc.dead"]
+        replacement_live = [
+            e for e in events if e.get("name") == "proc.live"
+            and _attr(e, "proc") == victim
+            and int(_attr(e, "generation", 0)) >= 1]
+        idents = {(e.get("_process"), e.get("_pid")) for e in events
+                  if str(e.get("_process") or "").startswith("engine-")}
+
+        # Per engine shard: compiles only before that shard's own last
+        # warmup marker, and (survivors only) every admitted rid has a
+        # completed serve.request span. The victim's shard is exempt
+        # from the rid join — its mid-flight admissions are exactly the
+        # ones the router re-routed.
+        by_shard: Dict[Any, List[Dict[str, Any]]] = {}
+        for e in events:
+            p = e.get("_process")
+            if isinstance(p, str) and p.startswith("engine-"):
+                by_shard.setdefault((p, e.get("_pid")), []).append(e)
+        late_compiles = 0
+        admissions = 0
+        dropped: List[str] = []
+        for (pname, pid), shard in sorted(by_shard.items(),
+                                          key=lambda kv: str(kv[0])):
+            warmups = [float(e["ts"]) for e in shard
+                       if e.get("name") == "serve.warmup_done"]
+            boundary = max(warmups) if warmups else t_window
+            late_compiles += len([e for e in shard
+                                  if e.get("name") == "jax.compile"
+                                  and float(e["ts"]) > boundary])
+            if pid == victim_pid:
+                continue
+            enq = {_attr(e, "rid") for e in shard
+                   if e.get("name") == "serve.enqueue"}
+            done = {_attr(e, "rid") for e in shard
+                    if e.get("kind") == "span"
+                    and e.get("name") == "serve.request"}
+            admissions += len(enq)
+            dropped += [f"{pname}:{r}" for r in sorted(
+                (r for r in enq - done), key=str)]
+        trace = {
+            "checked": True,
+            "spawns": len(spawns),
+            "deaths": len(deaths),
+            "death_reasons": sorted({str(_attr(e, "reason"))
+                                     for e in deaths}),
+            "replacement_live": len(replacement_live),
+            "process_identities": len(idents),
+            "admissions": admissions,
+            "dropped_rids": dropped[:8],
+            "compiles_after_warmup_trace": late_compiles,
+        }
+
+    ok = bool(
+        all_answered
+        and saw_degraded and saw_recovered
+        and routed_clean and mid_ok
+        and compiles_after == 0
+        and (not trace["checked"]
+             or (trace["spawns"] >= 4
+                 and trace["deaths"] >= 1
+                 and trace["replacement_live"] >= 1
+                 and trace["process_identities"] >= 4
+                 and trace["admissions"]
+                 and not trace["dropped_rids"]
+                 and trace["compiles_after_warmup_trace"] == 0))
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["sigkill-process"],
+        "processes": 3,
+        "victim": victim,
+        "victim_pid": victim_pid,
+        "load_posts": len(results),
+        "all_answered": all_answered,
+        "healthz_degraded": saw_degraded,
+        "healthz_recovered": saw_recovered,
+        "router_shunned_victim": routed_clean,
+        "served_during_outage": mid_ok,
+        "compiles_after_warmup": compiles_after,
+        "trace": trace,
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -1448,6 +1719,7 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
         out_dir, n_examples, epochs)
     scenarios["serve_lame_duck"] = scenario_serve_lame_duck(out_dir)
     scenarios["fleet_roll"] = scenario_fleet_roll(out_dir)
+    scenarios["proc_crash"] = scenario_proc_crash(out_dir)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
@@ -1459,7 +1731,8 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
                "scan_joern_deaths": "joern-worker-kill",
                "preempt_drain": "sigterm-drain",
                "serve_lame_duck": "sigterm-lame-duck",
-               "fleet_roll": "replica-roll"}
+               "fleet_roll": "replica-roll",
+               "proc_crash": "sigkill-process"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

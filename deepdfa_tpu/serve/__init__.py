@@ -22,9 +22,16 @@ Layout:
               lanes gnn/combined/gen (gen: batched-beam CodeT5 decode,
               warmed per (slot, src-length-bucket) — ISSUE 13)
   fleet.py    ServeFleet: N device-pinned replicas, routing, rolls
+  procfleet.py ProcFleet: N engine OS processes (real ``cli serve``
+              children), spawn/probe/roll/reap, PROCESS_IDS —
+              shared-nothing crash domains (ISSUE 17)
+  router.py   RouterHTTPServer: the accept/route tier in front of a
+              ProcFleet — /score scatter by content key, /metrics
+              aggregation, crash re-route to siblings
   http.py     stdlib http.server JSON endpoint (cli.py serve)
   replay.py   seeded bursty traces + virtual-clock replay + the
-              open-loop fleet load harness (bench, tests)
+              open-loop fleet load harness + the calibrated
+              process-timeline replay (bench, tests)
 
 Design anchors: Just-in-Time Dynamic-Batching (arXiv:1904.07421) for the
 deadline-aware flush policy; Fast Training of Sparse GNNs on Dense
@@ -38,16 +45,27 @@ from deepdfa_tpu.serve.batcher import (
     ServeRequest,
 )
 from deepdfa_tpu.serve.cache import ResultCache, content_hash, text_hash
-from deepdfa_tpu.serve.config import MAX_REPLICAS, REPLICA_IDS, ServeConfig
+from deepdfa_tpu.serve.config import (
+    MAX_PROCESSES,
+    MAX_REPLICAS,
+    PROCESS_IDS,
+    REPLICA_IDS,
+    ServeConfig,
+)
 from deepdfa_tpu.serve.engine import ServeEngine
 from deepdfa_tpu.serve.fleet import ServeFleet
 from deepdfa_tpu.serve.policy import AdaptiveFlushPolicy
+from deepdfa_tpu.serve.procfleet import NoLiveProcessError, ProcFleet
 
 __all__ = [
     "AdaptiveFlushPolicy",
+    "MAX_PROCESSES",
     "MAX_REPLICAS",
     "MicroBatcher",
+    "NoLiveProcessError",
     "OversizedError",
+    "PROCESS_IDS",
+    "ProcFleet",
     "REPLICA_IDS",
     "RejectedError",
     "ResultCache",

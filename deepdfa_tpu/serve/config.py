@@ -26,6 +26,15 @@ from deepdfa_tpu.graphs.batch import select_bucket
 REPLICA_IDS = ("r0", "r1", "r2", "r3", "r4", "r5", "r6", "r7")
 MAX_REPLICAS = len(REPLICA_IDS)
 
+# The statically-enumerated engine-PROCESS id set (ISSUE 17), the same
+# discipline one level up: a shared-nothing fleet of OS processes behind
+# the router tier (serve/procfleet.py). Every per-process metric or
+# trace-process name is formatted from a member of THIS tuple, so
+# cardinality stays code-bounded across restarts — a replacement process
+# reuses its predecessor's id with a bumped generation.
+PROCESS_IDS = ("p0", "p1", "p2", "p3", "p4", "p5", "p6", "p7")
+MAX_PROCESSES = len(PROCESS_IDS)
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:

@@ -713,7 +713,7 @@ class ServeEngine:
             done = self._clock()
         t_done = telemetry.now()
         self.in_flight = 0
-        self.stats.record_batch(len(reqs), slots)
+        self.stats.record_batch(len(reqs), slots, lane=lane_name)
         for i, r in enumerate(reqs):
             # The cache line holds only content-derived values; "degraded"
             # describes THIS request's handling (its tokenizer failure),

@@ -514,9 +514,25 @@ class ServeFleet:
             latency_p50_ms=latency_quantile(lat, 0.50),
             latency_p99_ms=latency_quantile(lat, 0.99),
             latency_samples=int(lat.size),
+            padding_waste_pct=round(100.0 * (1.0 - used / slots), 4)
+            if slots else 0.0,
             n_replicas=len(self.replicas),
             replicas=per,
         )
+        # Per-(lane, bucket) padding merges exactly on used/slot counts
+        # across replicas (each replica's snapshot carries its own).
+        padding: Dict[str, Dict[str, float]] = {}
+        for snap in per.values():
+            for key, cell in (snap.get("padding_waste") or {}).items():
+                acc = padding.setdefault(key, {"used": 0, "slots": 0})
+                acc["used"] += cell["used"]
+                acc["slots"] += cell["slots"]
+        for cell in padding.values():
+            cell["waste_pct"] = round(
+                100.0 * (1.0 - cell["used"] / cell["slots"]), 2
+            ) if cell["slots"] else 0.0
+        if padding:
+            out["padding_waste"] = padding
         return out
 
     def health(self) -> Dict[str, Any]:

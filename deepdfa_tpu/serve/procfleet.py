@@ -1,0 +1,490 @@
+"""Shared-nothing multi-process serving: the engine-process fleet.
+
+PR 11's :class:`~deepdfa_tpu.serve.fleet.ServeFleet` is N replicas
+inside ONE Python process — one GIL, one crash domain. This module is
+the same fleet idea promoted across the process boundary: each engine
+is a real OS process (``python -m deepdfa_tpu.cli serve --port 0``)
+owning its own AOT-warmed :class:`ServeEngine`, micro-batcher, pump
+threads, and lifecycle coordinator, while THIS process runs only the
+thin accept/route tier (serve/router.py).
+
+Design points, in dependency order:
+
+* **Spawn**: children are plain ``Popen`` (fork+exec — safe after
+  threads exist) with env from :func:`telemetry.context.child_env`, so
+  every child shards its telemetry into the parent's run and the merged
+  trace shows the whole fleet with real pids. Readiness is the historic
+  port-file handshake: ``cmd_serve`` writes the bound port only after
+  warmup, so a port file IS the warm signal. The spawn then records the
+  child's warmup compile count through ``/metrics`` — the
+  zero-post-warmup-compiles assertion is checked against that baseline
+  through the router, not inside the child.
+* **Health**: a single probe thread polls every live child's
+  ``/healthz``; ``probe_failures`` consecutive timeouts/refusals (or an
+  observed child exit) mark the process dead, shed its traffic to
+  siblings, and (by default) start a warmed replacement under the same
+  statically-enumerated process id with a bumped generation.
+* **Roll**: a rolling restart spawns the replacement FIRST, warms it to
+  the same zero-compile bar, atomically swaps it into the routing
+  table, then SIGTERMs the old process — its own PR-10 lifecycle
+  coordinator runs the lame-duck drain (admitted requests answered,
+  telemetry closed) before this process reaps it.
+* **Routing state**: the fleet tracks a router-side ``outstanding``
+  item count per process — the cross-process stand-in for the
+  in-process fleet's ``engine.in_flight``/queue-depth override, so
+  rendezvous content affinity still yields to load (the
+  continuous-batching admission property survives the promotion).
+
+Every wait on a child is deadline-bounded, and every kill precedes an
+unbounded-looking reap (GL015/GL025); all mutable state is
+instance-level behind one lock created in ``__init__`` (GL018/GL022),
+and no child forward happens while the lock is held (GL023).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.serve.config import MAX_PROCESSES, PROCESS_IDS
+from deepdfa_tpu.serve.fleet import _stable_hash
+from deepdfa_tpu.telemetry import context as trace_context
+
+logger = logging.getLogger("deepdfa.serve.procfleet")
+
+
+class NoLiveProcessError(Exception):
+    """Every engine process is dead or draining — the router answers
+    503 and keeps probing; admitted work already forwarded is still
+    being answered behind this."""
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+
+
+class EngineProc:
+    """One engine OS process plus its router-side routing state."""
+
+    def __init__(self, rid: str, generation: int):
+        self.rid = rid
+        self.generation = generation
+        self.popen: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.state = "starting"  # starting | live | draining | dead
+        self.outstanding = 0     # router-tracked in-flight items
+        self.probe_failures = 0
+        self.compiles_at_live: Optional[float] = None
+        self.spawned_at = time.monotonic()
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.popen.pid if self.popen is not None else None
+
+    def describe(self) -> Dict[str, object]:
+        return {"pid": self.pid, "port": self.port, "state": self.state,
+                "generation": self.generation,
+                "outstanding": self.outstanding}
+
+
+class ProcFleet:
+    """N engine processes behind one router process.
+
+    ``child_args`` are appended to every child's
+    ``deepdfa_tpu.cli serve`` argv (model config, batch knobs,
+    ``--run-dir`` — everything except the port plumbing this class
+    owns). Tests may override ``argv_for(rid, port_file)`` to front a
+    stub child; the default argv names ``deepdfa_tpu.cli``, so its env
+    always comes from the trace-context ``child_env`` helper (GL020).
+    """
+
+    def __init__(self, n: int, child_args: Sequence[str] = (), *,
+                 host: str = "127.0.0.1",
+                 probe_interval_s: Optional[float] = None,
+                 probe_timeout_s: Optional[float] = None,
+                 probe_failures: Optional[int] = None,
+                 spawn_deadline_s: Optional[float] = None,
+                 drain_grace_s: Optional[float] = None,
+                 auto_respawn: bool = True,
+                 argv_for: Optional[Callable[[str, str], List[str]]] = None,
+                 child_env: Optional[Callable[[str], Dict[str, str]]] = None,
+                 state_dir: Optional[str] = None):
+        if not 1 <= n <= MAX_PROCESSES:
+            raise ValueError(
+                f"processes must be in [1, {MAX_PROCESSES}] (the statically-"
+                "enumerated PROCESS_IDS set bounds per-process metric and "
+                "trace cardinality; grow it in serve/config.py to go wider)")
+        self.n = n
+        self.host = host
+        self.child_args = list(child_args)
+        self.probe_interval_s = (
+            probe_interval_s if probe_interval_s is not None
+            else _env_float("DEEPDFA_SERVE_PROBE_INTERVAL_S", 1.0))
+        self.probe_timeout_s = (
+            probe_timeout_s if probe_timeout_s is not None
+            else _env_float("DEEPDFA_SERVE_PROBE_TIMEOUT_S", 2.0))
+        self.probe_failures = (
+            probe_failures if probe_failures is not None
+            else int(_env_float("DEEPDFA_SERVE_PROBE_FAILURES", 2)))
+        self.spawn_deadline_s = (
+            spawn_deadline_s if spawn_deadline_s is not None
+            else _env_float("DEEPDFA_SERVE_SPAWN_DEADLINE_S", 300.0))
+        self.drain_grace_s = (
+            drain_grace_s if drain_grace_s is not None
+            else _env_float("DEEPDFA_DRAIN_GRACE_S", 10.0))
+        self.auto_respawn = auto_respawn
+        self._argv_for = argv_for or self._default_argv
+        self._proc_child_env = child_env or self._default_child_env
+        self._dir = state_dir or tempfile.mkdtemp(prefix="deepdfa-procfleet-")
+        self._lock = threading.Lock()
+        self._procs: Dict[str, EngineProc] = {}
+        self._spawn_errors: Dict[str, str] = {}
+        self._rr = 0
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._workers: List[threading.Thread] = []
+
+    # -- spawn / readiness -------------------------------------------------
+
+    def _default_argv(self, rid: str, port_file: str) -> List[str]:
+        return [sys.executable, "-m", "deepdfa_tpu.cli", "serve",
+                "--host", self.host, "--port", "0",
+                "--port-file", port_file, *self.child_args]
+
+    def _default_child_env(self, rid: str) -> Dict[str, str]:
+        # The child joins the parent's telemetry run: one merged trace
+        # shows the router and every engine process with real pids.
+        return trace_context.child_env(f"engine-{rid}")
+
+    def start(self) -> None:
+        """Spawn every engine process and block until all are live
+        (port bound, warm, zero-compile baseline recorded) or raise
+        after a deadline-bounded wait, reaping any stragglers."""
+        rids = PROCESS_IDS[: self.n]
+        threads = [threading.Thread(target=self._spawn, args=(rid, 0),
+                                    name=f"spawn-{rid}", daemon=True)
+                   for rid in rids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.spawn_deadline_s + 30.0)
+        failed = [rid for rid in rids
+                  if (p := self._procs.get(rid)) is None or p.state != "live"]
+        if failed:
+            errors = {rid: self._spawn_errors.get(rid, "spawn timed out")
+                      for rid in failed}
+            self.shutdown()
+            raise RuntimeError(f"engine processes failed to start: {errors}")
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="procfleet-probe", daemon=True)
+        self._probe_thread.start()
+
+    def _spawn(self, rid: str, generation: int) -> bool:
+        """Spawn one engine process, wait for warm-readiness, then
+        atomically install it in the routing table. Returns True when
+        the process reached live."""
+        proc = EngineProc(rid, generation)
+        port_file = os.path.join(self._dir, f"{rid}.g{generation}.port")
+        stderr_path = os.path.join(self._dir, f"{rid}.g{generation}.stderr")
+        argv = self._argv_for(rid, port_file)
+        env = self._proc_child_env(rid)
+        with open(stderr_path, "wb") as errf:
+            proc.popen = subprocess.Popen(argv, env=env,
+                                          stdout=subprocess.DEVNULL,
+                                          stderr=errf)
+        telemetry.event("proc.spawn", proc=rid, pid=proc.pid,
+                        generation=generation)
+        deadline = time.monotonic() + self.spawn_deadline_s
+        port: Optional[int] = None
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if os.path.exists(port_file):
+                with open(port_file, encoding="utf-8") as f:
+                    text = f.read().strip()
+                if text:
+                    port = int(text)
+                    break
+            if proc.popen.poll() is not None:
+                break
+            time.sleep(0.05)
+        if port is None:
+            self._fail_spawn(proc, stderr_path,
+                             "never bound its port (warmup wedged or "
+                             "startup crashed)")
+            return False
+        proc.port = port
+        # The port file is written after warmup, so the child is already
+        # serving. Record the warmup-compile baseline through its own
+        # /metrics: every later compile is a post-warmup compile.
+        snap = self._fetch_json(proc, "/metrics", deadline - time.monotonic())
+        if snap is None:
+            self._fail_spawn(proc, stderr_path,
+                             "bound its port but never answered /metrics")
+            return False
+        proc.compiles_at_live = float(snap.get("compiles", 0))
+        with self._lock:
+            old = self._procs.get(rid)
+            proc.state = "live"
+            self._procs[rid] = proc  # atomic routing swap
+            self._spawn_errors.pop(rid, None)
+        telemetry.event("proc.live", proc=rid, pid=proc.pid, port=port,
+                        generation=generation,
+                        spawn_s=round(time.monotonic() - proc.spawned_at, 3),
+                        warmup_compiles=proc.compiles_at_live)
+        if old is not None and old is not proc and old.state != "dead":
+            # Rolling replacement: the predecessor is out of rotation the
+            # moment the swap above lands; drain and reap it.
+            self._retire(old)
+        return True
+
+    def _fail_spawn(self, proc: EngineProc, stderr_path: str,
+                    why: str) -> None:
+        tail = ""
+        try:
+            with open(stderr_path, "rb") as f:
+                tail = f.read()[-2000:].decode("utf-8", "replace")
+        except OSError:
+            pass
+        self._reap(proc, grace_s=0.0)
+        proc.state = "dead"
+        msg = f"{why}; stderr tail: {tail!r}" if tail else why
+        with self._lock:
+            self._spawn_errors[proc.rid] = msg
+        telemetry.event("proc.dead", proc=proc.rid, pid=proc.pid,
+                        generation=proc.generation, reason="spawn")
+        logger.error("engine %s g%d failed to start: %s", proc.rid,
+                     proc.generation, msg)
+
+    def _reap(self, proc: EngineProc, grace_s: float) -> Optional[int]:
+        """SIGTERM (when grace allows) then kill-then-wait: the wait is
+        always bounded because a kill precedes it (GL015)."""
+        popen = proc.popen
+        if popen is None:
+            return None
+        if grace_s > 0 and popen.poll() is None:
+            try:
+                popen.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                popen.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                pass
+        if popen.poll() is None:
+            try:
+                popen.kill()
+            except OSError:
+                pass
+        try:
+            popen.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            logger.error("engine %s pid %s did not exit after SIGKILL",
+                         proc.rid, popen.pid)
+        telemetry.event("proc.reap", proc=proc.rid, pid=popen.pid,
+                        generation=proc.generation,
+                        exit=popen.returncode)
+        return popen.returncode
+
+    def _retire(self, proc: EngineProc) -> None:
+        """Lame-duck an out-of-rotation predecessor: SIGTERM lets its
+        own lifecycle coordinator answer admitted requests and close
+        telemetry; the bounded reap backstops a wedged drain."""
+        proc.state = "draining"
+        self._reap(proc, grace_s=self.drain_grace_s + 15.0)
+        proc.state = "dead"
+
+    # -- health / crash isolation ------------------------------------------
+
+    def _fetch_json(self, proc: EngineProc, path: str,
+                    timeout_s: float) -> Optional[dict]:
+        if proc.port is None:
+            return None
+        conn = http.client.HTTPConnection(self.host, proc.port,
+                                          timeout=max(timeout_s, 0.1))
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            return json.loads(body.decode("utf-8"))
+        except (OSError, ValueError):
+            return None
+        finally:
+            conn.close()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            for proc in self.live():
+                if self._stop.is_set():
+                    return
+                if proc.popen is not None and proc.popen.poll() is not None:
+                    self.mark_dead(proc.rid, "exited",
+                                   generation=proc.generation)
+                    continue
+                doc = self._fetch_json(proc, "/healthz",
+                                       self.probe_timeout_s)
+                if doc is None:
+                    proc.probe_failures += 1
+                    if proc.probe_failures >= self.probe_failures:
+                        self.mark_dead(proc.rid, "probe",
+                                       generation=proc.generation)
+                else:
+                    proc.probe_failures = 0
+
+    def mark_dead(self, rid: str, reason: str, *,
+                  generation: Optional[int] = None) -> bool:
+        """Take a process out of rotation (crash isolation): its traffic
+        sheds to siblings immediately; a warmed replacement is started
+        under the same id unless respawn is off or shutdown began.
+        Returns False when the process was already dead or replaced."""
+        with self._lock:
+            proc = self._procs.get(rid)
+            if proc is None or proc.state != "live":
+                return False
+            if generation is not None and proc.generation != generation:
+                return False  # a replacement already took the slot
+            proc.state = "dead"
+        telemetry.event("proc.dead", proc=rid, pid=proc.pid,
+                        generation=proc.generation, reason=reason)
+        telemetry.REGISTRY.counter("router_proc_deaths_total").inc()
+        logger.warning("engine %s g%d pid %s marked dead (%s)", rid,
+                       proc.generation, proc.pid, reason)
+        self._reap(proc, grace_s=0.0)
+        if self.auto_respawn and not self._stop.is_set():
+            t = threading.Thread(target=self._spawn,
+                                 args=(rid, proc.generation + 1),
+                                 name=f"respawn-{rid}", daemon=True)
+            t.start()
+            with self._lock:
+                self._workers.append(t)
+        return True
+
+    def roll(self, rid: str) -> None:
+        """Rolling restart of one engine process: replacement first
+        (spawned, warmed, zero-compile baseline through the router),
+        atomic routing swap, then lame-duck-drain and reap the old
+        process. Raises when the replacement never reaches live — the
+        incumbent keeps serving in that case."""
+        with self._lock:
+            old = self._procs.get(rid)
+            generation = old.generation + 1 if old is not None else 0
+        telemetry.event("proc.roll", proc=rid, generation=generation)
+        if not self._spawn(rid, generation):
+            raise RuntimeError(
+                f"rolling restart of {rid} failed: "
+                f"{self._spawn_errors.get(rid, 'replacement never warmed')}")
+
+    # -- routing state (used by serve/router.py) ---------------------------
+
+    def live(self) -> List[EngineProc]:
+        with self._lock:
+            return [p for p in self._procs.values() if p.state == "live"]
+
+    def route(self, key: Optional[str]) -> EngineProc:
+        """The in-process fleet's rendezvous routing, across the process
+        boundary: same graph-only content key, same stable hash, and the
+        same yield-to-load override with router-tracked outstanding
+        items standing in for ``engine.in_flight``."""
+        live = self.live()
+        if not live:
+            raise NoLiveProcessError("no live engine process")
+        if len(live) == 1:
+            return live[0]
+        with self._lock:
+            if key is not None:
+                pref = max(live,
+                           key=lambda p: _stable_hash(f"{key}|{p.rid}"))
+                if pref.outstanding == 0:
+                    return pref
+            order = live[self._rr % len(live):] + live[:self._rr % len(live)]
+            self._rr += 1
+            return min(order, key=lambda p: p.outstanding)
+
+    def begin_forward(self, proc: EngineProc, n_items: int) -> None:
+        with self._lock:
+            proc.outstanding += n_items
+
+    def end_forward(self, proc: EngineProc, n_items: int) -> None:
+        with self._lock:
+            proc.outstanding = max(0, proc.outstanding - n_items)
+
+    # -- aggregation -------------------------------------------------------
+
+    def processes(self) -> Dict[str, Dict[str, object]]:
+        """Per-process metadata for /metrics and /healthz — keys are the
+        statically-enumerated process ids."""
+        with self._lock:
+            return {rid: p.describe() for rid, p in self._procs.items()}
+
+    def fetch_snapshots(self, timeout_s: float = 2.0,
+                        ) -> Dict[str, Optional[dict]]:
+        """Every live child's /metrics JSON body (None where the fetch
+        failed — the child is counted, not silently dropped)."""
+        return {p.rid: self._fetch_json(p, "/metrics", timeout_s)
+                for p in self.live()}
+
+    def compiles_after_warmup(self, timeout_s: float = 5.0) -> float:
+        """Total compiles across live children since each went live —
+        the zero-post-warmup-compiles assertion, checked through the
+        router (the bench and chaos gates)."""
+        total = 0.0
+        for proc in self.live():
+            snap = self._fetch_json(proc, "/metrics", timeout_s)
+            if snap is not None and proc.compiles_at_live is not None:
+                total += float(snap.get("compiles", 0)) - proc.compiles_at_live
+        return total
+
+    # -- shutdown ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Stop probing, lame-duck every child (SIGTERM → bounded wait →
+        kill), reap all. Idempotent; every join is bounded."""
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(
+                timeout=self.probe_interval_s + self.probe_timeout_s + 10.0)
+        with self._lock:
+            workers = list(self._workers)
+            procs = list(self._procs.values())
+        for t in workers:
+            t.join(timeout=self.spawn_deadline_s + 10.0)
+        for proc in procs:
+            if proc.popen is not None and proc.popen.poll() is None:
+                try:
+                    proc.popen.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.drain_grace_s + 15.0
+        for proc in procs:
+            if proc.popen is None:
+                continue
+            try:
+                proc.popen.wait(
+                    timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                pass
+            if proc.popen.poll() is None:
+                try:
+                    proc.popen.kill()
+                except OSError:
+                    pass
+                try:
+                    proc.popen.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    logger.error("engine %s pid %s survived SIGKILL",
+                                 proc.rid, proc.popen.pid)
+            proc.state = "dead"

@@ -365,6 +365,131 @@ def replay_fleet(fleet, trace: Sequence[TraceEvent],
 
 
 # ---------------------------------------------------------------------------
+# Shared-nothing process-fleet replay: calibrated DES over process timelines
+# ---------------------------------------------------------------------------
+
+
+def replay_multiproc(trace: Sequence[TraceEvent], n_processes: int,
+                     batch_slots: int, cost_s: float, *,
+                     queue_capacity: int = 64, deadline_s: float = 0.5,
+                     flush_fraction: float = 0.5) -> Dict:
+    """Discrete-event replay of the shared-nothing process fleet
+    (serve/procfleet.py) over a *measured* full-batch service cost.
+
+    Each engine OS process gets its own service timeline — the model of
+    the deployment target (one core per process). A 1-core CI container
+    cannot produce that as saturated wall clock: N real children would
+    timeslice one core and measure the scheduler, not the architecture.
+    So the bench calibrates ``cost_s`` (wall seconds per full
+    ``batch_slots`` micro-batch, HTTP ``/score`` against REAL spawned
+    children) and replays the same open-loop trace over N independent
+    process timelines under the router's own rules:
+
+    * rendezvous process affinity on the per-event content key, with
+      the outstanding-items override (an occupied preferred process
+      yields to the least-loaded sibling — serve/router.py's rule);
+    * per-process FIFO queues bounded at ``queue_capacity`` items;
+      overflow sheds (open-loop: no client waits on a completion);
+    * micro-batching: a batch dispatches when ``batch_slots`` items are
+      queued (or the moment the process frees with a full queue), or at
+      the flush horizon — ``flush_fraction * deadline_s`` past the
+      oldest queued arrival (the batcher's deadline flush).
+
+    Throughput is completed/span — service capacity at overload, the
+    honest 1-vs-N number; latency covers queue wait + service.
+    """
+    from deepdfa_tpu.serve.config import PROCESS_IDS
+    from deepdfa_tpu.serve.fleet import _stable_hash
+
+    rids = list(PROCESS_IDS[:n_processes])
+    inf = float("inf")
+    queue: Dict[str, List[float]] = {r: [] for r in rids}  # arrival ts
+    in_service: Dict[str, List[float]] = {r: [] for r in rids}
+    busy_until: Dict[str, float] = {r: inf for r in rids}  # inf == idle
+    wait = flush_fraction * deadline_s
+    lat_ms: List[float] = []
+    shed = 0
+    rr = 0
+
+    def outstanding(r: str) -> int:
+        return len(queue[r]) + len(in_service[r])
+
+    def route(key: Optional[str]) -> str:
+        nonlocal rr
+        if key is not None:
+            pref = max(rids, key=lambda r: _stable_hash(f"{key}|{r}"))
+            if outstanding(pref) == 0:
+                return pref
+        lo = min(outstanding(r) for r in rids)
+        cands = [r for r in rids if outstanding(r) == lo]
+        rr += 1
+        return cands[rr % len(cands)]
+
+    def start_batch(r: str, now: float) -> None:
+        in_service[r] = queue[r][:batch_slots]
+        del queue[r][:batch_slots]
+        busy_until[r] = now + cost_s
+
+    i = 0
+    now = 0.0
+    while i < len(trace) or any(in_service[r] or queue[r] for r in rids):
+        t_arr = trace[i].at if i < len(trace) else inf
+        t_done = min(busy_until[r] for r in rids)
+        t_flush = min((queue[r][0] + wait for r in rids
+                       if queue[r] and not in_service[r]), default=inf)
+        now = max(now, min(t_arr, t_done, t_flush))
+        if t_done <= min(t_arr, t_flush):
+            for r in rids:
+                if busy_until[r] != t_done:
+                    continue
+                lat_ms += [(now - at) * 1e3 for at in in_service[r]]
+                in_service[r] = []
+                busy_until[r] = inf
+                if len(queue[r]) >= batch_slots or (
+                        queue[r] and queue[r][0] + wait <= now):
+                    start_batch(r, now)
+            continue
+        if t_flush < t_arr:
+            for r in rids:
+                if queue[r] and not in_service[r] \
+                        and queue[r][0] + wait <= now:
+                    start_batch(r, now)
+            continue
+        ev = trace[i]
+        i += 1
+        key = None
+        if ev.graph is not None:
+            key = f"g{ev.graph.get('id')}"
+        elif ev.code is not None:
+            key = f"c{_stable_hash(ev.code)}"
+        r = route(key)
+        if outstanding(r) >= queue_capacity:
+            shed += 1
+            continue
+        queue[r].append(ev.at)
+        if not in_service[r] and len(queue[r]) >= batch_slots:
+            start_batch(r, now)
+
+    from deepdfa_tpu.core.metrics import latency_quantile
+
+    span = now - (trace[0].at if trace else 0.0)
+    offered = (len(trace) / (trace[-1].at - trace[0].at)
+               if len(trace) > 1 and trace[-1].at > trace[0].at else 0.0)
+    return {
+        "n_offered": len(trace),
+        "offered_rps": offered,
+        "completed": len(lat_ms),
+        "shed": shed,
+        "span_s": span,
+        "rps": len(lat_ms) / span if span > 0 else 0.0,
+        "latency_p50_ms": latency_quantile(lat_ms, 0.50),
+        "latency_p99_ms": latency_quantile(lat_ms, 0.99),
+        "processes": n_processes,
+        "cost_s": cost_s,
+    }
+
+
+# ---------------------------------------------------------------------------
 # The scan lane: raw-source traffic with a seeded edit/repeat mix
 # ---------------------------------------------------------------------------
 

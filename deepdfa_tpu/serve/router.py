@@ -1,0 +1,589 @@
+"""The accept/route tier of the multi-process serving fleet (ISSUE 17).
+
+One thin HTTP process fronting the :class:`~deepdfa_tpu.serve.procfleet.
+ProcFleet` of engine OS processes. It speaks the historic serving
+surface — ``POST /score``, ``POST /scan``, ``GET /metrics``,
+``GET /healthz`` — and owns three responsibilities only:
+
+* **Routing**: each function in a POST is routed by the same graph-only
+  content key (code excluded) and rendezvous hash the in-process fleet
+  uses, with the router-side outstanding-items count standing in for
+  the mid-flush/queue-depth override. Items sharing a target coalesce
+  into ONE forwarded sub-batch per (client POST, engine process), so
+  the child's micro-batcher still sees batches, not single items.
+* **Crash isolation**: a connection failure on a forward marks the
+  child dead (the probe thread backs this up for silent hangs) and
+  re-routes that sub-batch to a live sibling — an admitted request is
+  answered or explicitly rejected, never dropped. Scoring is pure, so
+  a request re-executed after a mid-flush crash is safe.
+* **Aggregation**: ``/metrics`` sums the children's ServingStats
+  snapshots (counters summed, occupancy and hit-rate sample-weighted,
+  latency quantiles reported as the worst process's — honest across
+  shards), merges per-(lane, bucket) padding-waste exactly, and adds a
+  ``processes`` section with real pids — the chaos scenario reads its
+  SIGKILL victims from here. ``/healthz`` degrades when some-but-not-
+  all processes are live, mirroring the in-process fleet's contract.
+
+Every forward carries a ``traceparent`` continuing the client's trace
+(or a fresh one), so the merged trace joins client → router.request →
+router.forward → the child's serve.request across real pids.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.client
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.serve.config import ServeConfig
+from deepdfa_tpu.serve.procfleet import (EngineProc, NoLiveProcessError,
+                                         ProcFleet)
+from deepdfa_tpu.telemetry import context as trace_context
+
+logger = logging.getLogger("deepdfa.serve.router")
+
+
+def predeclare_router_metrics() -> None:
+    """PR-7 predeclare discipline: every router series exists from
+    startup.
+
+    The per-process loop iterates a *literal* constant tuple — the
+    GL014-documented bounded shape; drift between it and
+    ``PROCESS_IDS`` is pinned by a test in tests/test_procfleet.py.
+    """
+    for name in ("router_requests_total", "router_rerouted_total",
+                 "router_shed_total", "router_proc_deaths_total"):
+        telemetry.REGISTRY.counter(name)
+    for rid in ("p0", "p1", "p2", "p3", "p4", "p5", "p6", "p7"):
+        telemetry.REGISTRY.counter(f"router_forwards_{rid}_total")
+
+
+def routing_key(fn: Dict) -> Optional[str]:
+    """The fleet's routing key, computed from the raw wire payload:
+    gen lane routes on the source text, everything else on the
+    graph-only content hash (code excluded) — same affinity the
+    in-process fleet gives each function. Malformed payloads route on
+    load alone; the child's admission validator owns the 400 shape."""
+    from deepdfa_tpu.serve.cache import content_hash, text_hash
+
+    try:
+        if fn.get("lane") == "gen":
+            code = fn.get("code")
+            return text_hash(code) if code is not None else None
+        return content_hash(fn["graph"])
+    except Exception:
+        return None
+
+
+def aggregate_snapshots(snaps: Dict[str, Optional[dict]]) -> Dict:
+    """Fleet-wide ServingStats body from per-process snapshots.
+
+    Counters and sample counts sum; ``batch_occupancy`` weights by
+    batches and ``cache_hit_rate`` by lookups; latency quantiles take
+    the worst process (a cross-process pool of the underlying windows
+    does not exist here, and the max is the honest conservative bound);
+    per-(lane, bucket) padding merges exactly on used/slot counts."""
+    present = [s for s in snaps.values() if s]
+    out: Dict[str, object] = {}
+    keys = sorted({k for s in present for k, v in s.items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)})
+    for k in keys:
+        vals = [s.get(k, 0) or 0 for s in present]
+        if k in ("latency_p50_ms", "latency_p99_ms"):
+            out[k] = max(vals) if vals else 0.0
+        elif k == "batch_occupancy":
+            w = [s.get("batches", 0) or 0 for s in present]
+            out[k] = (sum(v * x for v, x in zip(vals, w)) / sum(w)
+                      if sum(w) else 0.0)
+        elif k == "cache_hit_rate":
+            w = [(s.get("cache_hits", 0) or 0)
+                 + (s.get("cache_misses", 0) or 0) for s in present]
+            out[k] = (sum(v * x for v, x in zip(vals, w)) / sum(w)
+                      if sum(w) else 0.0)
+        elif k == "padding_waste_pct":
+            w = [_occ_slots(s) for s in present]
+            out[k] = (sum(v * x for v, x in zip(vals, w)) / sum(w)
+                      if sum(w) else 0.0)
+        else:
+            out[k] = sum(vals)
+    padding: Dict[str, Dict[str, float]] = {}
+    for s in present:
+        for key, cell in (s.get("padding_waste") or {}).items():
+            acc = padding.setdefault(key, {"used": 0, "slots": 0})
+            acc["used"] += cell.get("used", 0)
+            acc["slots"] += cell.get("slots", 0)
+    for cell in padding.values():
+        cell["waste_pct"] = round(
+            100.0 * (1.0 - cell["used"] / cell["slots"]), 2
+        ) if cell["slots"] else 0.0
+    if padding:
+        out["padding_waste"] = padding
+    return out
+
+
+def _occ_slots(snap: dict) -> float:
+    # occupancy_slots is not in the snapshot body; weight the overall
+    # waste by batches — proportional enough for a fleet-level number.
+    return snap.get("batches", 0) or 0
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    server: "RouterHTTPServer"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send_json(self, code: int, payload: Dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        fleet = self.server.fleet
+        if self.path == "/healthz":
+            procs = fleet.processes()
+            live = sum(1 for p in procs.values() if p["state"] == "live")
+            doc: Dict[str, object] = {
+                "status": "ok", "size": fleet.n, "live": live,
+                "processes": procs, "inflight": self.server.inflight,
+                "telemetry_drops": telemetry.drop_count(),
+            }
+            if self.server.draining:
+                doc["status"] = "draining"
+            elif live == 0:
+                doc["status"] = "unavailable"
+            elif live < fleet.n:
+                doc["status"] = "degraded"
+            self._send_json(200 if doc["status"] == "ok" else 503, doc)
+        elif self.path == "/metrics":
+            snaps = fleet.fetch_snapshots(
+                timeout_s=max(fleet.probe_timeout_s, 1.0))
+            doc = aggregate_snapshots(snaps)
+            procs = fleet.processes()
+            for rid, snap in snaps.items():
+                if rid in procs and snap is not None:
+                    procs[rid]["snapshot"] = snap
+            doc["n_processes"] = fleet.n
+            doc["processes"] = procs
+            accept = self.headers.get("Accept", "") or ""
+            if "text/plain" in accept or "openmetrics" in accept:
+                body = telemetry.REGISTRY.prometheus_text(
+                    extra={f"serve_{k}": v for k, v in doc.items()})
+                self._send_text(200, body, "text/plain; version=0.0.4")
+            else:
+                self._send_json(200, doc)
+        else:
+            self._send_json(404, {"error": "not_found"})
+
+    # -- POST --------------------------------------------------------------
+
+    def _reject_draining(self) -> bool:
+        if not self.server.draining:
+            return False
+        retry_s = self.server.drain_retry_after_s()
+        self._send_json(503, {"error": "draining",
+                              "retry_after_s": retry_s},
+                        headers={"Retry-After":
+                                 str(max(int(-(-retry_s // 1)), 1))})
+        return True
+
+    def _request_trace(self) -> Tuple[str, bool]:
+        raw = self.headers.get(trace_context.TRACEPARENT_HEADER)
+        if raw is not None:
+            parsed = trace_context.parse_traceparent(raw)
+            if parsed is not None:
+                return parsed[0], True
+            telemetry.REGISTRY.counter("trace_ctx_malformed_total").inc()
+        return trace_context.new_trace_id(), False
+
+    def do_POST(self) -> None:
+        with self.server.track_inflight():
+            if self._reject_draining():
+                return
+            if self.path == "/score":
+                self._do_score()
+            elif self.path == "/scan":
+                self._do_scan()
+            else:
+                self._send_json(404, {"error": "not_found"})
+
+    def _read_doc(self) -> Optional[dict]:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            doc = json.loads(self.rfile.read(length).decode("utf-8"))
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            return doc
+        except Exception as e:
+            self._send_json(400, {"error": "bad_request", "detail": str(e)})
+            return None
+
+    def _do_score(self) -> None:
+        doc = self._read_doc()
+        if doc is None:
+            return
+        try:
+            functions = doc["functions"]
+            if not isinstance(functions, list) or not functions:
+                raise ValueError("'functions' must be a non-empty list")
+            deadline_ms = doc.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if not deadline_ms > 0:
+                    raise ValueError("deadline_ms must be > 0")
+        except Exception as e:
+            self._send_json(400, {"error": "bad_request", "detail": str(e)})
+            return
+        fleet = self.server.fleet
+        telemetry.REGISTRY.counter("router_requests_total").inc()
+        trace_id, trace_continued = self._request_trace()
+        results: List[Dict] = [{} for _ in functions]
+        with telemetry.span("router.request", n_functions=len(functions),
+                            trace_id=trace_id,
+                            trace_continued=trace_continued) as hs:
+            groups: Dict[str, Tuple[EngineProc, List[int]]] = {}
+            for i, fn in enumerate(functions):
+                if not isinstance(fn, dict):
+                    results[i] = {"error": "bad_request",
+                                  "detail": "function entries must be "
+                                            "objects"}
+                    continue
+                try:
+                    proc = fleet.route(routing_key(fn))
+                except NoLiveProcessError:
+                    telemetry.REGISTRY.counter("router_shed_total").inc()
+                    results[i] = {
+                        "error": "rejected",
+                        "retry_after_s": fleet.spawn_deadline_s
+                        if fleet.auto_respawn
+                        else self.server.serve_config.deadline_ms / 1000.0}
+                    continue
+                groups.setdefault(proc.rid, (proc, []))[1].append(i)
+            rerouted = 0
+            for proc, idxs in groups.values():
+                rerouted += self._dispatch(proc, functions, idxs,
+                                           deadline_ms, trace_id, results)
+            if rerouted:
+                telemetry.REGISTRY.counter(
+                    "router_rerouted_total").inc(rerouted)
+            if results and all(r.get("error") == "rejected"
+                               for r in results):
+                retry = max(float(r.get("retry_after_s", 1.0))
+                            for r in results)
+                hs.set(status=429, rerouted=rerouted)
+                self._send_json(429, {"error": "rejected",
+                                      "retry_after_s": retry},
+                                headers={"Retry-After":
+                                         str(max(int(-(-retry // 1)), 1))})
+                return
+            status = 500 if (results
+                             and all(r.get("error") == "internal"
+                                     for r in results)) else 200
+            hs.set(status=status, rerouted=rerouted,
+                   procs=sorted(groups))
+            self._send_json(status, {"results": results})
+
+    def _dispatch(self, proc: EngineProc, functions: List[Dict],
+                  idxs: List[int], deadline_ms: Optional[float],
+                  trace_id: str, results: List[Dict]) -> int:
+        """Forward one sub-batch, re-routing to live siblings when the
+        target dies under us (crash isolation) or rejects the whole
+        group (the fleet's retry-once-on-a-sibling contract). Returns
+        the number of items that had to be re-routed."""
+        fleet = self.server.fleet
+        config = self.server.serve_config
+        payload: Dict[str, object] = {
+            "functions": [functions[i] for i in idxs]}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        timeout_s = ((deadline_ms or config.deadline_ms) / 1000.0) \
+            * 10 + 30.0
+        header_tp = trace_context.make_traceparent(trace_id)
+        rerouted = 0
+        tried: List[EngineProc] = []
+        target: Optional[EngineProc] = proc
+        allow_reject_retry = True
+        while target is not None:
+            tried.append(target)
+            fleet.begin_forward(target, len(idxs))
+            try:
+                with telemetry.span("router.forward", proc=target.rid,
+                                    generation=target.generation,
+                                    pid=target.pid, n=len(idxs),
+                                    trace_id=trace_id) as fs:
+                    status, body = self._post_child(
+                        target, "/score", payload, header_tp, timeout_s)
+                    fs.set(status=status if status is not None else 0)
+            finally:
+                fleet.end_forward(target, len(idxs))
+            telemetry.REGISTRY.counter(
+                f"router_forwards_{target.rid}_total").inc()
+            if status is None:
+                # Died between accept and dispatch (or mid-flush):
+                # mark dead, shed this sub-batch to a live sibling.
+                fleet.mark_dead(target.rid, "connection",
+                                generation=target.generation)
+                rerouted += len(idxs)
+                target = self._next_target(tried)
+                continue
+            if status == 429:
+                if allow_reject_retry:
+                    allow_reject_retry = False
+                    nxt = self._next_target(tried)
+                    if nxt is not None:
+                        rerouted += len(idxs)
+                        target = nxt
+                        continue
+                retry = (body or {}).get("retry_after_s",
+                                         config.deadline_ms / 1000.0)
+                for i in idxs:
+                    results[i] = {"error": "rejected",
+                                  "retry_after_s": retry}
+                return rerouted
+            child_results = (body or {}).get("results")
+            if not isinstance(child_results, list) \
+                    or len(child_results) != len(idxs):
+                for i in idxs:
+                    results[i] = {"error": "internal",
+                                  "detail": "malformed engine response"}
+                return rerouted
+            for i, entry in zip(idxs, child_results):
+                results[i] = entry
+            return rerouted
+        # Every live process was tried and lost: the inline-error shape
+        # survives (500 overall when every item in the POST died).
+        telemetry.REGISTRY.counter("router_shed_total").inc(len(idxs))
+        for i in idxs:
+            results[i] = {"error": "internal",
+                          "detail": "no live engine process"}
+        return rerouted
+
+    def _next_target(self, tried: List[EngineProc]) -> Optional[EngineProc]:
+        live = [p for p in self.server.fleet.live() if p not in tried]
+        if not live:
+            return None
+        return min(live, key=lambda p: p.outstanding)
+
+    def _post_child(self, proc: EngineProc, path: str, payload: Dict,
+                    traceparent: str, timeout_s: float,
+                    ) -> Tuple[Optional[int], Optional[dict]]:
+        if proc.port is None:
+            return None, None
+        body = json.dumps(payload).encode()
+        conn = http.client.HTTPConnection(self.server.fleet.host,
+                                          proc.port, timeout=timeout_s)
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": "application/json",
+                trace_context.TRACEPARENT_HEADER: traceparent})
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                return resp.status, json.loads(raw.decode("utf-8"))
+            except ValueError:
+                return resp.status, None
+        except OSError:
+            return None, None
+        finally:
+            conn.close()
+
+    def _do_scan(self) -> None:
+        """POST /scan rides the same tier: the whole envelope forwards
+        to one live process routed on the first source's text hash (scan
+        results are per-POST artifacts, not per-function cache lines),
+        with the same dead-child re-route. Children without a scan
+        service answer 501 and the router relays it."""
+        from deepdfa_tpu.serve.cache import text_hash
+
+        doc = self._read_doc()
+        if doc is None:
+            return
+        functions = doc.get("functions")
+        if not isinstance(functions, list) or not functions:
+            self._send_json(400, {"error": "bad_request",
+                                  "detail": "'functions' must be a "
+                                            "non-empty list"})
+            return
+        key = None
+        first = functions[0]
+        if isinstance(first, dict) and isinstance(first.get("source"), str):
+            key = text_hash(first["source"])
+        fleet = self.server.fleet
+        trace_id, trace_continued = self._request_trace()
+        header_tp = trace_context.make_traceparent(trace_id)
+        timeout_s = (self.server.serve_config.deadline_ms / 1000.0) \
+            * 10 + 120.0
+        with telemetry.span("router.scan", n_functions=len(functions),
+                            trace_id=trace_id,
+                            trace_continued=trace_continued) as hs:
+            tried: List[EngineProc] = []
+            while True:
+                try:
+                    target = fleet.route(key)
+                except NoLiveProcessError:
+                    target = None
+                if target is None or target in tried:
+                    target = self._next_target(tried)
+                if target is None:
+                    hs.set(status=503)
+                    self._send_json(503, {"error": "draining",
+                                          "retry_after_s":
+                                          fleet.spawn_deadline_s})
+                    return
+                tried.append(target)
+                fleet.begin_forward(target, len(functions))
+                try:
+                    status, body = self._post_child(
+                        target, "/scan", doc, header_tp, timeout_s)
+                finally:
+                    fleet.end_forward(target, len(functions))
+                if status is None:
+                    fleet.mark_dead(target.rid, "connection",
+                                    generation=target.generation)
+                    continue
+                hs.set(status=status, proc=target.rid)
+                self._send_json(status, body if body is not None
+                                else {"error": "internal"})
+                return
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    """The router's transport: one handler thread per connection, all
+    blocking on child HTTP round-trips, drain machinery mirroring
+    :class:`ServeHTTPServer` so the PR-10 lifecycle drives the same
+    lame-duck dance one level up."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, fleet: ProcFleet, config: ServeConfig):
+        predeclare_router_metrics()
+        super().__init__(addr, RouterHandler)
+        self.fleet = fleet
+        self.serve_config = config
+        self.draining = False
+        self.drain_notice = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def track_inflight(self):
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain_retry_after_s(self) -> float:
+        notice = self.drain_notice
+        floor = (self.serve_config.flush_fraction
+                 * self.serve_config.deadline_ms / 1000.0)
+        if notice is None:
+            return max(floor, 1.0)
+        return max(notice.remaining(), floor, 1.0)
+
+    def begin_drain(self, notice=None) -> None:
+        self.drain_notice = notice
+        self.draining = True
+
+    def await_drained(self, deadline_s: float, beat=None,
+                      poll_s: float = 0.01) -> bool:
+        import time
+
+        deadline = time.monotonic() + max(deadline_s, 0.0)
+        last = -1
+        while time.monotonic() < deadline:
+            n = self.inflight
+            if n == 0:
+                return True
+            if beat is not None and n != last:
+                beat()
+                last = n
+            time.sleep(poll_s)
+        return self.inflight == 0
+
+
+def serve_forever_router(fleet: ProcFleet, config: ServeConfig,
+                         host: str = "127.0.0.1", port: int = 8080,
+                         port_file: Optional[str] = None):
+    """Blocking router entry, the multi-process analogue of
+    :func:`serve.http.serve_forever`: bind (after the fleet is live, so
+    the port file IS the whole-fleet warm signal), serve, and register
+    with the lifecycle coordinator — a preemption notice drains the
+    router (admissions 503, in-flight forwards answered), then shuts
+    the fleet down child by child (each child runs its own lame-duck).
+    Returns the notice (None on a plain shutdown)."""
+    from deepdfa_tpu.resilience import lifecycle
+
+    server = RouterHTTPServer((host, port), fleet, config)
+    if port_file:
+        tmp = f"{port_file}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(server.server_address[1]))
+        os.replace(tmp, port_file)
+    logger.info("routing on %s:%d (%d engine process(es))", host,
+                server.server_address[1], fleet.n)
+
+    coordinator = lifecycle.coordinator()
+    participant_box: Dict[str, object] = {}
+
+    def on_notice(notice) -> None:
+        participant = participant_box.get("p")
+        beat = participant.beat if participant else (lambda: None)
+        with telemetry.span("lifecycle.drain_router"):
+            server.begin_drain(notice)
+            beat()
+            budget = participant.deadline_s if participant \
+                else notice.grace_s
+            drained = server.await_drained(
+                min(budget, notice.remaining()), beat=beat)
+            if not drained:
+                logger.error("router drain overran its budget: "
+                             "inflight=%d", server.inflight)
+            beat()
+            fleet.shutdown()
+        if participant:
+            participant.drained(ok=drained)
+        telemetry.flush()
+        server.shutdown()
+
+    participant_box["p"] = coordinator.register("serve",
+                                                on_notice=on_notice)
+    try:
+        server.serve_forever()
+    finally:
+        try:
+            server.shutdown()
+        finally:
+            coordinator.unregister(participant_box["p"])
+    return coordinator.notice

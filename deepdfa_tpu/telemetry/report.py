@@ -150,6 +150,78 @@ def summarize(events: List[Dict[str, Any]],
         serve["lanes"] = {lane: _req_stats(group)
                           for lane, group in sorted(by_lane.items())}
 
+    # Padding waste from real flush shapes (ISSUE 17): every serve.flush
+    # span carries its lane, real request count, and padded slot count —
+    # the per-(lane, bucket) waste is the measured input the traffic-
+    # shaped dynamic-batching work starts from, computed from the trace
+    # alone so it holds across engine processes.
+    if flushes:
+        pad: Dict[str, Dict[str, float]] = {}
+        total_used = total_slots = 0
+        for f in flushes:
+            attrs = f.get("attrs") or {}
+            lane = attrs.get("lane")
+            n = attrs.get("n")
+            slots = attrs.get("slots")
+            if lane is None or n is None or slots is None:
+                continue
+            cell = pad.setdefault(f"{lane}:b{int(slots)}",
+                                  {"flushes": 0, "used": 0, "slots": 0})
+            cell["flushes"] += 1
+            cell["used"] += int(n)
+            cell["slots"] += int(slots)
+            total_used += int(n)
+            total_slots += int(slots)
+        for cell in pad.values():
+            cell["waste_pct"] = round(
+                100.0 * (1.0 - cell["used"] / cell["slots"]), 2
+            ) if cell["slots"] else 0.0
+        if pad:
+            serve["padding_waste"] = dict(sorted(pad.items()))
+            serve["padding_waste_pct"] = round(
+                100.0 * (1.0 - total_used / total_slots), 2
+            ) if total_slots else 0.0
+
+    # Multi-process fleet audit (ISSUE 17): the engine-process
+    # lifecycle (spawn/live/dead/reap/roll) and the router's forward/
+    # re-route accounting, joined per statically-enumerated process id
+    # — kill/shed/rejoin is readable from the merged trace alone.
+    proc_spawns = named(instants, ("proc.spawn",))
+    proc_forwards = named(spans, ("router.forward",))
+    router_reqs = named(spans, ("router.request",))
+    if proc_spawns or proc_forwards or router_reqs:
+        by_proc: Dict[str, Dict[str, Any]] = {}
+
+        def _proc_cell(rid: str) -> Dict[str, Any]:
+            return by_proc.setdefault(rid, {"spawns": 0, "deaths": 0,
+                                            "forwards": 0, "pids": []})
+
+        for e in proc_spawns:
+            attrs = e.get("attrs") or {}
+            cell = _proc_cell(str(attrs.get("proc", "?")))
+            cell["spawns"] += 1
+            if attrs.get("pid") is not None:
+                cell["pids"].append(attrs["pid"])
+        for e in named(instants, ("proc.dead",)):
+            attrs = e.get("attrs") or {}
+            _proc_cell(str(attrs.get("proc", "?")))["deaths"] += 1
+        for s in proc_forwards:
+            attrs = s.get("attrs") or {}
+            _proc_cell(str(attrs.get("proc", "?")))["forwards"] += 1
+        serve["procfleet"] = {
+            "spawns": len(proc_spawns),
+            "live_transitions": len(named(instants, ("proc.live",))),
+            "deaths": len(named(instants, ("proc.dead",))),
+            "reaps": len(named(instants, ("proc.reap",))),
+            "rolls": len(named(instants, ("proc.roll",))),
+            "router_requests": len(router_reqs),
+            "forwards": len(proc_forwards),
+            "rerouted": sum(int((s.get("attrs") or {})
+                                .get("rerouted", 0) or 0)
+                            for s in router_reqs),
+            "processes": dict(sorted(by_proc.items())),
+        }
+
     # Adaptive flush-policy audit: every controller decision is an
     # event; the report replays the decision history (counts by action
     # and replica, and each replica's final thresholds) from the trace

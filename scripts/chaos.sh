@@ -1,6 +1,6 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering eleven fault classes — simulated preemption (kill-and-resume
+# covering twelve fault classes — simulated preemption (kill-and-resume
 # must be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
 # requeue), serving flush failure (one flush fails alone), corrupt-corpus
@@ -16,7 +16,11 @@
 # new ones, drain inside the grace budget, compiles flat), and a rolling
 # replica drain of a 3-replica serving fleet mid-load (fleet_roll: the
 # rolled replica's admissions all answered, the other two keep serving,
-# /healthz degrades then recovers, zero compiles across the roll).
+# /healthz degrades then recovers, zero compiles across the roll), and a
+# SIGKILL of one of three engine OS processes behind the router tier
+# under live load (proc_crash: zero dropped admitted requests, the router
+# sheds to siblings, a warmed replacement rejoins at a bumped generation,
+# one merged trace shows kill/shed/rejoin across real pids).
 # Exits nonzero on any missed recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak

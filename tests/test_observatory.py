@@ -763,6 +763,7 @@ def test_cli_bench_diff_smoke_measures_and_appends(tmp_path, capsys):
                                    "smoke_ingest_rows_per_sec",
                                    "smoke_sigterm_to_durable_snapshot_ms",
                                    "smoke_serve_fleet_rps",
+                                   "smoke_serve_multiproc_rps",
                                    "smoke_gen_decode_tok_per_sec",
                                    "smoke_graftlint_full_repo_ms",
                                    "smoke_trace_propagation_rps"}

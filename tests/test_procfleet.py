@@ -1,0 +1,255 @@
+"""Router/process-fleet edge cases (ISSUE 17), on STUB engine children.
+
+The real multi-process stack (spawn + AOT warmup + scoring) is exercised
+by ``serve --processes N --smoke``, the proc_crash chaos scenario, and
+the multiproc bench; these tests pin the router's failure-handling
+contracts in tier-1 seconds by fronting the fleet with stub children —
+tiny HTTP servers injected through ProcFleet's ``argv_for`` hook that
+speak just enough of the engine surface (port-file handshake, /healthz,
+/metrics, /score) to drive the router, with a ``hang`` mode for the
+silent-failure path.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepdfa_tpu.serve.config import ServeConfig
+from deepdfa_tpu.serve.procfleet import ProcFleet
+from deepdfa_tpu.serve.router import RouterHTTPServer
+
+# The stub child: binds port 0, writes the port file (the warm signal —
+# cmd_serve writes it only after warmup, so the stub IS "warmed"), then
+# serves /healthz, /metrics (the snapshot the spawn baselines compiles
+# from), and /score. Mode "hang" sleeps past any probe deadline on
+# /healthz — the silent-hang failure the probe thread exists for.
+STUB = r"""
+import json, os, sys, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+port_file, mode = sys.argv[1], sys.argv[2]
+
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, doc, status=200):
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            if mode == "hang":
+                time.sleep(60.0)
+            self._send({"status": "ok"})
+        elif self.path == "/metrics":
+            self._send({"requests": 0, "compiles": 0})
+        else:
+            self._send({}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        doc = json.loads(self.rfile.read(n) or b"{}")
+        fns = doc.get("functions", [])
+        self._send({"results": [{"prob": 0.25, "cached": False}
+                                for _ in fns]})
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+tmp = port_file + ".tmp"
+with open(tmp, "w") as f:
+    f.write(str(srv.server_address[1]))
+os.replace(tmp, port_file)
+srv.serve_forever()
+"""
+
+
+def _stub_argv_for(mode_for):
+    def argv_for(rid, port_file):
+        return [sys.executable, "-c", STUB, port_file, mode_for(rid)]
+    return argv_for
+
+
+def _post(base, doc, timeout=30.0):
+    req = urllib.request.Request(
+        f"{base}/score", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _functions(n, offset=0):
+    # Distinct graphs => distinct content keys => rendezvous spreads
+    # them across processes instead of pinning one key's preference.
+    return [{"id": i, "graph": {"num_nodes": 2 + (i + offset) % 5,
+                                "senders": [0], "receivers": [1],
+                                "feats": {}}}
+            for i in range(offset, offset + n)]
+
+
+@pytest.fixture
+def router_fleet(request):
+    """A stub fleet + router; params: (n, mode_for, fleet_kwargs)."""
+    n, mode_for, kwargs = request.param
+    fleet = ProcFleet(n, argv_for=_stub_argv_for(mode_for), **kwargs)
+    fleet.start()
+    server = RouterHTTPServer(
+        ("127.0.0.1", 0), fleet,
+        ServeConfig(batch_slots=4, deadline_ms=200.0))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield fleet, server, f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        fleet.shutdown()
+
+
+@pytest.mark.parametrize(
+    "router_fleet",
+    # Probe effectively off: detection must come from the forward path.
+    [(2, lambda rid: "normal",
+      {"probe_interval_s": 60.0, "auto_respawn": False})],
+    indirect=True)
+def test_child_death_between_accept_and_dispatch_reroutes(router_fleet):
+    # A child SIGKILLed after the router accepted the request but before
+    # (or during) dispatch: the forward's connection failure marks it
+    # dead and the sub-batch re-routes to the sibling — answered, not
+    # dropped, and no error leaks into the per-item results.
+    fleet, _server, base = router_fleet
+    victim_pid = int(fleet.processes()["p0"]["pid"])
+    os.kill(victim_pid, signal.SIGKILL)
+    # No probe has run: the router still believes p0 is live and keeps
+    # routing onto it until a forward's connection failure marks it dead
+    # — every POST along the way must still be answered in full.
+    deadline = time.monotonic() + 10.0
+    offset = 0
+    while fleet.processes()["p0"]["state"] != "dead":
+        assert time.monotonic() < deadline, \
+            "router never routed onto the killed child"
+        status, body = _post(base, {"functions": _functions(4, offset)})
+        offset += 4
+        assert status == 200
+        assert [r["prob"] for r in body["results"]] == [0.25] * 4
+    assert fleet.processes()["p0"]["state"] == "dead"  # forward-detected
+
+
+@pytest.mark.parametrize(
+    "router_fleet",
+    [(2, lambda rid: "hang" if rid == "p1" else "normal",
+      {"probe_interval_s": 0.1, "probe_timeout_s": 0.3,
+       "probe_failures": 2, "auto_respawn": False})],
+    indirect=True)
+def test_hung_child_marked_dead_by_probe_and_shed(router_fleet):
+    # A child that accepts connections but never answers /healthz within
+    # the probe deadline: consecutive probe timeouts mark it dead (no
+    # connection failure ever fires — the silent-hang path), and routing
+    # sheds every key to the sibling.
+    fleet, _server, base = router_fleet
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline \
+            and fleet.processes()["p1"]["state"] != "dead":
+        time.sleep(0.05)
+    assert fleet.processes()["p1"]["state"] == "dead"
+    assert all(fleet.route(f"k{i}").rid == "p0" for i in range(16))
+    status, body = _post(base, {"functions": _functions(4)})
+    assert status == 200
+    assert all("prob" in r for r in body["results"])
+
+
+def test_malformed_processes_env_is_clean_parser_error(monkeypatch,
+                                                       capsys):
+    # DEEPDFA_SERVE_PROCESSES feeds --processes as a STRING default, so
+    # argparse applies type=int at parse time: a malformed value is a
+    # clean usage error (exit 2) before any engine or process work.
+    from deepdfa_tpu import cli
+
+    monkeypatch.setenv("DEEPDFA_SERVE_PROCESSES", "three")
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["serve", "--smoke", "1"])
+    assert ei.value.code == 2
+    assert "--processes" in capsys.readouterr().err
+
+
+def test_processes_env_default_parses(monkeypatch):
+    # The env default reaches cmd_serve as a real int — and the default
+    # of 1 keeps the historic single-process path (cmd_serve only
+    # branches to the router tier when processes > 1).
+    from deepdfa_tpu import cli
+
+    captured = {}
+    monkeypatch.setattr(
+        cli, "cmd_serve",
+        lambda args: captured.update(processes=args.processes) or {})
+    monkeypatch.delenv("DEEPDFA_SERVE_PROCESSES", raising=False)
+    cli.main(["serve"])
+    assert captured["processes"] == 1
+    monkeypatch.setenv("DEEPDFA_SERVE_PROCESSES", "3")
+    cli.main(["serve"])
+    assert captured["processes"] == 3
+
+
+def test_single_process_metrics_body_stays_engine_shaped():
+    # `serve --processes 1` never constructs the router, so the
+    # single-process /metrics JSON body stays the engine snapshot —
+    # including the new padding_waste_pct gauge — with none of the
+    # router-aggregation keys bleeding in.
+    from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve.engine import ServeEngine, random_gnn_params
+    from deepdfa_tpu.serve.http import ServeHTTPServer
+
+    config = ServeConfig(batch_slots=2, deadline_ms=100.0)
+    model = FlowGNN(FlowGNNConfig(
+        feature=FeatureSpec(limit_all=20, limit_subkeys=20),
+        hidden_dim=8, n_steps=2, num_output_layers=2))
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config)
+    server = ServeHTTPServer(("127.0.0.1", 0), engine)
+    server.start_pump()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            body = json.loads(resp.read())
+    finally:
+        server.shutdown()
+    assert {"compiles", "batch_occupancy", "latency_p99_ms",
+            "padding_waste_pct"} <= set(body)
+    assert "n_processes" not in body and "processes" not in body
+
+
+def test_procfleet_rejects_out_of_range_n():
+    from deepdfa_tpu.serve.config import MAX_PROCESSES
+
+    with pytest.raises(ValueError):
+        ProcFleet(0)
+    with pytest.raises(ValueError):
+        ProcFleet(MAX_PROCESSES + 1)
+
+
+def test_router_predeclares_every_process_series():
+    # predeclare_router_metrics iterates a literal tuple (the GL014
+    # bounded-cardinality shape); this pins it against PROCESS_IDS
+    # drifting — every process id must have its series from startup.
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.serve.config import PROCESS_IDS
+    from deepdfa_tpu.serve.router import predeclare_router_metrics
+
+    predeclare_router_metrics()
+    names = set(telemetry.REGISTRY.snapshot())
+    assert {f"router_forwards_{rid}_total" for rid in PROCESS_IDS} <= names
