@@ -388,6 +388,19 @@ def bench_checkpoint_resilience(reps: int = 3) -> dict:
     ``reps`` per the ``_timed`` variance protocol, one fresh coordinator
     per rep.
 
+    ``ckpt_redistribute_ms`` / ``ckpt_redistribute_fast_ms`` (ISSUE 18):
+    rewriting one flagship-state snapshot for a different process count
+    — the elastic-resume critical path. A sharded snapshot is fabricated
+    in-process (N managers on one dir, ``set_host(i, N)``, non-primaries
+    save first, the primary commits last — the same rendezvous a live
+    fleet runs), then ``redistribute`` is timed: the headline number is
+    the 2→1 ``consolidate`` rewrite (reassemble + plain orbax — the
+    shrink-to-one path every single-process tool depends on), and the
+    ``fast`` number is the 4→2 hardlink re-home (no byte copies; the
+    nested-shard-sets fast path). Best-of ``reps`` per the ``_timed``
+    variance protocol, a fresh fabricated snapshot per rep (the rewrite
+    consumes its input).
+
     ``resume_overhead_s``: wall-clock delta of a kill-and-resume versus
     the uninterrupted fit on the synthetic dataset — a 3-epoch tiny fit,
     preempted by an injected epoch-start fault at epoch 1, resumed with
@@ -446,6 +459,31 @@ def bench_checkpoint_resilience(reps: int = 3) -> dict:
 
     sigterm_ms = sigterm_to_snapshot_ms(state, reps=reps)
 
+    def _fabricate_sharded(directory: str, pc: int) -> CheckpointManager:
+        """A committed pc-process sharded "last" snapshot, written the
+        way a live fleet writes one: peers land shards + markers first,
+        the primary rendezvouses and owns the commit."""
+        mgrs = [CheckpointManager(directory) for _ in range(pc)]
+        for i, m in enumerate(mgrs):
+            m.set_host(i, pc)
+        for m in mgrs[1:]:
+            m.save_last(state, epoch=0)
+        mgrs[0].save_last(state, epoch=0)
+        return mgrs[0]
+
+    redist_fast, redist_cons = [], []
+    for _ in range(reps):
+        for old_pc, new_pc, sink in ((4, 2, redist_fast),
+                                     (2, 1, redist_cons)):
+            d = tempfile.mkdtemp(prefix="bench_redist_")
+            try:
+                primary = _fabricate_sharded(d, old_pc)
+                t0 = time.perf_counter()
+                primary.redistribute("last", new_pc, target=state)
+                sink.append(time.perf_counter() - t0)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
     tmp2 = tempfile.mkdtemp(prefix="bench_resume_")
     try:
         t0 = time.perf_counter()
@@ -463,6 +501,8 @@ def bench_checkpoint_resilience(reps: int = 3) -> dict:
         "ckpt_async_blocking_ms": float(np.median(async_blocks) * 1000.0),
         "ckpt_restore_ms": float(np.median(restores) * 1000.0),
         "sigterm_to_durable_snapshot_ms": sigterm_ms,
+        "ckpt_redistribute_ms": float(min(redist_cons) * 1000.0),
+        "ckpt_redistribute_fast_ms": float(min(redist_fast) * 1000.0),
         "resume_overhead_s": float(report["resume_overhead_s"]),
         "resume_bitwise_match": bool(report["bitwise_match"]),
     }
@@ -1742,6 +1782,19 @@ def main() -> None:
                             2),
                         "unit": "ms",
                         "vs_baseline": None,  # the reference just dies
+                    },
+                    {
+                        # One snapshot rewritten for a different process
+                        # count (ISSUE 18): the elastic-resume critical
+                        # path. Headline = 2→1 consolidate (plain-orbax
+                        # rewrite); fast = 4→2 hardlink re-home.
+                        "metric": "ckpt_redistribute_ms",
+                        "value": round(
+                            ckpt_report["ckpt_redistribute_ms"], 2),
+                        "unit": "ms",
+                        "vs_baseline": None,  # the reference can't shrink
+                        "fast_4_to_2_ms": round(
+                            ckpt_report["ckpt_redistribute_fast_ms"], 2),
                     },
                     {
                         "metric": "resume_overhead_s",

@@ -215,6 +215,27 @@ Rule catalogue (each backed by a positive+negative fixture in
                              persistent variant to offer stay unflagged
                              — precision over recall, the
                              empty-baseline contract.
+  GL026 unjoined-distributed-exit  a hard process exit (``sys.exit`` /
+                             ``os._exit``) lexically after a
+                             ``jax.distributed.initialize`` in the same
+                             function with no leave-through-the-barrier
+                             call in scope (``jax.distributed.shutdown``,
+                             ``sync_global_devices``, or the lifecycle
+                             drain/preempt helpers): the exiting process
+                             abandons the coordination service and every
+                             peer blocked in a collective wedges until
+                             its own timeout — the fleet-drain hazard
+                             class (ISSUE 18; the accepted shape is
+                             ``initialize`` + ``try/finally: shutdown``,
+                             or routing the exit through
+                             ``preempt_snapshot_exit``/the fleet drain
+                             barrier). ``os._exit`` skips ``finally``
+                             blocks, so only a barrier call lexically
+                             BETWEEN the initialize and the exit counts
+                             for it. Functions that never initialize,
+                             and exits before the join, stay unflagged —
+                             precision over recall, the empty-baseline
+                             contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -287,6 +308,7 @@ RULES: Dict[str, str] = {
     "GL023": "lock-order-inversion",
     "GL024": "fork-unsafe-spawn",
     "GL025": "blocking-join-on-main-path",
+    "GL026": "unjoined-distributed-exit",
 }
 
 #: Bump when analysis semantics change in a way file hashes cannot see —
@@ -395,6 +417,21 @@ _PALLAS_CALL_LEAF = "pallas_call"
 # a handler body must not contain, and the accepted signal-safe idioms
 # (one attribute/flag assignment; Event.set(); os.write on a self-pipe).
 _SIGNAL_REGISTER = frozenset({"signal.signal", "signal.sigaction"})
+
+# GL026: joining and leaving a jax.distributed job. The joiners are the
+# blessed ways out — the coordination-service shutdown, a cross-process
+# barrier, or the lifecycle helpers that drain through one.
+_DIST_INIT = frozenset({
+    "jax.distributed.initialize", "distributed.initialize",
+})
+_DIST_JOINERS = frozenset({
+    "jax.distributed.shutdown", "distributed.shutdown",
+    "multihost_utils.sync_global_devices", "sync_global_devices",
+    "jax.experimental.multihost_utils.sync_global_devices",
+    "preempt_snapshot_exit", "lifecycle.preempt_snapshot_exit",
+    "fleet_drain", "lifecycle.fleet_drain",
+})
+_HARD_EXITS = frozenset({"sys.exit", "os._exit"})
 _HANDLER_BLOCKING_CALLS = frozenset({
     "open", "print", "input", "os.fsync", "time.sleep", "json.dump",
     "json.dumps", "pickle.dump", "subprocess.run", "subprocess.Popen",
@@ -768,6 +805,7 @@ class _FunctionChecker:
         if not self.jit_scope:
             self._check_per_hypothesis_dispatch()
             self._check_scan_kernel_launch()
+            self._check_distributed_exit()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -1375,6 +1413,59 @@ class _FunctionChecker:
                     "inside the handler body; set a flag/event in the "
                     "handler and consume it on the main path "
                     "(resilience/lifecycle.py is the reference shape)",
+                )
+
+    # -- unjoined distributed exit (GL026) -----------------------------------
+
+    def _check_distributed_exit(self) -> None:
+        """GL026: a function that joins a ``jax.distributed`` job and
+        then hard-exits (``sys.exit``/``os._exit``) without leaving
+        through the barrier. The exiting process abandons the
+        coordination service mid-job; every peer blocked in a collective
+        wedges until its own timeout — the hazard class the fleet drain
+        choreography exists for. Lexical reaching, not CFG: the accepted
+        idiom is ``initialize`` + ``try/finally: shutdown``, where the
+        shutdown line FOLLOWS the exit — so for ``sys.exit`` any barrier
+        call after the initialize joins. ``os._exit`` skips ``finally``
+        blocks: only a barrier call lexically between the initialize and
+        the exit counts for it."""
+        init_lines: List[int] = []
+        joiner_lines: List[int] = []
+        exits: List[Tuple[ast.Call, str]] = []
+        for sub in ast.walk(self.fi.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = self.mod.resolve(sub.func)
+            if dotted in _DIST_INIT:
+                init_lines.append(sub.lineno)
+            elif dotted in _DIST_JOINERS:
+                joiner_lines.append(sub.lineno)
+            elif dotted in _HARD_EXITS:
+                exits.append((sub, dotted))
+        if not init_lines or not exits:
+            return
+        first_init = min(init_lines)
+        for node, dotted in exits:
+            if node.lineno <= first_init:
+                continue  # exit before the join: never entered the job
+            if dotted == "os._exit":
+                joined = any(first_init <= ln <= node.lineno
+                             for ln in joiner_lines)
+                how = ("a barrier call between the initialize and the "
+                       "exit (os._exit skips finally blocks)")
+            else:
+                joined = any(ln >= first_init for ln in joiner_lines)
+                how = ("jax.distributed.shutdown in a finally, or "
+                       "routing through preempt_snapshot_exit/the fleet "
+                       "drain barrier")
+            if not joined:
+                self._report(
+                    "GL026", node,
+                    f"{dotted}() after jax.distributed.initialize (line "
+                    f"{first_init}) with no leave-through-the-barrier "
+                    "call in scope: the exiting process abandons the "
+                    "coordination service and peers wedge in their next "
+                    f"collective; use {how}",
                 )
 
     # -- pallas interpret pinned in prod (GL016) -----------------------------

@@ -328,6 +328,12 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
       lifecycle notice poll → async ``save_preempt`` → drained durable
       commit, on the tiny trainer state (the preemption drain's
       critical path; bench.py carries the full-state headline).
+    * ``smoke_ckpt_redistribute_ms`` — a fabricated 2-process sharded
+      snapshot of the tiny state rewritten for one process by the
+      consolidate path (ISSUE 18: the elastic-resume critical path —
+      reassemble, plain orbax rewrite, checksum re-commit; bench.py
+      carries the flagship-state headline and the 4→2 hardlink-fast
+      companion).
     * ``smoke_serve_fleet_rps`` — a 2-replica serving fleet's
       saturation throughput over a tiny open-loop trace (the ISSUE-12
       fleet mechanism: routing, per-replica batchers, continuous
@@ -465,6 +471,30 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     sig_state, _ = make_train_state(FlowGNN(model_cfg), batch,
                                     TrainConfig())
     sigterm_ms = sigterm_to_snapshot_ms(sig_state, reps=reps)
+
+    # Elastic redistribution mechanism smoke (ISSUE 18): a fabricated
+    # 2-process sharded snapshot of the tiny state rewritten 2→1 by the
+    # consolidate path (reassemble + plain orbax + checksum re-commit) —
+    # the elastic-resume critical path; bench.py carries the
+    # flagship-state headline plus the 4→2 hardlink-fast companion.
+    # Best-of-reps, a fresh fabricated snapshot per rep (the rewrite
+    # consumes its input).
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    redist_dt = float("inf")
+    for _ in range(reps):
+        rtmp = tempfile.mkdtemp(prefix="bench_redist_smoke_")
+        try:
+            mgrs = [CheckpointManager(rtmp) for _ in range(2)]
+            for i, m in enumerate(mgrs):
+                m.set_host(i, 2)
+            mgrs[1].save_last(sig_state, epoch=0)
+            mgrs[0].save_last(sig_state, epoch=0)
+            t0 = time.perf_counter()
+            mgrs[0].redistribute("last", 1, target=sig_state)
+            redist_dt = min(redist_dt, time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(rtmp, ignore_errors=True)
 
     # Serving-fleet mechanism smoke (ISSUE 12): a 2-replica fleet's
     # saturation throughput over a tiny open-loop trace on per-replica
@@ -661,6 +691,8 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             "value": round(n_rows / ingest_dt, 1), "unit": "rows/s"},
         "smoke_sigterm_to_durable_snapshot_ms": {
             "value": round(sigterm_ms, 2), "unit": "ms"},
+        "smoke_ckpt_redistribute_ms": {
+            "value": round(redist_dt * 1000.0, 2), "unit": "ms"},
         "smoke_serve_fleet_rps": {
             "value": round(fleet_rps, 1), "unit": "req/s"},
         "smoke_serve_multiproc_rps": {

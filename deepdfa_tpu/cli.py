@@ -1821,18 +1821,21 @@ def cmd_analyze_code(args) -> Dict[str, Any]:
 
 
 def cmd_chaos(args) -> Dict[str, Any]:
-    """Chaos soak (deepdfa_tpu/resilience): provoke eleven fault classes —
-    simulated preemption, NaN loss, checkpoint corruption, ETL item
-    failure, serving flush failure, corrupt-corpus poisoning, a
+    """Chaos soak (deepdfa_tpu/resilience): provoke thirteen fault
+    classes — simulated preemption, NaN loss, checkpoint corruption, ETL
+    item failure, serving flush failure, corrupt-corpus poisoning, a
     mid-epoch kill under async checkpointing resumed on a different
     device count, pooled Joern workers killed mid-scan, a REAL SIGTERM
     to a mid-epoch training subprocess (step-granular preempt snapshot,
     mid-epoch resume, hung-step watchdog), a SIGTERM lame-duck drain
-    of a live serve subprocess under load, and a rolling replica drain
-    of a 3-replica serving fleet mid-load — against a tiny synthetic
-    workload and verify every recovery contract, including the
-    bit-for-bit kill-and-resume determinism gate. Exits nonzero on any
-    miss.
+    of a live serve subprocess under load, a rolling replica drain of a
+    3-replica serving fleet mid-load, a SIGKILLed engine process under
+    the multi-process router, and a SIGTERM to one member of a live
+    two-process ``jax.distributed`` training fleet (coordinated drain
+    barrier, both exit preempted, 2→1 checkpoint redistribution on
+    resume) — against a tiny synthetic workload and verify every
+    recovery contract, including the bit-for-bit kill-and-resume
+    determinism gate. Exits nonzero on any miss.
 
     (Custom fault plans don't belong here — the soak's scenarios arm
     their own; arm ``DEEPDFA_FAULT_PLAN`` against a regular command
@@ -2624,6 +2627,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     from deepdfa_tpu.resilience import lifecycle as _lifecycle
 
+    # Multi-controller bring-up (ISSUE 18): the elastic fleet harness (and
+    # any real multi-host launcher) sets DEEPDFA_DIST_COORD/COUNT/ID so
+    # every process joins one jax.distributed job BEFORE any command code
+    # touches jax — process_count()/process_index() then shape every
+    # host-sharded surface (mesh, batches, sharded snapshots). Absent the
+    # env, nothing changes: single-controller stays the default.
+    dist_coord = os.environ.get("DEEPDFA_DIST_COORD")
+    dist_joined = False
+    if dist_coord:
+        import jax as _jax
+
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # The CPU backend refuses cross-process computations without
+            # a collectives implementation; gloo-over-TCP ships in jaxlib
+            # and rides the coordination service joined below. Must land
+            # before the first backend touch (config, not env — the flag
+            # has no env hook).
+            _jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        _jax.distributed.initialize(
+            coordinator_address=dist_coord,
+            num_processes=int(os.environ["DEEPDFA_DIST_COUNT"]),
+            process_id=int(os.environ["DEEPDFA_DIST_ID"]),
+        )
+        dist_joined = True
+        logger.info("joined distributed job at %s as process %d/%d",
+                    dist_coord, _jax.process_index(), _jax.process_count())
     try:
         result = args.func(args)
     except _lifecycle.Preempted as p:
@@ -2636,6 +2665,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "snapshot": p.snapshot,
                           "exit_code": _lifecycle.EXIT_PREEMPTED}))
         return _lifecycle.EXIT_PREEMPTED
+    finally:
+        if dist_joined:
+            # Leave the coordination service cleanly on EVERY path —
+            # preempted drains included — so peers' barriers never hang
+            # on a vanished process (the GL026 hazard class).
+            import jax as _jax
+
+            try:
+                _jax.distributed.shutdown()
+            except Exception:
+                logger.warning("jax.distributed.shutdown failed",
+                               exc_info=True)
     # analyze-code carries the CI contract in exit_code (new findings -> 1);
     # every other command reports via its JSON line and exits 0.
     if isinstance(result, dict) and result.get("exit_code"):

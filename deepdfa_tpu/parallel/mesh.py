@@ -128,36 +128,69 @@ def snapshot_layout(mesh: Optional[Mesh]) -> dict:
 
 
 class ProcessCountMismatchError(RuntimeError):
-    """A resume sees a different ``process_count`` than the snapshot
-    recorded. Single-host DP reshapes move only per-shard packing, but a
-    multi-host reshape changes which process feeds which global batch
-    slice — resuming silently would shear the data order (and the
-    reshard would surface only as a shape mismatch deep in device_put).
-    Fail loud with the actionable fix instead."""
+    """A cross-process-count restore found the snapshot's shard set
+    genuinely unrecoverable: a missing shard directory, manifest, or
+    leaf file — something redistribution cannot reassemble the
+    replicated tree from. A mere ``process_count`` change is NOT this
+    error anymore: since ISSUE 18 :func:`check_layout_compatible`
+    routes it to checkpoint redistribution
+    (``CheckpointManager.redistribute`` — consolidate on the primary,
+    broadcast to the fleet, re-shard onto the new mesh), and the resume
+    proceeds. This error survives as the typed fail-loud for the cases
+    where the bytes themselves are incomplete; the fix is to restore
+    from another intact snapshot (the verified fallback does this
+    automatically) or re-run the original fleet."""
 
 
-def check_layout_compatible(prev: Optional[dict], cur: dict) -> None:
-    """Typed guard for topology-independent resume (the multi-host half
-    of the elastic-resume contract): a recorded ``process_count`` that
-    differs from the resuming one raises
-    :class:`ProcessCountMismatchError` before any reshard work starts.
-    Layouts without a recorded process count (pre-ISSUE-10 snapshots)
-    pass — there is nothing to compare against."""
+# Resume strategies check_layout_compatible routes to (ISSUE 18).
+RESUME_SAME = "same"
+RESUME_RESHARD = "reshard"
+RESUME_REDISTRIBUTE_FAST = "redistribute_fast"
+RESUME_REDISTRIBUTE_CONSOLIDATE = "redistribute_consolidate"
+
+
+def plan_resume(prev: Optional[dict], cur: dict) -> str:
+    """Pick the resume strategy for a snapshot layout vs the live one.
+
+    * ``same`` — identical logical layout (or nothing recorded to
+      compare: pre-ISSUE-10 snapshots resume as before).
+    * ``reshard`` — same process count, different shard/device count:
+      the single-host elastic path (replicated ``device_put`` onto the
+      new mesh, ``reshard_state``).
+    * ``redistribute_fast`` — process count changed and the old shard
+      set nests into the new one (``old % new == 0``, both > 1): leaf
+      files re-home by hardlink, no array deserialization.
+    * ``redistribute_consolidate`` — any other process-count change:
+      the primary consolidates every shard into the replicated tree,
+      broadcasts it, and re-shards onto the new topology.
+    """
     if not prev:
-        return
+        return RESUME_SAME
     prev_pc = prev.get("process_count")
     cur_pc = cur.get("process_count")
-    if prev_pc is None or cur_pc is None:
-        return
-    if int(prev_pc) != int(cur_pc):
-        raise ProcessCountMismatchError(
-            f"snapshot was written by a {prev_pc}-process job "
-            f"(layout {prev}); this resume runs {cur_pc} process(es) "
-            f"(layout {cur}). Cross-process-count resume is not "
-            "supported: restart the job on the original process count, "
-            "or consolidate to one host first (restore + re-save on a "
-            f"single-process mesh), then resume on {cur_pc}."
-        )
+    if prev_pc is None or cur_pc is None or int(prev_pc) == int(cur_pc):
+        if prev.get("n_shards") is not None \
+                and int(prev.get("n_shards", 1)) != int(cur.get("n_shards", 1)):
+            return RESUME_RESHARD
+        return RESUME_SAME
+    prev_pc, cur_pc = int(prev_pc), int(cur_pc)
+    if prev_pc > 1 and cur_pc > 1 and prev_pc % cur_pc == 0:
+        return RESUME_REDISTRIBUTE_FAST
+    return RESUME_REDISTRIBUTE_CONSOLIDATE
+
+
+def check_layout_compatible(prev: Optional[dict], cur: dict) -> str:
+    """Route a resume across topologies (the multi-host half of the
+    elastic-resume contract). Returns the strategy from
+    :func:`plan_resume`; a ``process_count`` change routes to
+    checkpoint redistribution instead of raising (the pre-ISSUE-18
+    fail-loud). The typed :class:`ProcessCountMismatchError` is no
+    longer raised here — it now marks genuinely unrecoverable shard
+    sets and is raised by the consolidate/redistribute machinery in
+    ``train/checkpoint.py`` when shard files are missing. Layouts
+    without a recorded process count (pre-ISSUE-10 snapshots) route to
+    ``same`` — there is nothing to compare against."""
+    return plan_resume(prev, cur)
 
 
 def reshard_state(state, mesh: Optional[Mesh]):

@@ -753,9 +753,11 @@ def _read_events(run_dir: str) -> List[Dict[str, Any]]:
     return events
 
 
-def _steps_in_epoch0(n_examples: int) -> int:
+def _steps_in_epoch0(n_examples: int, n_shards: int = 1) -> int:
     """Step count of the subprocess fit's epoch 0, computed with the SAME
-    config/packer the child runs (the fault-plan ordinal anchor)."""
+    config/packer the child runs (the fault-plan ordinal anchor).
+    ``n_shards`` matches the child's global mesh (the fleet scenario runs
+    an 8-shard mesh; every process iterates the same global step count)."""
     from deepdfa_tpu import cli
     from deepdfa_tpu.core.config import (
         DataConfig as DC,
@@ -781,7 +783,7 @@ def _steps_in_epoch0(n_examples: int) -> int:
                          oversample_factor=data_cfg.oversample_factor)
     return sum(1 for _ in _batches(examples, train_idx[idx0], data_cfg,
                                    subkeys_for(model_cfg.feature),
-                                   data_cfg.batch_size))
+                                   data_cfg.batch_size, n_shards))
 
 
 def scenario_preempt_drain(out_dir: str, n_examples: int,
@@ -1697,6 +1699,238 @@ def scenario_proc_crash(out_dir: str) -> Dict[str, Any]:
     }
 
 
+def scenario_elastic_shrink(out_dir: str, n_examples: int,
+                            epochs: int) -> Dict[str, Any]:
+    """THE elastic-fleet acceptance scenario (ISSUE 18): a **real
+    SIGTERM** to one of two ``jax.distributed`` training processes
+    mid-epoch, then a shrunk 2→1 resume. Demands:
+
+    * the signalled process announces the drain barrier and the
+      SURVIVOR follows it — both exit ``EXIT_PREEMPTED`` behind one
+      committed 2-process sharded ``preempt_<E>_<S>`` snapshot (the
+      coordinated drain, not one orphan and one wedged peer);
+    * the choreography is auditable from ONE merged trace: named
+      per-host tracks carrying ``lifecycle.drain_barrier`` events —
+      ``announce`` from the signalled host, ``observe``/``drain`` from
+      both;
+    * a single-process ``--resume`` on the same run dir redistributes
+      the sharded snapshots 2→1 via the new checkpoint path (audited by
+      its ``ckpt.redistribute`` event), restarts MID-epoch, and its
+      loss history is continuous with the uninterrupted 2-process
+      reference — pre-kill epochs bitwise (identical topology), resumed
+      epochs tolerance-bounded (the process-topology change moves the
+      cross-shard reduction order; same bound as the reshape story).
+
+    Topology: 2 processes × 4 virtual CPU devices → one 8-shard global
+    mesh; resume is 1 process × 8 devices — n_shards stays 8, so the
+    step-granular resume cursor and the per-shard packing survive the
+    shrink and only the process count changes.
+    """
+    import json as _json
+    import math
+    import shutil
+    import signal as _signal
+    import subprocess
+    import time
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.core.hostmesh import cpu_mesh_env
+    from deepdfa_tpu.resilience import elastic, lifecycle
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    root = os.path.join(out_dir, "elastic_shrink")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    n_shards = 8
+    steps_ep0 = _steps_in_epoch0(n_examples, n_shards=n_shards)
+
+    active = telemetry.current_run() if telemetry.enabled() else None
+
+    def history_of(run_dir):
+        with open(os.path.join(run_dir, "history.json")) as f:
+            return _json.load(f)
+
+    # --- uninterrupted 2-process reference ------------------------------
+    # (fleet_member_env scrubs inherited fault plans / trace payloads and
+    # re-adds the trace join per member, by name.)
+    ref_dir = os.path.join(root, "ref")
+    ref_procs = elastic.launch_fleet(
+        elastic.fit_argv(ref_dir, n_examples, epochs, n_devices=n_shards),
+        process_count=2, n_devices_per_proc=n_shards // 2,
+        process_prefix="ref", member_env={
+            pi: {"DEEPDFA_DRAIN_GRACE_S": "60"} for pi in range(2)},
+    )
+    ref_results = elastic.wait_fleet(ref_procs, timeout_s=600)
+    ref_ok = [r.get("returncode") for r in ref_results] == [0, 0]
+    ref_hist = history_of(ref_dir) if ref_ok else {"epochs": []}
+
+    # --- SIGTERM one of two, mid-epoch 1 --------------------------------
+    part_dir = os.path.join(root, "part")
+    plan = _json.dumps({"faults": [
+        {"site": "train.loss", "kind": "delay", "at": steps_ep0,
+         "seconds": 10.0}]})
+    member_env = {
+        0: {"DEEPDFA_DRAIN_GRACE_S": "60"},
+        # The delay pins where the signal lands: epoch 1's FIRST step
+        # sleeps 10 s on the to-be-killed member (its peer blocks on the
+        # same step's collective), the parent signals into that window.
+        # First step, not a later one: the drain target is completed+1,
+        # so a signal in the epoch's last step would slip the barrier to
+        # the next epoch boundary — legal, but this scenario must prove
+        # the MID-epoch drain (preempt_1_<s> with 0 < s < steps).
+        1: {"DEEPDFA_DRAIN_GRACE_S": "60", "DEEPDFA_FAULT_PLAN": plan},
+    }
+    procs = elastic.launch_fleet(
+        elastic.fit_argv(part_dir, n_examples, epochs, n_devices=n_shards),
+        process_count=2, n_devices_per_proc=n_shards // 2,
+        process_prefix="fleet", member_env=member_env,
+    )
+    saw_epoch0 = _wait_for_meta_epoch(part_dir, 0, 300.0, proc=procs[1])
+    time.sleep(0.5)
+    t_kill = time.monotonic()
+    procs[1].send_signal(_signal.SIGTERM)
+    results = elastic.wait_fleet(procs, timeout_s=180)
+    drain_wall_s = time.monotonic() - t_kill
+    exit_codes = [r.get("returncode") for r in results]
+    both_preempted = exit_codes == [lifecycle.EXIT_PREEMPTED,
+                                    lifecycle.EXIT_PREEMPTED]
+
+    # --- post-mortem: ONE coordinated sharded preempt snapshot ----------
+    probe = CheckpointManager(part_dir)
+    candidate = probe.resume_candidate()
+    pinfo = probe.preempt_info(candidate) if candidate else None
+    snapshot_verified = bool(candidate and probe.verify(candidate))
+    rec = (probe.best_meta.get("snapshots", {}) or {}).get(candidate or "",
+                                                           {})
+    snapshot_sharded_2 = int(rec.get("shards", 1)) == 2
+    # The fleet's preempt-time history (pre-kill epochs) — read NOW: the
+    # resume below rewrites history.json with the resumed epochs only.
+    part_hist = history_of(part_dir) if both_preempted else {"epochs": []}
+
+    # --- choreography audit from the parent's merged trace --------------
+    barrier: Dict[str, Any] = {"checked": False}
+    if active is not None:
+        telemetry.flush()
+        events = _read_events(active.run_dir)
+        db = [e for e in events if e.get("name") == "lifecycle.drain_barrier"]
+        by_phase: Dict[str, set] = {}
+        for e in db:
+            phase = (e.get("attrs") or {}).get("phase")
+            by_phase.setdefault(phase, set()).add(e.get("_process"))
+        barrier = {
+            "checked": True,
+            "events": len(db),
+            "announce_from": sorted(by_phase.get("announce", ())),
+            "observe_from": sorted(by_phase.get("observe", ())),
+            "drain_from": sorted(by_phase.get("drain", ())),
+            # The signalled host announces; the survivor observes; BOTH
+            # reach the drain phase on their own named tracks.
+            "choreography_ok": (
+                "fleet1" in by_phase.get("announce", set())
+                and "fleet0" in by_phase.get("observe", set())
+                and {"fleet0", "fleet1"} <= by_phase.get("drain", set())
+            ),
+        }
+
+    # --- shrunk resume: 1 process × 8 devices ---------------------------
+    env = cpu_mesh_env(_child_env(process="fit-shrunk"), n_shards,
+                       force_count=True)
+    res = subprocess.run(
+        elastic.fit_argv(part_dir, n_examples, epochs, n_devices=n_shards,
+                         resume=True),
+        env=env, capture_output=True, text=True, timeout=600)
+    res_ok = res.returncode == 0
+    res_hist = history_of(part_dir) if res_ok else {"epochs": []}
+    meta_after = CheckpointManager(part_dir).best_meta
+    snaps_after = meta_after.get("snapshots", {})
+    all_plain_after = all("shards" not in r for r in snaps_after.values())
+
+    redistributed = False
+    if active is not None:
+        telemetry.flush()
+        redist = [
+            (e.get("attrs") or {})
+            for e in _read_events(active.run_dir)
+            if e.get("name") == "ckpt.redistribute"
+            and e.get("_process") == "fit-shrunk"
+            and "strategy" in (e.get("attrs") or {})
+        ]
+        redistributed = bool(redist) and redist[0]["from_processes"] == 2 \
+            and redist[0]["to_processes"] == 1
+    else:
+        # Untraced runs: the on-disk rewrite is the evidence.
+        redistributed = all_plain_after
+
+    # --- loss continuity -------------------------------------------------
+    # Pre-kill epochs ran on the identical 2-process topology: bitwise.
+    preempt_epoch = int(pinfo["epoch"]) if pinfo else -1
+    pre_kill = part_hist["epochs"][:preempt_epoch] if preempt_epoch >= 0 \
+        else []
+    pre_kill_bitwise = (
+        bool(pre_kill)
+        and all(_records_match(a, b)
+                for a, b in zip(pre_kill, ref_hist["epochs"]))
+    )
+    # Resumed epochs re-run the preempted epoch onward on the shrunk
+    # process topology: same 8-shard packing, but the cross-process
+    # reduction became a single-process one — bounded drift, not
+    # bit-equality (the documented elastic tolerance).
+    tail = ref_hist["epochs"][preempt_epoch:] if preempt_epoch >= 0 else []
+    resumed = res_hist["epochs"]
+    deltas = [
+        abs(a[k] - b[k]) / max(abs(b[k]), 1e-12)
+        for a, b in zip(resumed, tail) for k in ("train_loss", "val_loss")
+        if math.isfinite(a[k]) and math.isfinite(b[k])
+    ]
+    max_rel_delta = max(deltas) if deltas else float("inf")
+    tolerance = 2e-3
+    continuity = (
+        bool(tail) and len(resumed) == len(tail)
+        and [e["epoch"] for e in resumed] == [e["epoch"] for e in tail]
+        and max_rel_delta <= tolerance
+    )
+
+    ok = bool(
+        ref_ok and saw_epoch0
+        and both_preempted
+        and drain_wall_s < 75.0          # grace + one fenced step + margin
+        and pinfo is not None and int(pinfo["epoch"]) == 1
+        and int(pinfo.get("seen", 0)) > 0   # genuinely MID-epoch
+        and snapshot_verified
+        and snapshot_sharded_2
+        and (not barrier["checked"] or barrier["choreography_ok"])
+        and res_ok
+        and redistributed
+        and all_plain_after
+        and pre_kill_bitwise
+        and continuity
+    )
+    return {
+        "ok": ok,
+        "fault_kinds": ["sigterm", "delay"],
+        "fleet_exit_codes": exit_codes,
+        "drain_wall_s": round(drain_wall_s, 2),
+        "preempt_snapshot": candidate,
+        "preempt_info": pinfo,
+        "snapshot_verified": snapshot_verified,
+        "snapshot_sharded_2": snapshot_sharded_2,
+        "drain_barrier": barrier,
+        "resume_exit_code": res.returncode,
+        "redistributed": redistributed,
+        "snapshots_plain_after_resume": all_plain_after,
+        "pre_kill_bitwise": pre_kill_bitwise,
+        "resumed_epochs": [e["epoch"] for e in resumed],
+        "continuity": continuity,
+        "continuity_tolerance": tolerance,
+        "max_rel_loss_delta": max_rel_delta,
+        "fleet_stderr_tail": {
+            i: (r.get("stderr") or "")[-800:]
+            for i, r in enumerate(results)
+            if r.get("returncode") != lifecycle.EXIT_PREEMPTED
+        },
+    }
+
+
 def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
              epochs: int = 3) -> Dict[str, Any]:
     """All scenarios, one report. ``ok`` only when every scenario passed;
@@ -1720,6 +1954,8 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
     scenarios["serve_lame_duck"] = scenario_serve_lame_duck(out_dir)
     scenarios["fleet_roll"] = scenario_fleet_roll(out_dir)
     scenarios["proc_crash"] = scenario_proc_crash(out_dir)
+    scenarios["elastic_shrink"] = scenario_elastic_shrink(
+        out_dir, n_examples, epochs)
 
     kind_of = {"preempt_resume": "preempt-raise",
                "nan_rollback": "nan-loss",
@@ -1732,7 +1968,8 @@ def run_soak(out_dir: str = "runs/chaos", n_examples: int = 48,
                "preempt_drain": "sigterm-drain",
                "serve_lame_duck": "sigterm-lame-duck",
                "fleet_roll": "replica-roll",
-               "proc_crash": "sigkill-process"}
+               "proc_crash": "sigkill-process",
+               "elastic_shrink": "sigterm-fleet-drain"}
     kinds: List[str] = sorted(kind_of[name] for name in scenarios)
     ok = all(res["ok"] for res in scenarios.values())
     return {

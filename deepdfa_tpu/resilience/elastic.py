@@ -1,0 +1,244 @@
+"""Two-process CI harness for elastic multi-controller training (ISSUE 18).
+
+Real multi-host TPU fleets are unavailable in CI; multi-CONTROLLER
+correctness (two ``jax.distributed``-joined processes running the real
+``cli fit``, host-sharded batches, sharded snapshots, the fleet drain
+barrier) is validated on CPU instead: each spawned process gets its own
+virtual device set via the ``cpu_mesh_env`` recipe
+(``--xla_force_host_platform_device_count=N``) and joins one
+coordination service via ``DEEPDFA_DIST_COORD/COUNT/ID`` (consumed by
+``cli.main`` before any command touches jax). Same program, same
+collectives, same snapshot rendezvous as a real fleet — CPU execution.
+
+Three consumers:
+
+* ``tests/test_elastic_fleet.py`` — the tier-1 gate (fleet fit, sharded
+  snapshots on disk, 2→1 elastic resume).
+* ``chaos.scenario_elastic_shrink`` — SIGTERM one of two processes
+  mid-epoch, audit the coordinated drain + redistributed resume.
+* ``scripts/test.sh`` — ``python -m deepdfa_tpu.resilience.elastic
+  --smoke``, the fast end-to-end bring-up check.
+
+Every process can join the caller's trace plane (``process=`` →
+``DEEPDFA_TRACE_CONTEXT`` via the blessed ``context.child_env`` helper),
+so one merged trace carries named per-host tracks — the choreography is
+audited from ONE ``cli trace report``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from deepdfa_tpu.core.hostmesh import cpu_mesh_env
+
+COORD_HOST = "127.0.0.1"
+#: Generous by design: two cold CPU jax processes compile serially on a
+#: loaded CI box.
+DEFAULT_TIMEOUT_S = 600.0
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the coordination service."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((COORD_HOST, 0))
+        return int(s.getsockname()[1])
+
+
+def fleet_member_env(
+    process_index: int,
+    process_count: int,
+    coord_port: int,
+    n_devices_per_proc: int,
+    process: Optional[str] = None,
+    base: Optional[Dict[str, str]] = None,
+    **extra: str,
+) -> Dict[str, str]:
+    """Env for one member of a ``process_count``-process fleet.
+
+    ``cpu_mesh_env`` pins the platform + per-process virtual device
+    count; ``DEEPDFA_DIST_*`` makes ``cli.main`` join the shared
+    ``jax.distributed`` job; ``process`` opts the child into the
+    caller's trace plane (named track in the merged trace). Stale fault
+    plans and trace payloads from the caller are scrubbed — each member
+    carries only what it is given.
+    """
+    from deepdfa_tpu.resilience import inject
+    from deepdfa_tpu.telemetry import context as trace_context
+
+    env = cpu_mesh_env(base or os.environ, n_devices_per_proc,
+                       force_count=True)
+    env.pop(inject.ENV_VAR, None)
+    env.pop(trace_context.ENV_VAR, None)
+    if process is not None:
+        env = trace_context.child_env(process, base=env)
+    env.update(
+        DEEPDFA_DIST_COORD=f"{COORD_HOST}:{coord_port}",
+        DEEPDFA_DIST_COUNT=str(int(process_count)),
+        DEEPDFA_DIST_ID=str(int(process_index)),
+        # The CPU backend refuses cross-process computations without a
+        # collectives implementation; gloo-over-TCP ships in jaxlib and
+        # rides the same coordination service the processes already join.
+        JAX_CPU_COLLECTIVES_IMPLEMENTATION="gloo",
+    )
+    env.update(extra)
+    return env
+
+
+def launch_fleet(
+    argv: Sequence[str],
+    process_count: int,
+    n_devices_per_proc: int,
+    process_prefix: Optional[str] = None,
+    coord_port: Optional[int] = None,
+    member_env: Optional[Dict[int, Dict[str, str]]] = None,
+    **popen_kw: Any,
+) -> List[subprocess.Popen]:
+    """Spawn ``process_count`` copies of ``argv`` joined as one fleet.
+
+    Every member runs the SAME argv (the multi-controller contract: one
+    program, per-process data slices). ``member_env`` adds per-index env
+    on top (fault plans target one host). Members capture their own
+    stdout/stderr by default (``text=True``) so a failed fleet is
+    diagnosable per process.
+    """
+    port = coord_port if coord_port is not None else free_port()
+    procs: List[subprocess.Popen] = []
+    popen_kw.setdefault("stdout", subprocess.PIPE)
+    popen_kw.setdefault("stderr", subprocess.PIPE)
+    popen_kw.setdefault("text", True)
+    try:
+        for pi in range(process_count):
+            extra = dict((member_env or {}).get(pi, {}))
+            env = fleet_member_env(
+                pi, process_count, port, n_devices_per_proc,
+                process=(f"{process_prefix}{pi}"
+                         if process_prefix is not None else None),
+                **extra,
+            )
+            procs.append(subprocess.Popen(list(argv), env=env, **popen_kw))
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+    return procs
+
+
+def wait_fleet(procs: Sequence[subprocess.Popen],
+               timeout_s: float = DEFAULT_TIMEOUT_S) -> List[Dict[str, Any]]:
+    """Wait for every member; returns per-member ``{returncode, stdout,
+    stderr}``. On timeout the WHOLE fleet is killed first (one wedged
+    member wedges every collective) and the timeout is reported as
+    returncode ``None`` in that member's record."""
+    deadline = time.monotonic() + timeout_s
+    results: List[Dict[str, Any]] = [{} for _ in procs]
+    timed_out = False
+    for i, p in enumerate(procs):
+        remaining = deadline - time.monotonic()
+        try:
+            out, err = p.communicate(timeout=max(remaining, 0.1))
+            results[i] = {"returncode": p.returncode, "stdout": out or "",
+                          "stderr": err or ""}
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            break
+    if timed_out:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for i, p in enumerate(procs):
+            if not results[i]:
+                out, err = p.communicate()
+                # None marks "killed by the harness timeout", distinct
+                # from any real exit code the member chose.
+                results[i] = {"returncode": None, "stdout": out or "",
+                              "stderr": err or ""}
+    return results
+
+
+def fit_argv(run_dir: str, n_examples: int, epochs: int, n_devices: int,
+             resume: bool = False) -> List[str]:
+    """A tiny-but-real ``cli fit`` argv for the fleet (the chaos TINY
+    shape with an explicit global mesh — every member runs this same
+    command)."""
+    argv = [sys.executable, "-m", "deepdfa_tpu.cli", "fit",
+            "--dataset", f"synthetic:{n_examples}",
+            "--checkpoint-dir", run_dir,
+            "--n-devices", str(int(n_devices)),
+            "--set", "model.hidden_dim=8", "--set", "model.n_steps=2",
+            "--set", "model.num_output_layers=2",
+            "--set", f"train.max_epochs={epochs}",
+            "--set", "train.learning_rate=0.002", "--set", "train.seed=0",
+            "--set", "data.batch_size=16", "--set", "data.eval_batch_size=16",
+            "--set", "data.max_nodes_per_graph=64",
+            "--set", "data.max_edges_per_node=4",
+            "--set", "data.undersample_factor=1.0"]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def smoke(out_dir: Optional[str] = None,
+          timeout_s: float = DEFAULT_TIMEOUT_S) -> Dict[str, Any]:
+    """End-to-end bring-up check: a 2-process × 2-virtual-device fleet
+    trains 2 tiny epochs through the real CLI; both members must exit 0
+    and the shared run dir must hold a committed 2-process sharded
+    snapshot. Returns an ``{"ok": bool, ...}`` report (the scripts/
+    test.sh contract)."""
+    own_tmp = out_dir is None
+    if own_tmp:
+        out_dir = tempfile.mkdtemp(prefix="elastic_smoke_")
+    run_dir = os.path.join(out_dir, "fleet")
+    procs = launch_fleet(fit_argv(run_dir, 32, 2, n_devices=4),
+                         process_count=2, n_devices_per_proc=2)
+    results = wait_fleet(procs, timeout_s=timeout_s)
+    codes = [r.get("returncode") for r in results]
+    report: Dict[str, Any] = {"ok": codes == [0, 0], "returncodes": codes,
+                              "run_dir": run_dir}
+    if not report["ok"]:
+        for i, r in enumerate(results):
+            report[f"stderr_{i}"] = (r.get("stderr") or "")[-2000:]
+        return report
+    meta_path = os.path.join(run_dir, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        report["ok"] = False
+        report["error"] = f"no readable {meta_path}"
+        return report
+    report["last_epoch"] = int(meta.get("last_epoch", -1))
+    snaps = meta.get("snapshots", {})
+    report["sharded_snapshots"] = sorted(
+        n for n, rec in snaps.items() if int(rec.get("shards", 1)) == 2)
+    if report["last_epoch"] != 1 or not report["sharded_snapshots"]:
+        report["ok"] = False
+        report["error"] = ("fleet finished but left no committed 2-process "
+                           "sharded snapshot")
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="deepdfa_tpu.resilience.elastic")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the 2-process fleet bring-up check")
+    parser.add_argument("--out-dir", default=None)
+    parser.add_argument("--timeout-s", type=float, default=DEFAULT_TIMEOUT_S)
+    args = parser.parse_args(argv)
+    if not args.smoke:
+        parser.error("nothing to do (pass --smoke)")
+    report = smoke(args.out_dir, timeout_s=args.timeout_s)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

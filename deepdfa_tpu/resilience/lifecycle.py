@@ -56,6 +56,7 @@ the full drain machinery without a real signal:
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import signal
@@ -607,3 +608,165 @@ def preempt_snapshot_exit(notice: PreemptionNotice, checkpointer, state,
     if participant is not None:
         participant.drained(ok=True)
     raise Preempted(notice, snapshot, int(epoch), int(step), history)
+
+
+# ---------------------------------------------------------------------------
+# Coordinated fleet preemption drain (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+FLEET_DRAIN_FILE = "FLEET_DRAIN.json"
+FLEET_CLEAR_WAIT_S = 30.0
+
+
+class FleetDrain:
+    """Filesystem drain barrier for a multi-controller training fleet.
+
+    One host's SIGTERM must not strand the others inside a collective:
+    the notified process *announces* a drain target — the next step
+    boundary, ``(epoch, step+1)`` — by atomically creating
+    ``FLEET_DRAIN.json`` under the shared run dir (the same rendezvous
+    discipline as the serve router's port files), then keeps
+    participating until the target. Every process checks the file
+    before dispatching each step and drains at exactly the target, so
+    all ``preempt_<E>_<S>`` shards describe the same state and every
+    process exits :data:`EXIT_PREEMPTED`.
+
+    Why "one more step": with per-step dispatch fencing (the train loop
+    blocks on each step's loss when a fleet is live) a peer can have
+    dispatched at most one step beyond the announcer's completed step,
+    and the announcer writes the file BEFORE dispatching that step
+    itself — so by the time any peer completes the target step the file
+    is already visible, and nobody ever dispatches a collective the
+    rest of the fleet will not join. First writer wins when two hosts
+    are signalled at once (atomic ``os.link`` create-if-absent); the
+    loser follows the existing target, which is within one step of its
+    own by the same fencing argument.
+
+    Every phase is auditable from the merged trace:
+    ``lifecycle.drain_barrier`` events with ``phase="announce"`` /
+    ``"observe"`` / ``"drain"`` carry the process index, so ``cli trace
+    report`` reconstructs the choreography per host.
+    """
+
+    def __init__(self, directory: str, process_index: int,
+                 process_count: int):
+        self.directory = os.path.abspath(directory)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.path = os.path.join(self.directory, FLEET_DRAIN_FILE)
+        self._announced = False
+        self._target: Optional[Dict[str, Any]] = None
+        self._observed = False
+
+    def clear(self, timeout_s: float = FLEET_CLEAR_WAIT_S) -> None:
+        """Start-of-fit hygiene: the primary removes a drain file left by
+        the run being resumed (it would otherwise read as an instantly
+        reached target); peers wait for the removal so no process can
+        observe the stale target first."""
+        if self.process_index == 0:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+            return
+        deadline = time.monotonic() + timeout_s
+        while os.path.exists(self.path):
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "fleet drain: stale %s not cleared by the primary "
+                    "within %.0fs; proceeding", self.path, timeout_s)
+                return
+            time.sleep(0.05)
+
+    def announce(self, epoch: int, step: int, reason: str) -> Dict[str, Any]:
+        """Publish the drain target (first writer wins; idempotent per
+        process). Returns the authoritative target."""
+        if not self._announced:
+            self._announced = True
+            payload = {
+                "epoch": int(epoch), "step": int(step),
+                "reason": str(reason), "initiator": self.process_index,
+            }
+            tmp = f"{self.path}.{self.process_index}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                # Atomic create-if-absent: os.link fails with EEXIST when
+                # a peer announced first — its target is authoritative.
+                os.link(tmp, self.path)
+                self._target = payload
+                telemetry.event("lifecycle.drain_barrier", phase="announce",
+                                epoch=int(epoch), step=int(step),
+                                reason=str(reason),
+                                process_index=self.process_index,
+                                process_count=self.process_count)
+                logger.warning(
+                    "fleet drain: process %d announced drain target "
+                    "(epoch %d, step %d) after %s", self.process_index,
+                    int(epoch), int(step), reason)
+            except FileExistsError:
+                pass
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        target = self.poll()
+        return target if target is not None else {
+            "epoch": int(epoch), "step": int(step), "reason": str(reason),
+            "initiator": self.process_index,
+        }
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """The authoritative drain target, or None. Cached after the
+        first read — the file is immutable once created."""
+        if self._target is not None:
+            return self._target
+        try:
+            with open(self.path) as f:
+                target = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+        self._target = target
+        if not self._observed and int(target.get("initiator", -1)) \
+                != self.process_index:
+            self._observed = True
+            telemetry.event("lifecycle.drain_barrier", phase="observe",
+                            epoch=int(target.get("epoch", -1)),
+                            step=int(target.get("step", -1)),
+                            initiator=int(target.get("initiator", -1)),
+                            process_index=self.process_index)
+            logger.warning(
+                "fleet drain: process %d observed drain target "
+                "(epoch %d, step %d) from process %d", self.process_index,
+                int(target.get("epoch", -1)), int(target.get("step", -1)),
+                int(target.get("initiator", -1)))
+        return target
+
+    def reached(self, epoch: int, seen: int) -> Optional[Dict[str, Any]]:
+        """The target, when ``(epoch, seen)`` is at or past it — the
+        step-boundary check every process runs before dispatching."""
+        target = self.poll()
+        if target is None:
+            return None
+        if (int(epoch), int(seen)) >= (int(target.get("epoch", -1)),
+                                       int(target.get("step", 0))):
+            return target
+        return None
+
+    def mark_draining(self, epoch: int, seen: int) -> None:
+        telemetry.event("lifecycle.drain_barrier", phase="drain",
+                        epoch=int(epoch), step=int(seen),
+                        process_index=self.process_index)
+
+
+def fleet_drain(directory: Optional[str],
+                host: Optional[Tuple[int, int]]) -> Optional[FleetDrain]:
+    """A :class:`FleetDrain` for a multi-process fit with a shared run
+    dir; None otherwise (single-process fits keep the immediate-drain
+    path and pay nothing)."""
+    if directory is None or host is None or int(host[1]) <= 1:
+        return None
+    return FleetDrain(directory, host[0], host[1])

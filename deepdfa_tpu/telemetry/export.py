@@ -225,10 +225,17 @@ def events_to_chrome_trace(events: Iterable[Dict[str, Any]],
 
 
 def _write_trace_doc(doc: Dict[str, Any], trace_path: str) -> int:
-    tmp = trace_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, trace_path)
+    # Pid-unique scratch: in an elastic fleet every member of a shared
+    # run dir rewrites the merged trace at its own end_run, and two
+    # writers racing one ".tmp" name lose it under the other's replace.
+    tmp = f"{trace_path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, trace_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return len(doc["traceEvents"])
 
 

@@ -289,6 +289,19 @@ def summarize(events: List[Dict[str, Any]],
         "reshapes": len(named(instants, ("ckpt.reshape",))),
         "drains": len(drains),
         "drain_wait_ms_max": round(max(drain_ms, default=0.0), 3),
+        # Cross-process-count redistributions (ISSUE 18). The instant
+        # event shares its name with the surrounding span — the
+        # "strategy" attr is what distinguishes it.
+        "redistributions": [
+            {"snapshot": a.get("snapshot", "?"),
+             "strategy": a.get("strategy", "?"),
+             "from_processes": int(a.get("from_processes", 0)),
+             "to_processes": int(a.get("to_processes", 0)),
+             "ms": round(float(a.get("ms", 0.0)), 3)}
+            for a in ((e.get("attrs") or {})
+                      for e in named(instants, ("ckpt.redistribute",)))
+            if "strategy" in a
+        ],
     }
 
     # --- roofline: cost.model events joined to measured spans -----------
@@ -317,6 +330,8 @@ def summarize(events: List[Dict[str, Any]],
         "hangs": len(hangs),
         "forced_exits": len([e for e in named(instants, ("lifecycle.exit",))
                              if (e.get("attrs") or {}).get("forced")]),
+        "fleet_barrier": _fleet_barrier(
+            named(instants, ("lifecycle.drain_barrier",))),
     }
 
     # --- SLO breaches observed live during the run ----------------------
@@ -355,6 +370,38 @@ def summarize(events: List[Dict[str, Any]],
         "processes": processes,
         "propagation": propagation,
         "telemetry_drops": drops,
+    }
+
+
+def _fleet_barrier(barrier: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The multi-process drain choreography (ISSUE 18), audited from the
+    merged trace: which fleet members announced the coordinated stop,
+    which observed a peer's announcement, and which reached the drain
+    target — keyed by the per-host track (``_process``) when the events
+    come from a merged fleet trace, falling back to the recorded
+    ``process_index``."""
+    by_phase: Dict[str, List[str]] = {}
+    target = None
+    for e in barrier:
+        a = e.get("attrs") or {}
+        who = str(e.get("_process")
+                  or a.get("process_index", "?"))
+        by_phase.setdefault(str(a.get("phase", "?")), []).append(who)
+        if a.get("phase") == "announce":
+            target = {"epoch": int(a.get("epoch", 0)),
+                      "step": int(a.get("step", 0)),
+                      "reason": a.get("reason", "?")}
+    phases = {k: sorted(set(v)) for k, v in sorted(by_phase.items())}
+    return {
+        "events": len(barrier),
+        "phases": phases,
+        "target": target,
+        # Complete choreography: someone announced, someone else
+        # observed it, and every participant seen in any phase drained.
+        "coordinated": bool(
+            phases.get("announce") and phases.get("observe")
+            and set(sum(phases.values(), []))
+            <= set(phases.get("drain", []))),
     }
 
 

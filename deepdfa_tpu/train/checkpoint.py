@@ -58,6 +58,27 @@ Elastic/async extensions (ISSUE 6):
 * ``DEEPDFA_ASYNC_CKPT=0`` is the escape hatch:
   :func:`make_checkpoint_manager` then returns the synchronous manager
   and training behaves bit-identically to the pre-async layer.
+
+Elastic multi-process snapshots (ISSUE 18):
+
+* Under a live multi-controller topology (``set_host``), every process
+  writes its own leaf-partitioned shard ``shard_<i>_of_<n>/`` (leaf
+  ``k`` of the path-sorted flatten belongs to process ``k % n``); the
+  primary waits for every shard's fsync'd ``.complete`` marker (a
+  filesystem rendezvous), writes ``shards.json``, and alone commits the
+  checksum + ``meta.json`` record. Restores of a sharded snapshot
+  consolidate — the primary reads every shard, reassembles the
+  replicated tree, and broadcasts it to the fleet
+  (``multihost_utils.broadcast_one_to_all``, the orbax discipline) —
+  so ``fit --resume`` works across a ``process_count`` change instead
+  of refusing. :meth:`redistribute` rewrites a snapshot for a new
+  process count up front (the benched ``ckpt_redistribute_ms`` path):
+  a hardlink re-grouping fast path when the old and new shard sets
+  nest (``old % new == 0``), a consolidate-and-reshard slow path
+  otherwise, and a plain orbax snapshot when the new count is 1. A
+  snapshot whose shard set is genuinely unrecoverable (missing shard
+  dir/manifest/leaf file) raises the typed
+  ``ProcessCountMismatchError`` — never a bare ``KeyError``.
 """
 
 from __future__ import annotations
@@ -67,13 +88,16 @@ import json
 import logging
 import os
 import re
+import shutil
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
+from deepdfa_tpu.parallel.mesh import ProcessCountMismatchError
 from deepdfa_tpu.resilience import inject
 from deepdfa_tpu import telemetry
 
@@ -119,6 +143,226 @@ def snapshot_checksum(path: str) -> str:
     return h.hexdigest()
 
 
+# ---------------------------------------------------------------------------
+# Sharded snapshot format (elastic multi-process, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+_SHARD_DIR_RE = re.compile(r"^shard_(\d+)_of_(\d+)$")
+
+# Rendezvous deadline (seconds) the primary waits for every process's
+# shard marker before declaring the fleet write failed.
+SHARD_WAIT_ENV = "DEEPDFA_SHARD_WAIT_S"
+
+
+def _shard_wait_s() -> float:
+    try:
+        return float(os.environ.get(SHARD_WAIT_ENV, "120"))
+    except ValueError:
+        return 120.0
+
+
+class _ShardSuperseded(CheckpointError):
+    """A peer's shard marker reports a newer epoch than the write being
+    committed: the async queue superseded this name on another process.
+    The stale commit is abandoned; the newer fleet write wins."""
+
+
+def _shard_dir_name(process_index: int, process_count: int) -> str:
+    return f"shard_{int(process_index)}_of_{int(process_count)}"
+
+
+def is_sharded_snapshot(path: str) -> bool:
+    """True when the snapshot directory holds the per-process shard
+    layout (written under a multi-controller topology) rather than a
+    plain orbax tree."""
+    if os.path.exists(os.path.join(path, "shards.json")):
+        return True
+    try:
+        return any(_SHARD_DIR_RE.match(d) for d in os.listdir(path))
+    except OSError:
+        return False
+
+
+def _shard_count(path: str) -> int:
+    """Process count a snapshot's bytes were written under (1 = plain)."""
+    sj = os.path.join(path, "shards.json")
+    if os.path.exists(sj):
+        try:
+            with open(sj) as f:
+                return int(json.load(f)["process_count"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
+    try:
+        for d in os.listdir(path):
+            m = _SHARD_DIR_RE.match(d)
+            if m:
+                return int(m.group(2))
+    except OSError:
+        pass
+    return 1
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16 et al. by name
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _fsync_write_json(path: str, payload: Dict[str, Any]) -> None:
+    with open(path, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_state_shard(path: str, host_state: Any, process_index: int,
+                      process_count: int, epoch: int) -> None:
+    """Write THIS process's leaf partition of ``host_state`` under
+    ``path/shard_<i>_of_<n>/``: raw little-endian leaf files plus a
+    MANIFEST.json (dtype/shape per leaf; non-numeric leaves inline),
+    finished by an fsync'd ``.complete`` marker carrying the epoch —
+    the rendezvous token the primary waits for."""
+    leaves, _ = jax.tree_util.tree_flatten(host_state)
+    os.makedirs(path, exist_ok=True)
+    sd = os.path.join(path, _shard_dir_name(process_index, process_count))
+    shutil.rmtree(sd, ignore_errors=True)
+    os.makedirs(sd)
+    manifest: Dict[str, Any] = {
+        "format": 1,
+        "process_index": int(process_index),
+        "process_count": int(process_count),
+        "epoch": int(epoch),
+        "n_leaves": len(leaves),
+        "leaves": {},
+    }
+    for i, leaf in enumerate(leaves):
+        if i % int(process_count) != int(process_index):
+            continue
+        arr = np.asarray(leaf)
+        if arr.dtype == object:
+            manifest["leaves"][str(i)] = {"value": leaf}
+            continue
+        fn = f"leaf_{i}.bin"
+        np.ascontiguousarray(arr).tofile(os.path.join(sd, fn))
+        manifest["leaves"][str(i)] = {
+            "file": fn, "dtype": str(arr.dtype), "shape": list(arr.shape),
+        }
+    _fsync_write_json(os.path.join(sd, "MANIFEST.json"), manifest)
+    # The marker is written LAST: its presence means every byte above is
+    # already on disk, so the primary's rendezvous wait doubles as the
+    # write barrier.
+    _fsync_write_json(os.path.join(sd, ".complete"), {"epoch": int(epoch)})
+
+
+def _write_shards_json(path: str, process_count: int) -> None:
+    _fsync_write_json(os.path.join(path, "shards.json"),
+                      {"process_count": int(process_count)})
+
+
+def _read_shard_manifest(path: str, process_index: int,
+                         process_count: int) -> Dict[str, Any]:
+    sd = os.path.join(path, _shard_dir_name(process_index, process_count))
+    mf = os.path.join(sd, "MANIFEST.json")
+    if not os.path.isdir(sd) or not os.path.exists(mf):
+        raise ProcessCountMismatchError(
+            f"snapshot {path} was written by {process_count} processes but "
+            f"shard {process_index} is missing ({sd}); the shard set is "
+            "unrecoverable — restore from another snapshot or re-run the "
+            "original fleet"
+        )
+    with open(mf) as f:
+        return json.load(f)
+
+
+def consolidate_sharded(path: str, host_target: Any) -> Any:
+    """Reassemble the full replicated tree from every per-process shard
+    of a sharded snapshot (the primary's half of the broadcast-from-
+    primary restore). ``host_target`` supplies the tree structure.
+    Raises the typed :class:`ProcessCountMismatchError` when a shard
+    dir, manifest, or leaf file is missing — never a bare ``KeyError``.
+    """
+    leaves_t, treedef = jax.tree_util.tree_flatten(host_target)
+    n = len(leaves_t)
+    pc = _shard_count(path)
+    values: Dict[int, Any] = {}
+    for p in range(pc):
+        manifest = _read_shard_manifest(path, p, pc)
+        if int(manifest.get("n_leaves", n)) != n:
+            raise ProcessCountMismatchError(
+                f"snapshot {path} shard {p} records "
+                f"{manifest.get('n_leaves')} leaves but the resume target "
+                f"has {n}: the tree structures do not match"
+            )
+        sd = os.path.join(path, _shard_dir_name(p, pc))
+        for key, spec in manifest["leaves"].items():
+            i = int(key)
+            if "value" in spec:
+                values[i] = spec["value"]
+                continue
+            fp = os.path.join(sd, spec["file"])
+            if not os.path.exists(fp):
+                raise ProcessCountMismatchError(
+                    f"snapshot {path} shard {p} is missing leaf file "
+                    f"{spec['file']}; the shard set is unrecoverable"
+                )
+            arr = np.fromfile(fp, dtype=_np_dtype(spec["dtype"]))
+            values[i] = arr.reshape([int(s) for s in spec["shape"]])
+    missing = sorted(set(range(n)) - set(values))
+    if missing:
+        raise ProcessCountMismatchError(
+            f"snapshot {path} shards cover only {len(values)} of {n} "
+            f"leaves (missing indices {missing[:8]}...); the shard set is "
+            "unrecoverable"
+        )
+    return jax.tree_util.tree_unflatten(treedef, [values[i] for i in range(n)])
+
+
+def _regroup_shards(path: str, tmp: str, old_pc: int, new_pc: int) -> None:
+    """The redistribution fast path (``old_pc % new_pc == 0``): every old
+    shard's leaf set maps wholly into one new shard (leaf ``k`` lives at
+    ``k % pc``, and ``k % new_pc`` is constant across an old shard), so
+    leaves re-home by hardlink without deserializing a single array."""
+    manifests = [_read_shard_manifest(path, p, old_pc) for p in range(old_pc)]
+    os.makedirs(tmp, exist_ok=True)
+    epoch = int(manifests[0].get("epoch", -1))
+    for q in range(new_pc):
+        sd = os.path.join(tmp, _shard_dir_name(q, new_pc))
+        os.makedirs(sd)
+        merged: Dict[str, Any] = {
+            "format": 1,
+            "process_index": q,
+            "process_count": new_pc,
+            "epoch": epoch,
+            "n_leaves": int(manifests[0]["n_leaves"]),
+            "leaves": {},
+        }
+        for p in range(old_pc):
+            if p % new_pc != q:
+                continue
+            src = os.path.join(path, _shard_dir_name(p, old_pc))
+            for key, spec in manifests[p]["leaves"].items():
+                merged["leaves"][key] = spec
+                if "file" in spec:
+                    sf = os.path.join(src, spec["file"])
+                    if not os.path.exists(sf):
+                        raise ProcessCountMismatchError(
+                            f"snapshot {path} shard {p} is missing leaf "
+                            f"file {spec['file']}; the shard set is "
+                            "unrecoverable"
+                        )
+                    df = os.path.join(sd, spec["file"])
+                    try:
+                        os.link(sf, df)
+                    except OSError:
+                        shutil.copy2(sf, df)
+        _fsync_write_json(os.path.join(sd, "MANIFEST.json"), merged)
+        _fsync_write_json(os.path.join(sd, ".complete"), {"epoch": epoch})
+    _write_shards_json(tmp, new_pc)
+
+
 class CheckpointManager:
     def __init__(self, directory: str, periodic_every: int = 25):
         self.directory = os.path.abspath(directory)
@@ -133,6 +377,11 @@ class CheckpointManager:
         # Logical DP layout recorded with every snapshot (set_layout):
         # restore compares it against the resuming topology and reshards.
         self._layout: Optional[Dict[str, Any]] = None
+        # Live multi-controller topology (set_host): (process_index,
+        # process_count), or None for single-process runs. When set with
+        # process_count > 1 snapshot writes are sharded per process and
+        # only the primary owns meta.json.
+        self._host: Optional[Tuple[int, int]] = None
         # verify() digest cache: name -> (stat signature, sha256). Fallback
         # resolution calls verify per candidate, sometimes repeatedly — a
         # gigabyte-class snapshot must not be re-read when its bytes
@@ -159,7 +408,95 @@ class CheckpointManager:
                     self.directory, e,
                 )
 
+    # -- multi-controller topology -----------------------------------------
+
+    @property
+    def _sharded(self) -> bool:
+        return self._host is not None and self._host[1] > 1
+
+    @property
+    def _owns_meta(self) -> bool:
+        """Only the primary (or a single-process run) commits checksums
+        and meta.json — peers write their shard bytes and nothing else."""
+        return self._host is None or self._host[0] == 0
+
+    def set_host(self, process_index: int, process_count: int) -> None:
+        """Declare the live multi-controller topology. With
+        ``process_count > 1`` every subsequent snapshot write is sharded
+        per process (leaf ``k`` to process ``k % n``) and only the
+        primary owns ``meta.json``; restores consolidate + broadcast."""
+        pi, pc = int(process_index), int(process_count)
+        self._host = None if pc <= 1 else (pi, pc)
+
     # -- writes ------------------------------------------------------------
+
+    def _write_bytes(self, path: str, host_state: Any, epoch: int) -> None:
+        """Land the snapshot bytes: plain orbax single-process, or this
+        process's shard + (primary only) the all-shards rendezvous."""
+        if not self._sharded:
+            self._ckpt.save(path, host_state, force=True)
+            self._ckpt.wait_until_finished()
+            return
+        pi, pc = self._host
+        write_state_shard(path, host_state, pi, pc, epoch)
+        if pi != 0:
+            return
+        # Primary: clear stale non-shard content (a plain snapshot this
+        # name held before a topology change; old-count shard dirs),
+        # then wait for every peer's marker before owning the commit.
+        for entry in os.listdir(path):
+            m = _SHARD_DIR_RE.match(entry)
+            if m and int(m.group(2)) == pc:
+                continue
+            if entry == "shards.json":
+                continue
+            full = os.path.join(path, entry)
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+        self._wait_for_shards(path, pc, epoch)
+        _write_shards_json(path, pc)
+
+    @staticmethod
+    def _wait_for_shards(path: str, process_count: int, epoch: int) -> None:
+        """Primary-side rendezvous: poll every shard's ``.complete``
+        marker until all report this epoch. A marker from a NEWER epoch
+        means a peer's async queue superseded this name — the stale
+        commit is abandoned rather than checksummed as a mixed-epoch
+        snapshot."""
+        deadline = time.monotonic() + _shard_wait_s()
+        while True:
+            done = 0
+            for p in range(process_count):
+                mk = os.path.join(path, _shard_dir_name(p, process_count),
+                                  ".complete")
+                try:
+                    with open(mk) as f:
+                        info = json.load(f)
+                except (OSError, json.JSONDecodeError, ValueError):
+                    continue
+                peer_epoch = int(info.get("epoch", -1))
+                if peer_epoch == int(epoch):
+                    done += 1
+                elif peer_epoch > int(epoch):
+                    raise _ShardSuperseded(
+                        f"shard {p} of {path} already holds epoch "
+                        f"{peer_epoch} > {epoch}; abandoning the stale "
+                        "commit"
+                    )
+            if done == process_count:
+                return
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"shard rendezvous for {path} timed out after "
+                    f"{_shard_wait_s()}s ({done}/{process_count} markers "
+                    f"at epoch {epoch})"
+                )
+            time.sleep(0.02)
 
     def _save(self, name: str, state: Any, epoch: int) -> None:
         """Write the snapshot and record its checksum in the in-memory
@@ -167,17 +504,23 @@ class CheckpointManager:
         the per-epoch hot path — bench_checkpoint_resilience's
         ckpt_save_ms — so one fsync'd write per save, not two)."""
         path = os.path.join(self.directory, name)
-        self._ckpt.save(path, jax.device_get(state), force=True)
-        self._ckpt.wait_until_finished()
+        self._write_bytes(path, jax.device_get(state), int(epoch))
         self._record_snapshot(name, path, epoch)
 
     def _record_snapshot(self, name: str, path: str, epoch: int) -> None:
         """Checksum the written snapshot into the in-memory meta (caller
-        commits), prime the digest cache, and run the damage fault hook."""
+        commits), prime the digest cache, and run the damage fault hook.
+        No-op on non-primary processes — the checksum must describe the
+        COMPLETE shard set, which only the primary's rendezvous sees."""
+        if not self._owns_meta:
+            self._digest_cache.pop(name, None)
+            return
         digest = snapshot_checksum(path)
         record: Dict[str, Any] = {"epoch": int(epoch), "sha256": digest}
         if self._layout is not None:
             record["layout"] = dict(self._layout)
+        if self._sharded:
+            record["shards"] = self._host[1]
         self._meta.setdefault("snapshots", {})[name] = record
         self._digest_cache[name] = (self._snapshot_sig(path), digest)
         # Fault hook AFTER the checksum is recorded: injected damage is
@@ -197,7 +540,9 @@ class CheckpointManager:
     def _write_meta(self) -> None:
         """Atomic: a reader (or a resumed run) sees either the old meta or
         the new one, never a torn write — and the rename is durable before
-        we report success."""
+        we report success. Non-primary processes never write meta.json."""
+        if not self._owns_meta:
+            return
         tmp = self._meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self._meta, f)
@@ -245,7 +590,10 @@ class CheckpointManager:
         meta record. Returns the snapshot name."""
         name = f"preempt_{int(epoch)}_{int(step)}"
         self._save(name, state, epoch)
-        record = self._meta.setdefault("snapshots", {})[name]
+        # Non-primary processes have no meta record (the primary owns the
+        # commit); keep the in-memory bookkeeping harmless for them.
+        record = self._meta.setdefault("snapshots", {}).setdefault(
+            name, {"epoch": int(epoch)})
         record["step"] = int(step)
         record["preempt"] = dict(resume or {})
         self._write_meta()
@@ -434,11 +782,22 @@ class CheckpointManager:
                 logger.error("snapshot %s failed integrity verification; "
                              "trying the next fallback", cand)
                 continue
+            path = os.path.join(self.directory, cand)
             try:
-                restored = self._ckpt.restore(
-                    os.path.join(self.directory, cand),
-                    target=jax.device_get(target),
-                )
+                if is_sharded_snapshot(path):
+                    restored = self._restore_sharded(path, target)
+                else:
+                    restored = self._ckpt.restore(
+                        path, target=jax.device_get(target),
+                    )
+            except ProcessCountMismatchError as e:
+                # A verified-but-unrecoverable shard set (a doctored
+                # shards.json whose checksum was re-recorded, say): keep
+                # the typed error if nothing else is intact.
+                logger.warning("restore of sharded snapshot %s failed "
+                               "(%s); trying the next fallback", cand, e)
+                last_err = e
+                continue
             except Exception as e:
                 # Checksums catch bit damage; this catches structural rot
                 # (legacy snapshot with no checksum, half-written tree).
@@ -456,10 +815,186 @@ class CheckpointManager:
                              "place of %s", cand,
                              self.last_restored["epoch"], name)
             return restored
+        if isinstance(last_err, ProcessCountMismatchError):
+            raise last_err
         raise CheckpointError(
             f"no intact snapshot under {self.directory} "
             f"(requested {name!r}, tried {candidates})"
         ) from last_err
+
+    def _restore_sharded(self, path: str, target: Any) -> Any:
+        """Restore a sharded snapshot: consolidate every shard into the
+        replicated host tree. Under a live multi-process topology the
+        PRIMARY alone reads the bytes and the tree is broadcast to the
+        fleet (``multihost_utils.broadcast_one_to_all`` — the orbax
+        broadcast-from-primary discipline), so N processes cost one read,
+        not N."""
+        host_target = jax.device_get(target)
+        if not self._sharded:
+            return consolidate_sharded(path, host_target)
+        from jax.experimental import multihost_utils
+
+        pi, _ = self._host
+        if pi == 0:
+            tree = consolidate_sharded(path, host_target)
+        else:
+            tree = jax.tree_util.tree_map(
+                lambda x: np.zeros_like(np.asarray(x)), host_target)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        out = multihost_utils.broadcast_one_to_all(
+            tuple(leaves), is_source=(pi == 0))
+        return jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(x) for x in out])
+
+    def consolidate(self, name: str, target: Any) -> Any:
+        """Host-side reassembly of one snapshot regardless of its on-disk
+        layout: a plain snapshot restores via orbax, a sharded one
+        through :func:`consolidate_sharded` (typed
+        ``ProcessCountMismatchError`` on a broken shard set). No
+        fallback — this is the surgical read ``redistribute`` and the
+        edge-case tests build on."""
+        self.drain()
+        if not self.has(name):
+            raise FileNotFoundError(
+                f"no checkpoint {name!r} under {self.directory}"
+            )
+        path = os.path.join(self.directory, name)
+        if is_sharded_snapshot(path):
+            return consolidate_sharded(path, jax.device_get(target))
+        return self._ckpt.restore(path, target=jax.device_get(target))
+
+    def redistribute(self, name: str, new_process_count: int,
+                     target: Any = None) -> Dict[str, Any]:
+        """Rewrite one snapshot for a different process count — the
+        cross-process-count resume path (and the benched
+        ``ckpt_redistribute_ms`` operation). Primary-only.
+
+        Strategies: ``fast`` re-homes leaf files by hardlink when the
+        old and new shard sets nest (``old % new == 0``, both > 1);
+        ``consolidate`` reassembles the replicated tree (needs
+        ``target`` for structure) and re-shards it — or writes a plain
+        orbax snapshot when ``new_process_count == 1``, so a shrunk-to-
+        one resume leaves a snapshot every single-process tool reads
+        natively. The swap is atomic-ish (write aside, two renames): a
+        crash mid-redistribute leaves either the old intact bytes or a
+        checksum-mismatched dir that the verified-restore fallback
+        skips. The snapshot's recorded step/preempt payload is
+        untouched — a redistributed ``preempt_<E>_<S>`` still resumes
+        mid-epoch."""
+        self.drain()
+        if not self.has(name):
+            raise FileNotFoundError(
+                f"no checkpoint {name!r} under {self.directory}"
+            )
+        if self._host is not None and self._host[0] != 0:
+            raise RuntimeError(
+                "redistribute is primary-only (non-primary processes wait "
+                "for the rewritten snapshot)"
+            )
+        path = os.path.join(self.directory, name)
+        old_pc = _shard_count(path)
+        new_pc = int(new_process_count)
+        if new_pc < 1:
+            raise ValueError(f"new_process_count must be >= 1, got {new_pc}")
+        if old_pc == new_pc:
+            return {"strategy": "noop", "from_processes": old_pc,
+                    "to_processes": new_pc, "ms": 0.0}
+        t0 = time.perf_counter()
+        with telemetry.span("ckpt.redistribute", snapshot=name,
+                            from_processes=old_pc, to_processes=new_pc):
+            tmp = path + ".redist"
+            shutil.rmtree(tmp, ignore_errors=True)
+            if old_pc > 1 and new_pc > 1 and old_pc % new_pc == 0:
+                strategy = "fast"
+                _regroup_shards(path, tmp, old_pc, new_pc)
+            else:
+                strategy = "consolidate"
+                if old_pc == 1:
+                    if target is None:
+                        tree = self._ckpt.restore(path)
+                    else:
+                        tree = self._ckpt.restore(
+                            path, target=jax.device_get(target))
+                else:
+                    if target is None:
+                        raise ValueError(
+                            "redistribute of a sharded snapshot needs a "
+                            "target state for the tree structure"
+                        )
+                    tree = consolidate_sharded(path, jax.device_get(target))
+                if new_pc == 1:
+                    self._ckpt.save(tmp, tree, force=True)
+                    self._ckpt.wait_until_finished()
+                else:
+                    epoch = self._snapshot_epoch(name)
+                    os.makedirs(tmp, exist_ok=True)
+                    for p in range(new_pc):
+                        write_state_shard(tmp, tree, p, new_pc, epoch)
+                    _write_shards_json(tmp, new_pc)
+            backup = path + ".old"
+            shutil.rmtree(backup, ignore_errors=True)
+            os.replace(path, backup)
+            os.replace(tmp, path)
+            shutil.rmtree(backup, ignore_errors=True)
+            record = self._meta.get("snapshots", {}).get(name)
+            if record is not None:
+                digest = snapshot_checksum(path)
+                record["sha256"] = digest
+                record.setdefault("layout", {})["process_count"] = new_pc
+                if new_pc > 1:
+                    record["shards"] = new_pc
+                else:
+                    record.pop("shards", None)
+                self._digest_cache[name] = (self._snapshot_sig(path), digest)
+                self._write_meta()
+            else:
+                self._digest_cache.pop(name, None)
+        ms = (time.perf_counter() - t0) * 1e3
+        telemetry.event("ckpt.redistribute", snapshot=name,
+                        from_processes=old_pc, to_processes=new_pc,
+                        strategy=strategy, ms=ms)
+        logger.info("redistributed snapshot %s %d->%d processes (%s, "
+                    "%.1f ms)", name, old_pc, new_pc, strategy, ms)
+        return {"strategy": strategy, "from_processes": old_pc,
+                "to_processes": new_pc, "ms": ms}
+
+    def _reload_meta(self) -> None:
+        """Re-read ``meta.json`` from disk — the non-primary half of a
+        redistribution rendezvous (the primary rewrote the record under
+        our feet) — and drop digest cache entries so the next verified
+        read re-hashes the rewritten bytes."""
+        if os.path.exists(self._meta_path):
+            try:
+                with open(self._meta_path) as f:
+                    self._meta = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                pass
+        self._digest_cache.clear()
+
+    def wait_redistributed(self, name: str, process_count: int,
+                           timeout_s: Optional[float] = None) -> None:
+        """Non-primary rendezvous: block until the primary's
+        :meth:`redistribute` of ``name`` has landed at ``process_count``
+        shards, then reload meta. Tolerates the brief window where the
+        snapshot dir is absent (the two-rename swap). Raises
+        :class:`CheckpointError` on timeout."""
+        if timeout_s is None:
+            timeout_s = _shard_wait_s()
+        path = os.path.join(self.directory, name)
+        want = int(process_count)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if os.path.exists(path) and not os.path.exists(path + ".redist"):
+                if _shard_count(path) == want:
+                    break
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"timed out after {timeout_s:.0f}s waiting for "
+                    f"snapshot {name!r} to be redistributed to "
+                    f"{want} process(es) (set {SHARD_WAIT_ENV} to adjust)"
+                )
+            time.sleep(0.05)
+        self._reload_meta()
 
     def restore_params(self, name: str = "best") -> Any:
         """Restore just the model variables of a saved state — the
@@ -483,7 +1018,18 @@ class CheckpointManager:
             "epoch": self._snapshot_epoch(used),
             "fallback": used != name,
         }
-        restored = self._ckpt.restore(os.path.join(self.directory, used))
+        used_path = os.path.join(self.directory, used)
+        if is_sharded_snapshot(used_path):
+            # Target-free reads need the orbax layout; a fleet-written
+            # snapshot must be consolidated first (resume does this
+            # automatically; operators run redistribute(name, 1)).
+            raise CheckpointError(
+                f"snapshot {used!r} under {self.directory} is sharded over "
+                f"{_shard_count(used_path)} processes; redistribute it to "
+                "a single process (CheckpointManager.redistribute(name, 1, "
+                "target)) before a params-only restore"
+            )
+        restored = self._ckpt.restore(used_path)
         if isinstance(restored, dict):
             inner = restored.get("params")
             if isinstance(inner, dict) and "params" in inner:
@@ -674,16 +1220,17 @@ class AsyncCheckpointManager(CheckpointManager):
                     "previous intact snapshot remains authoritative",
                     item.name, item.epoch,
                 )
-                if item.name not in self._meta.get("snapshots", {}):
+                if (self._owns_meta
+                        and item.name not in self._meta.get("snapshots", {})):
                     # A failed FIRST write of this name has no recorded
                     # checksum for verification to fail it against, so the
                     # pre-hardening grace path would bless the partial
                     # bytes on restore. Remove them: an absent snapshot
                     # can never win the fallback order. (With a committed
                     # record, the stale-checksum mismatch already damns
-                    # the bytes — leave them for forensics.)
-                    import shutil
-
+                    # the bytes — leave them for forensics. Non-primary
+                    # processes never remove: the dir holds peers' shards
+                    # and the primary's own failure path cleans up.)
                     shutil.rmtree(
                         os.path.join(self.directory, item.name),
                         ignore_errors=True,
@@ -697,8 +1244,7 @@ class AsyncCheckpointManager(CheckpointManager):
         path = os.path.join(self.directory, item.name)
         with telemetry.span("ckpt.write", snapshot=item.name, epoch=item.epoch):
             host_state = jax.device_get(item.state)
-            self._ckpt.save(path, host_state, force=True)
-            self._ckpt.wait_until_finished()
+            self._write_bytes(path, host_state, item.epoch)
             # Fault site between the byte write and the checksum/meta
             # commit: a `raise` here is the writer dying mid-save — bytes
             # possibly on disk, meta.json still pointing at the previous
@@ -718,7 +1264,11 @@ class AsyncCheckpointManager(CheckpointManager):
         with telemetry.span("ckpt.commit", snapshot=item.name, epoch=item.epoch):
             self._record_snapshot(item.name, path, item.epoch)
             if item.record_extra:
-                self._meta["snapshots"][item.name].update(item.record_extra)
+                # setdefault: non-primary processes have no record (the
+                # primary owns the commit) but must not KeyError.
+                self._meta.setdefault("snapshots", {}).setdefault(
+                    item.name, {"epoch": item.epoch},
+                ).update(item.record_extra)
             self._meta.update(item.meta_update)
             self._write_meta()
 

@@ -451,6 +451,12 @@ def fit_gen(
         from deepdfa_tpu.parallel.mesh import snapshot_layout
 
         checkpointer.set_layout(snapshot_layout(mesh))
+    # Coordinated fleet drain (ISSUE 18): one host's notice becomes a
+    # shared step-boundary target — same barrier as train/loop.py.
+    fleet = lifecycle.fleet_drain(
+        checkpointer.directory if checkpointer is not None else None, host)
+    if fleet is not None:
+        fleet.clear()
     try:
         for epoch in range(cfg.max_epochs):
             inject.fire("train.epoch_start", index=epoch)
@@ -463,12 +469,34 @@ def fit_gen(
                     train_data, cfg.batch_size, rng, pad_tail=True,
                     pad_id=pad_id
                 ):
+                    # Fleet drain target check BEFORE dispatch: every
+                    # process stops at the same (epoch, step).
+                    if fleet is not None:
+                        tgt_drain = fleet.reached(epoch, len(losses))
+                        if tgt_drain is not None:
+                            notice = lifecycle.poll()
+                            if notice is None:
+                                notice = lifecycle.coordinator().notify(
+                                    "fleet_drain")
+                            fleet.mark_draining(epoch, len(losses))
+                            lifecycle.preempt_snapshot_exit(
+                                notice,
+                                checkpointer
+                                if (host is None or host[0] == 0) else None,
+                                state, epoch, len(losses),
+                                history={"epochs": history},
+                                resume={"seen": len(losses), "loop": "gen"},
+                                loop="gen")
                     with telemetry.span("train.step", epoch=epoch,
                                         step=len(losses)):
                         state, loss = step(
                             state, _lift_rows(src, mesh, host),
                             _lift_rows(tgt, mesh, host)
                         )
+                    if fleet is not None:
+                        # Dispatch fence: the barrier's one-step-ahead
+                        # bound.
+                        jax.block_until_ready(loss)
                     losses.append(inject.corrupt_loss(loss))
                     # Step-granular preemption check (ISSUE 10): drain to
                     # a durable preempt snapshot and exit typed instead
@@ -476,14 +504,19 @@ def fit_gen(
                     # owns the run dir (the save_last gating).
                     notice = lifecycle.poll()
                     if notice is not None:
-                        lifecycle.preempt_snapshot_exit(
-                            notice,
-                            checkpointer if (host is None or host[0] == 0)
-                            else None,
-                            state, epoch, len(losses),
-                            history={"epochs": history},
-                            resume={"seen": len(losses), "loop": "gen"},
-                            loop="gen")
+                        if fleet is None:
+                            lifecycle.preempt_snapshot_exit(
+                                notice,
+                                checkpointer
+                                if (host is None or host[0] == 0) else None,
+                                state, epoch, len(losses),
+                                history={"epochs": history},
+                                resume={"seen": len(losses), "loop": "gen"},
+                                loop="gen")
+                        # Fleet: announce the next boundary (a peer may be
+                        # inside the next step's collective already) and
+                        # keep participating until reached() drains.
+                        fleet.announce(epoch, len(losses) + 1, notice.reason)
                 ep.fence(losses)
                 ep.set(steps=len(losses))
             record = {"epoch": epoch,

@@ -480,6 +480,10 @@ def fit(
         from deepdfa_tpu.parallel.mesh import snapshot_layout
 
         checkpointer.set_layout(snapshot_layout(mesh))
+        if host is not None:
+            # Multi-controller fleet: each process writes its own shard
+            # of every snapshot; the primary alone commits meta.
+            checkpointer.set_host(*host)
 
     train_step = make_train_step(model, tx, train_cfg)
     eval_step = make_eval_step(model, train_cfg)
@@ -507,6 +511,9 @@ def fit(
         resume and checkpointer is not None) else None
     if candidate is not None:
         from deepdfa_tpu.parallel.mesh import (
+            RESUME_REDISTRIBUTE_CONSOLIDATE,
+            RESUME_REDISTRIBUTE_FAST,
+            ProcessCountMismatchError,
             check_layout_compatible,
             reshard_state,
             snapshot_layout,
@@ -531,6 +538,42 @@ def fit(
                     include_preempt=False)
     if candidate is not None:
         meta = checkpointer.best_meta
+        # Elastic resume (ISSUE 18): a snapshot written under a different
+        # process count is rewritten on disk BEFORE the restore — the
+        # primary redistributes (hardlink re-home when the shard sets
+        # nest, consolidate+re-shard otherwise), peers rendezvous on the
+        # rewritten layout. check_layout_compatible routes; only a
+        # genuinely broken shard set still raises the typed error.
+        prev0 = checkpointer.snapshot_layout(candidate) or {}
+        plan0 = check_layout_compatible(prev0, snapshot_layout(mesh))
+        if plan0 in (RESUME_REDISTRIBUTE_FAST, RESUME_REDISTRIBUTE_CONSOLIDATE):
+            cur_pc = host[1] if host is not None else 1
+            telemetry.event(
+                "ckpt.redistribute_plan", snapshot=candidate, plan=plan0,
+                from_processes=int(prev0.get("process_count", 1)),
+                to_processes=cur_pc,
+            )
+            if host is None or host[0] == 0:
+                try:
+                    checkpointer.redistribute(candidate, cur_pc, target=state)
+                except ProcessCountMismatchError:
+                    # Unrecoverable shard set (missing shard/leaf files):
+                    # leave the snapshot alone — the verified-restore
+                    # fallback below skips it, or surfaces the typed
+                    # error if nothing intact remains.
+                    logger.exception(
+                        "resume: snapshot %s could not be redistributed; "
+                        "the restore fallback decides what happens next",
+                        candidate,
+                    )
+            else:
+                try:
+                    checkpointer.wait_redistributed(candidate, cur_pc)
+                except CheckpointError:
+                    logger.exception(
+                        "resume: primary never published the redistributed "
+                        "%s; continuing into the restore fallback", candidate,
+                    )
         try:
             state = checkpointer.restore(candidate, state)
         except CheckpointError:
@@ -601,10 +644,10 @@ def fit(
             prev_layout = checkpointer.snapshot_layout(
                 restored.get("name", candidate)) or {}
             cur_layout = snapshot_layout(mesh)
-            # Multi-host guard: a process-count change across the resume
-            # is not a reshard — fail with the typed, actionable error
-            # BEFORE any device placement (the shape mismatch it would
-            # otherwise become deep in reshard is undebuggable).
+            # By here any process-count change was already rewritten on
+            # disk (or the restore fell back to a snapshot the sharded
+            # reader consolidates host-side regardless of its count), so
+            # what remains is at most a device-level reshard.
             check_layout_compatible(prev_layout, cur_layout)
             if prev_layout and prev_layout.get("n_shards") != cur_layout["n_shards"]:
                 logger.warning(
@@ -806,6 +849,17 @@ def _fit_epochs_inner(
 ):
     from deepdfa_tpu.parallel.mesh import assemble_global_batch
 
+    # Coordinated fleet drain (ISSUE 18): in a multi-process fit with a
+    # shared run dir, one host's preemption notice becomes a drain
+    # barrier everyone reaches at the SAME (epoch, step) — so every
+    # preempt shard describes one state and nobody is stranded in a
+    # collective the rest of the fleet left. Single-process fits keep
+    # the immediate-drain path (fleet is None).
+    fleet = lifecycle.fleet_drain(
+        checkpointer.directory if checkpointer is not None else None, host)
+    if fleet is not None:
+        fleet.clear()
+
     for epoch in range(start_epoch, train_cfg.max_epochs):
         # Fault hook: a `raise` fault here is a simulated preemption — the
         # kill-and-resume determinism gate (tests/test_resilience.py) and
@@ -861,9 +915,17 @@ def _fit_epochs_inner(
         # step before the drain starts.
         notice = lifecycle.poll()
         if notice is not None:
-            _preempt_exit(notice, checkpointer, state, epoch, seen,
-                          n_batches, loss_sum, stats, bad_step, data_cfg,
-                          train_cfg, history, participant)
+            if fleet is None:
+                _preempt_exit(notice, checkpointer, state, epoch, seen,
+                              n_batches, loss_sum, stats, bad_step, data_cfg,
+                              train_cfg, history, participant)
+            # Fleet: announce the drain target instead of exiting — peers
+            # may already be dispatching into this epoch, and leaving now
+            # would strand them in a collective. The announce-before-
+            # dispatch ordering guarantees everyone sees the target
+            # before they can pass it; this process drains at the
+            # target's step-boundary check below like everyone else.
+            fleet.announce(epoch, seen + 1, notice.reason)
         # Epoch span, FENCED on the device loss accumulator: its duration
         # covers dispatch AND device execution (the honest wall time the
         # GL011 rule exists to enforce), while the per-step spans inside
@@ -879,10 +941,34 @@ def _fit_epochs_inner(
                 raw_batches += 1
                 if raw_batches <= skip:
                     continue  # already trained before the preemption
+                # The fleet drain barrier's step-boundary check, BEFORE
+                # dispatch: at or past the announced target, stop here —
+                # every process reaches this exact (epoch, step) because
+                # the target is durable before its step can complete
+                # anywhere. Survivors synthesize their notice.
+                if fleet is not None:
+                    tgt = fleet.reached(epoch, seen)
+                    if tgt is not None:
+                        notice = lifecycle.poll()
+                        if notice is None:
+                            notice = lifecycle.coordinator().notify(
+                                "fleet_drain")
+                        fleet.mark_draining(epoch, seen)
+                        _preempt_exit(notice, checkpointer, state, epoch,
+                                      seen, n_batches, loss_sum, stats,
+                                      bad_step, data_cfg, train_cfg, history,
+                                      participant)
                 if host is not None:
                     batch = assemble_global_batch(batch, mesh)
                 with telemetry.span("train.step", epoch=epoch, step=seen):
                     state, loss, bstats = train_step(state, batch)
+                if fleet is not None:
+                    # Dispatch fence: with at most ONE step in flight, a
+                    # peer can be at most one step past the announcer's
+                    # completed step — the bound the "+1" drain target
+                    # relies on. Single-process runs keep free-running
+                    # async dispatch.
+                    jax.block_until_ready(loss)
                 loss = inject.corrupt_loss(loss)
                 if guard.active:
                     bad_step = jnp.where(
@@ -900,9 +986,16 @@ def _fit_epochs_inner(
                 # (plus the lifecycle.preempt fault site) per step.
                 notice = lifecycle.poll()
                 if notice is not None:
-                    _preempt_exit(notice, checkpointer, state, epoch, seen,
-                                  n_batches, loss_sum, stats, bad_step,
-                                  data_cfg, train_cfg, history, participant)
+                    if fleet is None:
+                        _preempt_exit(notice, checkpointer, state, epoch,
+                                      seen, n_batches, loss_sum, stats,
+                                      bad_step, data_cfg, train_cfg, history,
+                                      participant)
+                    # Fleet drain: target the NEXT boundary — a peer may
+                    # already be blocked inside step `seen + 1`'s
+                    # collective (dispatch runs one step ahead of this
+                    # poll), so this process must participate in it too.
+                    fleet.announce(epoch, seen + 1, notice.reason)
                 if seen % log_every == 0:
                     rolled, (state, loss_sum, stats, n_batches) = guard.check(
                         epoch, bad_step, window,

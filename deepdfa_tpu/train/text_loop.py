@@ -634,6 +634,14 @@ def _fit_text_epochs(
     train_step, eval_step, state, best_state, history, rng, detect_anomaly,
     anomaly_budget,
 ):
+    # Coordinated fleet drain (ISSUE 18): same barrier as train/loop.py —
+    # in a multi-process fine-tune one host's notice becomes a shared
+    # step-boundary target instead of an immediate exit that would
+    # strand peers inside a collective.
+    fleet = lifecycle.fleet_drain(
+        checkpointer.directory if checkpointer is not None else None, host)
+    if fleet is not None:
+        fleet.clear()
     for epoch in range(cfg.max_epochs):
         inject.fire("train.epoch_start", index=epoch)
         t0 = time.time()
@@ -656,11 +664,31 @@ def _fit_text_epochs(
                 n_shards=n_shards, host=host,
             ):
                 num_missing += batch.n_missing
+                # Fleet drain target check BEFORE dispatch (ISSUE 18):
+                # every process stops at the same (epoch, step).
+                if fleet is not None:
+                    tgt = fleet.reached(epoch, n_batches)
+                    if tgt is not None:
+                        notice = lifecycle.poll()
+                        if notice is None:
+                            notice = lifecycle.coordinator().notify(
+                                "fleet_drain")
+                        fleet.mark_draining(epoch, n_batches)
+                        lifecycle.preempt_snapshot_exit(
+                            notice,
+                            checkpointer if (host is None or host[0] == 0)
+                            else None,
+                            state, epoch, n_batches, history=history,
+                            resume={"seen": int(n_batches), "loop": "text"},
+                            loop="text")
                 if host is not None:
                     batch = _assemble_text(batch, mesh)
                 with telemetry.span("train.step", epoch=epoch,
                                     step=n_batches):
                     state, loss, bstats = _run_step(train_step, state, batch)
+                if fleet is not None:
+                    # Dispatch fence: the barrier's one-step-ahead bound.
+                    jax.block_until_ready(loss)
                 loss = inject.corrupt_loss(loss)
                 loss_sum = loss_sum + loss
                 stats = stats + bstats
@@ -675,13 +703,19 @@ def _fit_text_epochs(
                 # process 0 owns the run dir, same gating as save_last.
                 notice = lifecycle.poll()
                 if notice is not None:
-                    lifecycle.preempt_snapshot_exit(
-                        notice,
-                        checkpointer if (host is None or host[0] == 0)
-                        else None,
-                        state, epoch, n_batches, history=history,
-                        resume={"seen": int(n_batches), "loop": "text"},
-                        loop="text")
+                    if fleet is None:
+                        lifecycle.preempt_snapshot_exit(
+                            notice,
+                            checkpointer if (host is None or host[0] == 0)
+                            else None,
+                            state, epoch, n_batches, history=history,
+                            resume={"seen": int(n_batches), "loop": "text"},
+                            loop="text")
+                    # Fleet: announce the next step boundary as the drain
+                    # target (a peer may already be inside step
+                    # n_batches + 1's collective) and keep participating
+                    # until it — the reached() check above drains.
+                    fleet.announce(epoch, n_batches + 1, notice.reason)
             ep.fence(loss_sum)
             ep.set(steps=n_batches)
         epoch_loss = float(loss_sum)

@@ -1,6 +1,6 @@
 #!/bin/bash
 # Chaos soak (deepdfa_tpu/resilience): deterministic fault-injection run
-# covering twelve fault classes — simulated preemption (kill-and-resume
+# covering thirteen fault classes — simulated preemption (kill-and-resume
 # must be bit-for-bit deterministic), NaN loss (rollback self-healing),
 # checkpoint corruption (checksum fallback), ETL item failure (attempt-cap
 # requeue), serving flush failure (one flush fails alone), corrupt-corpus
@@ -20,7 +20,12 @@
 # SIGKILL of one of three engine OS processes behind the router tier
 # under live load (proc_crash: zero dropped admitted requests, the router
 # sheds to siblings, a warmed replacement rejoins at a bumped generation,
-# one merged trace shows kill/shed/rejoin across real pids).
+# one merged trace shows kill/shed/rejoin across real pids), and a SIGTERM
+# to one member of a live two-process `jax.distributed` training fleet
+# (elastic_shrink: coordinated drain barrier — both processes exit
+# preempted behind ONE sharded preempt snapshot — then a single-process
+# --resume redistributes the checkpoint 2→1 and the loss history stays
+# continuous with the uninterrupted fleet).
 # Exits nonzero on any missed recovery contract — the scripts/test.sh gate.
 #
 #   bash scripts/chaos.sh                      # the default soak

@@ -61,7 +61,14 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli trace --smoke
 # re-featurized (one cache miss), untouched verdicts byte-identical, and
 # zero serve-engine compiles after warmup. No JVM, single device, seconds.
 JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli scan --smoke
-# Chaos soak: eleven injected fault classes against a tiny run — resume
+# Elastic-fleet smoke (deepdfa_tpu/resilience/elastic): TWO real
+# jax.distributed-joined `cli fit` processes on the virtual CPU mesh
+# (gloo collectives) train one run dir of 2-process sharded snapshots —
+# the multi-controller bring-up check (coordination service, collective
+# step, sharded snapshot rendezvous) in under a minute, before the soak
+# leans on the same harness to kill half the fleet.
+JAX_PLATFORMS=cpu python -m deepdfa_tpu.resilience.elastic --smoke
+# Chaos soak: thirteen injected fault classes against a tiny run — resume
 # determinism, NaN rollback, checkpoint-corruption fallback, ETL requeue,
 # serving flush isolation, corrupt-corpus quarantine+bitwise-clean
 # training, a mid-epoch kill under async checkpointing resumed on a
@@ -77,8 +84,11 @@ JAX_PLATFORMS=cpu python -m deepdfa_tpu.cli scan --smoke
 # dropped admitted requests, 503 for new ones), and a rolling replica
 # drain of a 3-replica serving fleet mid-load (fleet_roll: admissions
 # all answered, survivors keep serving, /healthz degrades-then-recovers,
-# compiles flat). Fails in minutes if a recovery contract regressed; the
-# eval below would never notice.
+# compiles flat), and a SIGTERM to one member of a two-process training
+# fleet (elastic_shrink: coordinated drain, both exit preempted, 2→1
+# checkpoint redistribution on resume, continuous loss history). Fails
+# in minutes if a recovery contract regressed; the eval below would
+# never notice.
 bash scripts/chaos.sh
 python -m deepdfa_tpu.cli test --config configs/default.yaml \
   --checkpoint-dir "${CHECKPOINT_DIR:-runs/deepdfa}" --which best "$@"

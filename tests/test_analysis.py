@@ -1762,8 +1762,9 @@ def test_self_check_covers_every_rule_implementation():
     assert set(RULES) == ({f"GL00{i}" for i in range(0, 10)}
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
                              "GL016", "GL017", "GL018", "GL019", "GL020",
-                             "GL021", "GL022", "GL023", "GL024", "GL025"})
-    assert len(RULES) == 25
+                             "GL021", "GL022", "GL023", "GL024", "GL025",
+                             "GL026"})
+    assert len(RULES) == 26
 
 
 def test_unparseable_file_is_a_finding(tmp_path):
@@ -2284,6 +2285,98 @@ def test_cli_analyze_code_sarif_flag(tmp_path, capsys):
     doc = json.loads(out.read_text())
     assert doc["version"] == "2.1.0"
     assert len(doc["runs"][0]["results"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# GL026: unjoined distributed exit
+# ---------------------------------------------------------------------------
+
+
+def test_gl026_unjoined_distributed_exit_fires():
+    # Joined a jax.distributed job, then sys.exit with no barrier call
+    # anywhere in scope: the coordination service is abandoned and every
+    # peer wedges in its next collective.
+    src = """
+import sys
+
+import jax
+
+def main():
+    jax.distributed.initialize(coordinator_address="h:1234",
+                               num_processes=2, process_id=0)
+    ok = run_everything()
+    if not ok:
+        sys.exit(1)
+    sys.exit(0)
+"""
+    assert "GL026" in rules_of(src)
+    assert len(findings_for(src, "GL026")) == 2  # both exit sites
+
+
+def test_gl026_os_exit_skips_finally_so_shutdown_there_does_not_join():
+    # os._exit never runs finally blocks: the shutdown below the exit is
+    # dead on that path, so the exit still fires.
+    src = """
+import os
+
+import jax
+
+def main():
+    jax.distributed.initialize(coordinator_address="h:1234",
+                               num_processes=2, process_id=0)
+    try:
+        run_everything()
+        os._exit(0)
+    finally:
+        jax.distributed.shutdown()
+"""
+    assert "GL026" in rules_of(src)
+
+
+def test_gl026_try_finally_shutdown_negative():
+    # The accepted cli.main shape: initialize, dispatch under try, and a
+    # finally that shuts the coordination service down on EVERY path —
+    # sys.exit raises SystemExit, so the finally runs before the process
+    # dies and peers see a clean leave.
+    src = """
+import sys
+
+import jax
+
+def main():
+    jax.distributed.initialize(coordinator_address="h:1234",
+                               num_processes=2, process_id=0)
+    try:
+        sys.exit(run_everything())
+    finally:
+        jax.distributed.shutdown()
+"""
+    assert "GL026" not in rules_of(src)
+
+
+def test_gl026_barrier_before_os_exit_and_no_init_unflagged():
+    # A barrier call lexically between initialize and os._exit joins
+    # (first def); exits in functions that never initialize are not this
+    # rule's business (second def).
+    src = """
+import os
+import sys
+
+import jax
+from jax.experimental import multihost_utils
+
+def worker():
+    jax.distributed.initialize(coordinator_address="h:1234",
+                               num_processes=2, process_id=1)
+    run_everything()
+    multihost_utils.sync_global_devices("done")
+    jax.distributed.shutdown()
+    os._exit(0)
+
+def single_process_tool():
+    sys.exit(run_everything())
+"""
+    assert "GL026" not in rules_of(src)
 
 
 # ---------------------------------------------------------------------------

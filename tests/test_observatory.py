@@ -756,12 +756,14 @@ def test_cli_bench_diff_smoke_measures_and_appends(tmp_path, capsys):
     assert out["metrics"]["smoke_gnn_train_graphs_per_sec"] > 0
     assert out["metrics"]["smoke_ingest_rows_per_sec"] > 0
     assert out["metrics"]["smoke_sigterm_to_durable_snapshot_ms"] > 0
+    assert out["metrics"]["smoke_ckpt_redistribute_ms"] > 0
     (row,) = benchwatch.read_history(hist)
     assert set(row["metrics"]) == {"smoke_gnn_train_graphs_per_sec",
                                    "smoke_gnn_train_graphs_per_sec_fused",
                                    "smoke_gnn_train_graphs_per_sec_persistent",
                                    "smoke_ingest_rows_per_sec",
                                    "smoke_sigterm_to_durable_snapshot_ms",
+                                   "smoke_ckpt_redistribute_ms",
                                    "smoke_serve_fleet_rps",
                                    "smoke_serve_multiproc_rps",
                                    "smoke_gen_decode_tok_per_sec",
