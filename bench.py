@@ -733,6 +733,85 @@ def bench_trace_propagation(n_requests: int = 256, batch_slots: int = 8,
     }
 
 
+def bench_traffic_capture_overhead(n_requests: int = 256,
+                                   batch_slots: int = 8, reps: int = 3,
+                                   gate_pct: float = 2.0) -> dict:
+    """The traffic-observatory tax (ISSUE 20 gate: < 2%, the same A/B
+    protocol as ``bench_trace_propagation``).
+
+    Both sides run the SAME warmed serve replay with telemetry ON — the
+    only difference is the shape-capture kill switch
+    (``telemetry.sketch.set_capture``), so the measurement isolates the
+    cost the traffic observatory itself adds on the submit path: sketch
+    binning per request (nodes + edges per graph), the per-(lane,bucket)
+    element accounting per flush, and the pow2-scheduled
+    ``traffic.shape`` mirror events. Alternated back-to-back per rep,
+    best-of-reps, recompile-free by assertion.
+    """
+    import shutil
+    import tempfile
+
+    from deepdfa_tpu import telemetry
+    from deepdfa_tpu.core.config import FlowGNNConfig
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock
+    from deepdfa_tpu.telemetry import sketch as traffic_sketch
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_cfg = FlowGNNConfig(
+        message_impl="band" if on_tpu else "segment",
+        dtype="bfloat16" if on_tpu else "float32",
+    )
+    config = ServeConfig(batch_slots=batch_slots, cache_capacity=0)
+    model = FlowGNN(model_cfg)
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config, clock=VirtualClock())
+    graphs = synthetic_bigvul(n_requests, model_cfg.feature,
+                              positive_fraction=0.5, seed=0)
+
+    def run_replay() -> float:
+        t0 = time.perf_counter()
+        for g in graphs:
+            engine.submit(g)
+        engine.drain()
+        telemetry.flush()
+        return time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="bench_traffic_cap_")
+    t_on, t_off = [], []
+    try:
+        with telemetry.run_scope(tmp):
+            engine.warmup()
+            compiles0 = engine.stats.compiles
+            run_replay()  # warm both code paths + the event machinery
+            for _ in range(reps):
+                t_on.append(run_replay())
+                traffic_sketch.set_capture(False)
+                try:
+                    t_off.append(run_replay())
+                finally:
+                    traffic_sketch.set_capture(True)
+            recompiled = engine.stats.compiles != compiles0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if recompiled:
+        raise AssertionError(
+            "traffic-capture bench recompiled after warmup")
+    on_s, off_s = float(np.min(t_on)), float(np.min(t_off))
+    pct = (on_s - off_s) / off_s * 100.0
+    return {
+        "overhead_pct": pct,
+        "gate_pct": gate_pct,
+        "gate_ok": pct < gate_pct,
+        "captured_rps": n_requests / on_s,
+        "uncaptured_rps": n_requests / off_s,
+        "n_requests": n_requests,
+    }
+
+
 def bench_serve(n_requests: int = 512, batch_slots: int = 16,
                 seed: int = 0) -> dict:
     """Serving-path latency/throughput on THE seeded bursty trace.
@@ -1486,6 +1565,9 @@ def main() -> None:
     # DEEPDFA_TELEMETRY=0 over the same warmed serve replay, same <2%
     # discipline.
     trace_prop_report = bench_trace_propagation()
+    # Traffic-observatory tax (ISSUE 20): shape-sketch capture on vs the
+    # capture kill switch, telemetry ON both sides, same <2% discipline.
+    traffic_cap_report = bench_traffic_capture_overhead()
     combined_eps, comb_diag = bench_combined_train(attention_impl="flash",
                                                    diagnostics=True)
     # The A/B at the parity shape, re-checked every run (flash wins since
@@ -1851,6 +1933,26 @@ def main() -> None:
                         "disabled_rps": round(
                             trace_prop_report["disabled_rps"], 1),
                         "n_requests": trace_prop_report["n_requests"],
+                    },
+                    {
+                        # Traffic-observatory tax (ISSUE 20): shape
+                        # capture on vs the sketch kill switch, telemetry
+                        # on both sides — isolates the observatory's own
+                        # submit-path cost.
+                        "metric": "traffic_capture_overhead_pct",
+                        "value": round(
+                            traffic_cap_report["overhead_pct"], 2),
+                        "unit": "%",
+                        # new capability: the reference has no observatory
+                        "vs_baseline": None,
+                        # MUST stay true: the <2% observability-tax gate.
+                        "gate_ok": traffic_cap_report["gate_ok"],
+                        "gate_pct": traffic_cap_report["gate_pct"],
+                        "captured_rps": round(
+                            traffic_cap_report["captured_rps"], 1),
+                        "uncaptured_rps": round(
+                            traffic_cap_report["uncaptured_rps"], 1),
+                        "n_requests": traffic_cap_report["n_requests"],
                     },
                     {
                         "metric": "combined_train_examples_per_sec",

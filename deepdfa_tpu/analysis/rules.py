@@ -236,6 +236,33 @@ Rule catalogue (each backed by a positive+negative fixture in
                              and exits before the join, stay unflagged —
                              precision over recall, the empty-baseline
                              contract.
+  GL027 unbounded-sample-accumulation  a sample list that only ever
+                             grows feeding an order-statistic: an
+                             ``append``/``extend`` on a receiver whose
+                             visible construction is ``[]``/``list()``/
+                             ``deque()`` without ``maxlen``, consumed by
+                             a quantile-class call (``percentile``/
+                             ``quantile``/``quantiles``/``median``/
+                             ``latency_quantile``, or a subscripted
+                             ``sorted(x)``) in the same scope, in a
+                             long-lived context — a ``self`` attribute
+                             appended outside ``__init__`` (the object
+                             outlives the method) or a local appended
+                             inside a ``while`` loop. A serving process
+                             accumulating per-request samples this way
+                             grows without bound until the quantile call
+                             itself becomes the latency spike; the
+                             blessed shapes are the registry Histogram's
+                             preallocated ring, ``deque(maxlen=...)``,
+                             the traffic observatory's fixed-bin
+                             :class:`~deepdfa_tpu.telemetry.sketch.
+                             ShapeSketch`, or any visible shrink
+                             (``pop``/``clear``/``del x[..]``/slice
+                             reassignment) on the same receiver.
+                             Dict-subscript receivers and constructions
+                             of unknown provenance stay unflagged —
+                             precision over recall, the empty-baseline
+                             contract.
   GL015 subprocess-without-timeout  an unbounded blocking wait on a child
                              process: ``.communicate()``/``.wait()`` with
                              no ``timeout=`` on a receiver whose reaching
@@ -309,6 +336,7 @@ RULES: Dict[str, str] = {
     "GL024": "fork-unsafe-spawn",
     "GL025": "blocking-join-on-main-path",
     "GL026": "unjoined-distributed-exit",
+    "GL027": "unbounded-sample-accumulation",
 }
 
 #: Bump when analysis semantics change in a way file hashes cannot see —
@@ -432,6 +460,12 @@ _DIST_JOINERS = frozenset({
     "fleet_drain", "lifecycle.fleet_drain",
 })
 _HARD_EXITS = frozenset({"sys.exit", "os._exit"})
+
+# GL027: order-statistic consumers — call leaves that need the whole
+# sample, so an unbounded receiver feeding one never stops costing.
+_QUANTILE_LEAVES = frozenset({
+    "percentile", "quantile", "quantiles", "median", "latency_quantile",
+})
 _HANDLER_BLOCKING_CALLS = frozenset({
     "open", "print", "input", "os.fsync", "time.sleep", "json.dump",
     "json.dumps", "pickle.dump", "subprocess.run", "subprocess.Popen",
@@ -806,6 +840,7 @@ class _FunctionChecker:
             self._check_per_hypothesis_dispatch()
             self._check_scan_kernel_launch()
             self._check_distributed_exit()
+            self._check_sample_accumulation()
         return self.findings
 
     # -- jit-scope rules (GL001/2/3/5/8) -------------------------------------
@@ -1467,6 +1502,152 @@ class _FunctionChecker:
                     "coordination service and peers wedge in their next "
                     f"collective; use {how}",
                 )
+
+    # -- unbounded sample accumulation (GL027) -------------------------------
+
+    def _check_sample_accumulation(self) -> None:
+        """GL027: a sample list that only ever grows feeding an
+        order-statistic. Quantiles need the whole sample, so the natural
+        first draft — append every observation, ``np.percentile`` on
+        demand — leaks in any long-lived context: a serving process's
+        per-request latency list grows until the sort inside the
+        quantile call IS the latency spike. The repo's blessed shapes
+        are bounded by construction (the registry Histogram's
+        preallocated ring, ``deque(maxlen=...)``, the traffic
+        observatory's fixed-bin ShapeSketch), so an unbounded receiver
+        that is appended in a long-lived scope, visibly constructed as
+        ``[]``/``list()``/``deque()``, consumed by a quantile-class
+        call, and never shrunk is a finding. Long-lived means: a
+        ``self`` attribute appended outside ``__init__``, or a local
+        appended inside a ``while`` loop. Dict-subscript receivers and
+        unknown-provenance constructions stay unflagged — precision
+        over recall, the empty-baseline contract."""
+        fn = self.fi.node
+        in_init = fn.name == "__init__"
+
+        def key_of(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Name):
+                return expr.id
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return f"self.{expr.attr}"
+            return None
+
+        whiles = [w for w in ast.walk(fn) if isinstance(w, ast.While)]
+
+        def in_while(call: ast.Call) -> bool:
+            return any(w.lineno < call.lineno <= (w.end_lineno or w.lineno)
+                       for w in whiles)
+
+        appends: Dict[str, ast.Call] = {}  # first grow site per receiver
+        for sub in ast.walk(fn):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("append", "extend")):
+                continue
+            key = key_of(sub.func.value)
+            if key is None:
+                continue
+            if key.startswith("self."):
+                if in_init:
+                    continue  # setup-time fill, not steady-state growth
+            elif not in_while(sub):
+                continue  # straight-line local: dies with the call
+            appends.setdefault(key, sub)
+        if not appends:
+            return
+
+        for key, call in sorted(appends.items(),
+                                key=lambda kv: kv[1].lineno):
+            scope = (self._enclosing_class() if key.startswith("self.")
+                     else fn)
+            if scope is None:
+                continue
+            facts = self._sample_facts(scope, key)
+            if (facts["unbounded"] and not facts["bounded"]
+                    and not facts["shrinks"] and facts["consumed"]):
+                self._report(
+                    "GL027", call,
+                    f"{key} only ever grows ({call.func.attr} here, no "
+                    "pop/clear/slice trim in scope) and feeds "
+                    f"{facts['consumer']} — an unbounded sample "
+                    "accumulation in a long-lived scope; use the "
+                    "registry Histogram ring, deque(maxlen=...), or a "
+                    "telemetry.sketch.ShapeSketch (bounded bins, exact "
+                    "merges)",
+                )
+
+    def _enclosing_class(self) -> Optional[ast.ClassDef]:
+        target = self.fi.node
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    sub is target for sub in ast.walk(node)):
+                return node
+        return None
+
+    def _sample_facts(self, scope: ast.AST, key: str) -> Dict[str, object]:
+        """GL027 evidence for one receiver over one scope (the function
+        for locals, the whole class for ``self`` attrs): how it was
+        constructed, whether anything shrinks it, and which
+        order-statistic call consumes it."""
+        def matches(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Name):
+                return key == expr.id
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return key == f"self.{expr.attr}"
+            return False
+
+        facts: Dict[str, object] = {"unbounded": False, "bounded": False,
+                                    "shrinks": False, "consumed": False,
+                                    "consumer": ""}
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign):
+                v = sub.value
+                for t in sub.targets:
+                    if matches(t):
+                        if isinstance(v, ast.List):
+                            facts["unbounded"] = True
+                        elif isinstance(v, ast.Call):
+                            ctor = self.mod.resolve(v.func)
+                            if ctor in ("list", "collections.deque",
+                                        "deque"):
+                                if any(kw.arg == "maxlen"
+                                       for kw in v.keywords):
+                                    facts["bounded"] = True
+                                else:
+                                    facts["unbounded"] = True
+                        elif (isinstance(v, ast.Subscript)
+                                and matches(v.value)):
+                            facts["shrinks"] = True  # x = x[-n:]
+                    elif isinstance(t, ast.Subscript) and matches(t.value):
+                        facts["shrinks"] = True  # x[:] = ... trim
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and matches(t.value):
+                        facts["shrinks"] = True
+            elif isinstance(sub, ast.Call):
+                f = sub.func
+                if (isinstance(f, ast.Attribute) and matches(f.value)
+                        and f.attr in ("pop", "popleft", "clear")):
+                    facts["shrinks"] = True
+                dotted = self.mod.resolve(f)
+                leaf = dotted.rsplit(".", 1)[-1] if dotted else ""
+                if leaf in _QUANTILE_LEAVES and any(
+                        matches(a) for a in list(sub.args)
+                        + [kw.value for kw in sub.keywords]):
+                    facts["consumed"] = True
+                    facts["consumer"] = f"{dotted}()"
+            elif isinstance(sub, ast.Subscript):
+                v = sub.value
+                if (isinstance(v, ast.Call)
+                        and self.mod.resolve(v.func) == "sorted"
+                        and v.args and matches(v.args[0])):
+                    facts["consumed"] = True
+                    facts["consumer"] = "a subscripted sorted()"
+        return facts
 
     # -- pallas interpret pinned in prod (GL016) -----------------------------
 

@@ -670,6 +670,29 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
     trace_on, trace_off = min(t_on), min(t_off)
     trace_overhead_pct = (trace_on - trace_off) / trace_off * 100.0
 
+    # Traffic-observatory mechanism smoke (ISSUE 20): the same warmed
+    # replay A/B'd on the shape-capture kill switch alone — telemetry
+    # stays ON both sides, so the delta is the sketch/waste-accounting
+    # cost itself, tiny shape, best-of-reps.
+    from deepdfa_tpu.telemetry import sketch as traffic_sketch
+
+    cap_tmp = tempfile.mkdtemp(prefix="bench_traffic_smoke_")
+    c_on, c_off = [], []
+    try:
+        with telemetry.run_scope(cap_tmp):
+            trace_replay(False)  # warm the capture path in this run
+            for _ in range(reps):
+                c_on.append(trace_replay(False))
+                traffic_sketch.set_capture(False)
+                try:
+                    c_off.append(trace_replay(False))
+                finally:
+                    traffic_sketch.set_capture(True)
+    finally:
+        shutil.rmtree(cap_tmp, ignore_errors=True)
+    cap_on, cap_off = min(c_on), min(c_off)
+    cap_overhead_pct = (cap_on - cap_off) / cap_off * 100.0
+
     # graftlint full-repo cold pass (stdlib AST work, no jax): the
     # analyzer's own cost rides the same gate as kernel perf. One rep —
     # deterministic CPU work, and the smoke budget matters.
@@ -710,5 +733,14 @@ def bench_smoke(n_steps: int = 40, n_rows: int = 400,
             # would flap (docstring) — the throughput above is the gate.
             "overhead_pct": round(trace_overhead_pct, 2),
             "disabled_rps": round(len(trace_graphs) / trace_off, 1),
+        },
+        "smoke_traffic_capture_rps": {
+            "value": round(len(trace_graphs) / cap_on, 1),
+            "unit": "req/s",
+            # Same un-gated-companion rule as trace propagation: the A/B
+            # percent sits at the noise floor on a smoke-sized replay —
+            # throughput is the gated number, the percent is the fact.
+            "overhead_pct": round(cap_overhead_pct, 2),
+            "uncaptured_rps": round(len(trace_graphs) / cap_off, 1),
         },
     }

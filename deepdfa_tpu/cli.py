@@ -1389,6 +1389,30 @@ def _serve_smoke_gates(report: Dict[str, Any], run_dir: Optional[str],
                 logger.error("serve smoke: no propagated traces in the "
                              "report (propagation=%s)", prop)
                 report["ok"] = False
+            # Traffic-observatory gate (ISSUE 20): every lane the smoke
+            # exercised must have captured raw shape samples — a lane
+            # serving traffic with an empty sketch means an admission
+            # edge lost its capture hook.
+            traffic = trace_rep.get("traffic") or {}
+            shapes = traffic.get("shapes") or {}
+            lanes = sorted((trace_rep.get("serve") or {})
+                           .get("lanes") or {})
+            missing = []
+            for lane in lanes:
+                series = ("traffic_shape_serve_gen_src_tokens"
+                          if lane == "gen"
+                          else f"traffic_shape_serve_{lane}_nodes")
+                if not (shapes.get(series) or {}).get("count"):
+                    missing.append(lane)
+            report["traffic"] = {
+                "samples": traffic.get("samples", 0),
+                "lanes": lanes,
+                "elem_waste_pct": traffic.get("elem_waste_pct"),
+            }
+            if missing:
+                logger.error("serve smoke: no traffic shape samples for "
+                             "active lanes %s", missing)
+                report["ok"] = False
     if not report["ok"]:
         report["exit_code"] = 1
     print(json.dumps(report))
@@ -1983,8 +2007,24 @@ def cmd_trace(args) -> Dict[str, Any]:
         if not out["ok"]:
             out["exit_code"] = 1
         return out
+    if args.action == "recommend-buckets":
+        # The offline ladder recommender (ISSUE 20): report-only replay
+        # of the run's traffic shape sketches against fitted ladders.
+        if not args.run_dir:
+            raise ValueError(
+                "usage: cli trace recommend-buckets <run-dir>")
+        from deepdfa_tpu.telemetry.report import recommend_buckets
+
+        kw: Dict[str, Any] = {}
+        if getattr(args, "quantiles", None):
+            kw["quantiles"] = tuple(
+                float(q) for q in args.quantiles.split(","))
+        rec = recommend_buckets(args.run_dir, **kw)
+        print(json.dumps(rec))
+        return rec
     if args.action != "report" or not args.run_dir:
         raise ValueError("usage: cli trace report <run-dir> | "
+                         "cli trace recommend-buckets <run-dir> | "
                          "cli trace --smoke")
     report = trace_report(args.run_dir)
     if args.slo:
@@ -2562,8 +2602,12 @@ def main(argv: Optional[List[str]] = None) -> int:
              "events.jsonl (step p50/p99, host/device split, post-warmup "
              "compiles, retry/fault/quarantine totals); `trace --smoke` "
              "runs a tiny instrumented fit and round-trips the report")
-    p_tr.add_argument("action", nargs="?", choices=["report"],
-                      help="report: summarize one run directory")
+    p_tr.add_argument("action", nargs="?",
+                      choices=["report", "recommend-buckets"],
+                      help="report: summarize one run directory; "
+                           "recommend-buckets: replay the run's traffic "
+                           "shape sketches against percentile-fitted "
+                           "bucket ladders (report-only)")
     p_tr.add_argument("run_dir", nargs="?", default=None,
                       help="run directory holding telemetry/events.jsonl")
     p_tr.add_argument("--smoke", action="store_true",
@@ -2576,6 +2620,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                       help="evaluate the report against an SLO spec "
                            "(JSON file or built-in name smoke/chaos/"
                            "default); breaches exit nonzero")
+    p_tr.add_argument("--quantiles", default=None,
+                      help="recommend-buckets ladder rung quantiles, "
+                           "comma-separated (default "
+                           "0.5,0.75,0.9,0.95,0.99,1.0)")
     p_tr.set_defaults(func=cmd_trace)
 
     p_bn = sub.add_parser(

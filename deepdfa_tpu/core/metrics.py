@@ -170,7 +170,7 @@ class ServingStats:
         # needs. Keys are (lane, n_slots); both come from code-
         # enumerated sets (lane names, the config slot ladder), so the
         # derived gauge names stay GL014-bounded.
-        self._padding: Dict[tuple, list] = {}
+        self._padding: Dict[tuple, Dict[str, int]] = {}
         self._latency_window = latency_window
         self._latencies_ms = np.zeros(latency_window, np.float64)
         self._latency_count = 0  # total ever observed (ring write cursor)
@@ -201,16 +201,60 @@ class ServingStats:
                     seconds * 1000.0)
 
     def record_batch(self, n_real: int, n_slots: int,
-                     lane: "str | None" = None) -> None:
+                     lane: "str | None" = None,
+                     elems_used: "int | None" = None,
+                     elems_per_slot: "int | None" = None,
+                     elems_budget: "int | None" = None) -> None:
+        """Fold one flushed micro-batch into the per-(lane, bucket) cells.
+
+        The slot axis (``n_real`` of ``n_slots``) is PR-17's accounting.
+        The element axis (ISSUE 20) decomposes the bucket's padded
+        element budget (graph lanes: nodes; gen lane: source tokens)
+        into three exactly-summing waste components:
+
+        * ``slot_underfill``  — empty slots x per-slot share (the same
+          waste the slot axis reports, in element units);
+        * ``inslot_pad``      — occupied slots' pad up to the per-slot
+          cap (the ``select_bucket`` node / src-length ladder's cost);
+        * ``flush_overhead``  — the bucket budget's own pow2/tile
+          rounding above ``n_slots * elems_per_slot``.
+
+        ``slot_underfill + inslot_pad + flush_overhead ==
+        elems_budget - elems_used`` by construction, so the element
+        decomposition ties exactly to the slot-axis cells it extends.
+        """
+        elems = (elems_used is not None and elems_per_slot is not None
+                 and elems_budget is not None)
         if lane is not None:
             with self._lock:
                 self.batches += 1
                 self.occupancy_used += n_real
                 self.occupancy_slots += n_slots
-                cell = self._padding.setdefault((lane, int(n_slots)), [0, 0])
-                cell[0] += n_real
-                cell[1] += n_slots
-                waste_pct = 100.0 * (1.0 - cell[0] / cell[1])
+                cell = self._padding.setdefault(
+                    (lane, int(n_slots)),
+                    {"used": 0, "slots": 0, "flushes": 0},
+                )
+                cell["used"] += n_real
+                cell["slots"] += n_slots
+                cell["flushes"] += 1
+                if elems:
+                    cell["elems_used"] = (
+                        cell.get("elems_used", 0) + int(elems_used))
+                    cell["elems_budget"] = (
+                        cell.get("elems_budget", 0) + int(elems_budget))
+                    cell["elems_slot_underfill"] = (
+                        cell.get("elems_slot_underfill", 0)
+                        + (n_slots - n_real) * int(elems_per_slot))
+                    cell["elems_inslot_pad"] = (
+                        cell.get("elems_inslot_pad", 0)
+                        + n_real * int(elems_per_slot) - int(elems_used))
+                    cell["elems_flush_overhead"] = (
+                        cell.get("elems_flush_overhead", 0)
+                        + int(elems_budget)
+                        - n_slots * int(elems_per_slot))
+                    elem_waste = 100.0 * (
+                        1.0 - cell["elems_used"] / cell["elems_budget"])
+                waste_pct = 100.0 * (1.0 - cell["used"] / cell["slots"])
             # Gauge name formatted from the lane parameter, the config
             # slot ladder, and the statically-enumerated replica id —
             # never from per-request data (GL014).
@@ -218,6 +262,10 @@ class ServingStats:
             REGISTRY.gauge(
                 f"serve_padding_waste_pct_{lane}_b{int(n_slots)}{suffix}"
             ).set(round(waste_pct, 4))
+            if elems:
+                REGISTRY.gauge(
+                    f"serve_elem_waste_pct_{lane}_b{int(n_slots)}{suffix}"
+                ).set(round(elem_waste, 4))
         else:
             with self._lock:
                 self.batches += 1
@@ -226,6 +274,10 @@ class ServingStats:
         REGISTRY.counter("serve_batches_total").inc()
         REGISTRY.counter("serve_slots_occupied_total").inc(n_real)
         REGISTRY.counter("serve_slots_padded_total").inc(n_slots - n_real)
+        if elems:
+            REGISTRY.counter("serve_elems_used_total").inc(int(elems_used))
+            REGISTRY.counter("serve_elems_budget_total").inc(
+                int(elems_budget))
         if self._replica is not None:
             REGISTRY.counter(f"serve_{self._replica}_batches_total").inc()
 
@@ -262,14 +314,90 @@ class ServingStats:
             if self.occupancy_slots else 0.0,
         )
         with self._lock:
-            padding = {f"{lane}:b{slots}": {
-                "used": used, "slots": total,
-                "waste_pct": round(100.0 * (1.0 - used / total), 2)}
-                for (lane, slots), (used, total)
-                in sorted(self._padding.items())}
+            padding = {}
+            for (lane, slots), cell in sorted(self._padding.items()):
+                c = {"used": cell["used"], "slots": cell["slots"],
+                     "waste_pct": round(
+                         100.0 * (1.0 - cell["used"] / cell["slots"]), 2),
+                     "flushes": cell["flushes"]}
+                if cell.get("elems_budget"):
+                    b = cell["elems_budget"]
+                    c.update(
+                        elems_used=cell.get("elems_used", 0),
+                        elems_budget=b,
+                        elems_slot_underfill=cell.get(
+                            "elems_slot_underfill", 0),
+                        elems_inslot_pad=cell.get("elems_inslot_pad", 0),
+                        elems_flush_overhead=cell.get(
+                            "elems_flush_overhead", 0),
+                        elem_waste_pct=round(
+                            100.0 * (1.0 - cell.get("elems_used", 0) / b),
+                            2),
+                        slot_underfill_pct=round(
+                            100.0 * cell.get("elems_slot_underfill", 0)
+                            / b, 2),
+                        inslot_pad_pct=round(
+                            100.0 * cell.get("elems_inslot_pad", 0) / b,
+                            2),
+                        flush_overhead_pct=round(
+                            100.0 * cell.get("elems_flush_overhead", 0)
+                            / b, 2),
+                    )
+                padding[f"{lane}:b{slots}"] = c
+            e_used = sum(c.get("elems_used", 0)
+                         for c in self._padding.values())
+            e_budget = sum(c.get("elems_budget", 0)
+                           for c in self._padding.values())
+        if e_budget:
+            out["elem_waste_pct"] = round(
+                100.0 * (1.0 - e_used / e_budget), 4)
         if padding:
             out["padding_waste"] = padding
         return out
+
+
+# Everything exactly summable across replicas / router processes in a
+# padding cell; derived pct keys are recomputed after the merge.
+_PADDING_SUM_KEYS = (
+    "used", "slots", "flushes", "elems_used", "elems_budget",
+    "elems_slot_underfill", "elems_inslot_pad", "elems_flush_overhead",
+)
+
+
+def merge_padding_cells(cell_maps) -> Dict[str, Dict[str, float]]:
+    """Exact aggregation of per-(lane, bucket) padding cells across
+    engine snapshots — the ONE merge the fleet front-end and the router
+    tier both use (it was copy-pasted in serve/fleet.py and
+    serve/router.py before ISSUE 20).
+
+    ``cell_maps`` is an iterable of ``snapshot()["padding_waste"]``
+    maps (None/missing entries tolerated). Counts sum exactly;
+    ``waste_pct`` and the element-axis pct columns are recomputed from
+    the merged counts, so the output for slot-only cells is
+    byte-identical to what the two former copies produced.
+    """
+    merged: Dict[str, Dict[str, float]] = {}
+    for cells in cell_maps:
+        for key, cell in (cells or {}).items():
+            acc = merged.setdefault(key, {"used": 0, "slots": 0})
+            for k in _PADDING_SUM_KEYS:
+                if k in cell:
+                    acc[k] = acc.get(k, 0) + cell[k]
+    for cell in merged.values():
+        cell["waste_pct"] = round(
+            100.0 * (1.0 - cell["used"] / cell["slots"]), 2
+        ) if cell["slots"] else 0.0
+        if cell.get("elems_budget"):
+            b = cell["elems_budget"]
+            cell["elem_waste_pct"] = round(
+                100.0 * (1.0 - cell.get("elems_used", 0) / b), 2)
+            cell["slot_underfill_pct"] = round(
+                100.0 * cell.get("elems_slot_underfill", 0) / b, 2)
+            cell["inslot_pad_pct"] = round(
+                100.0 * cell.get("elems_inslot_pad", 0) / b, 2)
+            cell["flush_overhead_pct"] = round(
+                100.0 * cell.get("elems_flush_overhead", 0) / b, 2)
+    return merged
 
 
 class IngestStats:

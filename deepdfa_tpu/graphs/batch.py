@@ -198,6 +198,7 @@ def batch_graphs(
     impl: str = "auto",
     with_dataflow: bool = False,
     slot_nodes: Optional[int] = None,
+    shape_series: Optional[str] = "train",
 ) -> "GraphBatch":
     """Pack up to ``n_graphs`` graphs into one padded batch (host-side).
 
@@ -221,9 +222,32 @@ def batch_graphs(
     input-pipeline path), "python" (numpy loop — the oracle), or "auto".
     Slot packing always takes the python path (a slot layout is an offset
     table, not a hot copy loop).
+
+    ``shape_series``: traffic-observatory series prefix for the raw
+    pre-bucket shapes in this batch (ISSUE 20). The default "train"
+    records every packed graph's node/edge counts into the
+    ``traffic_shape_train_*`` sketches plus the train-side pad ledger
+    (elements used vs the padded node budget — the goodput denominator
+    for fenced train rows in the roofline). Pass ``None`` on paths that
+    are NOT training admission — the serve engine captures its own
+    lanes at submit time and must not double-count here.
     """
     if len(graphs) > n_graphs:
         raise ValueError(f"{len(graphs)} graphs > {n_graphs} slots")
+    if shape_series is not None and graphs:
+        from deepdfa_tpu.telemetry import sketch as _traffic
+
+        if _traffic.capture_enabled():
+            used = 0
+            for g in graphs:
+                n = int(g["num_nodes"])
+                used += n
+                _traffic.observe_shape(
+                    f"traffic_shape_{shape_series}_nodes", n)
+                _traffic.observe_shape(
+                    f"traffic_shape_{shape_series}_edges",
+                    len(g["senders"]))
+            _traffic.observe_train_pad(used, int(max_nodes))
     if slot_nodes is not None:
         if slot_nodes < 1:
             raise ValueError(f"slot_nodes {slot_nodes} < 1")
@@ -410,6 +434,7 @@ def batch_iterator(
     band_bandwidth: Optional[int] = None,
     with_dataflow: bool = False,
     slot_nodes: Optional[int] = None,
+    shape_series: Optional[str] = "train",
 ):
     """Greedy packer: yields GraphBatches, spilling graphs that would
     overflow the budget into the next batch (static-shape replacement for
@@ -426,7 +451,7 @@ def batch_iterator(
         add_self_loops=add_self_loops, build_tile_adj=build_tile_adj,
         tile=tile, tile_pad_nz=tile_pad_nz, build_band_adj=build_band_adj,
         band_bandwidth=band_bandwidth, with_dataflow=with_dataflow,
-        slot_nodes=slot_nodes,
+        slot_nodes=slot_nodes, shape_series=shape_series,
     )
 
     def _cost(g):

@@ -207,6 +207,11 @@ class ScanService:
             except contracts.ContractError as e:
                 results[i] = self._fail(item_id, e, raw, t0, tattrs)
                 continue
+            # Traffic observatory (ISSUE 20): raw validated function size
+            # at the scan admission edge (cached or not — every admitted
+            # source is demand the extraction ladder must cover).
+            telemetry.observe_shape("traffic_shape_scan_source_bytes",
+                                    len(source))
             key = source_key(source)
             cached = self.cache.get(key)
             if cached is not None:

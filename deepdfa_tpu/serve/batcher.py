@@ -76,6 +76,9 @@ class ServeRequest:
     t_submit: float = 0.0         # telemetry clock (perf_counter seconds)
     input_ids: Optional[np.ndarray] = None   # combined + gen lanes
     src_bucket: Optional[int] = None         # gen lane: padded source len
+    src_tokens: Optional[int] = None         # gen lane: RAW token count —
+    # the pre-bucket size the traffic observatory charges in-slot pad
+    # against (input_ids is already padded to src_bucket).
     # Distributed trace context (ISSUE 14): the trace id this request
     # rides (continued from a client's traceparent header, or minted
     # fresh at admission); the serve.request span carries both so the
@@ -128,6 +131,11 @@ class MicroBatcher:
         # reproduce the static config exactly; set_flush_policy clamps.
         self._flush_fraction = config.flush_fraction
         self._fill_slots = config.batch_slots
+        # Why each lane's LAST bucket sealed (fill / deadline / drain):
+        # the engine stamps it onto the serve.flush span, so the trace
+        # report's traffic section can attribute slot-underfill waste to
+        # deadline pressure vs drain vs genuinely full buckets.
+        self._last_cause: Dict[str, str] = {}
 
     def set_flush_policy(self, fraction: Optional[float] = None,
                          fill_slots: Optional[int] = None) -> None:
@@ -263,6 +271,20 @@ class MicroBatcher:
         """
         with self._lock:
             q = self._pending[lane]
+            # Classify the seal under the same lock that decides it: a
+            # full bucket is a fill-flush even in drain mode; drain only
+            # explains partially-filled seals.
+            if len(q) >= self._fill_slots:
+                self._last_cause[lane] = "fill"
+            elif self._drain_mode:
+                self._last_cause[lane] = "drain"
+            else:
+                self._last_cause[lane] = "deadline"
             out = [q.popleft() for _ in range(min(len(q),
                                                   self.config.batch_slots))]
             return out
+
+    def last_flush_cause(self, lane: str) -> Optional[str]:
+        """Why ``lane``'s most recent bucket sealed (None before any)."""
+        with self._lock:
+            return self._last_cause.get(lane)

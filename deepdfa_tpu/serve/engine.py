@@ -116,6 +116,10 @@ def bucket_batch(config: ServeConfig, graphs: Sequence[Mapping], slots: int,
         graphs, slots, budget["max_nodes"], budget["max_edges"], subkeys,
         build_band_adj=band,
         band_bandwidth=config.band_bandwidth if band else None,
+        # Serve lanes capture their shapes at the admission edge
+        # (engine.submit) — counting them again here would double-book
+        # serve traffic into the train series.
+        shape_series=None,
     )
 
 
@@ -426,18 +430,22 @@ class ServeEngine:
             raise BadRequestError(str(e))
 
     def _encode_gen(self, code: str):
-        """(padded ids, src bucket) for one gen request — the gen lane's
-        only size check (the token-count analog of admission_caps)."""
+        """(padded ids, src bucket, raw token count) for one gen request
+        — the gen lane's only size check (the token-count analog of
+        admission_caps)."""
         from deepdfa_tpu.data.text import encode_function_t5
 
         tok = self._gen.tokenizer
         n = len(tok.tokenize(str(code))) + 2  # + bos/eos
+        # Raw pre-bucket demand, observed BEFORE the cap check: the
+        # ladder recommender needs to see oversize arrivals too.
+        telemetry.observe_shape("traffic_shape_serve_gen_src_tokens", n)
         if n > self.config.gen_src_len:
             raise OversizedError(
                 f"source has {n} tokens > gen-lane cap "
                 f"{self.config.gen_src_len}")
         src_b = self.config.gen_src_bucket_for(n)
-        return encode_function_t5(code, tok, block_size=src_b), src_b
+        return encode_function_t5(code, tok, block_size=src_b), src_b, n
 
     def submit(self, graph: Optional[Mapping], code: Optional[str] = None,
                deadline_ms: Optional[float] = None,
@@ -471,11 +479,12 @@ class ServeEngine:
                     "with a gen model)")
             if code is None:
                 raise BadRequestError("lane 'gen' requires 'code'")
-            input_ids, src_b = self._encode_gen(code)
+            input_ids, src_b, src_tokens = self._encode_gen(code)
             req = ServeRequest(
                 rid=next(self._rid), key=text_hash(code), graph=None,
                 lane="gen", arrival=now, deadline_s=deadline_s,
                 input_ids=input_ids, src_bucket=src_b,
+                src_tokens=src_tokens,
                 t_submit=telemetry.now(),
                 trace_id=trace_id, trace_continued=trace_continued,
             )
@@ -500,6 +509,14 @@ class ServeEngine:
                                exc_info=True)
                 degraded = True
                 self.stats.bump("degraded")
+        # Raw pre-bucket shape at the admission edge (ISSUE 20): the
+        # series name is formatted from the resolved lane, a member of
+        # the code-enumerated lane set (GL014 holds — observe_shape
+        # rejects names outside telemetry.sketch.SHAPE_SERIES).
+        telemetry.observe_shape(f"traffic_shape_serve_{lane}_nodes",
+                                int(norm["num_nodes"]))
+        telemetry.observe_shape(f"traffic_shape_serve_{lane}_edges",
+                                len(norm["senders"]))
 
         key = content_hash(norm, code if lane == "combined" else None)
         req = ServeRequest(
@@ -637,12 +654,38 @@ class ServeEngine:
                  "score": float(sc[i]), "model": "gen"}
                 for i in range(len(reqs))]
 
+    def _flush_elems(self, lane_name: str, reqs: List[ServeRequest],
+                     slots: int) -> "tuple[int, int, int]":
+        """(elems_used, elems_per_slot, elems_budget) of one flush — the
+        element axis of the padding decomposition. Graph lanes count
+        nodes against the per-slot admission cap and the bucket's pow2/
+        tile-rounded node budget; the gen lane counts raw source tokens
+        against the batch's padded src bucket."""
+        if lane_name == "gen":
+            per_slot = max(r.src_bucket for r in reqs)
+            used = sum(int(r.src_tokens) if r.src_tokens is not None
+                       else len(r.input_ids) for r in reqs)
+            return used, per_slot, slots * per_slot
+        from deepdfa_tpu.ops.tile_spmm import DEFAULT_TILE
+
+        lane = self._lanes[lane_name]
+        budget = self.config.budget_for(
+            slots, tile=DEFAULT_TILE if lane.band else None)
+        used = sum(int(r.graph["num_nodes"]) for r in reqs)
+        return used, self.config.max_nodes_per_graph, budget["max_nodes"]
+
     def _run_batch(self, lane_name: str, reqs: List[ServeRequest]) -> None:
         slots = self.config.bucket_for(len(reqs))
         ordinal = next(self._flush_ordinal)
         w0 = time.perf_counter()
+        e_used, e_slot, e_budget = self._flush_elems(lane_name, reqs, slots)
         span_attrs: Dict[str, Any] = dict(lane=lane_name, n=len(reqs),
-                                          slots=slots, ordinal=ordinal)
+                                          slots=slots, ordinal=ordinal,
+                                          elems=e_used, elems_slot=e_slot,
+                                          elems_budget=e_budget)
+        cause = self.batcher.last_flush_cause(lane_name)
+        if cause is not None:
+            span_attrs["cause"] = cause
         if self.replica is not None:
             span_attrs["replica"] = self.replica
         flush_span = telemetry.span("serve.flush", **span_attrs)
@@ -713,7 +756,9 @@ class ServeEngine:
             done = self._clock()
         t_done = telemetry.now()
         self.in_flight = 0
-        self.stats.record_batch(len(reqs), slots, lane=lane_name)
+        self.stats.record_batch(len(reqs), slots, lane=lane_name,
+                                elems_used=e_used, elems_per_slot=e_slot,
+                                elems_budget=e_budget)
         for i, r in enumerate(reqs):
             # The cache line holds only content-derived values; "degraded"
             # describes THIS request's handling (its tokenizer failure),

@@ -486,7 +486,8 @@ class ServeFleet:
         with each replica's own snapshot and drain state."""
         import numpy as np
 
-        from deepdfa_tpu.core.metrics import ServingStats, latency_quantile
+        from deepdfa_tpu.core.metrics import (
+            ServingStats, latency_quantile, merge_padding_cells)
 
         per: Dict[str, Dict[str, Any]] = {}
         out: Dict[str, Any] = {}
@@ -519,20 +520,19 @@ class ServeFleet:
             n_replicas=len(self.replicas),
             replicas=per,
         )
-        # Per-(lane, bucket) padding merges exactly on used/slot counts
-        # across replicas (each replica's snapshot carries its own).
-        padding: Dict[str, Dict[str, float]] = {}
-        for snap in per.values():
-            for key, cell in (snap.get("padding_waste") or {}).items():
-                acc = padding.setdefault(key, {"used": 0, "slots": 0})
-                acc["used"] += cell["used"]
-                acc["slots"] += cell["slots"]
-        for cell in padding.values():
-            cell["waste_pct"] = round(
-                100.0 * (1.0 - cell["used"] / cell["slots"]), 2
-            ) if cell["slots"] else 0.0
+        # Per-(lane, bucket) padding merges exactly on used/slot/element
+        # counts across replicas (each replica's snapshot carries its
+        # own) — the ONE shared merge, core.metrics.merge_padding_cells.
+        padding = merge_padding_cells(
+            snap.get("padding_waste") for snap in per.values())
         if padding:
             out["padding_waste"] = padding
+            e_used = sum(c.get("elems_used", 0) for c in padding.values())
+            e_budget = sum(c.get("elems_budget", 0)
+                           for c in padding.values())
+            if e_budget:
+                out["elem_waste_pct"] = round(
+                    100.0 * (1.0 - e_used / e_budget), 4)
         return out
 
     def health(self) -> Dict[str, Any]:

@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.metrics import merge_padding_cells
 from deepdfa_tpu.serve.config import ServeConfig
 from deepdfa_tpu.serve.procfleet import (EngineProc, NoLiveProcessError,
                                          ProcFleet)
@@ -107,24 +108,23 @@ def aggregate_snapshots(snaps: Dict[str, Optional[dict]]) -> Dict:
                  + (s.get("cache_misses", 0) or 0) for s in present]
             out[k] = (sum(v * x for v, x in zip(vals, w)) / sum(w)
                       if sum(w) else 0.0)
-        elif k == "padding_waste_pct":
+        elif k in ("padding_waste_pct", "elem_waste_pct"):
             w = [_occ_slots(s) for s in present]
             out[k] = (sum(v * x for v, x in zip(vals, w)) / sum(w)
                       if sum(w) else 0.0)
         else:
             out[k] = sum(vals)
-    padding: Dict[str, Dict[str, float]] = {}
-    for s in present:
-        for key, cell in (s.get("padding_waste") or {}).items():
-            acc = padding.setdefault(key, {"used": 0, "slots": 0})
-            acc["used"] += cell.get("used", 0)
-            acc["slots"] += cell.get("slots", 0)
-    for cell in padding.values():
-        cell["waste_pct"] = round(
-            100.0 * (1.0 - cell["used"] / cell["slots"]), 2
-        ) if cell["slots"] else 0.0
+    padding = merge_padding_cells(
+        s.get("padding_waste") for s in present)
     if padding:
         out["padding_waste"] = padding
+        e_used = sum(c.get("elems_used", 0) for c in padding.values())
+        e_budget = sum(c.get("elems_budget", 0) for c in padding.values())
+        if e_budget:
+            # Exact (not batch-weighted): the merged element counts ARE
+            # the fleet-wide ledger, so recompute rather than average.
+            out["elem_waste_pct"] = round(
+                100.0 * (1.0 - e_used / e_budget), 4)
     return out
 
 

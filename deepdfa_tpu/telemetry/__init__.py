@@ -44,12 +44,30 @@ The distributed trace plane (ISSUE 14) extends the layer with:
   registry; the report and the merged Chrome view read segments
   transparently.
 
+The traffic observatory (ISSUE 20) extends the layer with:
+
+* :mod:`~deepdfa_tpu.telemetry.sketch` — bounded deterministic
+  quantile sketches over raw pre-bucket request shapes (nodes/edges,
+  gen source tokens, scan sizes) at every admission edge, mirrored as
+  mergeable ``traffic.shape`` events; plus the ladder-fitting math
+  behind ``cli trace recommend-buckets``. The report's ``traffic``
+  section reconstructs shape quantiles and the two-axis padding-waste
+  decomposition (slot underfill vs in-slot pad vs flush overhead) from
+  ``events.jsonl`` alone, and the roofline gains a goodput column
+  (``effective_flops_frac`` / ``effective_mfu``).
+
 ``DEEPDFA_TELEMETRY=0`` disables everything; with no run active every
 hook is a cheap no-op, so instrumentation lives in production code paths.
 """
 
-from deepdfa_tpu.telemetry import context
+from deepdfa_tpu.telemetry import context, sketch
 from deepdfa_tpu.telemetry.registry import REGISTRY, Registry, sanitize
+from deepdfa_tpu.telemetry.sketch import (
+    SHAPE_SERIES,
+    ShapeSketch,
+    observe_shape,
+    observe_train_pad,
+)
 from deepdfa_tpu.telemetry.spans import (
     ENV_VAR,
     Span,
@@ -74,6 +92,8 @@ __all__ = [
     "ENV_VAR",
     "REGISTRY",
     "Registry",
+    "SHAPE_SERIES",
+    "ShapeSketch",
     "Span",
     "TelemetryRun",
     "context",
@@ -85,11 +105,14 @@ __all__ = [
     "flush",
     "in_child_shard",
     "now",
+    "observe_shape",
+    "observe_train_pad",
     "rebind_forked",
     "record_span",
     "run_scope",
     "sanitize",
     "set_enabled",
+    "sketch",
     "span",
     "start_run",
 ]

@@ -1,4 +1,5 @@
-"""One process-wide metrics registry: counters, gauges, histograms.
+"""One process-wide metrics registry: counters, gauges, histograms,
+shape sketches.
 
 The stack grew four private counter families (``core.metrics.ServingStats``,
 ``core.metrics.IngestStats``, ``contracts.STATS``, the resilience retry
@@ -168,6 +169,21 @@ class Registry:
     def histogram(self, name: str, window: int = 4096) -> Histogram:
         return self._get(name, Histogram, window=window)
 
+    def sketch(self, name: str):
+        """Create-or-get a bounded shape sketch
+        (:class:`deepdfa_tpu.telemetry.sketch.ShapeSketch`) — the
+        traffic-observatory metric kind: fixed log-spaced bins, exact
+        merge, no unbounded sample list."""
+        from deepdfa_tpu.telemetry.sketch import ShapeSketch
+        return self._get(name, ShapeSketch)
+
+    def sketches(self) -> List:
+        """Every registered sketch (the traffic flush hook iterates
+        these to emit final ``traffic.shape`` events)."""
+        with self._lock:
+            return [m for m in self._metrics.values()
+                    if getattr(m, "kind", "") == "sketch"]
+
     def reset(self) -> None:
         """Drop every metric — test isolation only."""
         with self._lock:
@@ -192,7 +208,7 @@ class Registry:
         for name, m in sorted(metrics.items()):
             full = prefix + name
             lines.append(f"# TYPE {full} {m.kind}")
-            if isinstance(m, Histogram):
+            if getattr(m, "kind", "") in ("histogram", "sketch"):
                 v = m.value
                 lines.append(f"{full}_count {_fmt(v['count'])}")
                 lines.append(f"{full}_sum {_fmt(v['sum'])}")

@@ -10,9 +10,10 @@ quarantines fired.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from deepdfa_tpu.core.metrics import latency_quantile as _quantile
+from deepdfa_tpu.telemetry import sketch as _sketch
 from deepdfa_tpu.telemetry.export import read_run_dir
 
 # Span names whose durations are per-step work (host-dispatch side).
@@ -304,8 +305,11 @@ def summarize(events: List[Dict[str, Any]],
         ],
     }
 
+    # --- traffic observatory: shapes + two-axis waste (ISSUE 20) --------
+    traffic = _traffic(flushes, instants)
+
     # --- roofline: cost.model events joined to measured spans -----------
-    roofline = _roofline(spans, instants, train)
+    roofline = _roofline(spans, instants, train, traffic)
 
     # --- memory: compiled HBM footprint + live device samples -----------
     memory = _memory(instants)
@@ -363,6 +367,7 @@ def summarize(events: List[Dict[str, Any]],
         "faults": {"total": len(faults), "by_site": by_site},
         "quarantined": len(quarantined),
         "serve": serve,
+        "traffic": traffic,
         "roofline": roofline,
         "memory": memory,
         "lifecycle": lifecycle,
@@ -504,6 +509,146 @@ def _propagation(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def _traffic_states(instants: List[Dict[str, Any]],
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Reconstruct per-series shape-sketch states from the trace alone.
+
+    Every process mirrors its sketches as *cumulative* ``traffic.shape``
+    events (pow2 schedule + final flush), so the per-process total is
+    the event with the highest count per (process, series); the cross-
+    process total is then an exact bin-wise merge — order-independent,
+    which is what makes the section stable across fleet shard layouts.
+    """
+    best: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for e in instants:
+        if e.get("name") != "traffic.shape":
+            continue
+        a = e.get("attrs") or {}
+        series = a.get("series")
+        if not series:
+            continue
+        key = (str(e.get("_process") or "main"), str(series))
+        cur = best.get(key)
+        if cur is None or int(a.get("count", 0) or 0) >= int(
+                cur.get("count", 0) or 0):
+            best[key] = a
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for (_, series), state in sorted(best.items()):
+        groups.setdefault(series, []).append(state)
+    return {series: _sketch.merge_states(states)
+            for series, states in sorted(groups.items())}
+
+
+def _traffic(flushes: List[Dict[str, Any]],
+             instants: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The traffic-observatory section (ISSUE 20), from the trace alone:
+    per-series raw shape distributions, the two-axis waste decomposition
+    per (lane, bucket) cell, flush-cause counts, and the training-side
+    pad ledger.
+
+    The cells reuse the SAME ``n``/``slots`` span attrs as
+    ``serve.padding_waste``, and the three components are an exact
+    integer partition of ``elems_budget - elems_used`` per flush —
+    slot-axis underfill (empty slots), in-slot shape pad (real inputs
+    below the per-slot cap), and flush overhead (tile/bucket headroom
+    above ``slots * per_slot``) — so the decomposition *sums* to the
+    waste the existing cells already report rather than re-estimating
+    it."""
+    states = _traffic_states(instants)
+    shapes = {series: _sketch.summarize_state(state)
+              for series, state in states.items()}
+
+    cells: Dict[str, Dict[str, Any]] = {}
+    causes: Dict[str, Dict[str, int]] = {}
+    total_used = total_budget = 0
+    for f in flushes:
+        a = f.get("attrs") or {}
+        lane, n, slots = a.get("lane"), a.get("n"), a.get("slots")
+        if lane is None or n is None or slots is None:
+            continue
+        lane, n, slots = str(lane), int(n), int(slots)
+        cause = a.get("cause")
+        if cause:
+            lane_causes = causes.setdefault(lane, {})
+            lane_causes[str(cause)] = lane_causes.get(str(cause), 0) + 1
+        e_used, e_slot, e_budget = (a.get("elems"), a.get("elems_slot"),
+                                    a.get("elems_budget"))
+        if e_used is None or e_slot is None or e_budget is None:
+            continue
+        e_used, e_slot, e_budget = int(e_used), int(e_slot), int(e_budget)
+        cell = cells.setdefault(f"{lane}:b{slots}", {
+            "flushes": 0, "used": 0, "slots": 0,
+            "elems_used": 0, "elems_budget": 0, "elems_per_slot": 0,
+            "elems_slot_underfill": 0, "elems_inslot_pad": 0,
+            "elems_flush_overhead": 0,
+        })
+        cell["flushes"] += 1
+        cell["used"] += n
+        cell["slots"] += slots
+        cell["elems_used"] += e_used
+        cell["elems_budget"] += e_budget
+        cell["elems_per_slot"] = max(cell["elems_per_slot"], e_slot)
+        cell["elems_slot_underfill"] += (slots - n) * e_slot
+        cell["elems_inslot_pad"] += n * e_slot - e_used
+        cell["elems_flush_overhead"] += e_budget - slots * e_slot
+        total_used += e_used
+        total_budget += e_budget
+    for cell in cells.values():
+        b = cell["elems_budget"]
+        cell["elem_waste_pct"] = round(
+            100.0 * (1.0 - cell["elems_used"] / b), 2) if b else 0.0
+        cell["slot_underfill_pct"] = round(
+            100.0 * cell["elems_slot_underfill"] / b, 2) if b else 0.0
+        cell["inslot_pad_pct"] = round(
+            100.0 * cell["elems_inslot_pad"] / b, 2) if b else 0.0
+        cell["flush_overhead_pct"] = round(
+            100.0 * cell["elems_flush_overhead"] / b, 2) if b else 0.0
+
+    # Train-side pad ledger: cumulative ``traffic.pad`` events, last
+    # (max batches) per process, summed across processes.
+    pad_best: Dict[str, Dict[str, Any]] = {}
+    for e in instants:
+        if e.get("name") != "traffic.pad":
+            continue
+        a = e.get("attrs") or {}
+        proc = str(e.get("_process") or "main")
+        cur = pad_best.get(proc)
+        if cur is None or int(a.get("batches", 0) or 0) >= int(
+                cur.get("batches", 0) or 0):
+            pad_best[proc] = a
+    train_pad: Optional[Dict[str, Any]] = None
+    if pad_best:
+        batches = sum(int(a.get("batches", 0) or 0)
+                      for a in pad_best.values())
+        p_used = sum(int(a.get("elems_used", 0) or 0)
+                     for a in pad_best.values())
+        p_budget = sum(int(a.get("elems_budget", 0) or 0)
+                       for a in pad_best.values())
+        train_pad = {
+            "batches": batches,
+            "elems_used": p_used,
+            "elems_budget": p_budget,
+            "elem_waste_pct": round(
+                100.0 * (1.0 - p_used / p_budget), 2) if p_budget else 0.0,
+        }
+
+    out: Dict[str, Any] = {
+        "shapes": shapes,
+        "samples": sum(int(s.get("count", 0)) for s in states.values()),
+    }
+    if cells:
+        out["waste"] = dict(sorted(cells.items()))
+        out["elem_waste_pct"] = round(
+            100.0 * (1.0 - total_used / total_budget), 4
+        ) if total_budget else 0.0
+    if causes:
+        out["flush_causes"] = {lane: dict(sorted(c.items()))
+                               for lane, c in sorted(causes.items())}
+    if train_pad is not None:
+        out["train_pad"] = train_pad
+    return out
+
+
 # cost.model event keys that are capture metadata, not span-join attrs.
 # analytic_flops/analytic_bytes are the capture's hand-counted Pallas
 # component (costmodel extra_flops/extra_bytes) — metadata feeding the
@@ -517,7 +662,9 @@ _CM_META = frozenset({
 
 
 def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
-              train: Dict[str, Any]) -> List[Dict[str, Any]]:
+              train: Dict[str, Any],
+              traffic: Optional[Dict[str, Any]] = None,
+              ) -> List[Dict[str, Any]]:
     """Per-kernel roofline rows: XLA cost-model FLOPs/bytes (the
     ``cost.model`` events the costmodel captures emit at warmup) joined
     to the run's measured span durations — per-kernel MFU, operational
@@ -530,7 +677,15 @@ def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
     is the same honesty for the FLOPs/bytes side: rows whose numbers
     include hand-counted Pallas work (analytic extra_flops/extra_bytes)
     say "analytic"/"xla+analytic" instead of passing as XLA-measured.
+
+    The goodput column is the same honesty for padding: MFU counts every
+    FLOP the padded program executed, but only ``effective_flops_frac``
+    of the budget carried real elements (the ``traffic`` section's
+    two-axis accounting), so ``effective_mfu = mfu * frac`` is the
+    utilization spent on actual inputs — the number the bucket-ladder
+    recommender tries to raise.
     """
+    traffic = traffic or {}
     latest: Dict[str, Dict[str, Any]] = {}
     for e in instants:
         if e.get("name") == "cost.model":
@@ -628,6 +783,27 @@ def _roofline(spans: List[Dict[str, Any]], instants: List[Dict[str, Any]],
             row["bound"] = ("compute-bound" if oi >= ridge else "hbm-bound")
         else:
             row["bound"] = None
+        # Goodput: fraction of the padded element budget occupied by
+        # real inputs. Serve kernels join their (lane, bucket) waste
+        # cell (same lane/slots attrs as the cost.model join); train
+        # kernels use the training-side pad ledger.
+        frac = None
+        cell_lane = join_attrs.get("lane")
+        cell_slots = join_attrs.get("slots")
+        if cell_lane is not None and cell_slots is not None:
+            cell = (traffic.get("waste") or {}).get(
+                f"{cell_lane}:b{int(cell_slots)}")
+            if cell and cell.get("elems_budget"):
+                frac = cell["elems_used"] / cell["elems_budget"]
+        elif cm.get("use_fenced_window"):
+            pad = traffic.get("train_pad")
+            if pad and pad.get("elems_budget"):
+                frac = pad["elems_used"] / pad["elems_budget"]
+        row["effective_flops_frac"] = (round(frac, 4)
+                                       if frac is not None else None)
+        row["effective_mfu"] = (round(row["mfu"] * frac, 4)
+                                if frac is not None and row["mfu"]
+                                else None)
         if join_attrs:
             row["attrs"] = join_attrs
         rows.append(row)
@@ -680,3 +856,140 @@ def trace_report(run_dir: str) -> Dict[str, Any]:
     report = summarize(events, shards=shards)
     report["run"] = run_dir
     return report
+
+
+def recommend_buckets(run_dir: str,
+                      quantiles: Tuple[float, ...] = (
+                          0.5, 0.75, 0.9, 0.95, 0.99, 1.0),
+                      ) -> Dict[str, Any]:
+    """``cli trace recommend-buckets <run>``: the offline bucket-ladder
+    recommender. Report-only — it changes nothing; it replays the
+    traffic observatory's shape distributions against percentile-fitted
+    ladders and prints what a reshaped ladder would have cost.
+
+    Per serve lane it proposes two ladders:
+
+    * the **value axis** (nodes for graph lanes, source tokens for gen):
+      rungs at the distribution's quantile bin edges, with predicted
+      in-slot pad waste vs the ladder actually used in the trace (the
+      observed per-slot caps) — both computed by the same
+      :func:`~deepdfa_tpu.telemetry.sketch.predicted_waste_pct` replay,
+      next to the *measured* in-slot waste over occupied slots;
+    * the **slot axis**: rungs fitted to the per-flush request-count
+      distribution vs the pow2 slot buckets the trace used, next to the
+      measured slot-underfill waste.
+
+    Every extra rung is an extra warmed program, so each proposal also
+    carries its compile-count price (value rungs x slot rungs)."""
+    events, shards = read_run_dir(run_dir)
+    if not shards:
+        raise FileNotFoundError(
+            f"no telemetry under {run_dir!r} "
+            f"(expected {events_path_of(run_dir)})")
+    events = [e for e in events if e.get("kind") != "meta"]
+    spans = [e for e in events if e.get("kind") == "span"]
+    instants = [e for e in events if e.get("kind") == "event"]
+    flushes = [s for s in spans if s.get("name") == "serve.flush"]
+    states = _traffic_states(instants)
+    traffic = _traffic(flushes, instants)
+    cells = traffic.get("waste") or {}
+
+    # Per-lane flush evidence: the per-slot caps the ladder actually
+    # used, the slot buckets hit, and the per-flush fill counts.
+    lane_caps: Dict[str, set] = {}
+    lane_slot_buckets: Dict[str, set] = {}
+    lane_fills: Dict[str, List[int]] = {}
+    for f in flushes:
+        a = f.get("attrs") or {}
+        lane, n, slots = a.get("lane"), a.get("n"), a.get("slots")
+        if lane is None or n is None or slots is None:
+            continue
+        lane = str(lane)
+        lane_slot_buckets.setdefault(lane, set()).add(int(slots))
+        lane_fills.setdefault(lane, []).append(int(n))
+        if a.get("elems_slot") is not None:
+            lane_caps.setdefault(lane, set()).add(int(a["elems_slot"]))
+
+    def _lane_cells(lane: str) -> List[Dict[str, Any]]:
+        return [c for key, c in cells.items()
+                if key.startswith(f"{lane}:b")]
+
+    recs: List[Dict[str, Any]] = []
+    for lane in sorted(lane_slot_buckets):
+        series = ("traffic_shape_serve_gen_src_tokens" if lane == "gen"
+                  else f"traffic_shape_serve_{lane}_nodes")
+        axis = "src_tokens" if lane == "gen" else "nodes"
+        n_slot_buckets = max(len(lane_slot_buckets[lane]), 1)
+        lane_cells = _lane_cells(lane)
+
+        # --- value axis --------------------------------------------------
+        state = states.get(series)
+        if state and state.get("count"):
+            current = sorted(lane_caps.get(lane, ()))
+            fitted = _sketch.fit_ladder(state, quantiles)
+            # Measured in-slot waste over occupied slots: pad within
+            # slots that held a real input — the waste a value-axis
+            # ladder can actually recover (empty slots belong to the
+            # slot axis below).
+            inslot = sum(c.get("elems_inslot_pad", 0) for c in lane_cells)
+            occupied = sum(c.get("elems_used", 0) for c in lane_cells)
+            occupied += inslot
+            rec: Dict[str, Any] = {
+                "lane": lane,
+                "axis": axis,
+                "series": series,
+                "samples": int(state.get("count", 0)),
+                "current_rungs": current,
+                "fitted_rungs": fitted,
+                "predicted_fitted_waste_pct": _sketch.predicted_waste_pct(
+                    state, fitted),
+                "compiles_current": len(current) * n_slot_buckets,
+                "compiles_fitted": len(fitted) * n_slot_buckets,
+            }
+            if current:
+                rec["predicted_current_waste_pct"] = (
+                    _sketch.predicted_waste_pct(state, current))
+            if occupied:
+                rec["measured_waste_pct"] = round(
+                    100.0 * inslot / occupied, 2)
+                rec["improves"] = bool(
+                    rec["predicted_fitted_waste_pct"]
+                    < rec["measured_waste_pct"])
+            recs.append(rec)
+
+        # --- slot axis ---------------------------------------------------
+        fills = lane_fills.get(lane) or []
+        if fills:
+            slot_state = _sketch.state_from_values(fills)
+            current_slots = sorted(lane_slot_buckets[lane])
+            fitted_slots = _sketch.fit_ladder(slot_state, quantiles)
+            used = sum(c.get("used", 0) for c in lane_cells)
+            slots_total = sum(c.get("slots", 0) for c in lane_cells)
+            rec = {
+                "lane": lane,
+                "axis": "slots",
+                "samples": len(fills),
+                "current_rungs": current_slots,
+                "fitted_rungs": fitted_slots,
+                "predicted_fitted_waste_pct": _sketch.predicted_waste_pct(
+                    slot_state, fitted_slots),
+                "predicted_current_waste_pct": _sketch.predicted_waste_pct(
+                    slot_state, current_slots),
+                "compiles_current": len(current_slots),
+                "compiles_fitted": len(fitted_slots),
+            }
+            if slots_total:
+                rec["measured_waste_pct"] = round(
+                    100.0 * (1.0 - used / slots_total), 2)
+                rec["improves"] = bool(
+                    rec["predicted_fitted_waste_pct"]
+                    < rec["measured_waste_pct"])
+            recs.append(rec)
+
+    return {
+        "run": run_dir,
+        "quantiles": [float(q) for q in quantiles],
+        "flushes": len(flushes),
+        "elem_waste_pct": traffic.get("elem_waste_pct"),
+        "recommendations": recs,
+    }

@@ -331,6 +331,12 @@ class TelemetryRun:
               dropped_segments=dropped)
 
     def close(self) -> None:
+        # Final traffic.shape/traffic.pad emission BEFORE the summary
+        # event: the run's last events must carry each series' complete
+        # distribution (the offline report keys on last-per-process).
+        from deepdfa_tpu.telemetry import sketch as _sketch
+
+        _sketch.flush_traffic()
         event("telemetry.flush", drops=drop_count() - self.drops0,
               events=self.n_written, process=self.process,
               rotations=self.rotations,
@@ -404,6 +410,11 @@ def start_run(run_dir: str) -> Optional[TelemetryRun]:
         _RUN = TelemetryRun(ctx.run_dir, process=ctx.process, inherit=ctx)
     else:
         _RUN = TelemetryRun(run_dir)
+    # Traffic sketches are per-run: a process serving several runs must
+    # not leak one run's shape distribution into the next run's trace.
+    from deepdfa_tpu.telemetry import sketch as _sketch
+
+    _sketch.reset_traffic()
     event("telemetry.start", run_dir=_RUN.run_dir,
           process=_RUN.process,
           **({"requested_run_dir": run_dir} if ctx is not None else {}))
@@ -429,6 +440,13 @@ def rebind_forked(process: str) -> Optional[TelemetryRun]:
     _REAPED_DROPS = 0
     _TLS.ring = None
     _RUN = TelemetryRun(run.run_dir, process=process, inherit=run)
+    # The fork copied the parent's sketch states by memory; re-emitting
+    # them from this child's shard would double-count the parent's
+    # samples in the merged report. Start the child's traffic ledger
+    # from zero.
+    from deepdfa_tpu.telemetry import sketch as _sketch
+
+    _sketch.reset_traffic()
     event("telemetry.start", run_dir=run.run_dir, process=process,
           forked=True)
     return _RUN
@@ -467,9 +485,17 @@ def run_scope(run_dir: str):
 
 
 def flush() -> int:
-    """Drain rings into the active run's events.jsonl (0 when none)."""
+    """Drain rings into the active run's events.jsonl (0 when none).
+
+    Emits any dirty traffic sketches first, so an explicit flush always
+    leaves the shape distributions on disk current."""
     run = _RUN
-    return run.flush() if run is not None else 0
+    if run is None:
+        return 0
+    from deepdfa_tpu.telemetry import sketch as _sketch
+
+    _sketch.flush_traffic()
+    return run.flush()
 
 
 # ---------------------------------------------------------------------------

@@ -169,6 +169,7 @@ def _batches(
     with_dataflow: bool = False,
     host: "Optional[Tuple[int, int]]" = None,
     with_global_meta: bool = False,
+    shape_series: Optional[str] = "train",
 ) -> Iterable[GraphBatch]:
     """Pack examples into padded batches.
 
@@ -220,6 +221,10 @@ def _batches(
         chosen, per_shard, budget_nodes, budget_edges, subkeys,
         build_tile_adj=build_dense_tile, build_band_adj=build_dense_band,
         with_dataflow=with_dataflow,
+        # Traffic observatory (ISSUE 20): training admission records raw
+        # pre-bucket shapes + the pad ledger; warmup/init packs pass
+        # shape_series=None so throwaway batches don't skew the series.
+        shape_series=shape_series,
     )
     if n_shards == 1:
         # with_global_meta is a multi-controller (n_shards > 1) concern;
@@ -459,7 +464,7 @@ def fit(
     example_batch = next(
         _batches(examples, splits["train"][:data_cfg.batch_size], data_cfg, subkeys,
                  max(data_cfg.batch_size // n_shards, 1), 1, use_tile, use_band,
-                 use_df)
+                 use_df, shape_series=None)
     )
     init_model = model.clone(mesh=None) if model.mesh is not None else model
     state, tx = make_train_state(init_model, example_batch, train_cfg)

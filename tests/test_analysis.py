@@ -1763,8 +1763,8 @@ def test_self_check_covers_every_rule_implementation():
                           | {"GL010", "GL011", "GL013", "GL014", "GL015",
                              "GL016", "GL017", "GL018", "GL019", "GL020",
                              "GL021", "GL022", "GL023", "GL024", "GL025",
-                             "GL026"})
-    assert len(RULES) == 26
+                             "GL026", "GL027"})
+    assert len(RULES) == 27
 
 
 def test_unparseable_file_is_a_finding(tmp_path):
@@ -2377,6 +2377,152 @@ def single_process_tool():
     sys.exit(run_everything())
 """
     assert "GL026" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# GL027: unbounded sample accumulation
+# ---------------------------------------------------------------------------
+
+
+def test_gl027_self_attr_sample_list_fires():
+    # The natural-but-leaky first draft: append every observation onto a
+    # long-lived object, np.percentile on demand. The list outlives
+    # every request; the quantile's sort eventually IS the latency spike.
+    src = """
+import numpy as np
+
+class LatencyTracker:
+    def __init__(self):
+        self.samples = []
+
+    def record(self, ms):
+        self.samples.append(ms)
+
+    def p99(self):
+        return np.percentile(self.samples, 99)
+"""
+    found = findings_for(src, "GL027")
+    assert len(found) == 1
+    assert "self.samples" in found[0].message
+    assert "percentile" in found[0].message
+
+
+def test_gl027_local_in_while_loop_fires():
+    # A serve-loop local has the same lifetime problem: the while loop is
+    # the process lifetime. A subscripted sorted() is the same consumer
+    # class as np.percentile.
+    src = """
+def serve_forever(queue):
+    waits = []
+    while True:
+        waits.append(queue.get())
+        if len(waits) % 1000 == 0:
+            print(sorted(waits)[len(waits) // 2])
+"""
+    assert "GL027" in rules_of(src)
+
+
+def test_gl027_extend_with_statistics_quantiles_fires():
+    src = """
+import statistics
+
+class Pool:
+    def __init__(self):
+        self.durations = list()
+
+    def reap(self, batch):
+        self.durations.extend(batch)
+
+    def summary(self):
+        return statistics.quantiles(self.durations, n=100)
+"""
+    assert "GL027" in rules_of(src)
+
+
+def test_gl027_bounded_deque_negative():
+    # deque(maxlen=...) is the blessed bounded shape — same consumer,
+    # bounded memory, clean.
+    src = """
+from collections import deque
+
+import numpy as np
+
+class LatencyTracker:
+    def __init__(self):
+        self.samples = deque(maxlen=1024)
+
+    def record(self, ms):
+        self.samples.append(ms)
+
+    def p99(self):
+        return np.percentile(self.samples, 99)
+"""
+    assert "GL027" not in rules_of(src)
+
+
+def test_gl027_visible_shrink_negative():
+    # A slice trim on the same receiver bounds it; so does a pop-based
+    # drain in another method of the same class.
+    src = """
+import numpy as np
+
+class LatencyTracker:
+    def __init__(self):
+        self.samples = []
+
+    def record(self, ms):
+        self.samples.append(ms)
+        self.samples[:] = self.samples[-1024:]
+
+    def p99(self):
+        return np.percentile(self.samples, 99)
+
+class DrainedTracker:
+    def __init__(self):
+        self.samples = []
+
+    def record(self, ms):
+        self.samples.append(ms)
+
+    def drain(self):
+        out = list(self.samples)
+        self.samples.clear()
+        return out
+
+    def p99(self):
+        return np.percentile(self.samples, 99)
+"""
+    assert "GL027" not in rules_of(src)
+
+
+def test_gl027_no_consumer_and_dict_receiver_unflagged():
+    # Growth without an order-statistic consumer is another rule's
+    # business (a buffer being batched elsewhere), and dict-subscript
+    # receivers are unknown provenance — both stay unflagged, plus the
+    # bounded straight-line local (no while loop: dies with the call).
+    src = """
+import numpy as np
+
+class Buffer:
+    def __init__(self):
+        self.rows = []
+
+    def add(self, row):
+        self.rows.append(row)
+
+def summarize(events):
+    d = {"ms": []}
+    while events:
+        d["ms"].append(events.pop())
+    return np.percentile(d["ms"], 99)
+
+def bench(reps):
+    t = []
+    for _ in range(reps):
+        t.append(measure())
+    return np.percentile(t, 50)
+"""
+    assert "GL027" not in rules_of(src)
 
 
 # ---------------------------------------------------------------------------

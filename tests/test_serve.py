@@ -777,7 +777,7 @@ def test_gen_lane_matches_direct_beam_search(gen_eng):
     code = "long parity_check(void);"
     req = eng.submit(None, code=code, lane="gen")
     eng.drain()
-    ids, src_b = eng._encode_gen(code)
+    ids, src_b, _ = eng._encode_gen(code)
     batch = np.full((1, src_b), gen_model.cfg.pad_token_id, np.int32)
     batch[0, : len(ids)] = ids
     seq, score = beam_search(gen_model, gen_params, jax.numpy.asarray(batch),

@@ -1,0 +1,323 @@
+"""Traffic observatory (ISSUE 20) — shape sketches, two-axis waste
+attribution, goodput roofline, and the offline bucket-ladder recommender.
+
+The contract under test: sketches are bounded and merge EXACTLY (bin-wise
+addition — associative, commutative, deterministic across seeds); the
+report reconstructs every distribution from ``events.jsonl`` alone,
+including across multi-process shard merges; the per-(lane, bucket) waste
+decomposition is an exact integer partition that ties to the existing
+``padding_waste`` cells; and the recommender's fitted ladder beats the
+measured pow2 waste on the same trace.
+"""
+
+import json
+import random
+
+import pytest
+
+from deepdfa_tpu import telemetry
+from deepdfa_tpu.core.metrics import ServingStats, merge_padding_cells
+from deepdfa_tpu.telemetry import sketch
+from deepdfa_tpu.telemetry.export import append_jsonl
+from deepdfa_tpu.telemetry.report import (
+    recommend_buckets,
+    summarize,
+    trace_report,
+)
+
+# ---------------------------------------------------------------------------
+# sketch: binning, merges, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_roundtrip_conservative_upper_edge():
+    # The inclusive upper edge is the "pad-to" value: >= v always, and
+    # within the ladder's 12.5% relative-error band (exact through 8).
+    for v in [1, 2, 7, 8, 9, 15, 16, 17, 63, 64, 65, 100, 1023, 4096,
+              (1 << 21) - 3, 1 << 24]:
+        upper = sketch.bucket_value(sketch.bucket_index(v))
+        assert v <= upper <= v + max(1, v // 8), (v, upper)
+
+
+def test_bucket_index_monotone_and_bounded():
+    last = -1
+    n_bins = 0
+    for v in range(1, 5000):
+        i = sketch.bucket_index(v)
+        assert i >= last
+        if i > last:
+            n_bins += 1
+        last = i
+    assert n_bins <= 180  # the bounded-memory promise
+
+
+def test_merge_exact_associative_commutative():
+    rng = random.Random(7)
+    chunks = [[rng.randint(1, 10_000) for _ in range(200)]
+              for _ in range(3)]
+    states = [sketch.state_from_values(c) for c in chunks]
+    a, b, c = states
+    m1 = sketch.merge_states([sketch.merge_states([a, b]), c])
+    m2 = sketch.merge_states([a, sketch.merge_states([b, c])])
+    m3 = sketch.merge_states([c, a, b])
+    flat = sketch.state_from_values(chunks[0] + chunks[1] + chunks[2])
+    assert m1 == m2 == m3 == flat  # exact, any order, any grouping
+
+
+def test_determinism_across_seeds_and_instances():
+    # Same multiset of values -> identical state and quantiles, no
+    # matter the arrival order or which ShapeSketch instance saw them.
+    values = [random.Random(0).randint(1, 500) for _ in range(300)]
+    for seed in (1, 2, 3):
+        shuffled = list(values)
+        random.Random(seed).shuffle(shuffled)
+        st = sketch.state_from_values(shuffled)
+        assert st == sketch.state_from_values(values)
+        assert sketch.quantile_from_bins(st["bins"], 0.99) == \
+            sketch.quantile_from_bins(
+                sketch.state_from_values(values)["bins"], 0.99)
+
+
+def test_shape_sketch_observe_matches_offline_state():
+    sk = sketch.ShapeSketch("t")
+    vals = [3, 17, 17, 250, 64]
+    for v in vals:
+        sk.observe(v)
+    st = sk.state()
+    offline = sketch.state_from_values(vals)
+    for key in ("count", "total", "min", "max", "bins"):
+        assert st[key] == offline[key]
+
+
+def test_fit_ladder_beats_single_cap():
+    rng = random.Random(11)
+    vals = [rng.randint(1, 60) for _ in range(500)]
+    st = sketch.state_from_values(vals)
+    fitted = sketch.fit_ladder(st)
+    assert fitted == sorted(set(fitted))  # deduped, ascending
+    single = [sketch.bucket_value(sketch.bucket_index(64))]
+    assert sketch.predicted_waste_pct(st, fitted) < \
+        sketch.predicted_waste_pct(st, single)
+
+
+# ---------------------------------------------------------------------------
+# two-axis decomposition + the shared padding merge helper
+# ---------------------------------------------------------------------------
+
+
+def test_record_batch_decomposition_is_exact_partition():
+    stats = ServingStats()
+    stats.record_batch(3, 4, lane="gnn", elems_used=90, elems_per_slot=64,
+                       elems_budget=512)
+    snap = stats.snapshot()
+    cell = snap["padding_waste"]["gnn:b4"]
+    assert (cell["elems_slot_underfill"] + cell["elems_inslot_pad"]
+            + cell["elems_flush_overhead"]
+            == cell["elems_budget"] - cell["elems_used"])
+    # The slot-axis component ties exactly to the slot waste the cell
+    # already reported: (4-3)/4 slots empty.
+    assert cell["waste_pct"] == 25.0
+    assert cell["elems_slot_underfill"] == 1 * 64
+
+
+def test_merge_padding_cells_legacy_bytes_pinned():
+    # Satellite pin: the shared helper replaces two copy-pasted merge
+    # loops (fleet snapshot + router aggregate); on legacy 3-key cells
+    # its JSON output must be byte-identical to what those loops built.
+    legacy = [
+        {"a:b4": {"used": 1, "slots": 4, "waste_pct": 75.0}},
+        {"a:b4": {"used": 3, "slots": 4, "waste_pct": 25.0}},
+    ]
+    merged = merge_padding_cells(legacy)
+    assert json.dumps(merged, sort_keys=True) == (
+        '{"a:b4": {"slots": 8, "used": 4, "waste_pct": 50.0}}')
+
+
+def test_merge_padding_cells_sums_elems_and_recomputes_pcts():
+    rich = {"gnn:b4": {"flushes": 1, "used": 3, "slots": 4,
+                       "elems_used": 90, "elems_budget": 512,
+                       "elems_slot_underfill": 64,
+                       "elems_inslot_pad": 102,
+                       "elems_flush_overhead": 256}}
+    merged = merge_padding_cells([rich, rich])
+    cell = merged["gnn:b4"]
+    assert cell["elems_used"] == 180 and cell["elems_budget"] == 1024
+    assert (cell["elems_slot_underfill"] + cell["elems_inslot_pad"]
+            + cell["elems_flush_overhead"] == 1024 - 180)
+    assert cell["elem_waste_pct"] == round(100.0 * (1 - 180 / 1024), 2)
+
+
+# ---------------------------------------------------------------------------
+# report round-trip: events.jsonl alone, multi-process shard merge
+# ---------------------------------------------------------------------------
+
+
+def _shape_event(proc, series, values, ts=1.0):
+    st = sketch.state_from_values(values)
+    return {"kind": "event", "name": "traffic.shape", "ts": ts,
+            "attrs": {"series": series, "count": st["count"],
+                      "total": st["total"], "min": st["min"],
+                      "max": st["max"], "bins": st["bins"]},
+            "_process": proc}
+
+
+def test_summarize_merges_shards_and_takes_last_cumulative():
+    # Cumulative mirror events: per (process, series) only the last
+    # (highest-count) state counts; processes then merge exactly.
+    events = [
+        _shape_event("p0", "traffic_shape_serve_gnn_nodes", [10, 20]),
+        _shape_event("p0", "traffic_shape_serve_gnn_nodes",
+                     [10, 20, 30, 40], ts=2.0),
+        _shape_event("p1", "traffic_shape_serve_gnn_nodes", [50]),
+    ]
+    shapes = summarize(events)["traffic"]["shapes"]
+    s = shapes["traffic_shape_serve_gnn_nodes"]
+    assert s["count"] == 5  # 4 (p0 last) + 1 (p1), not 2+4+1
+    assert s["max"] == 50
+
+
+def test_traffic_section_survives_file_shard_merge(tmp_path):
+    # The "from events.jsonl alone" contract, through the real reader:
+    # a primary shard and a synthesized child shard, merged by
+    # read_run_dir, must reconstruct the EXACT merged distribution.
+    run_dir = str(tmp_path / "run")
+    tdir = tmp_path / "run" / "telemetry"
+    tdir.mkdir(parents=True)
+    primary = str(tdir / "events.jsonl")
+    append_jsonl(primary, {"kind": "meta", "pid": 100, "process": "main",
+                           "wall_start": 0.0})
+    ev = _shape_event("main", "traffic_shape_serve_gnn_nodes", [8, 16])
+    ev.pop("_process")
+    append_jsonl(primary, ev)
+    child = str(tdir / "events-px-200.jsonl")
+    append_jsonl(child, {"kind": "meta", "pid": 200, "process": "px",
+                         "wall_start": 0.0})
+    ev2 = _shape_event("px", "traffic_shape_serve_gnn_nodes", [32, 64, 64])
+    ev2.pop("_process")
+    append_jsonl(child, ev2)
+    report = trace_report(run_dir)
+    s = report["traffic"]["shapes"]["traffic_shape_serve_gnn_nodes"]
+    assert s["count"] == 5
+    assert s["min"] == 8
+    expected = sketch.state_from_values([8, 16, 32, 64, 64])
+    assert s["p50"] == sketch.quantile_from_bins(expected["bins"], 0.5)
+
+
+# ---------------------------------------------------------------------------
+# end to end: serve replay -> trace report -> recommender
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_run(tmp_path_factory):
+    """A real warmed serve replay under a telemetry run: the trace every
+    end-to-end assertion below reads. Module-scoped — one compile."""
+    from deepdfa_tpu.core.config import FeatureSpec, FlowGNNConfig
+    from deepdfa_tpu.data.synthetic import synthetic_bigvul
+    from deepdfa_tpu.models.flowgnn import FlowGNN
+    from deepdfa_tpu.serve import ServeConfig, ServeEngine
+    from deepdfa_tpu.serve.engine import random_gnn_params
+    from deepdfa_tpu.serve.replay import VirtualClock
+
+    feat = FeatureSpec(limit_all=20, limit_subkeys=20)
+    tiny = FlowGNNConfig(feature=feat, hidden_dim=4, n_steps=1,
+                         num_output_layers=1)
+    config = ServeConfig(batch_slots=4, deadline_ms=100.0,
+                         cache_capacity=0)
+    model = FlowGNN(tiny)
+    engine = ServeEngine(model, random_gnn_params(model, config),
+                         config=config, clock=VirtualClock())
+    run_dir = str(tmp_path_factory.mktemp("traffic") / "run")
+    graphs = synthetic_bigvul(14, feat, positive_fraction=0.5, seed=0)
+    with telemetry.run_scope(run_dir):
+        engine.warmup()
+        compiles0 = engine.stats.compiles
+        for g in graphs:
+            engine.submit(g)
+        engine.drain()
+        recompiled = engine.stats.compiles != compiles0
+    return trace_report(run_dir), run_dir, len(graphs), recompiled
+
+
+def test_serve_replay_traffic_section(serve_run):
+    report, _, n_graphs, recompiled = serve_run
+    assert not recompiled  # zero post-warmup compiles still holds
+    traffic = report["traffic"]
+    nodes = traffic["shapes"]["traffic_shape_serve_gnn_nodes"]
+    edges = traffic["shapes"]["traffic_shape_serve_gnn_edges"]
+    assert nodes["count"] == n_graphs
+    assert edges["count"] == n_graphs
+    assert nodes["p50"] >= nodes["min"] >= 1
+    assert traffic["flush_causes"]["gnn"]  # every flush classified
+
+
+def test_serve_replay_decomposition_ties_to_padding_cells(serve_run):
+    report, _, _, _ = serve_run
+    traffic_cells = report["traffic"]["waste"]
+    pad_cells = report["serve"]["padding_waste"]
+    assert traffic_cells  # the replay produced attributed flushes
+    for key, cell in traffic_cells.items():
+        # Exact integer partition of the waste...
+        assert (cell["elems_slot_underfill"] + cell["elems_inslot_pad"]
+                + cell["elems_flush_overhead"]
+                == cell["elems_budget"] - cell["elems_used"]), key
+        # ...and the same used/slots evidence as the existing cells.
+        assert pad_cells[key]["used"] == cell["used"], key
+        assert pad_cells[key]["slots"] == cell["slots"], key
+        assert pad_cells[key]["waste_pct"] == round(
+            100.0 * (1.0 - cell["used"] / cell["slots"]), 2), key
+
+
+def test_serve_replay_goodput_roofline(serve_run):
+    report, _, _, _ = serve_run
+    rows = [r for r in report["roofline"]
+            if (r.get("attrs") or {}).get("lane") == "gnn" and r["calls"]]
+    assert rows, "no matched serve roofline rows"
+    for row in rows:
+        frac = row["effective_flops_frac"]
+        assert frac is not None and 0.0 < frac <= 1.0
+        if row["mfu"]:
+            assert row["effective_mfu"] == round(row["mfu"] * frac, 4)
+            assert row["effective_mfu"] <= row["mfu"]
+
+
+def test_recommender_beats_measured_pow2_waste(serve_run):
+    report, run_dir, _, _ = serve_run
+    rec = recommend_buckets(run_dir)
+    by_axis = {(r["lane"], r["axis"]): r for r in rec["recommendations"]}
+    nodes = by_axis[("gnn", "nodes")]
+    assert nodes["samples"] > 0
+    assert nodes["fitted_rungs"] == sorted(set(nodes["fitted_rungs"]))
+    # The acceptance property: the fitted ladder's predicted in-slot
+    # waste is strictly below the pow2 ladder's MEASURED waste on the
+    # same trace.
+    assert nodes["predicted_fitted_waste_pct"] < nodes[
+        "measured_waste_pct"]
+    assert nodes["improves"] is True
+    slots = by_axis[("gnn", "slots")]
+    assert slots["current_rungs"]  # the pow2 ladder the trace used
+    # Every extra rung is priced: value rungs x slot buckets programs.
+    assert nodes["compiles_fitted"] == (
+        len(nodes["fitted_rungs"]) * len(slots["current_rungs"]))
+
+
+def test_capture_kill_switch_and_disabled_telemetry():
+    # The A/B lever the overhead bench uses: capture off -> no sketch
+    # observations, telemetry itself still on.
+    sketch.set_capture(False)
+    try:
+        assert not sketch.capture_enabled()
+        before = telemetry.REGISTRY.sketch(
+            "traffic_shape_serve_gnn_nodes").state()["count"]
+        sketch.observe_shape("traffic_shape_serve_gnn_nodes", 10)
+        after = telemetry.REGISTRY.sketch(
+            "traffic_shape_serve_gnn_nodes").state()["count"]
+        assert after == before
+    finally:
+        sketch.set_capture(True)
+
+
+def test_observe_shape_rejects_unregistered_series():
+    # GL014 discipline: the series namespace is static.
+    with pytest.raises(ValueError):
+        sketch.observe_shape("traffic_shape_adhoc_thing", 1)
